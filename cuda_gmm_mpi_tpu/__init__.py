@@ -1,0 +1,23 @@
+"""cuda_gmm_mpi_tpu: a TPU-native GMM-EM clustering framework.
+
+A from-scratch JAX/XLA/Pallas re-design with the full capabilities of the
+CUDA/MPI/OpenMP reference (Corv/CUDA-GMM-MPI): full- and diagonal-covariance
+GMM fitting by EM over large event x dimension matrices, and a Rissanen/MDL
+model-order search merging clusters from a starting K down to a target K.
+
+See SURVEY.md at the repo root for the structural analysis of the reference and
+the file:line provenance cited throughout this package.
+"""
+
+from .config import DEFAULT_CONFIG, GMMConfig
+from .models import GMMModel, GMMResult, compute_memberships, fit_gmm
+from .state import GMMState, compact, zeros_state
+
+__version__ = "0.1.0"
+
+__all__ = [
+    "DEFAULT_CONFIG", "GMMConfig",
+    "GMMModel", "GMMResult", "compute_memberships", "fit_gmm",
+    "GMMState", "compact", "zeros_state",
+    "__version__",
+]

@@ -1,0 +1,185 @@
+"""Command-line driver: ``gmm num_clusters infile outfile [target_num_clusters]``.
+
+L6 of the layer map -- same positional CLI as the reference
+(``gaussian.cu:1111-1178``, ``README.txt:66-70``) with every compile-time knob
+from ``gaussian.h`` promoted to a runtime flag (SURVEY.md SS5.6), including the
+north-star ``--device=tpu`` selector (BASELINE.json).
+
+Argument validation mirrors validateArguments (gaussian.cu:1111-1166):
+num_clusters in [1, max_clusters]; infile must be openable; absent
+target_num_clusters means "search down to 1, keep best Rissanen"
+(stop_number logic, gaussian.cu:177-181).
+"""
+
+from __future__ import annotations
+
+import argparse
+import os
+import sys
+import time
+
+
+def build_parser() -> argparse.ArgumentParser:
+    p = argparse.ArgumentParser(
+        prog="gmm",
+        description="TPU-native GMM-EM clustering with Rissanen model-order "
+        "search (capabilities of CUDA-GMM-MPI's gaussianMPI).",
+    )
+    p.add_argument("num_clusters", type=int,
+                   help="number of starting clusters")
+    p.add_argument("infile", help="input data: CSV (first line = header) or "
+                   "*.bin (int32 N, int32 D, float32 data)")
+    p.add_argument("outfile", help="output basename; writes "
+                   "<outfile>.summary and <outfile>.results")
+    p.add_argument("target_num_clusters", type=int, nargs="?", default=0,
+                   help="desired number of clusters (<= num_clusters); "
+                   "omit to search for the best Rissanen score")
+
+    g = p.add_argument_group("runtime config (reference gaussian.h defines)")
+    g.add_argument("--device", default=None,
+                   help="JAX platform: tpu | cpu | gpu (default: auto)")
+    g.add_argument("--diag-only", action="store_true",
+                   help="diagonal covariance (DIAG_ONLY, gaussian.h:23)")
+    g.add_argument("--min-iters", type=int, default=100,
+                   help="MIN_ITERS (gaussian.h:27)")
+    g.add_argument("--max-iters", type=int, default=100,
+                   help="MAX_ITERS (gaussian.h:26)")
+    g.add_argument("--max-clusters", type=int, default=512,
+                   help="MAX_CLUSTERS bound for num_clusters (gaussian.h:10)")
+    g.add_argument("--dynamic-range", type=float, default=1e3,
+                   help="COVARIANCE_DYNAMIC_RANGE regularizer (gaussian.h:12)")
+    g.add_argument("--epsilon-scale", type=float, default=0.01,
+                   help="convergence epsilon scale (gaussian.cu:458)")
+    g.add_argument("--no-output", action="store_true",
+                   help="skip .summary/.results content (ENABLE_OUTPUT=0)")
+    g.add_argument("--verbose", "-v", action="store_true",
+                   help="status prints (ENABLE_PRINT, gaussian.h:35)")
+    g.add_argument("--debug", action="store_true",
+                   help="debug prints (ENABLE_DEBUG, gaussian.h:31)")
+
+    t = p.add_argument_group("TPU-native tuning")
+    t.add_argument("--chunk-size", type=int, default=65536,
+                   help="events per fused E+M pass")
+    t.add_argument("--precision", default="highest",
+                   choices=["highest", "high", "default"],
+                   help="matmul precision on MXU")
+    t.add_argument("--quad-mode", default="expanded",
+                   choices=["expanded", "centered"],
+                   help="quadratic-form evaluation strategy")
+    t.add_argument("--no-center", action="store_true",
+                   help="disable global data centering")
+    t.add_argument("--pallas", default="auto", choices=["auto", "always", "never"],
+                   help="use the Pallas fused kernel")
+    t.add_argument("--mesh", default=None,
+                   help="device mesh 'DATA[,CLUSTER]', e.g. --mesh=4 or "
+                   "--mesh=4,2; default: all devices on the event axis")
+    t.add_argument("--profile", action="store_true",
+                   help="per-phase timing report (reference profile_t taxonomy)")
+    t.add_argument("--checkpoint-dir", default=None,
+                   help="orbax checkpoint directory for the K-sweep (resume "
+                   "with the same path)")
+    return p
+
+
+def main(argv=None) -> int:
+    args = build_parser().parse_args(argv)
+
+    # Platform must be pinned before JAX initializes its backends. Set the env
+    # for child processes AND update the config directly: environments that
+    # preload jax at interpreter start (sitecustomize hooks) have already read
+    # JAX_PLATFORMS, so only the config.update reliably takes effect here.
+    if args.device:
+        os.environ["JAX_PLATFORMS"] = args.device
+        import jax
+
+        jax.config.update("jax_platforms", args.device)
+
+    # Heavy imports deferred until after platform selection.
+    from .config import GMMConfig
+    from .io import read_data, write_results, write_summary
+    from .models import compute_memberships, fit_gmm
+
+    if not os.path.isfile(args.infile):
+        print("Invalid infile.\n", file=sys.stderr)  # gaussian.cu:1130
+        return 2
+    try:
+        config = GMMConfig(
+            max_clusters=args.max_clusters,
+            covariance_dynamic_range=args.dynamic_range,
+            diag_only=args.diag_only,
+            min_iters=args.min_iters,
+            max_iters=args.max_iters,
+            epsilon_scale=args.epsilon_scale,
+            matmul_precision=args.precision,
+            chunk_size=args.chunk_size,
+            quad_mode=args.quad_mode,
+            center_data=not args.no_center,
+            use_pallas=args.pallas,
+            device=args.device,
+            mesh_shape=_parse_mesh(args.mesh),
+            enable_debug=args.debug,
+            enable_print=args.verbose or args.debug,
+            enable_output=not args.no_output,
+            profile=args.profile,
+            checkpoint_dir=args.checkpoint_dir,
+        )
+    except ValueError as e:
+        print(str(e), file=sys.stderr)
+        return 1
+    if not (1 <= args.num_clusters <= config.max_clusters):
+        print("Invalid number of starting clusters\n", file=sys.stderr)  # :1122
+        return 1
+    if args.target_num_clusters > args.num_clusters:
+        print("target_num_clusters must be less than equal to num_clusters\n",
+              file=sys.stderr)  # :1150
+        return 4
+
+    t_io0 = time.perf_counter()
+    try:
+        data = read_data(args.infile)
+    except Exception as e:
+        print("Error parsing input file. This could be due to an empty file "
+              f"or an inconsistent number of dimensions. Aborting. ({e})",
+              file=sys.stderr)  # gaussian.cu:204-205
+        return 1
+    t_io = time.perf_counter() - t_io0
+    if config.enable_print:
+        print(f"Number of events: {data.shape[0]}")
+        print(f"Number of dimensions: {data.shape[1]}\n")  # gaussian.cu:223-224
+        stop = args.target_num_clusters or 1
+        print(f"Starting with {args.num_clusters} cluster(s), will stop at "
+              f"{stop} cluster(s).")  # :226
+
+    result = fit_gmm(
+        data, args.num_clusters, args.target_num_clusters, config=config
+    )
+
+    t_out0 = time.perf_counter()
+    summary_path = args.outfile + ".summary"
+    write_summary(summary_path, result, enable_output=config.enable_output)
+    if config.enable_output:
+        memberships = compute_memberships(result, data, config)
+        write_results(args.outfile + ".results", data, memberships)
+    t_out = time.perf_counter() - t_out0
+
+    if config.profile:
+        em_s = sum(rec[4] for rec in result.sweep_log)
+        print(f"I/O time: {(t_io + t_out) * 1e3:.3f} (ms)")  # :1093
+        print(f"EM time: {em_s * 1e3:.3f} (ms) over "
+              f"{sum(r[3] for r in result.sweep_log)} iterations")
+    return 0
+
+
+def _parse_mesh(spec):
+    if not spec:
+        return None
+    parts = [int(x) for x in spec.split(",")]
+    if len(parts) == 1:
+        return (parts[0], 1)
+    if len(parts) == 2:
+        return tuple(parts)
+    raise SystemExit("--mesh must be DATA or DATA,CLUSTER")
+
+
+if __name__ == "__main__":
+    sys.exit(main())

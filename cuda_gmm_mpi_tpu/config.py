@@ -1,0 +1,96 @@
+"""Runtime configuration for the TPU-native GMM framework.
+
+Every compile-time ``#define`` in the reference's ``gaussian.h:10-60`` is promoted
+to a runtime field here (the reference requires a recompile to change any of them,
+``README.txt:48-57``). Field-by-field provenance:
+
+- ``max_clusters``           <- MAX_CLUSTERS            (gaussian.h:10)
+- ``covariance_dynamic_range`` <- COVARIANCE_DYNAMIC_RANGE (gaussian.h:12)
+- ``diag_only``              <- DIAG_ONLY               (gaussian.h:23)
+- ``min_iters``/``max_iters`` <- MIN_ITERS/MAX_ITERS    (gaussian.h:26-27)
+- ``enable_debug``/``enable_print``/``enable_output``
+                             <- ENABLE_DEBUG/PRINT/OUTPUT (gaussian.h:31-38)
+- ``device``                 <- DEVICE                  (gaussian.h:19) -- here a
+  JAX platform name ('tpu'/'cpu'/'gpu') instead of a CUDA ordinal, plus the
+  north-star ``--device=tpu`` flag from BASELINE.json.
+
+The CUDA launch-geometry knobs (NUM_BLOCKS, NUM_THREADS_*) have no TPU meaning;
+their TPU-native analog is ``chunk_size`` (events per fused E+M pass, which bounds
+the on-chip working set the way the reference's grid split over 16 blocks bounded
+per-block work, gaussian_kernel.cu:367-381).
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from typing import Optional, Tuple
+
+
+@dataclasses.dataclass(frozen=True)
+class GMMConfig:
+    """Configuration for GMM-EM fitting and the model-order search."""
+
+    # --- algorithm semantics (reference parity) ---
+    max_clusters: int = 512
+    covariance_dynamic_range: float = 1e3
+    diag_only: bool = False
+    min_iters: int = 100
+    max_iters: int = 100
+    # Convergence threshold scale: epsilon = nparams_per_cluster * ln(N*D) * scale
+    # (gaussian.cu:458). Runtime-tunable here.
+    epsilon_scale: float = 0.01
+
+    # --- numerics (TPU-native policy) ---
+    # The reference mixes natural log (device invert, gaussian_kernel.cu:139) and
+    # log10 (host invert_cpu, invert_matrix.cpp:61) for log-determinants. We use
+    # natural log everywhere (documented deviation; SURVEY.md SS2.3).
+    dtype: str = "float32"
+    # Matmul precision for the fused E/M contractions: 'highest' keeps true fp32
+    # accumulate on the MXU; 'default' allows bf16 passes.
+    matmul_precision: str = "highest"
+    # Events per fused E+M chunk (lax.scan step). Bounds the (chunk, K, D) and
+    # (chunk, D*D) intermediates in VMEM/HBM.
+    chunk_size: int = 65536
+    # Quadratic-form evaluation: 'expanded' = x Rinv x^T - 2 b x + c as pure
+    # matmuls (fastest on MXU; data is centered at fit() time to keep it
+    # well-conditioned); 'centered' = explicit (x-mu) staging (most stable).
+    quad_mode: str = "expanded"
+    # Center data at fit() time (shift-equivariant; outputs are shifted back).
+    center_data: bool = True
+    # Pallas fused kernel for the E+M pass ('auto' uses it on TPU when available).
+    use_pallas: str = "auto"  # 'auto' | 'always' | 'never'
+
+    # --- platform / parallelism ---
+    device: Optional[str] = None  # None = JAX default platform
+    # Mesh shape over (event axis, cluster axis). None = all local devices on the
+    # event ('data') axis, cluster axis unsharded.
+    mesh_shape: Optional[Tuple[int, int]] = None
+
+    # --- output / logging (reference: compile-time, default off; here runtime,
+    # output on by default since a clustering tool that writes nothing is only
+    # useful for benchmarking) ---
+    enable_debug: bool = False
+    enable_print: bool = False
+    enable_output: bool = True
+
+    # --- aux subsystems ---
+    profile: bool = False
+    checkpoint_dir: Optional[str] = None
+    seed: int = 0  # RNG seed for any randomized paths (reference is deterministic)
+
+    def __post_init__(self):
+        if self.min_iters > self.max_iters:
+            raise ValueError(
+                f"min_iters ({self.min_iters}) must be <= max_iters ({self.max_iters})"
+            )
+        if self.max_clusters < 1:
+            raise ValueError("max_clusters must be >= 1")
+        if self.quad_mode not in ("expanded", "centered"):
+            raise ValueError(f"unknown quad_mode: {self.quad_mode!r}")
+        if self.use_pallas not in ("auto", "always", "never"):
+            raise ValueError(f"unknown use_pallas: {self.use_pallas!r}")
+        if self.chunk_size < 1:
+            raise ValueError("chunk_size must be >= 1")
+
+
+DEFAULT_CONFIG = GMMConfig()

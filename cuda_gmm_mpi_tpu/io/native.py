@@ -1,0 +1,116 @@
+"""ctypes bindings to the native C++ I/O library (native/gmm_io.cpp).
+
+The reference's data path is native C++ (readData.cpp); this module keeps that
+property for the TPU build: a small C++ shared library does the hot text
+parsing/formatting, loaded via ctypes (no pybind11 in this image). Falls back
+gracefully -- callers check ``available()`` and use the NumPy paths otherwise.
+
+The library is built on demand by ``ensure_built()`` using the repo's
+``native/Makefile``.
+"""
+
+from __future__ import annotations
+
+import ctypes
+import os
+import subprocess
+import threading
+from typing import Optional
+
+import numpy as np
+
+_NATIVE_DIR = os.path.join(os.path.dirname(os.path.dirname(os.path.dirname(
+    os.path.abspath(__file__)))), "native")
+_LIB_PATH = os.path.join(_NATIVE_DIR, "libgmm_io.so")
+
+_lock = threading.Lock()
+_lib: Optional[ctypes.CDLL] = None
+_tried = False
+
+
+def ensure_built(force: bool = False) -> bool:
+    """Build libgmm_io.so via make if missing. Returns True on success."""
+    if os.path.exists(_LIB_PATH) and not force:
+        return True
+    makefile = os.path.join(_NATIVE_DIR, "Makefile")
+    if not os.path.exists(makefile):
+        return False
+    try:
+        subprocess.run(
+            ["make", "-C", _NATIVE_DIR, "libgmm_io.so"],
+            check=True, capture_output=True, timeout=120,
+        )
+    except Exception:
+        return False
+    return os.path.exists(_LIB_PATH)
+
+
+def _load() -> Optional[ctypes.CDLL]:
+    global _lib, _tried
+    with _lock:
+        if _lib is not None or _tried:
+            return _lib
+        _tried = True
+        if not ensure_built():
+            return None
+        try:
+            lib = ctypes.CDLL(_LIB_PATH)
+        except OSError:
+            return None
+        lib.gmm_read_data.restype = ctypes.c_int
+        lib.gmm_read_data.argtypes = [
+            ctypes.c_char_p,
+            ctypes.POINTER(ctypes.c_int64),
+            ctypes.POINTER(ctypes.c_int64),
+            ctypes.POINTER(ctypes.POINTER(ctypes.c_float)),
+        ]
+        lib.gmm_free.restype = None
+        lib.gmm_free.argtypes = [ctypes.POINTER(ctypes.c_float)]
+        lib.gmm_write_results.restype = ctypes.c_int
+        lib.gmm_write_results.argtypes = [
+            ctypes.c_char_p,
+            ctypes.POINTER(ctypes.c_float), ctypes.POINTER(ctypes.c_float),
+            ctypes.c_int64, ctypes.c_int64, ctypes.c_int64,
+        ]
+        _lib = lib
+        return _lib
+
+
+def available() -> bool:
+    return _load() is not None
+
+
+def read_data(path: str) -> np.ndarray:
+    lib = _load()
+    if lib is None:
+        raise RuntimeError("native gmm_io library unavailable")
+    n = ctypes.c_int64()
+    d = ctypes.c_int64()
+    buf = ctypes.POINTER(ctypes.c_float)()
+    rc = lib.gmm_read_data(path.encode(), ctypes.byref(n), ctypes.byref(d),
+                           ctypes.byref(buf))
+    if rc != 0:
+        raise ValueError(f"native reader failed on {path!r} (rc={rc})")
+    try:
+        arr = np.ctypeslib.as_array(buf, shape=(n.value, d.value)).copy()
+    finally:
+        lib.gmm_free(buf)
+    return arr
+
+
+def write_results(path: str, data: np.ndarray, memberships: np.ndarray) -> None:
+    lib = _load()
+    if lib is None:
+        raise RuntimeError("native gmm_io library unavailable")
+    data = np.ascontiguousarray(data, np.float32)
+    memberships = np.ascontiguousarray(memberships, np.float32)
+    n, d = data.shape
+    k = memberships.shape[1]
+    rc = lib.gmm_write_results(
+        path.encode(),
+        data.ctypes.data_as(ctypes.POINTER(ctypes.c_float)),
+        memberships.ctypes.data_as(ctypes.POINTER(ctypes.c_float)),
+        n, d, k,
+    )
+    if rc != 0:
+        raise IOError(f"native writer failed on {path!r} (rc={rc})")

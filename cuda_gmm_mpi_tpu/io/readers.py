@@ -1,0 +1,108 @@
+"""Input data readers: CSV (with header drop) and BIN.
+
+Python/NumPy implementation of the reference's ``readData.cpp`` semantics, with
+an optional native C++ fast path (see ``cuda_gmm_mpi_tpu.io.native``) that this
+module transparently prefers when the shared library is available.
+
+Reference semantics reproduced exactly:
+- dispatch on filename: names ending in "bin" -> binary, else CSV
+  (readData.cpp:25-33 -- the reference compares the last 3 chars)
+- BIN layout: int32 num_events, int32 num_dimensions, then
+  num_events*num_dimensions float32 row-major (readData.cpp:35-47)
+- CSV: comma-delimited; dimension count taken from the first line; the FIRST
+  LINE IS DROPPED as a header (readData.cpp:84); blank lines skipped
+  (readData.cpp:61); ragged rows -> error (readData.cpp:104-107); fields parsed
+  with atof semantics (invalid text parses as 0.0)
+"""
+
+from __future__ import annotations
+
+import os
+from typing import Tuple
+
+import numpy as np
+
+
+def read_data(path: str, use_native: str = "auto") -> np.ndarray:
+    """Read events as a float32 [num_events, num_dimensions] array.
+
+    ``use_native``: 'auto' tries the C++ reader and falls back to Python;
+    'always' requires it; 'never' forces the Python path.
+    """
+    if use_native != "never":
+        from . import native
+
+        if native.available():
+            return native.read_data(path)
+        if use_native == "always":
+            raise RuntimeError("native gmm_io library unavailable "
+                               "(use_native='always')")
+    if path.endswith("bin"):
+        return read_bin(path)
+    return read_csv(path)
+
+
+def read_bin(path: str) -> np.ndarray:
+    with open(path, "rb") as f:
+        header = np.fromfile(f, dtype=np.int32, count=2)
+        if header.size != 2:
+            raise ValueError(f"{path}: truncated BIN header")
+        num_events, num_dims = int(header[0]), int(header[1])
+        data = np.fromfile(f, dtype=np.float32, count=num_events * num_dims)
+    if data.size != num_events * num_dims:
+        raise ValueError(f"{path}: truncated BIN payload")
+    return data.reshape(num_events, num_dims)
+
+
+def _atof(s: str) -> float:
+    """C atof semantics: parse a leading float, else 0.0 (readData.cpp:108)."""
+    s = s.strip()
+    try:
+        return float(s)
+    except ValueError:
+        # atof parses the longest valid prefix; approximate cheaply
+        for end in range(len(s), 0, -1):
+            try:
+                return float(s[:end])
+            except ValueError:
+                continue
+        return 0.0
+
+
+def read_csv(path: str) -> np.ndarray:
+    with open(path, "r") as f:
+        lines = [ln for ln in (raw.strip("\r\n") for raw in f) if ln != ""]
+    if not lines:
+        raise ValueError(f"{path}: empty input file")
+
+    num_dims = len(lines[0].split(","))
+    body = lines[1:]  # first line dropped as header (readData.cpp:84)
+    num_events = len(body)
+    if num_events == 0:
+        raise ValueError(f"{path}: no data rows after header")
+
+    # Fast path: try numpy's parser; fall back to atof semantics row-by-row.
+    try:
+        data = np.genfromtxt(body, delimiter=",", dtype=np.float32)
+        data = np.atleast_2d(data)
+        if data.shape[1] != num_dims or np.isnan(data).any():
+            raise ValueError
+    except Exception:
+        data = np.empty((num_events, num_dims), np.float32)
+        for i, ln in enumerate(body):
+            fields = ln.split(",")
+            if len(fields) != num_dims:
+                raise ValueError(
+                    f"{path}: row {i + 2} has {len(fields)} fields, "
+                    f"expected {num_dims}"
+                )
+            data[i] = [_atof(fields[j]) for j in range(num_dims)]
+    return data
+
+
+def write_bin(path: str, data: np.ndarray) -> None:
+    """Writer for the BIN format (test fixtures / dataset prep)."""
+    data = np.ascontiguousarray(data, dtype=np.float32)
+    with open(path, "wb") as f:
+        np.asarray([data.shape[0], data.shape[1]], np.int32).tofile(f)
+        data.tofile(f)

@@ -1,0 +1,85 @@
+"""Output writers: the reference's ``.summary`` and ``.results`` formats.
+
+Format provenance (README.txt:79-84, gaussian.cu:998-1061, 1180-1201):
+
+``<outfile>.summary`` -- per saved cluster:
+    Cluster #<c>
+    Probability: <pi %f>
+    N: <N %f>
+    Means: <%.3f per dim, space-separated, trailing space>
+
+    R Matrix:
+    <%.3f per entry, space-separated rows, trailing space>
+    <blank><blank>
+
+``<outfile>.results`` -- per event:
+    <data CSV %f> \t <membership CSV %f>
+
+A native C++ writer for .results exists (io.native) because formatting
+N x (D + K) floats through printf is itself a bottleneck at 1M+ events.
+"""
+
+from __future__ import annotations
+
+from typing import IO
+
+import numpy as np
+
+
+def _fmt(x: float) -> str:
+    return f"{float(x):f}"  # C printf %f: 6 decimal places
+
+
+def write_cluster(f: IO[str], pi: float, n: float, means: np.ndarray,
+                  R: np.ndarray) -> None:
+    """One cluster block (writeCluster, gaussian.cu:1180-1197)."""
+    f.write(f"Probability: {_fmt(pi)}\n")
+    f.write(f"N: {_fmt(n)}\n")
+    f.write("Means: " + "".join(f"{m:.3f} " for m in means) + "\n")
+    f.write("\nR Matrix:\n")
+    for row in R:
+        f.write("".join(f"{v:.3f} " for v in row) + "\n")
+
+
+def write_summary(path: str, result, enable_output: bool = True) -> None:
+    """``<outfile>.summary`` (gaussian.cu:1014-1040).
+
+    The file is created unconditionally (as the reference does); cluster blocks
+    are written when ``enable_output`` (the runtime ENABLE_OUTPUT).
+    """
+    means = result.means
+    state = result.state
+    with open(path, "w") as f:
+        if not enable_output:
+            return
+        for c in range(result.ideal_num_clusters):
+            f.write(f"Cluster #{c}\n")
+            write_cluster(
+                f,
+                float(np.asarray(state.pi)[c]),
+                float(np.asarray(state.N)[c]),
+                means[c],
+                np.asarray(state.R)[c],
+            )
+            f.write("\n\n")
+
+
+def write_results(path: str, data: np.ndarray, memberships: np.ndarray,
+                  use_native: str = "auto") -> None:
+    """``<outfile>.results`` (gaussian.cu:1042-1059): data CSV, tab,
+    per-cluster membership CSV, one line per event."""
+    if use_native != "never":
+        from . import native
+
+        if native.available():
+            native.write_results(path, data, memberships)
+            return
+        if use_native == "always":
+            raise RuntimeError("native gmm_io library unavailable "
+                               "(use_native='always')")
+    with open(path, "w") as f:
+        for i in range(data.shape[0]):
+            f.write(",".join(_fmt(v) for v in data[i]))
+            f.write("\t")
+            f.write(",".join(_fmt(v) for v in memberships[i]))
+            f.write("\n")

@@ -1,0 +1,213 @@
+"""Rissanen/MDL model-order search: the reference's outer K-sweep.
+
+Orchestrates the L6/L5 control flow of ``main`` (``gaussian.cu:479-960``):
+run EM at the current cluster count, score with Rissanen/MDL, save the best
+configuration, eliminate empty clusters, merge the closest pair, repeat down to
+``target_num_clusters`` (or 1). Per-K work is entirely jitted device code; the
+host loop only moves scalars (loglik, active count, rissanen).
+
+Best-model save rule (gaussian.cu:839): keep when it's the first K, or when
+rissanen improves and no target K was requested, or when K equals the target.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import functools
+import time
+from typing import Optional
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from ..config import GMMConfig
+from ..ops.formulas import convergence_epsilon, rissanen_score
+from ..ops.merge import eliminate_empty, reduce_order_step
+from ..ops.seeding import seed_clusters_host
+from ..state import GMMState, compact
+from .gmm import GMMModel, chunk_events
+
+
+@dataclasses.dataclass
+class GMMResult:
+    """Final fit: the best (lowest-Rissanen) configuration across the sweep.
+
+    Mirrors the reference's ``saved_clusters`` + summary scalars
+    (gaussian.cu:262-281, 839-854, 961-963). ``state`` is compacted (inactive
+    slots dropped) and ``means`` are in the original data coordinates (the
+    centering shift applied at fit time is undone).
+    """
+
+    state: GMMState
+    ideal_num_clusters: int
+    min_rissanen: float
+    final_loglik: float
+    epsilon: float
+    num_events: int
+    num_dimensions: int
+    data_shift: np.ndarray  # [D] centering shift (zeros if centering disabled)
+    # per-K trajectory: (num_clusters, loglik, rissanen, em_iters, seconds)
+    sweep_log: list = dataclasses.field(default_factory=list)
+
+    @property
+    def means(self) -> np.ndarray:
+        return np.asarray(self.state.means) + self.data_shift[None, :]
+
+    @property
+    def covariances(self) -> np.ndarray:
+        return np.asarray(self.state.R)
+
+    @property
+    def weights(self) -> np.ndarray:
+        return np.asarray(self.state.pi)
+
+
+def fit_gmm(
+    data: np.ndarray,
+    num_clusters: int,
+    target_num_clusters: int = 0,
+    config: GMMConfig = GMMConfig(),
+    model: Optional[GMMModel] = None,
+    verbose: Optional[bool] = None,
+) -> GMMResult:
+    """Full GMM fit with model-order search -- the library entry point.
+
+    Args mirror the reference CLI (gaussian.cu:1111-1178): ``num_clusters`` is
+    the starting K (1..max_clusters), ``target_num_clusters`` = 0 means search
+    all the way down to 1 keeping the best Rissanen score (stop_number logic,
+    gaussian.cu:177-181).
+    """
+    if not (1 <= num_clusters <= config.max_clusters):
+        raise ValueError(
+            f"num_clusters must be in [1, {config.max_clusters}], got {num_clusters}"
+        )
+    if target_num_clusters > num_clusters:
+        raise ValueError("target_num_clusters must be <= num_clusters")
+    stop_number = target_num_clusters if target_num_clusters > 0 else 1
+    verbose = config.enable_print if verbose is None else verbose
+
+    if config.device:
+        # The runtime replacement for the reference's compile-time DEVICE
+        # (gaussian.h:19) + the north-star --device flag. config.update (not
+        # just env) because preloading sitecustomize hooks may have consumed
+        # JAX_PLATFORMS already.
+        jax.config.update("jax_platforms", config.device)
+
+    data = np.ascontiguousarray(data)
+    n_events, n_dims = data.shape
+    dtype = np.dtype(config.dtype)
+    data = data.astype(dtype, copy=False)
+
+    # Global centering keeps the expanded quadratic form well-conditioned
+    # (shift-equivariant: EM on x - c equals EM on x with means shifted by c).
+    if config.center_data:
+        shift = data.mean(axis=0, dtype=np.float64).astype(dtype)
+        data = data - shift[None, :]
+    else:
+        shift = np.zeros((n_dims,), dtype)
+
+    if model is None:
+        if config.mesh_shape is not None:
+            from ..parallel import ShardedGMMModel
+
+            model = ShardedGMMModel(config)
+        else:
+            model = GMMModel(config)
+
+    # Host-side seeding: only K gathered rows + global moments touch the
+    # device; the chunked copy below is the only full device-resident dataset.
+    state = seed_clusters_host(
+        data, num_clusters,
+        covariance_dynamic_range=config.covariance_dynamic_range,
+    )
+
+    num_shards = getattr(model, "data_size", 1)
+    chunks_np, wts_np = chunk_events(data, config.chunk_size, num_shards)
+    if hasattr(model, "prepare"):  # sharded path: pad K, place on the mesh
+        state, chunks, wts = model.prepare(state, chunks_np, wts_np)
+    else:
+        chunks, wts = jnp.asarray(chunks_np), jnp.asarray(wts_np)
+    epsilon = convergence_epsilon(n_events, n_dims, config.epsilon_scale)
+    if verbose:
+        print(f"epsilon = {epsilon}")  # gaussian.cu:462
+
+    elim_fn = jax.jit(eliminate_empty)
+    reduce_fn = jax.jit(
+        functools.partial(reduce_order_step, diag_only=config.diag_only)
+    )
+
+    sweep_log = []
+    min_rissanen = np.inf
+    ideal_k, best_state, best_ll = num_clusters, state, -np.inf
+
+    k = num_clusters
+    while k >= stop_number:
+        t0 = time.perf_counter()
+        state, ll, iters = model.run_em(state, chunks, wts, epsilon)
+        ll_f = float(ll)
+        riss = rissanen_score(ll_f, k, n_events, n_dims)
+        dt = time.perf_counter() - t0
+        sweep_log.append((k, ll_f, riss, int(iters), dt))
+        if verbose:
+            print(f"K={k}: loglik={ll_f:.6e} rissanen={riss:.6e} "
+                  f"iters={int(iters)} ({dt:.2f}s)")
+
+        if (
+            k == num_clusters
+            or (riss < min_rissanen and target_num_clusters == 0)
+            or k == target_num_clusters
+        ):  # gaussian.cu:839
+            min_rissanen, ideal_k = riss, k
+            best_state, best_ll = state, ll_f
+
+        if k <= stop_number:
+            break
+        # Order reduction (gaussian.cu:857-952)
+        state = elim_fn(state)
+        k = int(state.num_active())
+        if k < 2:
+            break
+        if verbose:
+            print(f"non-empty clusters: {k}; merging closest pair")
+        state, _, min_d = reduce_fn(state)
+        if not np.isfinite(float(min_d)):
+            # No valid merge pair (degenerate covariances everywhere); stop
+            # the sweep rather than corrupt the state.
+            break
+        k -= 1
+
+    compact_state, n_active = compact(best_state)
+    if verbose:
+        print(f"Final rissanen score was: {min_rissanen}, "
+              f"with {ideal_k} clusters.")  # gaussian.cu:962
+
+    return GMMResult(
+        state=compact_state,
+        ideal_num_clusters=n_active,
+        min_rissanen=float(min_rissanen),
+        final_loglik=best_ll,
+        epsilon=epsilon,
+        num_events=n_events,
+        num_dimensions=n_dims,
+        data_shift=np.asarray(shift),
+        sweep_log=sweep_log,
+    )
+
+
+def compute_memberships(
+    result: GMMResult, data: np.ndarray, config: GMMConfig = GMMConfig(),
+    model: Optional[GMMModel] = None,
+) -> np.ndarray:
+    """Posteriors [N, K_final] for output -- recomputed from the saved params.
+
+    Bit-equivalent to the reference's saved memberships (the EM loop ends on an
+    E-step, so the stored memberships ARE the posteriors of the final params;
+    gaussian.cu:713-714, 768).
+    """
+    model = model or GMMModel(config)
+    dtype = np.dtype(config.dtype)
+    data = data.astype(dtype, copy=False) - result.data_shift[None, :]
+    chunks_np, _ = chunk_events(data, config.chunk_size)
+    w = model.memberships(result.state, jnp.asarray(chunks_np))
+    return w[: data.shape[0]]

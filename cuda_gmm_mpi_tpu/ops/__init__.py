@@ -1,0 +1,20 @@
+"""Numeric ops: the TPU-native equivalents of the reference's device kernels.
+
+Layer L1/L2 of SURVEY.md's layer map -- log-densities and posteriors (estep),
+sufficient statistics and parameter updates (mstep), Cholesky-based constants
+(constants), seeding, merge machinery (merge), and the scalar formulas.
+"""
+
+from .constants import chol_inverse_logdet, compute_constants, LOG_2PI
+from .estep import log_densities, posteriors
+from .formulas import convergence_epsilon, free_params_per_cluster, rissanen_score
+from .mstep import SuffStats, accumulate_stats, apply_mstep, chunk_stats, zeros_stats
+from .seeding import seed_clusters, seed_means_indices
+
+__all__ = [
+    "chol_inverse_logdet", "compute_constants", "LOG_2PI",
+    "log_densities", "posteriors",
+    "convergence_epsilon", "free_params_per_cluster", "rissanen_score",
+    "SuffStats", "accumulate_stats", "apply_mstep", "chunk_stats", "zeros_stats",
+    "seed_clusters", "seed_means_indices",
+]

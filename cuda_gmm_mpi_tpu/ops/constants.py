@@ -1,0 +1,99 @@
+"""Per-cluster derived quantities: Rinv, log|R|, the Gaussian log-constant, pi.
+
+TPU-native equivalent of the reference's ``constants_kernel``
+(``gaussian_kernel.cu:250-259``) and its helpers ``compute_constants``
+(``:196-243``), ``invert`` (``:107-169``) and ``compute_pi`` (``:172-193``).
+
+Design deviations (documented per SURVEY.md SS2.3):
+- Inversion/log-det use a batched **Cholesky** factorization instead of the
+  reference's unpivoted LU: R is symmetric and, thanks to the avgvar diagonal
+  loading (gaussian_kernel.cu:673-675), positive definite. Cholesky is the
+  right primitive on TPU (one `lax.linalg` call batched over K, no per-element
+  control flow) and is strictly more numerically robust here.
+- Natural log everywhere. The reference uses ln on device
+  (gaussian_kernel.cu:139) but log10 on the host merge path
+  (invert_matrix.cpp:61); we standardize on ln.
+- Clusters whose covariance is not positive definite (Cholesky produces
+  non-finite entries) are reset to the identity covariance, mirroring the
+  reference's empty-cluster identity reset (gaussian.cu:669-678).
+"""
+
+from __future__ import annotations
+
+import math
+
+import jax
+import jax.numpy as jnp
+
+LOG_2PI = math.log(2.0 * math.pi)
+
+
+def chol_inverse_logdet(R: jax.Array, diag_only: bool = False):
+    """Batched inverse + log-determinant of covariance matrices.
+
+    Args:
+      R: [K, D, D] symmetric positive-definite covariance matrices.
+      diag_only: treat R as diagonal (DIAG_ONLY fast path,
+        gaussian_kernel.cu:215-223: reciprocal diagonal + log of diagonal
+        product).
+
+    Returns:
+      (Rinv [K,D,D], log_det [K], ok [K] bool) -- ``ok`` is False where the
+      factorization failed (non-PD input); callers reset those clusters.
+    """
+    K, D, _ = R.shape
+    if diag_only:
+        d = jnp.diagonal(R, axis1=-2, axis2=-1)  # [K, D]
+        ok = jnp.all(d > 0, axis=-1)
+        safe = jnp.where(d > 0, d, 1.0)
+        log_det = jnp.sum(jnp.log(safe), axis=-1)
+        Rinv = jnp.zeros_like(R)
+        Rinv = Rinv.at[..., jnp.arange(D), jnp.arange(D)].set(1.0 / safe)
+        return Rinv, log_det, ok
+
+    L = jax.lax.linalg.cholesky(R)  # [K, D, D], NaN rows where not PD
+    ok = jnp.all(jnp.isfinite(L.reshape(K, -1)), axis=-1)
+    eyeK = jnp.broadcast_to(jnp.eye(D, dtype=R.dtype), R.shape)
+    L_safe = jnp.where(ok[:, None, None], L, eyeK)
+    diag = jnp.diagonal(L_safe, axis1=-2, axis2=-1)
+    log_det = 2.0 * jnp.sum(jnp.log(jnp.abs(diag)), axis=-1)
+    # Rinv = L^-T L^-1 via two batched triangular solves against I.
+    Linv = jax.lax.linalg.triangular_solve(
+        L_safe, eyeK, left_side=True, lower=True
+    )
+    Rinv = jnp.einsum("kji,kjl->kil", Linv, Linv)  # L^-T @ L^-1
+    return Rinv, log_det, ok
+
+
+def compute_constants(state, diag_only: bool = False,
+                      cluster_axis: str | None = None):
+    """Recompute Rinv, constant, and pi from R and N.
+
+    Mirrors constants_kernel (gaussian_kernel.cu:250-259):
+      constant[c] = -D/2 * ln(2*pi) - 1/2 * ln|R_c|   (:241)
+      pi[c]       = N[c] / sum(N)   with a 1e-10 floor when N[c] < 0.5
+                    (compute_pi, :184-189; the reference's pi[threadIdx.x]
+                    indexing quirk is equivalent to pi[c] for K <= blockDim and
+                    is implemented here with the intended pi[c] semantics)
+
+    Non-PD covariances are reset to identity before the constant is computed.
+    Inactive clusters keep pi's floor value but are masked out of the E-step
+    entirely, so their values are inert.
+    """
+    D = state.num_dimensions
+    Rinv, log_det, ok = chol_inverse_logdet(state.R, diag_only=diag_only)
+    eyeK = jnp.broadcast_to(jnp.eye(D, dtype=state.R.dtype), state.R.shape)
+    R = jnp.where(ok[:, None, None], state.R, eyeK)
+    Rinv = jnp.where(ok[:, None, None], Rinv, eyeK)
+    log_det = jnp.where(ok, log_det, 0.0)
+    constant = (-D * 0.5) * LOG_2PI - 0.5 * log_det
+
+    n_total = jnp.sum(jnp.where(state.active, state.N, 0.0))
+    if cluster_axis is not None:
+        # K is sharded across this mesh axis: pi's denominator is the global
+        # soft count (the reference's sum over all clusters, compute_pi,
+        # gaussian_kernel.cu:175-180).
+        n_total = jax.lax.psum(n_total, cluster_axis)
+    pi = jnp.where(state.N < 0.5, 1e-10, state.N / jnp.maximum(n_total, 1e-30))
+    return state.replace(R=R, Rinv=Rinv, constant=constant.astype(state.R.dtype),
+                         pi=pi.astype(state.R.dtype))

@@ -1,0 +1,132 @@
+"""E-step: per-event cluster log-densities, posteriors, log-likelihood.
+
+TPU-native redesign of the reference's hottest kernels ``estep1``
+(``gaussian_kernel.cu:383-444``) and ``estep2`` (``:446-512``). The reference
+computes the Mahalanobis quadratic form with a serial D x D loop per (event,
+cluster) thread; here the whole E-step is expressed as dense matmuls that XLA
+tiles onto the MXU:
+
+  expanded mode (default; data is globally centered at fit() time):
+    q[n,k] = (x xT)[n] . Rinv[k] - 2 (Rinv[k] mu[k]) . x[n] + mu[k].Rinv[k].mu[k]
+    -> one (B, D^2) @ (D^2, K) matmul + one (B, D) @ (D, K) matmul
+  centered mode (reference-shaped, for validation):
+    q[n,k] = (x-mu_k)T Rinv_k (x-mu_k) staged explicitly.
+
+  logp[n,k]   = -0.5*q + constant[k] + ln(pi[k])      (estep1, :442)
+  logZ[n]     = logsumexp_k logp[n,k]                 (estep2, :483-494)
+  w[n,k]      = exp(logp - logZ)                      (estep2, :499-502)
+  loglik      = sum_n logZ[n]                         (estep2, :495)
+
+Inactive (masked) clusters get logp = -inf, which makes them exactly inert in
+the log-sum-exp -- the mask-based replacement for the reference's compaction.
+"""
+
+from __future__ import annotations
+
+from functools import partial
+
+import jax
+import jax.numpy as jnp
+from jax import lax
+
+NEG_INF = -jnp.inf
+
+
+def _precision(name: str):
+    return {
+        "highest": lax.Precision.HIGHEST,
+        "high": lax.Precision.HIGH,
+        "default": lax.Precision.DEFAULT,
+    }[name]
+
+
+def log_densities(
+    state,
+    x: jax.Array,
+    *,
+    diag_only: bool = False,
+    quad_mode: str = "expanded",
+    matmul_precision: str = "highest",
+    xouter: jax.Array | None = None,
+) -> jax.Array:
+    """Unnormalized log posteriors: [B, K] = -0.5*q + constant + ln(pi).
+
+    Matches estep1's output (gaussian_kernel.cu:442), vectorized over clusters.
+    ``xouter`` optionally supplies the precomputed [B, D*D] flattened outer
+    products so the fused E+M pass computes them once per chunk.
+    """
+    prec = _precision(matmul_precision)
+    mu, Rinv, = state.means, state.Rinv
+    B, D = x.shape
+    K = mu.shape[0]
+
+    if diag_only:
+        # q = sum_d (x_d - mu_d)^2 * a_d, a = diag(Rinv)
+        # (estep1 DIAG_ONLY branch, gaussian_kernel.cu:430-433)
+        a = jnp.diagonal(Rinv, axis1=-2, axis2=-1)  # [K, D]
+        x2 = x * x
+        q = (
+            jnp.einsum("nd,kd->nk", x2, a, precision=prec)
+            - 2.0 * jnp.einsum("nd,kd->nk", x, a * mu, precision=prec)
+            + jnp.sum(a * mu * mu, axis=-1)[None, :]
+        )
+    elif quad_mode == "expanded":
+        # xx^T flattened once per chunk; shared with the M-step accumulator.
+        if xouter is None:
+            xouter = (x[:, :, None] * x[:, None, :]).reshape(B, D * D)
+        b = jnp.einsum("kde,ke->kd", Rinv, mu, precision=prec)  # Rinv mu
+        c = jnp.sum(b * mu, axis=-1)  # mu^T Rinv mu
+        q = (
+            jnp.einsum("nf,kf->nk", xouter, Rinv.reshape(K, D * D), precision=prec)
+            - 2.0 * jnp.einsum("nd,kd->nk", x, b, precision=prec)
+            + c[None, :]
+        )
+    elif quad_mode == "centered":
+        xc = x[:, None, :] - mu[None, :, :]  # [B, K, D]
+        q = jnp.einsum("nkd,kde,nke->nk", xc, Rinv, xc, precision=prec)
+    else:
+        raise ValueError(f"unknown quad_mode {quad_mode!r}")
+
+    logp = -0.5 * q + state.constant[None, :] + jnp.log(state.pi)[None, :]
+    return jnp.where(state.active[None, :], logp, NEG_INF)
+
+
+def posteriors(
+    state,
+    x: jax.Array,
+    *,
+    diag_only: bool = False,
+    quad_mode: str = "expanded",
+    matmul_precision: str = "highest",
+    xouter: jax.Array | None = None,
+    cluster_axis: str | None = None,
+):
+    """(w [B,K], logZ [B]): normalized responsibilities and per-event evidence.
+
+    estep2 semantics (gaussian_kernel.cu:481-502): max-shifted log-sum-exp, then
+    w = exp(logp - logZ).
+
+    When ``cluster_axis`` names a mesh axis the cluster dimension is sharded
+    across devices (the cross-device generalization of the reference's
+    per-cluster grid parallelism, SURVEY.md SS5.7): the log-sum-exp becomes a
+    two-stage collective -- ``pmax`` of the per-shard maxima, then ``psum`` of
+    the shifted exponential sums -- and the returned ``w`` covers only the
+    local cluster shard while ``logZ`` is identical on every shard.
+    """
+    logp = log_densities(
+        state, x, diag_only=diag_only, quad_mode=quad_mode,
+        matmul_precision=matmul_precision, xouter=xouter,
+    )
+    m = jnp.max(logp, axis=1, keepdims=True)
+    if cluster_axis is not None:
+        m = lax.pmax(m, cluster_axis)
+    # All-inactive is impossible (>=1 active cluster globally), but a single
+    # SHARD can be all-inactive: guard the -inf max.
+    m = jnp.where(jnp.isfinite(m), m, 0.0)
+    expd = jnp.exp(logp - m)
+    denom = jnp.sum(expd, axis=1, keepdims=True)
+    if cluster_axis is not None:
+        denom = lax.psum(denom, cluster_axis)
+    logZ = (m + jnp.log(denom))[:, 0]
+    w = expd / denom
+    return w, logZ

@@ -1,0 +1,38 @@
+"""Scalar formulas: convergence epsilon and the Rissanen/MDL score.
+
+Straight functional ports of the reference's two closed-form expressions:
+
+  epsilon  = (1 + D + 0.5*(D+1)*D) * ln(N*D) * 0.01      (gaussian.cu:458)
+  rissanen = -loglik
+             + 0.5 * (K*(1 + D + 0.5*(D+1)*D) - 1) * ln(N*D)   (gaussian.cu:826)
+
+The inner factor is the per-cluster free-parameter count (1 weight + D mean
+components + D(D+1)/2 covariance entries).
+"""
+
+from __future__ import annotations
+
+import math
+
+
+def free_params_per_cluster(num_dimensions: int) -> float:
+    d = num_dimensions
+    return 1.0 + d + 0.5 * (d + 1) * d
+
+
+def convergence_epsilon(
+    num_events: int, num_dimensions: int, scale: float = 0.01
+) -> float:
+    return (
+        free_params_per_cluster(num_dimensions)
+        * math.log(float(num_events) * num_dimensions)
+        * scale
+    )
+
+
+def rissanen_score(
+    loglik: float, num_clusters: int, num_events: int, num_dimensions: int
+) -> float:
+    return -loglik + 0.5 * (
+        num_clusters * free_params_per_cluster(num_dimensions) - 1.0
+    ) * math.log(float(num_events) * num_dimensions)

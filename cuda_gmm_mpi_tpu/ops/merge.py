@@ -1,0 +1,147 @@
+"""Cluster-merge machinery for the Rissanen/MDL model-order search.
+
+TPU-native redesign of the reference's host-side L5 layer:
+``cluster_distance`` (``gaussian.cu:1203-1208``), ``add_clusters``
+(``:1210-1253``), ``copy_cluster`` compaction (``:1255-1263``) and the
+empty-cluster elimination + exhaustive O(K^2) pair scan in main
+(``:865-907``). The reference runs this serially on the rank-0 host with an
+O(D^3) LU inversion per candidate pair; here the whole pair scan is a batched
+device computation (scan over rows of merged covariances, batched Cholesky
+log-dets) and "compaction" is a mask update -- no shapes change, nothing
+recompiles, nothing leaves the device except the final argmin pair.
+
+Merge formulas (add_clusters, gaussian.cu:1213-1252), for clusters i, j:
+  wt1   = N_i / (N_i + N_j)
+  mu_m  = wt1*mu_i + wt2*mu_j
+  R_m   = wt1*(R_i + (mu_m-mu_i)(mu_m-mu_i)^T) + wt2*(R_j + (mu_m-mu_j)(mu_m-mu_j)^T)
+  pi_m  = pi_i + pi_j          (not renormalized -- reference semantics)
+  N_m   = N_i + N_j
+  const_m = -D/2 ln(2 pi) - 1/2 ln|R_m|    (ln, not the host log10 of
+            invert_matrix.cpp:61 -- we standardize on natural log)
+  distance(i,j) = N_i*const_i + N_j*const_j - N_m*const_m   (:1207)
+"""
+
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+from jax import lax
+
+from .constants import LOG_2PI, chol_inverse_logdet
+
+
+def eliminate_empty(state):
+    """Mask off active clusters with N < 0.5 (gaussian.cu:865-874)."""
+    return state.replace(active=state.active & (state.N >= 0.5))
+
+
+def _merged_cov_row(state, i):
+    """Merged covariance of cluster i with every cluster j: [K, D, D]."""
+    N_i, N_j = state.N[i], state.N
+    mu_i, mu_j = state.means[i], state.means
+    denom = jnp.maximum(N_i + N_j, 1e-30)
+    wt1 = (N_i / denom)[..., None]
+    wt2 = 1.0 - wt1
+    mu_m = wt1 * mu_i[None, :] + wt2 * mu_j  # [K, D]
+    d1 = mu_m - mu_i[None, :]
+    d2 = mu_m - mu_j
+    R_m = wt1[..., None] * (state.R[i][None] + d1[:, :, None] * d1[:, None, :]) + \
+        wt2[..., None] * (state.R + d2[:, :, None] * d2[:, None, :])
+    return mu_m, R_m
+
+
+def pairwise_merge_distances(state, diag_only: bool = False):
+    """Full [K, K] merge-cost matrix; +inf on invalid pairs.
+
+    Valid pairs are (i, j) with i < j in slot order and both active -- the same
+    enumeration order as the reference's compacted c1 < c2 scan
+    (gaussian.cu:882-894), so first-minimum tie-breaking matches.
+
+    Memory: rows are processed one at a time via lax.map, so the peak live
+    intermediate is [K, D, D] merged covariances per row, never [K, K, D, D].
+    """
+    K, D = state.means.shape
+    dtype = state.R.dtype
+
+    def row(i):
+        _, R_m = _merged_cov_row(state, i)
+        _, log_det, ok = chol_inverse_logdet(R_m, diag_only=diag_only)
+        const_m = (-D * 0.5) * LOG_2PI - 0.5 * log_det
+        N_m = state.N[i] + state.N
+        dist = (
+            state.N[i] * state.constant[i]
+            + state.N * state.constant
+            - N_m * const_m
+        )
+        j = jnp.arange(K)
+        valid = ok & state.active & state.active[i] & (j > i)
+        return jnp.where(valid, dist, jnp.inf).astype(dtype)
+
+    return lax.map(row, jnp.arange(K))
+
+
+def argmin_pair(dist: jax.Array):
+    """First (row-major) minimum of the [K, K] distance matrix -> (i, j)."""
+    K = dist.shape[0]
+    flat = jnp.ravel(dist)
+    idx = jnp.argmin(flat)  # first occurrence on ties, like the strict < scan
+    return idx // K, idx % K
+
+
+def merge_pair(state, i, j, diag_only: bool = False):
+    """Merge cluster j into slot i and deactivate j.
+
+    Equivalent to add_clusters + copy_cluster compaction (gaussian.cu:899-907):
+    with masks, writing the merged cluster into slot i and masking slot j
+    preserves exactly the compacted relative order. Rinv and constant of the
+    merged cluster are recomputed here (the reference's add_clusters calls
+    invert_cpu at :1247 because the next K's initial E-step consumes Rinv
+    directly, with no intervening constants kernel).
+    """
+    K, D = state.means.shape
+    N_i, N_j = state.N[i], state.N[j]
+    denom = jnp.maximum(N_i + N_j, 1e-30)
+    wt1 = N_i / denom
+    wt2 = 1.0 - wt1
+    mu_m = wt1 * state.means[i] + wt2 * state.means[j]
+    d1 = mu_m - state.means[i]
+    d2 = mu_m - state.means[j]
+    R_m = wt1 * (state.R[i] + d1[:, None] * d1[None, :]) + \
+        wt2 * (state.R[j] + d2[:, None] * d2[None, :])
+
+    Rinv_m, log_det, ok = chol_inverse_logdet(R_m[None], diag_only=diag_only)
+    eye = jnp.eye(D, dtype=state.R.dtype)
+    R_m = jnp.where(ok[0], R_m, eye)
+    Rinv_m = jnp.where(ok[0], Rinv_m[0], eye)
+    const_m = (-D * 0.5) * LOG_2PI - 0.5 * jnp.where(ok[0], log_det[0], 0.0)
+
+    return state.replace(
+        N=state.N.at[i].set(N_i + N_j).at[j].set(0.0),
+        pi=state.pi.at[i].set(state.pi[i] + state.pi[j]),
+        constant=state.constant.at[i].set(const_m.astype(state.constant.dtype)),
+        avgvar=state.avgvar,  # same for all clusters (gaussian.cu:1252)
+        means=state.means.at[i].set(mu_m),
+        R=state.R.at[i].set(R_m),
+        Rinv=state.Rinv.at[i].set(Rinv_m),
+        active=state.active.at[j].set(False),
+    )
+
+
+def reduce_order_step(state, diag_only: bool = False):
+    """One full order-reduction step: pair scan + merge of the closest pair.
+
+    Returns ``(new_state, (i, j), min_distance)``. If no valid pair exists
+    (``min_distance`` is +inf -- e.g. every merged covariance failed its
+    factorization) the state is returned UNCHANGED; callers must check the
+    distance before decrementing K. Caller is responsible for empty-cluster
+    elimination first, matching the reference's sequencing (gaussian.cu:865-907).
+    """
+    dist = pairwise_merge_distances(state, diag_only=diag_only)
+    i, j = argmin_pair(dist)
+    merged = merge_pair(state, i, j, diag_only=diag_only)
+    min_d = dist[i, j]
+    valid = jnp.isfinite(min_d)
+    out = jax.tree_util.tree_map(
+        lambda a, b: jnp.where(valid, a, b), merged, state
+    )
+    return out, (i, j), min_d

@@ -1,0 +1,199 @@
+"""M-step: sufficient-statistic accumulation and parameter update.
+
+TPU-native redesign of ``mstep_N`` (``gaussian_kernel.cu:551-577``),
+``mstep_means`` (``:522-545``) and ``mstep_covariance1`` (``:605-677``). The
+reference launches three kernels that each re-read the memberships array and
+sum per-shard; the host then allreduces and divides (``gaussian.cu:541-687``).
+Here a single fused pass per event-chunk produces all statistics at once --
+the posteriors ``w`` are computed inline (never materialized at N x K) and the
+covariance accumulation reuses the chunk's flattened outer products as one
+``(K, B) @ (B, D^2)`` MXU matmul:
+
+  Nk  = sum_n w[n,k]                       (mstep_N)
+  M1  = sum_n w[n,k] x[n]                  (mstep_means; division deferred)
+  M2  = sum_n w[n,k] x[n] x[n]^T           (mstep_covariance1's sums, with the
+        per-cluster centering folded out: sum w (x-mu')(x-mu')^T = M2 - Nk mu'mu'^T
+        exactly, since mu' = M1/Nk is the same new mean the reference uses)
+
+The update (``apply_mstep``) reproduces the reference's host-side division and
+guards:
+  means = M1/Nk if Nk > 0.5 else 0                       (gaussian.cu:614-618)
+  cov_sums zeroed when Nk < 1                            (gaussian_kernel.cu:658-668)
+  R     = (cov_sum + avgvar*I) / Nk if Nk > 0.5 else I   (gaussian.cu:663-679;
+          avgvar diagonal loading gaussian_kernel.cu:673-675 -- the reference
+          adds avgvar once **per GPU shard** before the global sum; we add it
+          exactly once, i.e. the single-GPU semantics, making results
+          device-count-invariant)
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from typing import Optional
+
+import jax
+import jax.numpy as jnp
+from jax import lax
+
+from .estep import posteriors, _precision
+from .constants import compute_constants
+
+
+@jax.tree_util.register_dataclass
+@dataclasses.dataclass(frozen=True)
+class SuffStats:
+    """Per-shard (or global, after psum) EM sufficient statistics.
+
+    loglik: scalar sum of per-event log-evidence (estep2's likelihood output)
+    Nk:     [K]   soft counts
+    M1:     [K,D] weighted event sums
+    M2:     [K,D,D] weighted outer-product sums (or [K,D] diagonal when
+            diag_only -- the DIAG_ONLY path never forms off-diagonals,
+            mirroring gaussian_kernel.cu:621-628)
+    """
+
+    loglik: jax.Array
+    Nk: jax.Array
+    M1: jax.Array
+    M2: jax.Array
+
+    def __add__(self, other: "SuffStats") -> "SuffStats":
+        return SuffStats(
+            self.loglik + other.loglik,
+            self.Nk + other.Nk,
+            self.M1 + other.M1,
+            self.M2 + other.M2,
+        )
+
+
+def zeros_stats(K: int, D: int, dtype, diag_only: bool = False) -> SuffStats:
+    m2_shape = (K, D) if diag_only else (K, D, D)
+    return SuffStats(
+        loglik=jnp.zeros((), dtype),
+        Nk=jnp.zeros((K,), dtype),
+        M1=jnp.zeros((K, D), dtype),
+        M2=jnp.zeros(m2_shape, dtype),
+    )
+
+
+def chunk_stats(
+    state,
+    x: jax.Array,
+    wts: Optional[jax.Array] = None,
+    *,
+    diag_only: bool = False,
+    quad_mode: str = "expanded",
+    matmul_precision: str = "highest",
+    cluster_axis: str | None = None,
+) -> SuffStats:
+    """Fused E+M statistics for one chunk of events.
+
+    ``wts`` is a [B] 0/1 validity mask for padded events (the TPU-native
+    replacement for the reference's 16-aligned block splits,
+    gaussian_kernel.cu:367-381: we pad to a static chunk grid and mask instead).
+    """
+    B, D = x.shape
+    K = state.means.shape[0]
+    prec = _precision(matmul_precision)
+
+    xouter = None
+    if not diag_only and quad_mode == "expanded":
+        xouter = (x[:, :, None] * x[:, None, :]).reshape(B, D * D)
+
+    w, logZ = posteriors(
+        state, x, diag_only=diag_only, quad_mode=quad_mode,
+        matmul_precision=matmul_precision, xouter=xouter,
+        cluster_axis=cluster_axis,
+    )
+    if wts is not None:
+        w = w * wts[:, None]
+        logZ = logZ * wts
+
+    loglik = jnp.sum(logZ)
+    Nk = jnp.sum(w, axis=0)
+    M1 = jnp.einsum("nk,nd->kd", w, x, precision=prec)
+    if diag_only:
+        M2 = jnp.einsum("nk,nd->kd", w, x * x, precision=prec)
+    else:
+        if xouter is None:
+            xouter = (x[:, :, None] * x[:, None, :]).reshape(B, D * D)
+        M2 = jnp.einsum("nk,nf->kf", w, xouter, precision=prec).reshape(K, D, D)
+    return SuffStats(loglik=loglik, Nk=Nk, M1=M1, M2=M2)
+
+
+def accumulate_stats(
+    state,
+    data_chunks: jax.Array,
+    wts_chunks: Optional[jax.Array] = None,
+    *,
+    diag_only: bool = False,
+    quad_mode: str = "expanded",
+    matmul_precision: str = "highest",
+    cluster_axis: str | None = None,
+) -> SuffStats:
+    """Scan the fused E+M pass over [num_chunks, B, D] event chunks.
+
+    The scan keeps the working set to one chunk's intermediates -- the
+    TPU-native analog of the reference streaming events through a fixed grid of
+    thread blocks -- and means the N x K posterior matrix never exists in HBM.
+    """
+    num_chunks, B, D = data_chunks.shape
+    K = state.means.shape[0]
+
+    def body(acc, inp):
+        x, wts = inp
+        s = chunk_stats(
+            state, x, wts, diag_only=diag_only, quad_mode=quad_mode,
+            matmul_precision=matmul_precision, cluster_axis=cluster_axis,
+        )
+        return acc + s, None
+
+    if wts_chunks is None:
+        wts_chunks = jnp.ones(data_chunks.shape[:2], data_chunks.dtype)
+    init = zeros_stats(K, D, data_chunks.dtype, diag_only=diag_only)
+    acc, _ = lax.scan(body, init, (data_chunks, wts_chunks))
+    return acc
+
+
+def apply_mstep(state, stats: SuffStats, *, diag_only: bool = False,
+                cluster_axis: str | None = None):
+    """Parameter update from (globally reduced) sufficient statistics.
+
+    Reproduces the reference's host-side division/guard sequence and the
+    subsequent constants_kernel (gaussian.cu:611-701). Returns the new state
+    with N, means, R, Rinv, constant, pi updated.
+    """
+    dtype = state.R.dtype
+    K, D = state.means.shape
+    Nk = stats.Nk
+    nonempty = Nk > 0.5  # gaussian.cu:614,664
+
+    means = jnp.where(nonempty[:, None], stats.M1 / jnp.maximum(Nk, 1e-30)[:, None], 0.0)
+
+    if diag_only:
+        cov_sum = stats.M2 - Nk[:, None] * means * means  # [K, D] diagonal
+        cov_sum = jnp.where((Nk >= 1.0)[:, None], cov_sum, 0.0)  # gaussian_kernel.cu:658-668
+        cov_sum = cov_sum + state.avgvar[:, None]  # diagonal loading (:673-675)
+        var = jnp.where(nonempty[:, None], cov_sum / jnp.maximum(Nk, 1e-30)[:, None], 1.0)
+        R = jnp.zeros((K, D, D), dtype).at[:, jnp.arange(D), jnp.arange(D)].set(var)
+    else:
+        mmT = means[:, :, None] * means[:, None, :]
+        cov_sum = stats.M2 - Nk[:, None, None] * mmT
+        cov_sum = jnp.where((Nk >= 1.0)[:, None, None], cov_sum, 0.0)
+        eye = jnp.eye(D, dtype=dtype)
+        cov_sum = cov_sum + state.avgvar[:, None, None] * eye[None]
+        R = jnp.where(
+            nonempty[:, None, None],
+            cov_sum / jnp.maximum(Nk, 1e-30)[:, None, None],
+            eye[None],
+        )  # empty clusters -> identity (gaussian.cu:669-678)
+
+    # Inactive clusters keep inert placeholder params.
+    act = state.active
+    new_state = state.replace(
+        N=jnp.where(act, Nk, 0.0).astype(dtype),
+        means=jnp.where(act[:, None], means, 0.0).astype(dtype),
+        R=jnp.where(act[:, None, None], R, jnp.eye(D, dtype=dtype)[None]).astype(dtype),
+    )
+    return compute_constants(new_state, diag_only=diag_only,
+                             cluster_axis=cluster_axis)

@@ -1,0 +1,129 @@
+"""Initial GMM state from data.
+
+TPU-native equivalent of the reference's two-stage seeding: the device
+``seed_clusters`` kernel (``gaussian_kernel.cu:269-328``) followed by the host
+``seed_clusters`` override (``gaussian.cu:108-123``) that re-seeds the means from
+the FULL dataset (the device kernel only saw the master GPU's shard,
+``gaussian.cu:392``). The net effective initial state, reproduced here in one
+functional step:
+
+  means[c]  = data[floor(c * seed)], seed = (N_events-1)/(K-1)  (host override,
+              gaussian.cu:110-121; evenly spaced events across the full data)
+  R         = identity                                   (gaussian_kernel.cu:316-320)
+  pi        = 1/K                                        (:323)
+  N         = N_events / K                               (:324)
+  avgvar    = mean_d(Var_d) / COVARIANCE_DYNAMIC_RANGE   (:325, averageVariance :71-102)
+  constant  = -D/2 ln(2*pi)  (constants_kernel on R=I: log|I| = 0)
+
+Deviation: the reference computes avgvar from the master GPU's event shard only;
+we use the full dataset (identical in single-process runs, and strictly more
+correct distributed).
+"""
+
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+
+from ..state import GMMState
+from .constants import compute_constants
+
+
+def seed_means_indices(num_events: int, num_clusters: int) -> jnp.ndarray:
+    """Evenly spaced event indices, matching gaussian.cu:110-120 float math."""
+    if num_clusters > 1:
+        seed = (num_events - 1.0) / (num_clusters - 1.0)
+    else:
+        seed = 0.0
+    # float32 multiply then truncate, like the reference's (int)(c*seed)
+    idx = (jnp.arange(num_clusters, dtype=jnp.float32) * jnp.float32(seed)).astype(
+        jnp.int32
+    )
+    return jnp.clip(idx, 0, num_events - 1)
+
+
+def seed_clusters_host(
+    data,
+    num_clusters: int,
+    num_clusters_padded: int | None = None,
+    covariance_dynamic_range: float = 1e3,
+    dtype=None,
+) -> GMMState:
+    """Host-side seeding from a NumPy array -- avoids shipping the full dataset
+    to device a second time (the chunked copy is the only device-resident one).
+
+    Only K gathered rows and two global moments are needed; moments are
+    computed in float64 on host for accuracy.
+    """
+    import numpy as np
+
+    n_events, _ = data.shape
+    dtype = dtype or data.dtype
+    if num_clusters > 1:
+        seed = (n_events - 1.0) / (num_clusters - 1.0)
+    else:
+        seed = 0.0
+    idx = (np.arange(num_clusters, dtype=np.float32) * np.float32(seed)).astype(
+        np.int64
+    )
+    means = np.ascontiguousarray(data[np.clip(idx, 0, n_events - 1)])
+    mean64 = data.mean(axis=0, dtype=np.float64)
+    var = (data.astype(np.float64) ** 2).mean(axis=0) - mean64 * mean64
+    return _build_seed_state(
+        jnp.asarray(means, dtype), n_events, num_clusters,
+        num_clusters_padded or num_clusters,
+        jnp.asarray(var.mean() / covariance_dynamic_range, dtype),
+        jnp.dtype(dtype),
+    )
+
+
+def seed_clusters(
+    data: jax.Array,
+    num_clusters: int,
+    num_clusters_padded: int | None = None,
+    covariance_dynamic_range: float = 1e3,
+    data_mean: jax.Array | None = None,
+    data_var_mean: jax.Array | None = None,
+) -> GMMState:
+    """Build the initial state (padded to ``num_clusters_padded``, extra slots
+    inactive).
+
+    ``data_mean`` / ``data_var_mean`` optionally supply precomputed global
+    moments (used by the sharded path where ``data`` is only this host's shard).
+    """
+    n_events, D = data.shape
+    K = num_clusters
+    Kp = num_clusters_padded or K
+    dtype = data.dtype
+
+    if data_var_mean is None:
+        if data_mean is None:
+            data_mean = jnp.mean(data, axis=0)
+        # E[x^2] - E[x]^2 per dimension, averaged over dimensions
+        # (averageVariance, gaussian_kernel.cu:79-99)
+        var = jnp.mean(data * data, axis=0) - data_mean * data_mean
+        data_var_mean = jnp.mean(var)
+    avgvar_val = data_var_mean / jnp.asarray(covariance_dynamic_range, dtype)
+
+    idx = seed_means_indices(n_events, K)
+    means_active = data[idx]  # [K, D]
+    return _build_seed_state(means_active, n_events, K, Kp, avgvar_val, dtype)
+
+
+def _build_seed_state(means_active, n_events, K, Kp, avgvar_val, dtype):
+    D = means_active.shape[-1]
+    means = jnp.zeros((Kp, D), dtype).at[:K].set(means_active)
+    active = jnp.arange(Kp) < K
+    eye = jnp.broadcast_to(jnp.eye(D, dtype=dtype), (Kp, D, D))
+    state = GMMState(
+        N=jnp.where(active, n_events / K, 0.0).astype(dtype),
+        pi=jnp.where(active, 1.0 / K, 0.0).astype(dtype),
+        constant=jnp.zeros((Kp,), dtype),
+        avgvar=jnp.where(active, avgvar_val, 0.0).astype(dtype),
+        means=means,
+        R=eye,
+        Rinv=eye,
+        active=active,
+    )
+    # constants_kernel after seeding (gaussian.cu:404)
+    return compute_constants(state)

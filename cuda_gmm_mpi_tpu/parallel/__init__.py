@@ -1,0 +1,11 @@
+"""Distributed layer (SURVEY L3): mesh, sharded EM step, multihost init.
+
+The TPU-native replacement for the reference's MPI+OpenMP+memcpy reduction
+stack (SURVEY.md SS2.8): ``jax.lax.psum`` of the sufficient-statistics pytree
+over an ICI/DCN device mesh inside ``shard_map``.
+"""
+
+from .mesh import make_mesh, shard_chunks
+from .sharded_em import ShardedGMMModel
+
+__all__ = ["make_mesh", "shard_chunks", "ShardedGMMModel"]

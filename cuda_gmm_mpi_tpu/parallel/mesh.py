@@ -1,0 +1,87 @@
+"""Device mesh construction and data placement.
+
+The TPU-native replacement for the reference's process/thread/device topology
+(MPI ranks x OpenMP threads x GPUs, ``gaussian.cu:133-139, 289-301``): a 2-D
+``jax.sharding.Mesh`` with axes
+
+  ``data``    -- events sharded along it (the reference's only strategy:
+                 contiguous event shards per GPU, gaussian.cu:347-377)
+  ``cluster`` -- clusters sharded along it (cross-device generalization of the
+                 reference's per-cluster grid dimension, e.g. estep1's
+                 blockIdx.y, gaussian_kernel.cu:396)
+
+On real hardware the data axis should map to ICI-adjacent devices so the
+sufficient-statistics psum rides ICI, with DCN only across slices (the
+reference's intra-node OpenMP vs inter-node MPI split, collapsed into XLA
+collective lowering).
+"""
+
+from __future__ import annotations
+
+import math
+from typing import Optional, Sequence, Tuple
+
+import jax
+import numpy as np
+from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+
+DATA_AXIS = "data"
+CLUSTER_AXIS = "cluster"
+
+
+def make_mesh(
+    shape: Optional[Tuple[int, int]] = None,
+    devices: Optional[Sequence[jax.Device]] = None,
+) -> Mesh:
+    """Build the (data, cluster) mesh. ``shape=None`` puts every device on the
+    data axis (pure event-parallel, the reference's layout)."""
+    devices = list(devices if devices is not None else jax.devices())
+    if shape is None:
+        shape = (len(devices), 1)
+    n = shape[0] * shape[1]
+    if n > len(devices):
+        raise ValueError(
+            f"mesh shape {shape} needs {n} devices, have {len(devices)}"
+        )
+    arr = np.asarray(devices[:n]).reshape(shape)
+    return Mesh(arr, (DATA_AXIS, CLUSTER_AXIS))
+
+
+def shard_chunks(mesh: Mesh, data_chunks, wts_chunks):
+    """Place [num_chunks, B, D] event chunks sharded along the data axis.
+
+    The per-host loading analog of the reference's per-GPU event-slice upload
+    (gaussian.cu:347-377) -- but as one sharded global array, never replicated.
+    """
+    cspec = NamedSharding(mesh, P(DATA_AXIS, None, None))
+    wspec = NamedSharding(mesh, P(DATA_AXIS, None))
+    return (
+        jax.device_put(data_chunks, cspec),
+        jax.device_put(wts_chunks, wspec),
+    )
+
+
+def state_pspecs(diag_only: bool = False):
+    """PartitionSpecs for a GMMState pytree: K axis sharded over 'cluster'."""
+    from ..state import GMMState
+
+    return GMMState(
+        N=P(CLUSTER_AXIS), pi=P(CLUSTER_AXIS), constant=P(CLUSTER_AXIS),
+        avgvar=P(CLUSTER_AXIS), means=P(CLUSTER_AXIS, None),
+        R=P(CLUSTER_AXIS, None, None), Rinv=P(CLUSTER_AXIS, None, None),
+        active=P(CLUSTER_AXIS),
+    )
+
+
+def stats_pspecs(diag_only: bool = False):
+    """PartitionSpecs for SuffStats: per-cluster stats sharded over 'cluster'."""
+    from ..ops.mstep import SuffStats
+
+    m2 = P(CLUSTER_AXIS, None) if diag_only else P(CLUSTER_AXIS, None, None)
+    return SuffStats(loglik=P(), Nk=P(CLUSTER_AXIS), M1=P(CLUSTER_AXIS, None),
+                     M2=m2)
+
+
+def pad_clusters(num_clusters: int, cluster_size: int) -> int:
+    """Padded K: a multiple of the cluster-axis size (inactive tail slots)."""
+    return int(math.ceil(num_clusters / cluster_size) * cluster_size)

@@ -1,0 +1,152 @@
+"""Sharded EM: the whole per-K EM loop as one SPMD program over the mesh.
+
+This is the collapse of the reference's entire L3 layer (SURVEY.md SS2.8/3.2):
+where the reference stages every M-step substep device->host->OpenMP
+reduction->MPI_Allreduce->host->device (~10 boundary crossings and 4 network
+collectives per EM iteration, ``gaussian.cu:541-741``), here the full
+``while`` loop runs inside ONE ``shard_map``-wrapped jit:
+
+  - events sharded over the ``data`` mesh axis; each device scans its local
+    chunks with the fused E+M pass,
+  - sufficient statistics psum'd over ``data`` (the MPI_Allreduce of
+    N / means-sums / R-sums / loglik, gaussian.cu:516,566,605,658 -- one fused
+    collective of the whole stats pytree instead of four staged ones),
+  - optionally clusters sharded over the ``cluster`` axis: the E-step
+    normalization becomes a two-stage collective log-sum-exp (pmax + psum)
+    and each shard updates only its own clusters' parameters,
+  - parameter update replicated (data axis) / local (cluster axis); no
+    parameter broadcast ever happens because SPMD program order replaces the
+    reference's MPI_Bcast-after-merge (gaussian.cu:918-924).
+"""
+
+from __future__ import annotations
+
+import functools
+from typing import Optional
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+from jax import lax
+from jax.sharding import NamedSharding, PartitionSpec as P
+
+from ..config import GMMConfig
+from ..models.gmm import GMMModel, em_while_loop
+from ..ops.mstep import SuffStats, accumulate_stats
+from ..ops.estep import posteriors
+from .mesh import (
+    CLUSTER_AXIS, DATA_AXIS, make_mesh, pad_clusters, shard_chunks,
+    state_pspecs,
+)
+
+try:  # jax>=0.4.35 exposes shard_map at top level; fall back to experimental
+    from jax import shard_map as _shard_map_mod  # type: ignore
+
+    shard_map = _shard_map_mod  # pragma: no cover
+except ImportError:  # pragma: no cover
+    from jax.experimental.shard_map import shard_map
+
+
+def make_psum_reduce(data_axis: str = DATA_AXIS):
+    """Stats reduction hook: one psum of the whole SuffStats pytree.
+
+    The TPU-native MPI_Allreduce (SURVEY.md SS2.8 table): loglik, Nk, M1, M2
+    reduced in a single fused collective over the event-sharding axis.
+    """
+
+    def reduce(stats: SuffStats) -> SuffStats:
+        return jax.tree_util.tree_map(
+            lambda a: lax.psum(a, data_axis), stats
+        )
+
+    return reduce
+
+
+class ShardedGMMModel:
+    """Drop-in GMMModel with the EM loop running under shard_map on a mesh.
+
+    Interface-compatible with GMMModel.run_em/memberships so fit_gmm and the
+    order search are oblivious to the parallelism (the reference needed
+    bespoke MPI/OpenMP plumbing through every step of main()).
+    """
+
+    def __init__(self, config: GMMConfig = GMMConfig(), mesh=None):
+        self.config = config
+        self.mesh = mesh if mesh is not None else make_mesh(config.mesh_shape)
+        self.data_size = self.mesh.shape[DATA_AXIS]
+        self.cluster_size = self.mesh.shape[CLUSTER_AXIS]
+        cluster_axis = CLUSTER_AXIS if self.cluster_size > 1 else None
+
+        kw = dict(
+            diag_only=config.diag_only,
+            quad_mode=config.quad_mode,
+            matmul_precision=config.matmul_precision,
+        )
+        self._kw = kw
+
+        em_fn = functools.partial(
+            em_while_loop,
+            reduce_stats=make_psum_reduce(DATA_AXIS),
+            cluster_axis=cluster_axis,
+            **kw,
+        )
+        sspec = state_pspecs()
+        scalar = P()
+        self._em_run = jax.jit(
+            shard_map(
+                em_fn,
+                mesh=self.mesh,
+                in_specs=(sspec, P(DATA_AXIS, None, None), P(DATA_AXIS, None),
+                          scalar, scalar, scalar),
+                out_specs=(sspec, scalar, scalar),
+                check_vma=False,
+            )
+        )
+        # Posterior pass for output: run unsharded (output path only).
+        self._plain = GMMModel(config)
+
+    def prepare(self, state, data_chunks, wts_chunks):
+        """Pad K to the cluster-axis size and place data sharded on the mesh."""
+        Kp = pad_clusters(state.num_clusters_padded, self.cluster_size)
+        if Kp != state.num_clusters_padded:
+            pad = Kp - state.num_clusters_padded
+            D = state.num_dimensions
+            eye = jnp.broadcast_to(
+                jnp.eye(D, dtype=state.R.dtype), (pad, D, D)
+            )
+            zk = jnp.zeros((pad,), state.N.dtype)
+            state = state.replace(
+                N=jnp.concatenate([state.N, zk]),
+                pi=jnp.concatenate([state.pi, zk]),
+                constant=jnp.concatenate([state.constant, zk]),
+                avgvar=jnp.concatenate([state.avgvar, zk]),
+                means=jnp.concatenate(
+                    [state.means, jnp.zeros((pad, D), state.means.dtype)]
+                ),
+                R=jnp.concatenate([state.R, eye]),
+                Rinv=jnp.concatenate([state.Rinv, eye]),
+                active=jnp.concatenate([state.active, jnp.zeros((pad,), bool)]),
+            )
+        chunks, wts = shard_chunks(self.mesh, data_chunks, wts_chunks)
+        sspec = state_pspecs()
+        state = jax.device_put(
+            state,
+            jax.tree_util.tree_map(
+                lambda s: NamedSharding(self.mesh, s), sspec
+            ),
+        )
+        return state, chunks, wts
+
+    def run_em(self, state, data_chunks, wts_chunks, epsilon: float):
+        cfg = self.config
+        dtype = data_chunks.dtype
+        return self._em_run(
+            state, data_chunks, wts_chunks,
+            jnp.asarray(epsilon, dtype),
+            jnp.asarray(cfg.min_iters, jnp.int32),
+            jnp.asarray(cfg.max_iters, jnp.int32),
+        )
+
+    def memberships(self, state, data_chunks) -> np.ndarray:
+        state = jax.device_get(state)
+        return self._plain.memberships(state, np.asarray(data_chunks))

@@ -1,0 +1,100 @@
+"""GMM model state as a JAX pytree.
+
+TPU-native re-design of the reference's ``clusters_t`` struct-of-arrays
+(``gaussian.h:62-76``): the same fields (N, pi, constant, avgvar, means, R, Rinv)
+plus an ``active`` mask that replaces the reference's realloc-and-shift cluster
+compaction (``gaussian.cu:866-874, 902-907``) with fixed shapes, so the whole
+model-order sweep runs under a single jit compilation instead of recompiling per K.
+
+The big ``memberships`` array (N x M posteriors, ``gaussian.h:75``) is deliberately
+NOT part of the state: the fused E+M pass never materializes it (SURVEY.md SS7
+"hard parts"); posteriors are recomputed on demand for output only.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from typing import Tuple
+
+import jax
+import jax.numpy as jnp
+
+
+@jax.tree_util.register_dataclass
+@dataclasses.dataclass(frozen=True)
+class GMMState:
+    """Parameters of a K-component Gaussian mixture, padded to a fixed K.
+
+    Shapes (K = padded cluster count, D = dimensions):
+      N        [K]     soft event counts      (clusters_t.N)
+      pi       [K]     mixture weights        (clusters_t.pi)
+      constant [K]     log normalizing const  (clusters_t.constant)
+                       = -D/2*ln(2*pi) - 1/2*ln|R|   (gaussian_kernel.cu:241)
+      avgvar   [K]     diagonal regularizer   (clusters_t.avgvar)
+      means    [K, D]                         (clusters_t.means)
+      R        [K, D, D] covariance           (clusters_t.R)
+      Rinv     [K, D, D] inverse covariance   (clusters_t.Rinv)
+      active   [K]     bool mask; True = cluster participates. Replaces the
+                       reference's in-place compaction; inactive clusters are
+                       algebraically inert (log-density forced to -inf).
+    """
+
+    N: jax.Array
+    pi: jax.Array
+    constant: jax.Array
+    avgvar: jax.Array
+    means: jax.Array
+    R: jax.Array
+    Rinv: jax.Array
+    active: jax.Array
+
+    @property
+    def num_clusters_padded(self) -> int:
+        return self.N.shape[0]
+
+    @property
+    def num_dimensions(self) -> int:
+        return self.means.shape[-1]
+
+    def num_active(self) -> jax.Array:
+        """Number of active clusters (traced value under jit)."""
+        return jnp.sum(self.active.astype(jnp.int32))
+
+    def replace(self, **kwargs) -> "GMMState":
+        return dataclasses.replace(self, **kwargs)
+
+
+def zeros_state(num_clusters: int, num_dimensions: int, dtype=jnp.float32) -> GMMState:
+    """An all-inactive state of the given padded size."""
+    K, D = num_clusters, num_dimensions
+    eye = jnp.broadcast_to(jnp.eye(D, dtype=dtype), (K, D, D))
+    return GMMState(
+        N=jnp.zeros((K,), dtype),
+        pi=jnp.zeros((K,), dtype),
+        constant=jnp.zeros((K,), dtype),
+        avgvar=jnp.zeros((K,), dtype),
+        means=jnp.zeros((K, D), dtype),
+        R=eye,
+        Rinv=eye,
+        active=jnp.zeros((K,), bool),
+    )
+
+
+def compact(state: GMMState) -> Tuple[GMMState, int]:
+    """Host-side compaction: drop inactive clusters, preserving relative order.
+
+    Equivalent to the reference's left-shift compaction (gaussian.cu:869-871,
+    903-907) applied at output time. Not jittable (shape depends on the mask).
+    """
+    mask = jax.device_get(state.active)
+    idx = jnp.asarray([i for i, a in enumerate(mask) if a], dtype=jnp.int32)
+    n_active = int(idx.shape[0])
+    take = lambda a: jnp.take(jnp.asarray(jax.device_get(a)), idx, axis=0)
+    return (
+        GMMState(
+            N=take(state.N), pi=take(state.pi), constant=take(state.constant),
+            avgvar=take(state.avgvar), means=take(state.means), R=take(state.R),
+            Rinv=take(state.Rinv), active=jnp.ones((n_active,), bool),
+        ),
+        n_active,
+    )

@@ -1,0 +1,1 @@
+"""Auxiliary subsystems (SURVEY SS5): profiling, logging, checkpointing."""

@@ -1,0 +1,49 @@
+"""Test harness: 8 fake CPU devices (SURVEY.md SS4: the TPU-native analog of
+"test multi-node without a cluster") and float64 enabled for oracle comparisons.
+
+Must run before any jax import, hence module-level env mutation in conftest.
+"""
+
+import os
+
+# NOTE: this image preloads jax via a sitecustomize hook, so JAX_PLATFORMS in
+# os.environ is read before conftest runs -- the config.update calls below are
+# what actually pins the test platform. The env mutations cover subprocesses.
+os.environ["JAX_PLATFORMS"] = "cpu"
+_flags = os.environ.get("XLA_FLAGS", "")
+if "xla_force_host_platform_device_count" not in _flags:
+    os.environ["XLA_FLAGS"] = (
+        _flags + " --xla_force_host_platform_device_count=8"
+    ).strip()
+
+import jax
+
+jax.config.update("jax_platforms", "cpu")
+jax.config.update("jax_num_cpu_devices", 8)
+jax.config.update("jax_enable_x64", True)
+
+import numpy as np
+import pytest
+
+
+@pytest.fixture
+def rng():
+    return np.random.default_rng(1234)
+
+
+def make_blobs(rng, n=2000, d=3, k=4, spread=8.0, dtype=np.float64):
+    """Well-separated synthetic mixture with known parameters."""
+    centers = rng.normal(scale=spread, size=(k, d))
+    chunks = []
+    for c in range(k):
+        a = rng.normal(size=(d, d)) * 0.3
+        cov = a @ a.T + np.eye(d)
+        chunks.append(rng.multivariate_normal(centers[c], cov, size=n // k))
+    x = np.concatenate(chunks, axis=0)
+    rng.shuffle(x)
+    return x.astype(dtype), centers
+
+
+@pytest.fixture
+def blobs(rng):
+    return make_blobs(rng)

@@ -1,0 +1,124 @@
+"""Pure-NumPy float64 oracle implementing the reference's EM semantics.
+
+Independent re-derivation of the algorithm from SURVEY.md SS2-3 (estep1/estep2,
+mstep_*, constants_kernel, host division/guards) used to validate the JAX ops.
+"""
+
+from __future__ import annotations
+
+import numpy as np
+
+LOG_2PI = np.log(2.0 * np.pi)
+
+
+def np_seed(data: np.ndarray, k: int, dynamic_range: float = 1e3):
+    n, d = data.shape
+    seed = (n - 1.0) / (k - 1.0) if k > 1 else 0.0
+    idx = (np.arange(k, dtype=np.float32) * np.float32(seed)).astype(np.int32)
+    means = data[np.clip(idx, 0, n - 1)].astype(np.float64)
+    var = (data.astype(np.float64) ** 2).mean(0) - data.astype(np.float64).mean(0) ** 2
+    avgvar = var.mean() / dynamic_range
+    return dict(
+        N=np.full(k, n / k, float),
+        pi=np.full(k, 1.0 / k, float),
+        avgvar=np.full(k, avgvar, float),
+        means=means,
+        R=np.stack([np.eye(d)] * k),
+        Rinv=np.stack([np.eye(d)] * k),
+        constant=np.full(k, -d * 0.5 * LOG_2PI, float),
+    )
+
+
+def np_log_densities(params, x):
+    k, d = params["means"].shape
+    logp = np.empty((x.shape[0], k))
+    for c in range(k):
+        xc = x - params["means"][c]
+        q = np.einsum("ni,ij,nj->n", xc, params["Rinv"][c], xc)
+        logp[:, c] = -0.5 * q + params["constant"][c] + np.log(params["pi"][c])
+    return logp
+
+
+def np_estep(params, x):
+    logp = np_log_densities(params, x)
+    m = logp.max(axis=1, keepdims=True)
+    denom = np.exp(logp - m).sum(axis=1, keepdims=True)
+    logz = m + np.log(denom)
+    w = np.exp(logp - logz)
+    return w, float(logz.sum())
+
+
+def np_mstep(params, x, w, diag_only: bool = False):
+    """M-step + constants with the reference's guards (single-GPU semantics)."""
+    k, d = params["means"].shape
+    out = {key: val.copy() for key, val in params.items()}
+    Nk = w.sum(axis=0)
+    out["N"] = Nk
+    for c in range(k):
+        if Nk[c] > 0.5:
+            mu = (w[:, c : c + 1] * x).sum(0) / Nk[c]
+        else:
+            mu = np.zeros(d)
+        out["means"][c] = mu
+        xc = x - mu
+        if Nk[c] >= 1.0:
+            cov_sum = np.einsum("n,ni,nj->ij", w[:, c], xc, xc)
+        else:
+            cov_sum = np.zeros((d, d))
+        if diag_only:
+            cov_sum = np.diag(np.diag(cov_sum))
+        cov_sum = cov_sum + params["avgvar"][c] * np.eye(d)
+        if Nk[c] > 0.5:
+            out["R"][c] = cov_sum / Nk[c]
+        else:
+            out["R"][c] = np.eye(d)
+    # constants_kernel
+    for c in range(k):
+        if diag_only:
+            diag = np.diag(out["R"][c])
+            out["Rinv"][c] = np.diag(1.0 / diag)
+            logdet = np.log(diag).sum()
+        else:
+            out["Rinv"][c] = np.linalg.inv(out["R"][c])
+            _, logdet = np.linalg.slogdet(out["R"][c])
+        out["constant"][c] = -d * 0.5 * LOG_2PI - 0.5 * logdet
+    total = Nk.sum()
+    out["pi"] = np.where(Nk < 0.5, 1e-10, Nk / total)
+    return out
+
+
+def np_em(data, k, iters, diag_only=False, dynamic_range=1e3):
+    """Run `iters` full EM iterations; returns (params, loglik trajectory)."""
+    params = np_seed(data, k, dynamic_range)
+    x = data.astype(np.float64)
+    w, ll = np_estep(params, x)
+    lls = [ll]
+    for _ in range(iters):
+        params = np_mstep(params, x, w, diag_only=diag_only)
+        w, ll = np_estep(params, x)
+        lls.append(ll)
+    return params, lls, w
+
+
+def np_merge(params, c1, c2):
+    """add_clusters oracle (gaussian.cu:1210-1253), natural-log constant."""
+    n1, n2 = params["N"][c1], params["N"][c2]
+    wt1 = n1 / (n1 + n2)
+    wt2 = 1.0 - wt1
+    mu = wt1 * params["means"][c1] + wt2 * params["means"][c2]
+    d1 = mu - params["means"][c1]
+    d2 = mu - params["means"][c2]
+    R = wt1 * (params["R"][c1] + np.outer(d1, d1)) + \
+        wt2 * (params["R"][c2] + np.outer(d2, d2))
+    d = mu.shape[0]
+    _, logdet = np.linalg.slogdet(R)
+    const = -d * 0.5 * LOG_2PI - 0.5 * logdet
+    return dict(N=n1 + n2, pi=params["pi"][c1] + params["pi"][c2],
+                means=mu, R=R, constant=const)
+
+
+def np_cluster_distance(params, c1, c2):
+    merged = np_merge(params, c1, c2)
+    return (params["N"][c1] * params["constant"][c1]
+            + params["N"][c2] * params["constant"][c2]
+            - merged["N"] * merged["constant"])

@@ -1,0 +1,79 @@
+"""CLI end-to-end: reference argv semantics, outputs, error codes."""
+
+import subprocess
+import sys
+
+import numpy as np
+import pytest
+
+from cuda_gmm_mpi_tpu.cli import main
+from cuda_gmm_mpi_tpu.io.readers import write_bin
+
+from .conftest import make_blobs
+
+
+@pytest.fixture
+def csv_file(tmp_path, rng):
+    data, _ = make_blobs(rng, n=400, d=3, k=3, dtype=np.float32)
+    p = tmp_path / "events.csv"
+    header = ",".join(f"d{i}" for i in range(3))
+    rows = "\n".join(",".join(f"{v:.6f}" for v in row) for row in data)
+    p.write_text(header + "\n" + rows + "\n")
+    return str(p)
+
+
+def run_cli(args):
+    return main(args)
+
+
+def test_cli_end_to_end(csv_file, tmp_path):
+    out = str(tmp_path / "out")
+    rc = run_cli(["3", csv_file, out, "3",
+                  "--min-iters=3", "--max-iters=3", "--chunk-size=256"])
+    assert rc == 0
+    summary = (tmp_path / "out.summary").read_text()
+    assert summary.count("Cluster #") == 3
+    assert "Probability:" in summary and "R Matrix:" in summary
+    with open(csv_file) as f:
+        n_events = len(f.read().splitlines()) - 1  # minus header
+    results = (tmp_path / "out.results").read_text().splitlines()
+    assert len(results) == n_events
+    data_part, memb_part = results[0].split("\t")
+    assert len(data_part.split(",")) == 3
+    assert len(memb_part.split(",")) == 3
+
+
+def test_cli_bin_input(tmp_path, rng):
+    data, _ = make_blobs(rng, n=300, d=2, k=2, dtype=np.float32)
+    p = tmp_path / "events.bin"
+    write_bin(str(p), data)
+    rc = run_cli(["2", str(p), str(tmp_path / "o"), "2",
+                  "--min-iters=2", "--max-iters=2", "--chunk-size=256"])
+    assert rc == 0
+    assert (tmp_path / "o.summary").exists()
+
+
+def test_cli_invalid_infile(tmp_path):
+    rc = run_cli(["3", str(tmp_path / "missing.csv"), "out"])
+    assert rc == 2  # gaussian.cu:1132
+
+
+def test_cli_invalid_k(csv_file, tmp_path):
+    assert run_cli(["0", csv_file, str(tmp_path / "o")]) == 1
+    assert run_cli(["513", csv_file, str(tmp_path / "o")]) == 1  # > MAX_CLUSTERS
+
+
+def test_cli_target_gt_k(csv_file, tmp_path):
+    rc = run_cli(["3", csv_file, str(tmp_path / "o"), "5"])
+    assert rc == 4  # gaussian.cu:1149-1153
+
+
+def test_cli_no_output(csv_file, tmp_path):
+    out = str(tmp_path / "noout")
+    rc = run_cli(["2", csv_file, out, "2", "--no-output",
+                  "--min-iters=2", "--max-iters=2", "--chunk-size=256"])
+    assert rc == 0
+    # summary file created but empty; no results file (ENABLE_OUTPUT=0
+    # semantics, gaussian.cu:1015, 1042)
+    assert (tmp_path / "noout.summary").read_text() == ""
+    assert not (tmp_path / "noout.results").exists()

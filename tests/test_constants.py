@@ -1,0 +1,76 @@
+"""Unit tests: Cholesky inverse/log-det and the constants op vs NumPy oracles."""
+
+import jax.numpy as jnp
+import numpy as np
+
+from cuda_gmm_mpi_tpu.ops.constants import (
+    LOG_2PI, chol_inverse_logdet, compute_constants,
+)
+from cuda_gmm_mpi_tpu.state import zeros_state
+
+
+def random_spd(rng, k, d, scale=1.0):
+    a = rng.normal(size=(k, d, d)) * scale
+    return a @ np.swapaxes(a, 1, 2) + 0.5 * np.eye(d)
+
+
+def test_inverse_logdet_matches_numpy(rng):
+    R = random_spd(rng, 6, 5)
+    Rinv, logdet, ok = chol_inverse_logdet(jnp.asarray(R))
+    assert bool(jnp.all(ok))
+    np.testing.assert_allclose(np.asarray(Rinv), np.linalg.inv(R), rtol=1e-9,
+                               atol=1e-9)
+    np.testing.assert_allclose(
+        np.asarray(logdet), np.linalg.slogdet(R)[1], rtol=1e-10
+    )
+
+
+def test_diag_only_path(rng):
+    d = np.abs(rng.normal(size=(4, 6))) + 0.1
+    R = np.stack([np.diag(row) for row in d])
+    Rinv, logdet, ok = chol_inverse_logdet(jnp.asarray(R), diag_only=True)
+    assert bool(jnp.all(ok))
+    np.testing.assert_allclose(
+        np.asarray(Rinv), np.stack([np.diag(1.0 / row) for row in d]), rtol=1e-12
+    )
+    np.testing.assert_allclose(np.asarray(logdet), np.log(d).sum(1), rtol=1e-12)
+
+
+def test_non_pd_flagged(rng):
+    R = random_spd(rng, 3, 4)
+    R[1] = -np.eye(4)  # not PD
+    _, _, ok = chol_inverse_logdet(jnp.asarray(R))
+    assert bool(ok[0]) and not bool(ok[1]) and bool(ok[2])
+
+
+def test_compute_constants_semantics(rng):
+    k, d = 5, 3
+    state = zeros_state(k, d, dtype=jnp.float64)
+    R = random_spd(rng, k, d)
+    N = np.array([100.0, 0.2, 50.0, 0.0, 10.0])
+    state = state.replace(
+        R=jnp.asarray(R), N=jnp.asarray(N),
+        active=jnp.ones(k, bool),
+    )
+    out = compute_constants(state)
+    # constant = -D/2 ln 2pi - 1/2 ln|R|  (gaussian_kernel.cu:241)
+    expected_const = -d * 0.5 * LOG_2PI - 0.5 * np.linalg.slogdet(R)[1]
+    np.testing.assert_allclose(np.asarray(out.constant), expected_const,
+                               rtol=1e-9)
+    # pi floor 1e-10 when N < 0.5 (gaussian_kernel.cu:184-189)
+    pi = np.asarray(out.pi)
+    assert pi[1] == 1e-10 and pi[3] == 1e-10
+    np.testing.assert_allclose(pi[0], 100.0 / N.sum(), rtol=1e-9)
+
+
+def test_non_pd_reset_to_identity(rng):
+    k, d = 2, 3
+    state = zeros_state(k, d, dtype=jnp.float64)
+    R = random_spd(rng, k, d)
+    R[0] = np.diag([1.0, -1.0, 1.0])  # indefinite
+    state = state.replace(R=jnp.asarray(R), N=jnp.ones(k) * 10,
+                          active=jnp.ones(k, bool))
+    out = compute_constants(state)
+    np.testing.assert_allclose(np.asarray(out.R[0]), np.eye(d))
+    np.testing.assert_allclose(np.asarray(out.Rinv[0]), np.eye(d))
+    np.testing.assert_allclose(float(out.constant[0]), -d * 0.5 * LOG_2PI)

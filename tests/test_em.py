@@ -1,0 +1,89 @@
+"""EM loop integration: oracle parity, monotone log-likelihood, convergence."""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from cuda_gmm_mpi_tpu.config import GMMConfig
+from cuda_gmm_mpi_tpu.models.gmm import GMMModel, chunk_events
+from cuda_gmm_mpi_tpu.ops.formulas import convergence_epsilon
+from cuda_gmm_mpi_tpu.ops.seeding import seed_clusters
+
+from .reference_impl import np_em
+
+
+def run_em(data, k, min_iters, max_iters, dtype=np.float64, **cfg_kw):
+    cfg = GMMConfig(min_iters=min_iters, max_iters=max_iters,
+                    chunk_size=256, dtype="float64", **cfg_kw)
+    model = GMMModel(cfg)
+    chunks, wts = chunk_events(data.astype(dtype), cfg.chunk_size)
+    state = seed_clusters(jnp.asarray(data.astype(dtype)), k)
+    eps = convergence_epsilon(data.shape[0], data.shape[1])
+    return model.run_em(state, jnp.asarray(chunks), jnp.asarray(wts), eps)
+
+
+def test_em_matches_numpy_oracle(blobs):
+    """5 full EM iterations bit-track the float64 NumPy oracle."""
+    data, _ = blobs
+    k = 4
+    state, ll, iters = run_em(data, k, 5, 5)
+    params, lls, _ = np_em(data, k, 5)
+    assert int(iters) == 5
+    np.testing.assert_allclose(float(ll), lls[-1], rtol=1e-9)
+    np.testing.assert_allclose(np.asarray(state.means), params["means"],
+                               rtol=1e-7, atol=1e-8)
+    np.testing.assert_allclose(np.asarray(state.R), params["R"], rtol=1e-6,
+                               atol=1e-8)
+    np.testing.assert_allclose(np.asarray(state.N), params["N"], rtol=1e-8)
+    np.testing.assert_allclose(np.asarray(state.pi), params["pi"], rtol=1e-8)
+
+
+def test_loglik_monotone(blobs):
+    """EM guarantees monotone non-decreasing log-likelihood (the reference
+    never asserts this; SURVEY.md SS4 calls it out as a required test)."""
+    data, _ = blobs
+    _, lls, _ = np_em(data, 4, 12)
+    # oracle monotone (sanity of the test itself)
+    assert all(b >= a - 1e-7 for a, b in zip(lls, lls[1:]))
+    # jax path: track loglik across single-step runs
+    prev = None
+    for iters in range(1, 8):
+        _, ll, _ = run_em(data, 4, iters, iters)
+        ll = float(ll)
+        if prev is not None:
+            assert ll >= prev - 1e-6
+        prev = ll
+
+
+def test_convergence_early_exit(blobs):
+    """min_iters=1 lets the epsilon test stop well before max_iters on
+    well-separated data (the reference ships MIN==MAX which disables this;
+    we verify the runtime-configurable path)."""
+    data, _ = blobs
+    state, ll, iters = run_em(data, 4, 1, 200)
+    assert 1 <= int(iters) < 200
+
+
+def test_diag_only_em_runs(blobs):
+    data, _ = blobs
+    state, ll, iters = run_em(data, 4, 3, 3, diag_only=True)
+    R = np.asarray(state.R)
+    off = R - np.stack([np.diag(np.diag(R[c])) for c in range(R.shape[0])])
+    assert np.abs(off).max() == 0.0
+    assert np.isfinite(float(ll))
+
+
+def test_em_float32_close_to_oracle(blobs):
+    data, _ = blobs
+    k = 4
+    cfg = GMMConfig(min_iters=5, max_iters=5, chunk_size=256, dtype="float32")
+    model = GMMModel(cfg)
+    x32 = data.astype(np.float32)
+    chunks, wts = chunk_events(x32, cfg.chunk_size)
+    state = seed_clusters(jnp.asarray(x32), k)
+    eps = convergence_epsilon(*data.shape)
+    state, ll, _ = model.run_em(state, jnp.asarray(chunks), jnp.asarray(wts), eps)
+    params, lls, _ = np_em(data, k, 5)
+    np.testing.assert_allclose(float(ll), lls[-1], rtol=2e-5)
+    np.testing.assert_allclose(np.asarray(state.means), params["means"],
+                               rtol=2e-3, atol=2e-3)

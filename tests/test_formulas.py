@@ -1,0 +1,23 @@
+"""Scalar formula parity: epsilon (gaussian.cu:458), rissanen (gaussian.cu:826)."""
+
+import math
+
+from cuda_gmm_mpi_tpu.ops.formulas import (
+    convergence_epsilon, free_params_per_cluster, rissanen_score,
+)
+
+
+def test_free_params():
+    assert free_params_per_cluster(24) == 1 + 24 + 0.5 * 25 * 24
+
+
+def test_epsilon():
+    n, d = 10000, 24
+    expected = (1 + d + 0.5 * (d + 1) * d) * math.log(n * d) * 0.01
+    assert convergence_epsilon(n, d) == expected
+
+
+def test_rissanen():
+    ll, k, n, d = -1.23e5, 8, 10000, 16
+    expected = -ll + 0.5 * (k * (1 + d + 0.5 * (d + 1) * d) - 1) * math.log(n * d)
+    assert rissanen_score(ll, k, n, d) == expected

@@ -1,0 +1,80 @@
+"""Reader/writer semantics: header drop, BIN layout, output formats."""
+
+import numpy as np
+import pytest
+
+from cuda_gmm_mpi_tpu.io.readers import read_bin, read_csv, read_data, write_bin
+
+
+def test_csv_drops_header(tmp_path):
+    p = tmp_path / "data.csv"
+    p.write_text("colA,colB,colC\n1.0,2.0,3.0\n4.5,5.5,6.5\n")
+    data = read_csv(str(p))
+    assert data.shape == (2, 3)
+    np.testing.assert_allclose(data, [[1.0, 2.0, 3.0], [4.5, 5.5, 6.5]])
+    assert data.dtype == np.float32
+
+
+def test_csv_blank_lines_skipped(tmp_path):
+    p = tmp_path / "data.csv"
+    p.write_text("h1,h2\n\n1,2\n\n3,4\n\n")
+    data = read_csv(str(p))
+    assert data.shape == (2, 2)
+
+
+def test_csv_ragged_row_errors(tmp_path):
+    p = tmp_path / "data.csv"
+    p.write_text("h1,h2,h3\n1,2,3\n4,5\n")
+    with pytest.raises(ValueError):
+        read_csv(str(p))
+
+
+def test_bin_roundtrip(tmp_path, rng):
+    p = tmp_path / "data.bin"
+    data = rng.normal(size=(37, 5)).astype(np.float32)
+    write_bin(str(p), data)
+    out = read_bin(str(p))
+    np.testing.assert_array_equal(out, data)
+    # header layout: int32 nevents, int32 ndims (readData.cpp:38-39)
+    raw = np.fromfile(str(p), dtype=np.int32, count=2)
+    assert raw[0] == 37 and raw[1] == 5
+
+
+def test_dispatch_on_extension(tmp_path, rng):
+    data = rng.normal(size=(10, 3)).astype(np.float32)
+    pbin = tmp_path / "x.bin"
+    write_bin(str(pbin), data)
+    np.testing.assert_array_equal(read_data(str(pbin), use_native="never"), data)
+    pcsv = tmp_path / "x.csv"
+    pcsv.write_text("a,b,c\n" + "\n".join(
+        ",".join(f"{v:.6f}" for v in row) for row in data
+    ))
+    np.testing.assert_allclose(read_data(str(pcsv), use_native="never"), data,
+                               rtol=1e-5, atol=1e-6)
+
+
+def test_summary_format(tmp_path):
+    from cuda_gmm_mpi_tpu.io.writers import write_cluster
+    import io
+
+    f = io.StringIO()
+    means = np.array([1.25, -2.5])
+    R = np.array([[1.0, 0.5], [0.5, 2.0]])
+    write_cluster(f, 0.25, 100.0, means, R)
+    text = f.getvalue()
+    assert "Probability: 0.250000\n" in text
+    assert "N: 100.000000\n" in text
+    assert "Means: 1.250 -2.500 \n" in text  # %.3f with trailing space
+    assert "\nR Matrix:\n1.000 0.500 \n0.500 2.000 \n" in text
+
+
+def test_results_format(tmp_path):
+    from cuda_gmm_mpi_tpu.io.writers import write_results
+
+    data = np.array([[1.0, 2.0], [3.0, 4.0]], np.float32)
+    w = np.array([[0.75, 0.25], [0.1, 0.9]], np.float32)
+    p = tmp_path / "out.results"
+    write_results(str(p), data, w, use_native="never")
+    lines = p.read_text().splitlines()
+    assert lines[0] == "1.000000,2.000000\t0.750000,0.250000"
+    assert lines[1] == "3.000000,4.000000\t0.100000,0.900000"

@@ -1,0 +1,95 @@
+"""Merge machinery vs the add_clusters/cluster_distance oracle."""
+
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from cuda_gmm_mpi_tpu.ops.merge import (
+    argmin_pair, eliminate_empty, merge_pair, pairwise_merge_distances,
+    reduce_order_step,
+)
+
+from .reference_impl import np_cluster_distance, np_merge
+from .test_estep import make_state
+from .test_mstep import as_params
+
+
+def test_pairwise_distances_match_oracle(rng):
+    k, d = 5, 3
+    state = make_state(rng, k, d)
+    dist = np.asarray(pairwise_merge_distances(state))
+    params = as_params(state)
+    for i in range(k):
+        for j in range(k):
+            if j <= i:
+                assert np.isinf(dist[i, j])
+            else:
+                np.testing.assert_allclose(
+                    dist[i, j], np_cluster_distance(params, i, j),
+                    rtol=1e-8, atol=1e-8,
+                )
+
+
+def test_inactive_pairs_excluded(rng):
+    k, d = 5, 3
+    state = make_state(rng, k, d, inactive=(1,))
+    dist = np.asarray(pairwise_merge_distances(state))
+    assert np.all(np.isinf(dist[1, :])) and np.all(np.isinf(dist[:, 1]))
+
+
+def test_merge_pair_matches_oracle(rng):
+    k, d = 4, 3
+    state = make_state(rng, k, d)
+    params = as_params(state)
+    merged = np_merge(params, 1, 3)
+    out = merge_pair(state, jnp.asarray(1), jnp.asarray(3))
+    np.testing.assert_allclose(float(out.N[1]), merged["N"], rtol=1e-10)
+    np.testing.assert_allclose(float(out.pi[1]), merged["pi"], rtol=1e-10)
+    np.testing.assert_allclose(np.asarray(out.means[1]), merged["means"],
+                               rtol=1e-9)
+    np.testing.assert_allclose(np.asarray(out.R[1]), merged["R"], rtol=1e-9)
+    np.testing.assert_allclose(float(out.constant[1]), merged["constant"],
+                               rtol=1e-9)
+    np.testing.assert_allclose(np.asarray(out.Rinv[1]),
+                               np.linalg.inv(merged["R"]), rtol=1e-7, atol=1e-9)
+    assert not bool(out.active[3])
+    # untouched clusters unchanged
+    np.testing.assert_allclose(np.asarray(out.means[0]), params["means"][0])
+
+
+def test_eliminate_empty(rng):
+    k, d = 4, 3
+    state = make_state(rng, k, d)
+    state = state.replace(N=jnp.asarray([10.0, 0.3, 5.0, 0.49]))
+    out = eliminate_empty(state)
+    np.testing.assert_array_equal(np.asarray(out.active),
+                                  [True, False, True, False])
+
+
+def test_reduce_order_step_merges_argmin(rng):
+    k, d = 5, 3
+    state = make_state(rng, k, d)
+    dist = np.asarray(pairwise_merge_distances(state))
+    i_exp, j_exp = np.unravel_index(np.argmin(dist), dist.shape)
+    out, (i, j), min_d = reduce_order_step(state)
+    assert (int(i), int(j)) == (i_exp, j_exp)
+    assert float(min_d) == pytest.approx(dist[i_exp, j_exp])
+    assert int(out.num_active()) == k - 1
+
+
+def test_reduce_order_step_no_valid_pair(rng):
+    """All-inf distances leave the state untouched (degenerate-sweep guard)."""
+    k, d = 3, 3
+    state = make_state(rng, k, d, inactive=(0, 1, 2))
+    out, _, min_d = reduce_order_step(state)
+    assert not np.isfinite(float(min_d))
+    np.testing.assert_array_equal(np.asarray(out.active),
+                                  np.asarray(state.active))
+    np.testing.assert_allclose(np.asarray(out.N), np.asarray(state.N))
+
+
+def test_argmin_pair_first_tie():
+    d = jnp.asarray(np.array([[np.inf, 2.0, 2.0], [np.inf, np.inf, 2.0],
+                              [np.inf, np.inf, np.inf]]))
+    i, j = argmin_pair(d)
+    assert (int(i), int(j)) == (0, 1)  # first in row-major scan order

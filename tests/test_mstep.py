@@ -1,0 +1,137 @@
+"""M-step sufficient stats + parameter update vs the NumPy oracle."""
+
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from cuda_gmm_mpi_tpu.ops.estep import posteriors
+from cuda_gmm_mpi_tpu.ops.mstep import (
+    accumulate_stats, apply_mstep, chunk_stats,
+)
+
+from .reference_impl import np_estep, np_mstep
+from .test_estep import make_state
+
+
+def as_params(state):
+    return {
+        "N": np.asarray(state.N), "pi": np.asarray(state.pi),
+        "constant": np.asarray(state.constant),
+        "avgvar": np.asarray(state.avgvar),
+        "means": np.asarray(state.means), "R": np.asarray(state.R),
+        "Rinv": np.asarray(state.Rinv),
+    }
+
+
+def test_chunk_stats_match_oracle(rng):
+    k, d, n = 4, 3, 200
+    state = make_state(rng, k, d)
+    x = rng.normal(scale=2.0, size=(n, d))
+    stats = chunk_stats(state, jnp.asarray(x))
+    w, ll = np_estep(as_params(state), x)
+    np.testing.assert_allclose(float(stats.loglik), ll, rtol=1e-10)
+    np.testing.assert_allclose(np.asarray(stats.Nk), w.sum(0), rtol=1e-9)
+    np.testing.assert_allclose(np.asarray(stats.M1), w.T @ x, rtol=1e-9)
+    M2 = np.einsum("nk,nd,ne->kde", w, x, x)
+    np.testing.assert_allclose(np.asarray(stats.M2), M2, rtol=1e-8, atol=1e-10)
+
+
+def test_accumulate_equals_single_chunk(rng):
+    k, d, n, b = 3, 4, 96, 32
+    state = make_state(rng, k, d)
+    x = rng.normal(size=(n, d))
+    whole = chunk_stats(state, jnp.asarray(x))
+    chunked = accumulate_stats(
+        state, jnp.asarray(x.reshape(n // b, b, d)),
+        jnp.ones((n // b, b)),
+    )
+    for name in ("loglik", "Nk", "M1", "M2"):
+        np.testing.assert_allclose(
+            np.asarray(getattr(chunked, name)), np.asarray(getattr(whole, name)),
+            rtol=1e-9, atol=1e-12,
+        )
+
+
+def test_padding_mask_ignored(rng):
+    k, d, n, b = 3, 3, 50, 32
+    state = make_state(rng, k, d)
+    x = rng.normal(size=(n, d))
+    pad = (-n) % b
+    xp = np.concatenate([x, np.zeros((pad, d))]).reshape(-1, b, d)
+    wts = np.concatenate([np.ones(n), np.zeros(pad)]).reshape(-1, b)
+    padded = accumulate_stats(state, jnp.asarray(xp), jnp.asarray(wts))
+    exact = chunk_stats(state, jnp.asarray(x))
+    np.testing.assert_allclose(float(padded.loglik), float(exact.loglik),
+                               rtol=1e-10)
+    np.testing.assert_allclose(np.asarray(padded.Nk), np.asarray(exact.Nk),
+                               rtol=1e-10)
+    np.testing.assert_allclose(np.asarray(padded.M2), np.asarray(exact.M2),
+                               rtol=1e-9)
+
+
+@pytest.mark.parametrize("diag_only", [False, True])
+def test_apply_mstep_matches_oracle(rng, diag_only):
+    k, d, n = 4, 3, 300
+    state = make_state(rng, k, d)
+    state = state.replace(avgvar=jnp.full((k,), 0.37))
+    if diag_only:
+        # Diag mode assumes a diagonal model state (DIAG_ONLY builds never
+        # produce off-diagonals); diagonalize so oracle and op see the same w.
+        R = np.asarray(state.R)
+        Rd = np.stack([np.diag(np.diag(R[c])) for c in range(k)])
+        const = -d * 0.5 * np.log(2 * np.pi) - 0.5 * np.log(
+            np.diagonal(Rd, axis1=1, axis2=2)
+        ).sum(1)
+        state = state.replace(
+            R=jnp.asarray(Rd), Rinv=jnp.asarray(np.linalg.inv(Rd)),
+            constant=jnp.asarray(const),
+        )
+    x = rng.normal(scale=2.0, size=(n, d))
+    params = as_params(state)
+    w, _ = np_estep(params, x)
+    expected = np_mstep(params, x, w, diag_only=diag_only)
+
+    stats = chunk_stats(state, jnp.asarray(x), diag_only=diag_only)
+    out = apply_mstep(state, stats, diag_only=diag_only)
+    np.testing.assert_allclose(np.asarray(out.N), expected["N"], rtol=1e-9)
+    np.testing.assert_allclose(np.asarray(out.means), expected["means"],
+                               rtol=1e-8, atol=1e-10)
+    np.testing.assert_allclose(np.asarray(out.R), expected["R"], rtol=1e-7,
+                               atol=1e-9)
+    np.testing.assert_allclose(np.asarray(out.Rinv), expected["Rinv"],
+                               rtol=1e-6, atol=1e-8)
+    np.testing.assert_allclose(np.asarray(out.constant), expected["constant"],
+                               rtol=1e-8)
+    np.testing.assert_allclose(np.asarray(out.pi), expected["pi"], rtol=1e-9)
+
+
+def test_empty_cluster_guards(rng):
+    """N<0.5 -> means 0, R identity; 0.5<=N<1 -> cov sums zeroed (reference
+    gaussian.cu:614-618, 663-679; gaussian_kernel.cu:658-668)."""
+    k, d, n = 3, 3, 40
+    state = make_state(rng, k, d)
+    state = state.replace(avgvar=jnp.full((k,), 0.2))
+    x = rng.normal(size=(n, d))
+    w = np.zeros((n, k))
+    w[:, 0] = 1.0  # cluster 0 owns everything
+    w[0, 0] = 0.3
+    w[0, 1] = 0.7  # cluster 1: N = 0.7 (between 0.5 and 1)
+    # cluster 2: N = 0 (empty)
+    from cuda_gmm_mpi_tpu.ops.mstep import SuffStats
+
+    stats = SuffStats(
+        loglik=jnp.asarray(0.0),
+        Nk=jnp.asarray(w.sum(0)),
+        M1=jnp.asarray(w.T @ x),
+        M2=jnp.asarray(np.einsum("nk,nd,ne->kde", w, x, x)),
+    )
+    out = apply_mstep(state, stats)
+    # empty cluster -> identity R, zero means
+    np.testing.assert_allclose(np.asarray(out.R[2]), np.eye(d))
+    np.testing.assert_allclose(np.asarray(out.means[2]), 0.0)
+    # 0.5 < N < 1: cov sums zeroed, R = avgvar*I/N
+    np.testing.assert_allclose(
+        np.asarray(out.R[1]), 0.2 * np.eye(d) / 0.7, rtol=1e-9
+    )
+    # pi floor for empty
+    assert float(out.pi[2]) == pytest.approx(1e-10)

@@ -1,0 +1,65 @@
+"""End-to-end fit_gmm: model-order search, best-model save, memberships."""
+
+import numpy as np
+
+from cuda_gmm_mpi_tpu.config import GMMConfig
+from cuda_gmm_mpi_tpu.models import compute_memberships, fit_gmm
+
+from .conftest import make_blobs
+
+
+def fast_cfg(**kw):
+    base = dict(min_iters=4, max_iters=4, chunk_size=512, dtype="float64")
+    base.update(kw)
+    return GMMConfig(**base)
+
+
+def test_target_k_fit(rng):
+    data, centers = make_blobs(rng, n=1200, d=3, k=4)
+    cfg = fast_cfg(min_iters=15, max_iters=15)
+    result = fit_gmm(data, 8, 4, config=cfg)
+    assert result.ideal_num_clusters == 4
+    # recovered means close to true centers (well-separated blobs)
+    got = sorted(map(tuple, np.round(result.means, 0)))
+    exp = sorted(map(tuple, np.round(centers, 0)))
+    err = np.abs(np.array(got) - np.array(exp)).max()
+    assert err <= 1.5
+    # sweep visited K = 8,7,6,5,4
+    assert [rec[0] for rec in result.sweep_log] == [8, 7, 6, 5, 4]
+
+
+def test_search_down_to_one(rng):
+    data, _ = make_blobs(rng, n=600, d=2, k=3)
+    result = fit_gmm(data, 5, 0, config=fast_cfg())
+    ks = [rec[0] for rec in result.sweep_log]
+    assert ks[0] == 5 and ks[-1] == 1
+    # best rissanen selected
+    assert result.min_rissanen == min(rec[2] for rec in result.sweep_log)
+
+
+def test_memberships_shape_and_normalization(rng):
+    data, _ = make_blobs(rng, n=500, d=3, k=3)
+    cfg = fast_cfg()
+    result = fit_gmm(data, 3, 3, config=cfg)
+    w = compute_memberships(result, data, cfg)
+    assert w.shape == (data.shape[0], 3)
+    np.testing.assert_allclose(w.sum(1), 1.0, rtol=1e-8)
+
+
+def test_centering_invariance(rng):
+    """fit with centering == fit without (means shifted back exactly)."""
+    data, _ = make_blobs(rng, n=400, d=2, k=2)
+    data = data + 500.0  # big offset
+    r1 = fit_gmm(data, 3, 2, config=fast_cfg(center_data=True))
+    r2 = fit_gmm(data, 3, 2, config=fast_cfg(center_data=False))
+    np.testing.assert_allclose(r1.means, r2.means, rtol=1e-6, atol=1e-6)
+    np.testing.assert_allclose(
+        np.asarray(r1.state.R), np.asarray(r2.state.R), rtol=1e-5, atol=1e-6
+    )
+
+
+def test_single_cluster(rng):
+    data, _ = make_blobs(rng, n=300, d=2, k=2)
+    result = fit_gmm(data, 1, 1, config=fast_cfg())
+    assert result.ideal_num_clusters == 1
+    np.testing.assert_allclose(result.means[0], data.mean(0), rtol=1e-5)

@@ -1,0 +1,90 @@
+"""Distributed EM on the 8-fake-device CPU mesh: sharded == single-device.
+
+The TPU-native analog of "test multi-node without a cluster" (SURVEY.md SS4):
+event sharding (data axis), cluster sharding (cluster axis), and the 2-D
+combination must all reproduce the single-device EM trajectory.
+"""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from cuda_gmm_mpi_tpu.config import GMMConfig
+from cuda_gmm_mpi_tpu.models import fit_gmm
+from cuda_gmm_mpi_tpu.models.gmm import GMMModel, chunk_events
+from cuda_gmm_mpi_tpu.ops.formulas import convergence_epsilon
+from cuda_gmm_mpi_tpu.ops.seeding import seed_clusters_host
+from cuda_gmm_mpi_tpu.parallel import ShardedGMMModel, make_mesh
+
+from .conftest import make_blobs
+
+
+def run_single(data, k, iters, chunk=128):
+    cfg = GMMConfig(min_iters=iters, max_iters=iters, chunk_size=chunk,
+                    dtype="float64")
+    model = GMMModel(cfg)
+    chunks, wts = chunk_events(data, cfg.chunk_size)
+    state = seed_clusters_host(data, k)
+    eps = convergence_epsilon(*data.shape)
+    s, ll, it = model.run_em(state, jnp.asarray(chunks), jnp.asarray(wts), eps)
+    return jax.device_get(s), float(ll)
+
+
+def run_sharded(data, k, iters, mesh_shape, chunk=128):
+    cfg = GMMConfig(min_iters=iters, max_iters=iters, chunk_size=chunk,
+                    dtype="float64", mesh_shape=mesh_shape)
+    model = ShardedGMMModel(cfg)
+    chunks, wts = chunk_events(data, cfg.chunk_size, model.data_size)
+    state = seed_clusters_host(data, k)
+    state, chunks, wts = model.prepare(state, chunks, wts)
+    eps = convergence_epsilon(*data.shape)
+    s, ll, it = model.run_em(state, chunks, wts, eps)
+    return jax.device_get(s), float(ll)
+
+
+@pytest.mark.parametrize("mesh_shape", [(8, 1), (4, 2), (2, 4), (1, 8)])
+def test_sharded_matches_single(rng, mesh_shape):
+    data, _ = make_blobs(rng, n=1024, d=3, k=4)
+    k = 4
+    s0, ll0 = run_single(data, k, 5)
+    s1, ll1 = run_sharded(data, k, 5, mesh_shape)
+    np.testing.assert_allclose(ll1, ll0, rtol=1e-9)
+    kp = s0.means.shape[0]
+    np.testing.assert_allclose(np.asarray(s1.means)[:kp], s0.means,
+                               rtol=1e-7, atol=1e-9)
+    np.testing.assert_allclose(np.asarray(s1.R)[:kp], s0.R, rtol=1e-6,
+                               atol=1e-9)
+    np.testing.assert_allclose(np.asarray(s1.N)[:kp], s0.N, rtol=1e-8)
+
+
+def test_cluster_padding(rng):
+    """K not divisible by the cluster axis: padded slots stay inactive."""
+    data, _ = make_blobs(rng, n=512, d=3, k=3)
+    s0, ll0 = run_single(data, 3, 4)
+    s1, ll1 = run_sharded(data, 3, 4, (2, 4))  # K=3 padded to 4
+    np.testing.assert_allclose(ll1, ll0, rtol=1e-9)
+    act = np.asarray(s1.active)
+    assert act[:3].all() and not act[3:].any()
+    np.testing.assert_allclose(np.asarray(s1.means)[:3], s0.means[:3],
+                               rtol=1e-7, atol=1e-9)
+
+
+def test_fit_gmm_with_mesh(rng):
+    """Full sweep through fit_gmm on a 2-D mesh matches the plain fit."""
+    data, _ = make_blobs(rng, n=512, d=2, k=3)
+    kw = dict(min_iters=3, max_iters=3, chunk_size=128, dtype="float64")
+    r0 = fit_gmm(data, 5, 3, config=GMMConfig(**kw))
+    r1 = fit_gmm(data, 5, 3, config=GMMConfig(mesh_shape=(4, 2), **kw))
+    assert r1.ideal_num_clusters == r0.ideal_num_clusters
+    np.testing.assert_allclose(r1.min_rissanen, r0.min_rissanen, rtol=1e-8)
+    np.testing.assert_allclose(r1.means, r0.means, rtol=1e-6, atol=1e-8)
+
+
+def test_uneven_events_across_shards(rng):
+    """Event count not divisible by devices*chunk: mask padding preserved."""
+    data, _ = make_blobs(rng, n=700, d=2, k=2)  # 698 events actually
+    s0, ll0 = run_single(data, 2, 3, chunk=64)
+    s1, ll1 = run_sharded(data, 2, 3, (8, 1), chunk=64)
+    np.testing.assert_allclose(ll1, ll0, rtol=1e-9)
+    np.testing.assert_allclose(np.asarray(s1.N)[:2], s0.N, rtol=1e-9)

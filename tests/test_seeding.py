@@ -1,0 +1,57 @@
+"""Seeding semantics vs the reference's two-stage init (net effective state)."""
+
+import jax.numpy as jnp
+import numpy as np
+
+from cuda_gmm_mpi_tpu.ops.constants import LOG_2PI
+from cuda_gmm_mpi_tpu.ops.seeding import seed_clusters, seed_means_indices
+
+
+def test_seed_indices_match_reference_float_math():
+    # (int)(c * seed), seed = (N-1)/(K-1)  -- gaussian.cu:110-120
+    n, k = 1000, 7
+    idx = np.asarray(seed_means_indices(n, k))
+    seed = (n - 1.0) / (k - 1.0)
+    expected = [int(np.float32(c) * np.float32(seed)) for c in range(k)]
+    np.testing.assert_array_equal(idx, expected)
+    assert idx[0] == 0 and idx[-1] == n - 1
+
+
+def test_seed_single_cluster():
+    idx = np.asarray(seed_means_indices(100, 1))
+    np.testing.assert_array_equal(idx, [0])
+
+
+def test_seed_state_fields(rng):
+    n, d, k = 500, 4, 5
+    data = rng.normal(scale=2.0, size=(n, d))
+    state = seed_clusters(jnp.asarray(data), k, covariance_dynamic_range=1e3)
+
+    np.testing.assert_allclose(np.asarray(state.N), n / k)          # :324
+    np.testing.assert_allclose(np.asarray(state.pi), 1.0 / k)       # :323
+    np.testing.assert_allclose(np.asarray(state.R),
+                               np.stack([np.eye(d)] * k))           # :316-320
+    np.testing.assert_allclose(np.asarray(state.Rinv),
+                               np.stack([np.eye(d)] * k))
+    # constant on R=I: -D/2 ln 2pi
+    np.testing.assert_allclose(np.asarray(state.constant),
+                               -d * 0.5 * LOG_2PI, rtol=1e-12)
+    # avgvar = mean_d(E[x^2]-E[x]^2)/1e3  (gaussian_kernel.cu:79-99,325)
+    var = (data ** 2).mean(0) - data.mean(0) ** 2
+    np.testing.assert_allclose(np.asarray(state.avgvar), var.mean() / 1e3,
+                               rtol=1e-10)
+    # means: evenly spaced events from the FULL data (host override,
+    # gaussian.cu:108-123)
+    idx = np.asarray(seed_means_indices(n, k))
+    np.testing.assert_allclose(np.asarray(state.means), data[idx])
+    assert bool(jnp.all(state.active))
+
+
+def test_seed_padded(rng):
+    n, d, k, kp = 200, 3, 4, 8
+    data = rng.normal(size=(n, d))
+    state = seed_clusters(jnp.asarray(data), k, num_clusters_padded=kp)
+    assert state.num_clusters_padded == kp
+    np.testing.assert_array_equal(np.asarray(state.active),
+                                  [True] * k + [False] * (kp - k))
+    assert np.all(np.asarray(state.N)[k:] == 0)
