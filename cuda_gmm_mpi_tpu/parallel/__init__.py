@@ -5,7 +5,12 @@ stack (SURVEY.md SS2.8): ``jax.lax.psum`` of the sufficient-statistics pytree
 over an ICI/DCN device mesh inside ``shard_map``.
 """
 
-from .mesh import make_mesh, shard_chunks
-from .sharded_em import ShardedGMMModel
+from .distributed import host_slice, initialize, sharded_chunks_from_host_data
+from .mesh import make_mesh, pad_clusters, shard_chunks, state_pspecs
+from .sharded_em import ShardedGMMModel, make_psum_reduce
 
-__all__ = ["make_mesh", "shard_chunks", "ShardedGMMModel"]
+__all__ = [
+    "host_slice", "initialize", "sharded_chunks_from_host_data",
+    "make_mesh", "pad_clusters", "shard_chunks", "state_pspecs",
+    "ShardedGMMModel", "make_psum_reduce",
+]

@@ -1,0 +1,89 @@
+"""Multi-host bootstrap and per-host sharded data loading.
+
+TPU-native replacement for the reference's MPI bootstrap + dataset broadcast
+(``gaussian.cu:130-207``): instead of rank 0 reading the file and
+``MPI_Bcast``-ing the ENTIRE dataset to every node (full replication,
+gaussian.cu:191-201), each host loads only its contiguous slice of the events
+and assembles a single globally-sharded array -- the data is never replicated
+anywhere. The multi-controller runtime (``jax.distributed.initialize``) is the
+analog of ``MPI_Init_thread`` (gaussian.cu:133); world size/rank come from the
+same coordinator concept as MPI_COMM_WORLD.
+
+Single-host callers can use everything here unchanged (process_count==1).
+"""
+
+from __future__ import annotations
+
+from typing import Optional, Tuple
+
+import jax
+import numpy as np
+from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+
+from .mesh import DATA_AXIS
+
+
+def initialize(
+    coordinator_address: Optional[str] = None,
+    num_processes: Optional[int] = None,
+    process_id: Optional[int] = None,
+) -> Tuple[int, int]:
+    """Initialize the multi-controller runtime; returns (process_id, count).
+
+    No-op on single-process runs (the reference likewise runs under plain
+    ``./gaussianMPI`` without mpirun). With arguments (or the standard cluster
+    env vars), brings up jax.distributed -- the MPI_Init/rank/size equivalent
+    (gaussian.cu:133-139).
+    """
+    if coordinator_address is not None or num_processes is not None:
+        jax.distributed.initialize(
+            coordinator_address=coordinator_address,
+            num_processes=num_processes,
+            process_id=process_id,
+        )
+    return jax.process_index(), jax.process_count()
+
+
+def host_slice(num_events: int, process_id: int, process_count: int):
+    """This host's contiguous event range [start, stop).
+
+    Mirrors the reference's contiguous per-GPU sharding arithmetic
+    (events_per_gpu * gpu_num, gaussian.cu:347-368) at host granularity, but
+    distributes the remainder across the first hosts instead of dumping it on
+    one rank (the reference's remainder quirk, gaussian.cu:350-352).
+    """
+    base, rem = divmod(num_events, process_count)
+    start = process_id * base + min(process_id, rem)
+    stop = start + base + (1 if process_id < rem else 0)
+    return start, stop
+
+
+def sharded_chunks_from_host_data(
+    mesh: Mesh,
+    local_chunks: np.ndarray,
+    local_wts: np.ndarray,
+):
+    """Assemble per-host chunk arrays into one globally data-sharded array.
+
+    Each host passes the chunks for ITS slice of the events (shape
+    [local_num_chunks, B, D]); the result is a global [total_chunks, B, D]
+    array sharded over the mesh's data axis with no cross-host transfer --
+    the anti-MPI_Bcast (SURVEY.md SS2.8 "Bcast of the dataset -> per-host
+    sharded loading").
+    """
+    from jax.experimental import multihost_utils
+
+    cspec = NamedSharding(mesh, P(DATA_AXIS, None, None))
+    wspec = NamedSharding(mesh, P(DATA_AXIS, None))
+    if jax.process_count() == 1:
+        return (
+            jax.device_put(local_chunks, cspec),
+            jax.device_put(local_wts, wspec),
+        )
+    chunks = multihost_utils.host_local_array_to_global_array(
+        local_chunks, mesh, P(DATA_AXIS, None, None)
+    )
+    wts = multihost_utils.host_local_array_to_global_array(
+        local_wts, mesh, P(DATA_AXIS, None)
+    )
+    return chunks, wts
