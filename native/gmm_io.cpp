@@ -1,0 +1,195 @@
+// Native I/O runtime for the TPU GMM framework.
+//
+// The reference's data path is native C++ (readData.cpp); this library keeps
+// that property for the TPU build: hot text parsing and result formatting run
+// in C++, exposed through a minimal C ABI consumed via ctypes
+// (cuda_gmm_mpi_tpu/io/native.py). Semantics match the reference readers:
+//   - dispatch on a trailing "bin" in the filename (readData.cpp:28)
+//   - BIN: int32 nevents, int32 ndims, float32 row-major payload
+//     (readData.cpp:35-47)
+//   - CSV: dims counted from the first line, FIRST LINE DROPPED as a header
+//     (readData.cpp:84), blank lines skipped, atof-style field parsing
+//     (strtof prefix semantics), ragged rows -> error (readData.cpp:104-107)
+// and the .results writer (gaussian.cu:1042-1059): "%f" CSV of the event data,
+// a tab, "%f" CSV of the per-cluster memberships, one line per event.
+
+#include <cstdint>
+#include <cstdio>
+#include <cstdlib>
+#include <cstring>
+#include <string>
+#include <vector>
+
+extern "C" {
+
+// rc: 0 ok, 1 open/alloc failure, 2 malformed content
+int gmm_read_data(const char* path, int64_t* n_out, int64_t* d_out,
+                  float** data_out);
+void gmm_free(float* p);
+int gmm_write_results(const char* path, const float* data, const float* memb,
+                      int64_t n, int64_t d, int64_t k);
+
+}  // extern "C"
+
+namespace {
+
+int read_bin(const char* path, int64_t* n_out, int64_t* d_out,
+             float** data_out) {
+  FILE* f = std::fopen(path, "rb");
+  if (!f) return 1;
+  int32_t header[2];
+  if (std::fread(header, sizeof(int32_t), 2, f) != 2) {
+    std::fclose(f);
+    return 2;
+  }
+  const int64_t n = header[0], d = header[1];
+  if (n <= 0 || d <= 0) {
+    std::fclose(f);
+    return 2;
+  }
+  const size_t count = static_cast<size_t>(n) * static_cast<size_t>(d);
+  float* data = static_cast<float*>(std::malloc(count * sizeof(float)));
+  if (!data) {
+    std::fclose(f);
+    return 1;
+  }
+  if (std::fread(data, sizeof(float), count, f) != count) {
+    std::free(data);
+    std::fclose(f);
+    return 2;
+  }
+  std::fclose(f);
+  *n_out = n;
+  *d_out = d;
+  *data_out = data;
+  return 0;
+}
+
+// Count comma-separated fields on [p, end).
+int64_t count_fields(const char* p, const char* end) {
+  int64_t fields = 1;
+  for (; p < end; ++p)
+    if (*p == ',') ++fields;
+  return fields;
+}
+
+int read_csv(const char* path, int64_t* n_out, int64_t* d_out,
+             float** data_out) {
+  FILE* f = std::fopen(path, "rb");
+  if (!f) return 1;
+  std::fseek(f, 0, SEEK_END);
+  const long size = std::ftell(f);
+  std::fseek(f, 0, SEEK_SET);
+  std::string buf(static_cast<size_t>(size), '\0');
+  if (size > 0 && std::fread(&buf[0], 1, static_cast<size_t>(size), f) !=
+                      static_cast<size_t>(size)) {
+    std::fclose(f);
+    return 2;
+  }
+  std::fclose(f);
+
+  // Split into non-empty lines (skip blanks, strip \r) -- readData.cpp:58-64.
+  const char* p = buf.data();
+  const char* const end = p + buf.size();
+  std::vector<std::pair<const char*, const char*>> lines;
+  while (p < end) {
+    const char* nl = static_cast<const char*>(
+        std::memchr(p, '\n', static_cast<size_t>(end - p)));
+    const char* stop = nl ? nl : end;
+    const char* right = stop;
+    while (right > p && (right[-1] == '\r')) --right;
+    if (right > p) lines.emplace_back(p, right);
+    p = nl ? nl + 1 : end;
+  }
+  if (lines.empty()) return 2;
+
+  const int64_t d = count_fields(lines[0].first, lines[0].second);
+  const int64_t n = static_cast<int64_t>(lines.size()) - 1;  // header dropped
+  if (n <= 0) return 2;
+
+  float* data = static_cast<float*>(
+      std::malloc(static_cast<size_t>(n) * static_cast<size_t>(d) *
+                  sizeof(float)));
+  if (!data) return 1;
+
+  for (int64_t i = 0; i < n; ++i) {
+    const char* q = lines[static_cast<size_t>(i) + 1].first;
+    const char* qe = lines[static_cast<size_t>(i) + 1].second;
+    if (count_fields(q, qe) != d) {
+      std::free(data);
+      return 2;
+    }
+    for (int64_t j = 0; j < d; ++j) {
+      // strtof prefix parse == atof semantics (readData.cpp:108); it stops at
+      // the comma on its own, no per-field copies needed.
+      char* next = nullptr;
+      data[i * d + j] = std::strtof(q, &next);
+      if (next == q) data[i * d + j] = 0.0f;  // non-numeric field -> 0.0
+      const char* comma = static_cast<const char*>(
+          std::memchr(q, ',', static_cast<size_t>(qe - q)));
+      q = comma ? comma + 1 : qe;
+    }
+  }
+  *n_out = n;
+  *d_out = d;
+  *data_out = data;
+  return 0;
+}
+
+// %f formatting without printf overhead: 6 fixed decimals, round-half-away.
+char* format_f(char* out, double v) {
+  if (v < 0) {
+    *out++ = '-';
+    v = -v;
+  }
+  // Overflow-safe for the float32 inputs we emit (fits in int64 up to ~9e12).
+  if (v > 9e12) return out + std::sprintf(out, "%f", v);
+  const int64_t scaled = static_cast<int64_t>(v * 1e6 + 0.5);
+  const int64_t ip = scaled / 1000000, fp = scaled % 1000000;
+  out += std::sprintf(out, "%lld", static_cast<long long>(ip));
+  *out++ = '.';
+  for (int64_t div = 100000; div >= 1; div /= 10)
+    *out++ = static_cast<char>('0' + (fp / div) % 10);
+  return out;
+}
+
+}  // namespace
+
+int gmm_read_data(const char* path, int64_t* n_out, int64_t* d_out,
+                  float** data_out) {
+  const size_t len = std::strlen(path);
+  if (len >= 3 && std::strcmp(path + len - 3, "bin") == 0)
+    return read_bin(path, n_out, d_out, data_out);
+  return read_csv(path, n_out, d_out, data_out);
+}
+
+void gmm_free(float* p) { std::free(p); }
+
+int gmm_write_results(const char* path, const float* data, const float* memb,
+                      int64_t n, int64_t d, int64_t k) {
+  FILE* f = std::fopen(path, "w");
+  if (!f) return 1;
+  // Worst-case per value: sign + 20 int digits + '.' + 6 decimals + sep.
+  const size_t line_cap = static_cast<size_t>(d + k) * 32 + 8;
+  std::vector<char> line(line_cap);
+  for (int64_t i = 0; i < n; ++i) {
+    char* out = line.data();
+    for (int64_t j = 0; j < d; ++j) {
+      if (j) *out++ = ',';
+      out = format_f(out, static_cast<double>(data[i * d + j]));
+    }
+    *out++ = '\t';
+    for (int64_t c = 0; c < k; ++c) {
+      if (c) *out++ = ',';
+      out = format_f(out, static_cast<double>(memb[i * k + c]));
+    }
+    *out++ = '\n';
+    if (std::fwrite(line.data(), 1, static_cast<size_t>(out - line.data()),
+                    f) != static_cast<size_t>(out - line.data())) {
+      std::fclose(f);
+      return 1;
+    }
+  }
+  std::fclose(f);
+  return 0;
+}

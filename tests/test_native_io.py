@@ -1,0 +1,84 @@
+"""Native C++ reader/writer parity with the Python implementations."""
+
+import numpy as np
+import pytest
+
+from cuda_gmm_mpi_tpu.io import native
+from cuda_gmm_mpi_tpu.io.readers import read_bin, read_csv, write_bin
+from cuda_gmm_mpi_tpu.io.writers import write_results
+
+pytestmark = pytest.mark.skipif(
+    not native.available(), reason="native gmm_io library not built"
+)
+
+
+def test_native_csv_matches_python(tmp_path, rng):
+    data = rng.normal(scale=100, size=(500, 7)).astype(np.float32)
+    p = tmp_path / "d.csv"
+    p.write_text(
+        ",".join(f"h{i}" for i in range(7)) + "\n"
+        + "\n".join(",".join(f"{v:.6f}" for v in row) for row in data)
+    )
+    a = native.read_data(str(p))
+    b = read_csv(str(p))
+    np.testing.assert_array_equal(a, b)
+    assert a.dtype == np.float32 and a.shape == (500, 7)
+
+
+def test_native_bin_matches_python(tmp_path, rng):
+    data = rng.normal(size=(123, 4)).astype(np.float32)
+    p = tmp_path / "d.bin"
+    write_bin(str(p), data)
+    np.testing.assert_array_equal(native.read_data(str(p)), read_bin(str(p)))
+
+
+def test_native_csv_blank_lines_and_crlf(tmp_path):
+    p = tmp_path / "d.csv"
+    p.write_bytes(b"a,b\r\n\r\n1.5,2.5\r\n\r\n3.5,4.5\r\n")
+    out = native.read_data(str(p))
+    np.testing.assert_allclose(out, [[1.5, 2.5], [3.5, 4.5]])
+
+
+def test_native_csv_ragged_errors(tmp_path):
+    p = tmp_path / "d.csv"
+    p.write_text("a,b,c\n1,2,3\n4,5\n")
+    with pytest.raises(ValueError):
+        native.read_data(str(p))
+
+
+def test_native_csv_atof_semantics(tmp_path):
+    p = tmp_path / "d.csv"
+    p.write_text("a,b\nhello,1.25e2\n-3.5xyz,0\n")
+    out = native.read_data(str(p))
+    np.testing.assert_allclose(out, [[0.0, 125.0], [-3.5, 0.0]])
+
+
+def test_native_writer_matches_python(tmp_path, rng):
+    data = rng.normal(scale=10, size=(200, 5)).astype(np.float32)
+    memb = rng.random(size=(200, 3)).astype(np.float32)
+    memb /= memb.sum(1, keepdims=True)
+    p_native = tmp_path / "n.results"
+    p_python = tmp_path / "p.results"
+    native.write_results(str(p_native), data, memb)
+    write_results(str(p_python), data, memb, use_native="never")
+    a = p_native.read_text().splitlines()
+    b = p_python.read_text().splitlines()
+    assert len(a) == len(b) == 200
+    mismatches = [
+        (x, y) for x, y in zip(a, b) if x != y
+    ]
+    # printf %f and our fixed-point formatter may differ in the last digit on
+    # ties; allow a tiny number of one-ulp formatting diffs but no structural
+    # ones.
+    for x, y in mismatches:
+        xs = x.replace("\t", ",").split(",")
+        ys = y.replace("\t", ",").split(",")
+        assert len(xs) == len(ys)
+        np.testing.assert_allclose(
+            [float(v) for v in xs], [float(v) for v in ys], atol=2e-6
+        )
+
+
+def test_native_missing_file():
+    with pytest.raises(ValueError):
+        native.read_data("/nonexistent/file.csv")
