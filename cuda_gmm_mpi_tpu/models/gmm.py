@@ -72,7 +72,8 @@ class GMMModel:
     """
 
     def __init__(self, config: GMMConfig = GMMConfig(),
-                 reduce_stats: Optional[ReduceFn] = None):
+                 reduce_stats: Optional[ReduceFn] = None,
+                 stats_fn: Optional[Callable] = None):
         self.config = config
         self.reduce_stats = reduce_stats
 
@@ -83,11 +84,20 @@ class GMMModel:
         )
         self._kw = kw
 
+        if stats_fn is None:
+            from ..ops.pallas import fused_stats_pallas, should_use_pallas
+
+            if should_use_pallas(config):
+                stats_fn = fused_stats_pallas
+        self.stats_fn = stats_fn
+
         self._em_run = jax.jit(
-            functools.partial(em_while_loop, reduce_stats=reduce_stats, **kw)
+            functools.partial(em_while_loop, reduce_stats=reduce_stats,
+                              stats_fn=stats_fn, **kw)
         )
         self._estep_stats = jax.jit(
-            functools.partial(self._estep_stats_impl, reduce_stats=reduce_stats, **kw)
+            functools.partial(self._estep_stats_impl, reduce_stats=reduce_stats,
+                              stats_fn=stats_fn, **kw)
         )
         self._posteriors = jax.jit(
             functools.partial(
@@ -99,8 +109,12 @@ class GMMModel:
         )
 
     @staticmethod
-    def _estep_stats_impl(state, data_chunks, wts_chunks, *, reduce_stats=None, **kw):
-        stats = accumulate_stats(state, data_chunks, wts_chunks, **kw)
+    def _estep_stats_impl(state, data_chunks, wts_chunks, *, reduce_stats=None,
+                          stats_fn=None, **kw):
+        if stats_fn is not None:
+            stats = stats_fn(state, data_chunks, wts_chunks)
+        else:
+            stats = accumulate_stats(state, data_chunks, wts_chunks, **kw)
         return reduce_stats(stats) if reduce_stats else stats
 
     def run_em(self, state, data_chunks, wts_chunks, epsilon: float):
@@ -145,13 +159,22 @@ def em_while_loop(
     quad_mode: str = "expanded",
     matmul_precision: str = "highest",
     cluster_axis: str | None = None,
+    stats_fn: Optional[Callable] = None,
 ):
-    """The whole per-K EM algorithm as one traced program."""
+    """The whole per-K EM algorithm as one traced program.
+
+    ``stats_fn(state, data_chunks, wts_chunks) -> SuffStats`` overrides the
+    jnp fused pass -- the hook through which the Pallas TPU kernel
+    (ops/pallas/fused_stats.py) replaces XLA-generated code on the hot path.
+    """
     kw = dict(diag_only=diag_only, quad_mode=quad_mode,
               matmul_precision=matmul_precision, cluster_axis=cluster_axis)
 
     def estep(s) -> SuffStats:
-        stats = accumulate_stats(s, data_chunks, wts_chunks, **kw)
+        if stats_fn is not None:
+            stats = stats_fn(s, data_chunks, wts_chunks)
+        else:
+            stats = accumulate_stats(s, data_chunks, wts_chunks, **kw)
         return reduce_stats(stats) if reduce_stats else stats
 
     stats0 = estep(state)  # initial E-step (gaussian.cu:487-516)

@@ -1,0 +1,28 @@
+"""Pallas/Mosaic TPU kernels -- the hand-tuned hot path (SURVEY L2).
+
+``should_use_pallas`` decides kernel-vs-jnp per config/platform: the Pallas
+fused E+M kernel needs a TPU (or interpret mode for tests), float32, full
+covariance, the expanded quadratic form, and an unsharded cluster axis.
+"""
+
+from __future__ import annotations
+
+import jax
+
+from .fused_stats import fused_stats_pallas
+
+
+def should_use_pallas(config, cluster_sharded: bool = False) -> bool:
+    if config.use_pallas == "never":
+        return False
+    if config.diag_only or cluster_sharded or config.dtype != "float32":
+        return False
+    if config.use_pallas == "always":
+        return True
+    try:
+        return jax.devices()[0].platform == "tpu"
+    except Exception:
+        return False
+
+
+__all__ = ["fused_stats_pallas", "should_use_pallas"]

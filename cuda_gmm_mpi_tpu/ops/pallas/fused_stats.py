@@ -1,0 +1,197 @@
+"""Pallas TPU kernel: fused E-step + M-step sufficient statistics.
+
+This is the TPU-native replacement for the reference's entire kernel sequence
+``estep1 -> estep2 -> mstep_N -> mstep_means -> mstep_covariance1``
+(``gaussian_kernel.cu:383-677``), which makes 5 passes over HBM-resident data
+and a full N x K memberships array. Here ONE kernel makes ONE pass over the
+events; everything else lives in VMEM:
+
+  per event-tile [B_t, D]:
+    x2   = flattened outer products x x^T            (VMEM only -- the jnp
+           path materializes this [N, D^2] in HBM; eliminating that traffic
+           is the kernel's whole point)
+    q    = x2 @ A^T - 2 x @ h^T + (folded into g)    (MXU)
+    logp = -0.5 q + g                                (g = constant + ln pi
+           - 0.5 mu^T Rinv mu, -inf for masked clusters)
+    logZ = max-shifted log-sum-exp over K            (VPU, = estep2)
+    w    = exp(logp - logZ) * event_mask             (never leaves VMEM)
+    ll  += sum logZ;  Nk += sum w;  M1 += w^T x;  M2 += w^T x2   (MXU)
+
+Stats accumulate in VMEM scratch across the sequential TPU grid and are
+written once on the last tile. Requires an unsharded cluster axis (the
+cluster-sharded path uses the jnp implementation with collective LSE).
+"""
+
+from __future__ import annotations
+
+import functools
+
+import jax
+import jax.numpy as jnp
+from jax.experimental import pallas as pl
+from jax.experimental.pallas import tpu as pltpu
+
+from ..mstep import SuffStats
+
+NEG_LARGE = -1e30  # stand-in for -inf: exp() underflows to 0, avoids inf-inf
+
+
+def _fused_stats_kernel(x_ref, wt_ref, A_ref, h_ref, g_ref,
+                        ll_ref, nk_ref, m1_ref, m2_ref,
+                        ll_acc, nk_acc, m1_acc, m2_acc):
+    i = pl.program_id(0)
+    n_tiles = pl.num_programs(0)
+
+    @pl.when(i == 0)
+    def _init():
+        ll_acc[:] = jnp.zeros_like(ll_acc)
+        nk_acc[:] = jnp.zeros_like(nk_acc)
+        m1_acc[:] = jnp.zeros_like(m1_acc)
+        m2_acc[:] = jnp.zeros_like(m2_acc)
+
+    x = x_ref[:]                      # [B_t, D]
+    wt = wt_ref[:]                    # [B_t, 1]
+    bt, d = x.shape
+
+    # Flattened outer products, built in VMEM: [B_t, D*D].
+    x2 = (x[:, :, None] * x[:, None, :]).reshape(bt, d * d)
+
+    # Quadratic form as two MXU contractions (estep1's double D-loop per
+    # thread becomes one (B_t, D^2) @ (D^2, K) matmul).
+    q = jax.lax.dot_general(
+        x2, A_ref[:], (((1,), (1,)), ((), ())),
+        preferred_element_type=jnp.float32,
+    )  # [B_t, K]
+    q = q - 2.0 * jax.lax.dot_general(
+        x, h_ref[:], (((1,), (1,)), ((), ())),
+        preferred_element_type=jnp.float32,
+    )
+    logp = -0.5 * q + g_ref[:]        # [B_t, K]; g broadcasts from [1, K]
+
+    # estep2: max-shifted log-sum-exp + normalized responsibilities.
+    m = jnp.max(logp, axis=1, keepdims=True)
+    m = jnp.maximum(m, NEG_LARGE)     # all-masked guard
+    e = jnp.exp(logp - m)
+    s = jnp.sum(e, axis=1, keepdims=True)
+    logz = (m + jnp.log(s)) * wt      # padded events contribute 0
+    w = (e / s) * wt
+
+    ll_acc[0, 0] += jnp.sum(logz)
+    nk_acc[:] += jnp.sum(w, axis=0, keepdims=True)          # [1, K]
+    m1_acc[:] += jax.lax.dot_general(                       # [K, D]
+        w, x, (((0,), (0,)), ((), ())),
+        preferred_element_type=jnp.float32,
+    )
+    m2_acc[:] += jax.lax.dot_general(                       # [K, D*D]
+        w, x2, (((0,), (0,)), ((), ())),
+        preferred_element_type=jnp.float32,
+    )
+
+    @pl.when(i == n_tiles - 1)
+    def _flush():
+        ll_ref[:] = ll_acc[:]
+        nk_ref[:] = nk_acc[:]
+        m1_ref[:] = m1_acc[:]
+        m2_ref[:] = m2_acc[:]
+
+
+@functools.partial(jax.jit, static_argnames=("block_b", "interpret"))
+def _fused_stats_call(x, wt, A, h, g, *, block_b: int, interpret: bool):
+    n, d = x.shape
+    k = A.shape[0]
+    grid = n // block_b
+    f32 = jnp.float32
+    out_shapes = (
+        jax.ShapeDtypeStruct((1, 1), f32),
+        jax.ShapeDtypeStruct((1, k), f32),
+        jax.ShapeDtypeStruct((k, d), f32),
+        jax.ShapeDtypeStruct((k, d * d), f32),
+    )
+    rep = lambda *_: (0, 0)  # accumulator outputs: same block every step
+    ll, nk, m1, m2 = pl.pallas_call(
+        _fused_stats_kernel,
+        grid=(grid,),
+        in_specs=[
+            pl.BlockSpec((block_b, d), lambda i: (i, 0),
+                         memory_space=pltpu.VMEM),
+            pl.BlockSpec((block_b, 1), lambda i: (i, 0),
+                         memory_space=pltpu.VMEM),
+            pl.BlockSpec((k, d * d), rep, memory_space=pltpu.VMEM),
+            pl.BlockSpec((k, d), rep, memory_space=pltpu.VMEM),
+            pl.BlockSpec((1, k), rep, memory_space=pltpu.VMEM),
+        ],
+        out_specs=(
+            pl.BlockSpec((1, 1), rep, memory_space=pltpu.VMEM),
+            pl.BlockSpec((1, k), rep, memory_space=pltpu.VMEM),
+            pl.BlockSpec((k, d), rep, memory_space=pltpu.VMEM),
+            pl.BlockSpec((k, d * d), rep, memory_space=pltpu.VMEM),
+        ),
+        out_shape=out_shapes,
+        scratch_shapes=[
+            pltpu.VMEM((1, 1), f32),
+            pltpu.VMEM((1, k), f32),
+            pltpu.VMEM((k, d), f32),
+            pltpu.VMEM((k, d * d), f32),
+        ],
+        cost_estimate=pl.CostEstimate(
+            flops=4 * n * k * d * d,
+            bytes_accessed=n * d * 4 + k * d * d * 8,
+            transcendentals=2 * n,
+        ),
+        interpret=interpret,
+    )(x, wt, A, h, g)
+    return ll, nk, m1, m2
+
+
+def fused_stats_pallas(
+    state,
+    data_chunks: jax.Array,
+    wts_chunks: jax.Array | None,
+    *,
+    block_b: int = 1024,
+    interpret: bool = False,
+) -> SuffStats:
+    """SuffStats for all chunks via the fused Pallas kernel.
+
+    Drop-in for ``accumulate_stats`` (full-covariance, unsharded cluster axis).
+    ``data_chunks`` is the [C, B, D] chunk array; it is viewed flat and gridded
+    into ``block_b``-event tiles.
+    """
+    c, b, d = data_chunks.shape
+    n = c * b
+    x = data_chunks.reshape(n, d).astype(jnp.float32)
+    if wts_chunks is None:
+        wt = jnp.ones((n, 1), jnp.float32)
+    else:
+        wt = wts_chunks.reshape(n, 1).astype(jnp.float32)
+
+    # Pad events to a whole number of tiles (masked out via wt).
+    pad = (-n) % block_b
+    if pad:
+        x = jnp.concatenate([x, jnp.zeros((pad, d), x.dtype)])
+        wt = jnp.concatenate([wt, jnp.zeros((pad, 1), wt.dtype)])
+
+    # Per-cluster linear/constant terms, computed once outside the kernel:
+    # logp = -0.5 (x2.A - 2 x.h) + g
+    K = state.means.shape[0]
+    Rinv = state.Rinv.astype(jnp.float32)
+    mu = state.means.astype(jnp.float32)
+    A = Rinv.reshape(K, d * d)
+    h = jnp.einsum("kde,ke->kd", Rinv, mu)
+    g = (
+        -0.5 * jnp.sum(h * mu, axis=-1)
+        + state.constant.astype(jnp.float32)
+        + jnp.log(jnp.maximum(state.pi.astype(jnp.float32), 1e-37))
+    )
+    g = jnp.where(state.active, g, NEG_LARGE)[None, :]  # [1, K]
+
+    ll, nk, m1, m2 = _fused_stats_call(
+        x, wt, A, h, g, block_b=block_b, interpret=interpret
+    )
+    dt = data_chunks.dtype
+    return SuffStats(
+        loglik=ll[0, 0].astype(dt),
+        Nk=nk[0].astype(dt),
+        M1=m1.astype(dt),
+        M2=m2.reshape(K, d, d).astype(dt),
+    )

@@ -84,10 +84,17 @@ class ShardedGMMModel:
         )
         self._kw = kw
 
+        stats_fn = None
+        if cluster_axis is None:
+            from ..ops.pallas import fused_stats_pallas, should_use_pallas
+
+            if should_use_pallas(config):
+                stats_fn = fused_stats_pallas
         em_fn = functools.partial(
             em_while_loop,
             reduce_stats=make_psum_reduce(DATA_AXIS),
             cluster_axis=cluster_axis,
+            stats_fn=stats_fn,
             **kw,
         )
         sspec = state_pspecs()

@@ -1,0 +1,111 @@
+"""Pallas fused E+M kernel vs the jnp reference path (interpret mode on CPU).
+
+SURVEY.md SS4: 'kernel tests: Pallas kernels in interpret=True mode vs the jnp
+reference implementation'.
+"""
+
+import functools
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from cuda_gmm_mpi_tpu.config import GMMConfig
+from cuda_gmm_mpi_tpu.models.gmm import GMMModel, chunk_events
+from cuda_gmm_mpi_tpu.ops.formulas import convergence_epsilon
+from cuda_gmm_mpi_tpu.ops.mstep import accumulate_stats
+from cuda_gmm_mpi_tpu.ops.pallas import should_use_pallas
+from cuda_gmm_mpi_tpu.ops.pallas.fused_stats import fused_stats_pallas
+from cuda_gmm_mpi_tpu.ops.seeding import seed_clusters_host
+
+from .conftest import make_blobs
+from .test_estep import make_state
+
+pallas_interp = functools.partial(fused_stats_pallas, block_b=64,
+                                  interpret=True)
+
+
+def to_f32(state):
+    return jax.tree_util.tree_map(
+        lambda a: a.astype(jnp.float32) if a.dtype != bool else a, state
+    )
+
+
+def test_fused_stats_matches_jnp(rng):
+    k, d, n, b = 5, 4, 256, 64
+    state = to_f32(make_state(rng, k, d))
+    data = rng.normal(scale=2.0, size=(n, d)).astype(np.float32)
+    chunks = jnp.asarray(data.reshape(n // b, b, d))
+    wts = jnp.ones((n // b, b), jnp.float32)
+
+    ref = accumulate_stats(state, chunks, wts, matmul_precision="highest")
+    out = pallas_interp(state, chunks, wts)
+
+    np.testing.assert_allclose(float(out.loglik), float(ref.loglik), rtol=1e-5)
+    np.testing.assert_allclose(np.asarray(out.Nk), np.asarray(ref.Nk),
+                               rtol=1e-5, atol=1e-5)
+    np.testing.assert_allclose(np.asarray(out.M1), np.asarray(ref.M1),
+                               rtol=1e-4, atol=1e-4)
+    np.testing.assert_allclose(np.asarray(out.M2), np.asarray(ref.M2),
+                               rtol=1e-4, atol=1e-3)
+
+
+def test_fused_stats_masking(rng):
+    """Padded events and inactive clusters contribute exactly nothing."""
+    k, d, n, b = 4, 3, 128, 64
+    state = to_f32(make_state(rng, k, d, inactive=(2,)))
+    data = rng.normal(size=(n, d)).astype(np.float32)
+    chunks = jnp.asarray(data.reshape(n // b, b, d))
+    wts_np = np.ones((n // b, b), np.float32)
+    wts_np[-1, 32:] = 0.0  # pad out the tail
+    out = pallas_interp(state, chunks, jnp.asarray(wts_np))
+    ref = accumulate_stats(state, chunks, jnp.asarray(wts_np),
+                           matmul_precision="highest")
+    assert float(out.Nk[2]) == 0.0
+    np.testing.assert_allclose(float(out.loglik), float(ref.loglik), rtol=1e-5)
+    np.testing.assert_allclose(np.asarray(out.Nk), np.asarray(ref.Nk),
+                               rtol=1e-5, atol=1e-5)
+
+
+def test_fused_stats_uneven_tiles(rng):
+    """Event count not divisible by block_b: internal padding handles it."""
+    k, d = 3, 3
+    state = to_f32(make_state(rng, k, d))
+    data = rng.normal(size=(96, d)).astype(np.float32)  # 96 = 1.5 * 64
+    chunks = jnp.asarray(data.reshape(2, 48, d))
+    wts = jnp.ones((2, 48), jnp.float32)
+    out = pallas_interp(state, chunks, wts)
+    ref = accumulate_stats(state, chunks, wts, matmul_precision="highest")
+    np.testing.assert_allclose(float(out.loglik), float(ref.loglik), rtol=1e-5)
+    np.testing.assert_allclose(np.asarray(out.M2), np.asarray(ref.M2),
+                               rtol=1e-4, atol=1e-3)
+
+
+def test_em_loop_with_pallas_backend(rng):
+    """Full EM through GMMModel with the kernel as stats backend."""
+    data, _ = make_blobs(rng, n=512, d=3, k=3, dtype=np.float32)
+    cfg = GMMConfig(min_iters=4, max_iters=4, chunk_size=128, dtype="float32")
+    m_ref = GMMModel(cfg)
+    m_pal = GMMModel(cfg, stats_fn=pallas_interp)
+    chunks, wts = chunk_events(data, cfg.chunk_size)
+    chunks, wts = jnp.asarray(chunks), jnp.asarray(wts)
+    state = seed_clusters_host(data, 3)
+    eps = convergence_epsilon(*data.shape)
+    s_ref, ll_ref, _ = m_ref.run_em(state, chunks, wts, eps)
+    s_pal, ll_pal, _ = m_pal.run_em(state, chunks, wts, eps)
+    np.testing.assert_allclose(float(ll_pal), float(ll_ref), rtol=1e-4)
+    np.testing.assert_allclose(np.asarray(s_pal.means), np.asarray(s_ref.means),
+                               rtol=1e-3, atol=1e-3)
+
+
+def test_should_use_pallas_gating():
+    assert not should_use_pallas(GMMConfig(use_pallas="never"))
+    assert not should_use_pallas(GMMConfig(use_pallas="always", diag_only=True))
+    assert not should_use_pallas(GMMConfig(use_pallas="always",
+                                           dtype="float64"))
+    assert should_use_pallas(GMMConfig(use_pallas="always"))
+    assert not should_use_pallas(GMMConfig(use_pallas="always"),
+                                 cluster_sharded=True)
+    # auto on CPU -> False
+    assert not should_use_pallas(GMMConfig(use_pallas="auto"))
