@@ -164,6 +164,8 @@ def main(argv=None) -> int:
 
     if config.profile:
         em_s = sum(rec[4] for rec in result.sweep_log)
+        if result.profile_report:
+            print(result.profile_report)  # 7-category table (gaussian.cu:967)
         print(f"I/O time: {(t_io + t_out) * 1e3:.3f} (ms)")  # :1093
         print(f"EM time: {em_s * 1e3:.3f} (ms) over "
               f"{sum(r[3] for r in result.sweep_log)} iterations")

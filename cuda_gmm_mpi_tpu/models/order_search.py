@@ -12,6 +12,7 @@ rissanen improves and no target K was requested, or when K equals the target.
 
 from __future__ import annotations
 
+import contextlib
 import dataclasses
 import functools
 import time
@@ -26,7 +27,14 @@ from ..ops.formulas import convergence_epsilon, rissanen_score
 from ..ops.merge import eliminate_empty, reduce_order_step
 from ..ops.seeding import seed_clusters_host
 from ..state import GMMState, compact
+from ..utils.logging_ import get_logger, metrics_line
+from ..utils.profiling import PhaseTimer
 from .gmm import GMMModel, chunk_events
+
+
+@contextlib.contextmanager
+def _null_phase(_name):
+    yield
 
 
 @dataclasses.dataclass
@@ -49,6 +57,8 @@ class GMMResult:
     data_shift: np.ndarray  # [D] centering shift (zeros if centering disabled)
     # per-K trajectory: (num_clusters, loglik, rissanen, em_iters, seconds)
     sweep_log: list = dataclasses.field(default_factory=list)
+    profile: Optional[dict] = None          # seconds per phase (7 categories)
+    profile_report: Optional[str] = None    # formatted report
 
     @property
     def means(self) -> np.ndarray:
@@ -94,18 +104,23 @@ def fit_gmm(
         # JAX_PLATFORMS already.
         jax.config.update("jax_platforms", config.device)
 
-    data = np.ascontiguousarray(data)
-    n_events, n_dims = data.shape
-    dtype = np.dtype(config.dtype)
-    data = data.astype(dtype, copy=False)
+    log = get_logger(config)
+    timer = PhaseTimer() if config.profile else None
+    phase = timer.phase if timer else _null_phase
 
-    # Global centering keeps the expanded quadratic form well-conditioned
-    # (shift-equivariant: EM on x - c equals EM on x with means shifted by c).
-    if config.center_data:
-        shift = data.mean(axis=0, dtype=np.float64).astype(dtype)
-        data = data - shift[None, :]
-    else:
-        shift = np.zeros((n_dims,), dtype)
+    with phase("cpu"):
+        data = np.ascontiguousarray(data)
+        n_events, n_dims = data.shape
+        dtype = np.dtype(config.dtype)
+        data = data.astype(dtype, copy=False)
+
+        # Global centering keeps the expanded quadratic form well-conditioned
+        # (shift-equivariant: EM on x-c equals EM on x with means shifted by c).
+        if config.center_data:
+            shift = data.mean(axis=0, dtype=np.float64).astype(dtype)
+            data = data - shift[None, :]
+        else:
+            shift = np.zeros((n_dims,), dtype)
 
     if model is None:
         if config.mesh_shape is not None:
@@ -115,22 +130,26 @@ def fit_gmm(
         else:
             model = GMMModel(config)
 
-    # Host-side seeding: only K gathered rows + global moments touch the
-    # device; the chunked copy below is the only full device-resident dataset.
-    state = seed_clusters_host(
-        data, num_clusters,
-        covariance_dynamic_range=config.covariance_dynamic_range,
-    )
+    with phase("cpu"):
+        # Host-side seeding: only K gathered rows + global moments touch the
+        # device; the chunked copy below is the only full device-resident copy.
+        state = seed_clusters_host(
+            data, num_clusters,
+            covariance_dynamic_range=config.covariance_dynamic_range,
+        )
+        num_shards = getattr(model, "data_size", 1)
+        chunks_np, wts_np = chunk_events(data, config.chunk_size, num_shards)
 
-    num_shards = getattr(model, "data_size", 1)
-    chunks_np, wts_np = chunk_events(data, config.chunk_size, num_shards)
-    if hasattr(model, "prepare"):  # sharded path: pad K, place on the mesh
-        state, chunks, wts = model.prepare(state, chunks_np, wts_np)
-    else:
-        chunks, wts = jnp.asarray(chunks_np), jnp.asarray(wts_np)
+    with phase("memcpy"):
+        if hasattr(model, "prepare"):  # sharded path: pad K, place on mesh
+            state, chunks, wts = model.prepare(state, chunks_np, wts_np)
+        else:
+            chunks, wts = jnp.asarray(chunks_np), jnp.asarray(wts_np)
     epsilon = convergence_epsilon(n_events, n_dims, config.epsilon_scale)
     if verbose:
         print(f"epsilon = {epsilon}")  # gaussian.cu:462
+    log.debug("epsilon=%s n=%d d=%d k=%d", epsilon, n_events, n_dims,
+              num_clusters)
 
     elim_fn = jax.jit(eliminate_empty)
     reduce_fn = jax.jit(
@@ -140,18 +159,46 @@ def fit_gmm(
     sweep_log = []
     min_rissanen = np.inf
     ideal_k, best_state, best_ll = num_clusters, state, -np.inf
-
     k = num_clusters
+    step = 0
+
+    ckpt = None
+    if config.checkpoint_dir:
+        from ..utils.checkpoint import SweepCheckpointer
+
+        ckpt = SweepCheckpointer(config.checkpoint_dir)
+        restored = ckpt.restore()
+        if restored is not None and int(restored["num_clusters"]) == num_clusters:
+            state = restored["state"]
+            if hasattr(model, "prepare"):
+                state, _, _ = model.prepare(state, chunks_np, wts_np)
+            best_state = restored["best_state"]
+            min_rissanen = float(restored["min_rissanen"])
+            ideal_k = int(restored["ideal_k"])
+            best_ll = float(restored["best_ll"])
+            k = int(restored["k"])
+            step = int(restored["step"]) + 1
+            sweep_log = [tuple(r) for r in np.asarray(
+                restored["sweep_log"]).tolist()] if len(
+                    restored.get("sweep_log", [])) else []
+            log.info("resumed sweep from checkpoint: next K=%d", k)
+
     while k >= stop_number:
         t0 = time.perf_counter()
-        state, ll, iters = model.run_em(state, chunks, wts, epsilon)
-        ll_f = float(ll)
+        with phase("e_step"):  # fused E+M loop (m_step/constants folded in)
+            state, ll, iters = model.run_em(state, chunks, wts, epsilon)
+            ll_f = float(ll)  # device sync
         riss = rissanen_score(ll_f, k, n_events, n_dims)
         dt = time.perf_counter() - t0
+        if timer:
+            timer.counts["e_step"] += int(iters) - 1  # per-iteration averages
         sweep_log.append((k, ll_f, riss, int(iters), dt))
         if verbose:
             print(f"K={k}: loglik={ll_f:.6e} rissanen={riss:.6e} "
                   f"iters={int(iters)} ({dt:.2f}s)")
+        metrics_line("em_done", k=k, loglik=ll_f, rissanen=riss,
+                     iters=int(iters), seconds=round(dt, 4)) if (
+                         config.enable_debug) else None
 
         if (
             k == num_clusters
@@ -164,20 +211,38 @@ def fit_gmm(
         if k <= stop_number:
             break
         # Order reduction (gaussian.cu:857-952)
-        state = elim_fn(state)
-        k = int(state.num_active())
-        if k < 2:
-            break
-        if verbose:
-            print(f"non-empty clusters: {k}; merging closest pair")
-        state, _, min_d = reduce_fn(state)
-        if not np.isfinite(float(min_d)):
+        with phase("reduce"):
+            state = elim_fn(state)
+            k = int(state.num_active())
+            if k < 2:
+                break
+            if verbose:
+                print(f"non-empty clusters: {k}; merging closest pair")
+            state, _, min_d = reduce_fn(state)
+            valid_merge = bool(np.isfinite(float(min_d)))
+        if not valid_merge:
             # No valid merge pair (degenerate covariances everywhere); stop
             # the sweep rather than corrupt the state.
+            log.warning("no valid merge pair at K=%d; stopping sweep", k)
             break
         k -= 1
 
-    compact_state, n_active = compact(best_state)
+        if ckpt is not None:
+            with phase("cpu"):
+                ckpt.save(step, {
+                    "state": jax.device_get(state),
+                    "best_state": jax.device_get(best_state),
+                    "min_rissanen": float(min_rissanen),
+                    "ideal_k": int(ideal_k),
+                    "best_ll": float(best_ll),
+                    "k": int(k),
+                    "num_clusters": int(num_clusters),
+                    "sweep_log": np.asarray(sweep_log, np.float64),
+                })
+        step += 1
+
+    with phase("memcpy"):
+        compact_state, n_active = compact(best_state)
     if verbose:
         print(f"Final rissanen score was: {min_rissanen}, "
               f"with {ideal_k} clusters.")  # gaussian.cu:962
@@ -192,6 +257,8 @@ def fit_gmm(
         num_dimensions=n_dims,
         data_shift=np.asarray(shift),
         sweep_log=sweep_log,
+        profile=timer.as_dict() if timer else None,
+        profile_report=timer.report() if timer else None,
     )
 
 

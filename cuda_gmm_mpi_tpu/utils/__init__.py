@@ -1,1 +1,6 @@
 """Auxiliary subsystems (SURVEY SS5): profiling, logging, checkpointing."""
+
+from .logging_ import get_logger, metrics_line
+from .profiling import CATEGORIES, PhaseTimer, trace
+
+__all__ = ["get_logger", "metrics_line", "CATEGORIES", "PhaseTimer", "trace"]

@@ -1,0 +1,51 @@
+"""Structured logging: the runtime replacement for the reference's printf
+macro levels (``DEBUG``/``PRINT``/``EMUPRINT``, ``gaussian.h:44-60``).
+
+The reference's three compile-time verbosity tiers map to standard logging
+levels selected at runtime from GMMConfig:
+
+  ENABLE_DEBUG (gaussian.h:31) -> logging.DEBUG
+  ENABLE_PRINT (gaussian.h:35) -> logging.INFO
+  default (both off)           -> logging.WARNING
+
+``metrics_line`` emits machine-readable one-line JSON records (loglik,
+rissanen, iteration timing) -- the structured upgrade over the reference's
+ad-hoc printf telemetry (SURVEY.md SS5.5).
+"""
+
+from __future__ import annotations
+
+import json
+import logging
+import sys
+import time
+from typing import Any, Dict, Optional
+
+_LOGGER_NAME = "cuda_gmm_mpi_tpu"
+
+
+def get_logger(config=None) -> logging.Logger:
+    logger = logging.getLogger(_LOGGER_NAME)
+    if not logger.handlers:
+        h = logging.StreamHandler(sys.stderr)
+        h.setFormatter(logging.Formatter(
+            "%(asctime)s %(name)s %(levelname)s %(message)s"
+        ))
+        logger.addHandler(h)
+        logger.propagate = False
+    if config is not None:
+        if getattr(config, "enable_debug", False):
+            logger.setLevel(logging.DEBUG)
+        elif getattr(config, "enable_print", False):
+            logger.setLevel(logging.INFO)
+        else:
+            logger.setLevel(logging.WARNING)
+    return logger
+
+
+def metrics_line(event: str, stream=None, **fields: Any) -> Dict[str, Any]:
+    """Emit one JSON metrics record; returns the record."""
+    rec = {"event": event, "ts": round(time.time(), 3)}
+    rec.update(fields)
+    print(json.dumps(rec), file=stream or sys.stderr)
+    return rec

@@ -1,0 +1,83 @@
+"""Per-phase profiler with the reference's 7-category taxonomy.
+
+The reference instruments every phase with cudaEvent timers grouped in a
+``profile_t`` struct -- categories ``e_step, m_step, constants, reduce,
+memcpy, cpu, mpi`` (``gaussian.cu:76-84``) -- and prints totals plus
+per-iteration averages at the end (``gaussian.cu:967``). This module keeps the
+same taxonomy so baselines compare 1:1, with the TPU-native mapping:
+
+  e_step    fused E-step + sufficient-stats pass (estep1+estep2+mstep sums --
+            fused on TPU, so the reference's separate m_step kernel time is
+            largely folded in here)
+  m_step    parameter update from stats (division/guards, gaussian.cu:611-686)
+  constants Cholesky Rinv/log-det/pi (constants_kernel)
+  reduce    model-order reduction: empty elimination + pair scan + merge
+            (the reference's "Order Reduce" timer, gaussian.cu:858-953)
+  memcpy    host<->device transfers (device_put/device_get)
+  cpu       host-side work: parsing, chunking, seeding, output assembly
+  mpi       cross-host collective setup (inside jit on TPU; ~0 single-host)
+
+Two usage modes:
+  - coarse (always available): wrap phases via ``timer.phase(name)`` context
+    managers around the jitted calls;
+  - deep-dive: ``jax.profiler`` trace capture via ``trace(log_dir)``.
+"""
+
+from __future__ import annotations
+
+import contextlib
+import time
+from typing import Dict, Optional
+
+CATEGORIES = ("e_step", "m_step", "constants", "reduce", "memcpy", "cpu", "mpi")
+
+
+class PhaseTimer:
+    """Accumulating wall-clock timers, one slot per reference category."""
+
+    def __init__(self):
+        self.seconds: Dict[str, float] = {c: 0.0 for c in CATEGORIES}
+        self.counts: Dict[str, int] = {c: 0 for c in CATEGORIES}
+
+    @contextlib.contextmanager
+    def phase(self, name: str):
+        if name not in self.seconds:  # allow ad-hoc categories too
+            self.seconds[name] = 0.0
+            self.counts[name] = 0
+        t0 = time.perf_counter()
+        try:
+            yield
+        finally:
+            self.seconds[name] += time.perf_counter() - t0
+            self.counts[name] += 1
+
+    def add(self, name: str, seconds: float, count: int = 1) -> None:
+        self.seconds[name] = self.seconds.get(name, 0.0) + seconds
+        self.counts[name] = self.counts.get(name, 0) + count
+
+    def report(self) -> str:
+        """Total + per-call average per category (gaussian.cu:967's layout)."""
+        lines = ["Phase profile (seconds total / calls / avg):"]
+        for name, total in self.seconds.items():
+            n = max(self.counts.get(name, 0), 1)
+            lines.append(f"  {name:<10s}\t{total:9.4f}\t{self.counts.get(name, 0):6d}"
+                         f"\t{total / n:9.6f}")
+        return "\n".join(lines)
+
+    def as_dict(self) -> Dict[str, float]:
+        return dict(self.seconds)
+
+
+@contextlib.contextmanager
+def trace(log_dir: Optional[str]):
+    """jax.profiler trace capture (TensorBoard-viewable), no-op when None."""
+    if not log_dir:
+        yield
+        return
+    import jax
+
+    jax.profiler.start_trace(log_dir)
+    try:
+        yield
+    finally:
+        jax.profiler.stop_trace()

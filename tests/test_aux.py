@@ -1,0 +1,85 @@
+"""Aux subsystems: profiler taxonomy, checkpoint/resume, structured logging."""
+
+import json
+
+import numpy as np
+import pytest
+
+from cuda_gmm_mpi_tpu.config import GMMConfig
+from cuda_gmm_mpi_tpu.models import fit_gmm
+from cuda_gmm_mpi_tpu.utils.logging_ import get_logger, metrics_line
+from cuda_gmm_mpi_tpu.utils.profiling import CATEGORIES, PhaseTimer
+
+from .conftest import make_blobs
+
+
+def fast_cfg(**kw):
+    base = dict(min_iters=3, max_iters=3, chunk_size=256, dtype="float64")
+    base.update(kw)
+    return GMMConfig(**base)
+
+
+def test_phase_timer_categories():
+    t = PhaseTimer()
+    assert set(CATEGORIES) == {"e_step", "m_step", "constants", "reduce",
+                               "memcpy", "cpu", "mpi"}  # gaussian.cu:76-84
+    with t.phase("e_step"):
+        pass
+    with t.phase("custom"):
+        pass
+    assert t.counts["e_step"] == 1
+    rep = t.report()
+    for c in CATEGORIES:
+        assert c in rep
+    assert "custom" in rep
+
+
+def test_fit_profile_populated(rng):
+    data, _ = make_blobs(rng, n=400, d=2, k=2)
+    result = fit_gmm(data, 3, 2, config=fast_cfg(profile=True))
+    assert result.profile is not None
+    assert result.profile["e_step"] > 0
+    assert result.profile["reduce"] > 0  # one merge happened
+    assert "e_step" in result.profile_report
+
+
+def test_checkpoint_resume(rng, tmp_path):
+    data, _ = make_blobs(rng, n=400, d=2, k=3)
+    cfg = fast_cfg(checkpoint_dir=str(tmp_path / "ck"))
+    r1 = fit_gmm(data, 6, 2, config=cfg)
+    # a second run with the same dir resumes (partially) and must agree
+    r2 = fit_gmm(data, 6, 2, config=cfg)
+    assert r2.ideal_num_clusters == r1.ideal_num_clusters
+    np.testing.assert_allclose(r2.min_rissanen, r1.min_rissanen, rtol=1e-9)
+    np.testing.assert_allclose(r2.means, r1.means, rtol=1e-7, atol=1e-8)
+    # resumed run skipped the already-completed K values
+    assert len(r2.sweep_log) <= len(r1.sweep_log)
+
+
+def test_checkpoint_ignored_for_different_k(rng, tmp_path):
+    data, _ = make_blobs(rng, n=300, d=2, k=2)
+    cfg = fast_cfg(checkpoint_dir=str(tmp_path / "ck2"))
+    fit_gmm(data, 4, 2, config=cfg)
+    r = fit_gmm(data, 3, 2, config=cfg)  # different starting K -> fresh sweep
+    assert r.sweep_log[0][0] == 3
+
+
+def test_logger_levels():
+    import logging
+
+    lg = get_logger(GMMConfig(enable_debug=True))
+    assert lg.level == logging.DEBUG
+    lg = get_logger(GMMConfig(enable_print=True))
+    assert lg.level == logging.INFO
+    lg = get_logger(GMMConfig())
+    assert lg.level == logging.WARNING
+
+
+def test_metrics_line(capsys):
+    import io
+
+    buf = io.StringIO()
+    rec = metrics_line("em_done", stream=buf, k=5, loglik=-1.5)
+    parsed = json.loads(buf.getvalue())
+    assert parsed["event"] == "em_done" and parsed["k"] == 5
+    assert rec["loglik"] == -1.5
