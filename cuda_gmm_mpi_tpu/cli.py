@@ -75,6 +75,8 @@ def build_parser() -> argparse.ArgumentParser:
                    "--mesh=4,2; default: all devices on the event axis")
     t.add_argument("--profile", action="store_true",
                    help="per-phase timing report (reference profile_t taxonomy)")
+    t.add_argument("--debug-nans", action="store_true",
+                   help="trap NaN/Inf at the producing op (sanitizer mode)")
     t.add_argument("--checkpoint-dir", default=None,
                    help="orbax checkpoint directory for the K-sweep (resume "
                    "with the same path)")
@@ -122,6 +124,7 @@ def main(argv=None) -> int:
             enable_output=not args.no_output,
             profile=args.profile,
             checkpoint_dir=args.checkpoint_dir,
+            debug_nans=args.debug_nans,
         )
     except ValueError as e:
         print(str(e), file=sys.stderr)

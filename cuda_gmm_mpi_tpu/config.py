@@ -77,6 +77,11 @@ class GMMConfig:
     profile: bool = False
     checkpoint_dir: Optional[str] = None
     seed: int = 0  # RNG seed for any randomized paths (reference is deterministic)
+    # Numerical-sanitizer analog (SURVEY SS5.2: the reference has no race
+    # detection / sanitizers; JAX's functional model removes data races, and
+    # this enables the remaining useful check -- trap NaN/Inf at the op that
+    # produced it).
+    debug_nans: bool = False
 
     def __post_init__(self):
         if self.min_iters > self.max_iters:

@@ -103,6 +103,8 @@ def fit_gmm(
         # just env) because preloading sitecustomize hooks may have consumed
         # JAX_PLATFORMS already.
         jax.config.update("jax_platforms", config.device)
+    if config.debug_nans:
+        jax.config.update("jax_debug_nans", True)
 
     log = get_logger(config)
     timer = PhaseTimer() if config.profile else None
