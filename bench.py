@@ -92,28 +92,50 @@ def main() -> int:
     for a in sys.argv[1:]:
         if a.startswith("--config="):
             cfg_name = a.split("=", 1)[1]
-    spec = CONFIGS[cfg_name]
+    spec = CONFIGS.get(cfg_name)
+    if spec is None:
+        print(
+            f"bench.py: unknown --config={cfg_name!r}; valid: "
+            + ", ".join(sorted(CONFIGS)),
+            file=sys.stderr,
+        )
+        return 2
 
-    if not probe_default_platform():
+    # NOTE: JAX_PLATFORMS env is NOT authoritative on this image (a
+    # sitecustomize hook re-pins jax_platforms to the accelerator), so CPU
+    # selection must go through config.update. GMM_BENCH_CPU=1 forces CPU
+    # and skips the probe entirely (reliable escape hatch for CI).
+    want_cpu = os.environ.get("GMM_BENCH_CPU") == "1"
+    if not want_cpu and not probe_default_platform():
         # Wedged/unavailable accelerator tunnel: fall back to CPU rather than
         # hanging the harness; the platform is recorded in the metric.
-        import jax
-
-        jax.config.update("jax_platforms", "cpu")
+        print("bench.py: accelerator probe failed; using CPU", file=sys.stderr)
+        want_cpu = True
 
     import jax
+
+    if want_cpu:
+        jax.config.update("jax_platforms", "cpu")
     import jax.numpy as jnp
 
     platform = jax.devices()[0].platform
     on_accel = platform not in ("cpu",)
 
     n_events, n_dims, k = spec["n"], spec["d"], spec["k"]
+    target_k = int(spec.get("target_k", 0))
     if on_accel:
         bench_iters, chunk = 20, 131072
     else:
         # Scaled down on CPU so the harness stays fast.
         n_events = min(n_events, 100_000)
         bench_iters, chunk = 5, 16384
+    if target_k:
+        # Model-order-search configs sweep K..target_k full EM runs; fewer
+        # iterations per K keeps the bench bounded.
+        bench_iters = 5 if on_accel else 2
+    # Small configs: never pad beyond the dataset (padding would inflate the
+    # accelerator's per-iteration work and deflate vs_baseline).
+    chunk = min(chunk, n_events)
 
     from cuda_gmm_mpi_tpu.config import GMMConfig
     from cuda_gmm_mpi_tpu.models.gmm import GMMModel, chunk_events
@@ -128,26 +150,55 @@ def main() -> int:
     ).astype(np.float32)
 
     diag = bool(spec.get("diag", False))
-    cfg = GMMConfig(min_iters=bench_iters, max_iters=bench_iters,
-                    chunk_size=chunk, diag_only=diag)
-    model = GMMModel(cfg)
-    chunks, wts = chunk_events(data, cfg.chunk_size)
-    chunks, wts = jnp.asarray(chunks), jnp.asarray(wts)
     state = seed_clusters_host(data, k)
-    eps = convergence_epsilon(n_events, n_dims)
+    sweep_extra = {}
+    if target_k:
+        # Model-order-search config: time the full Rissanen sweep K..target_k
+        # (gaussian.cu:479-960). The first K's entry absorbs compilation and is
+        # excluded from the throughput aggregate.
+        from cuda_gmm_mpi_tpu.models.order_search import fit_gmm
 
-    # Warmup/compile: 1 iteration.
-    warm_cfg = GMMConfig(min_iters=1, max_iters=1, chunk_size=chunk,
-                         diag_only=diag)
-    warm = GMMModel(warm_cfg)
-    s, ll, _ = warm.run_em(state, chunks, wts, eps)
-    jax.block_until_ready(s)
+        fit_cfg = GMMConfig(min_iters=bench_iters, max_iters=bench_iters,
+                            chunk_size=chunk, diag_only=diag)
+        t0 = time.perf_counter()
+        res = fit_gmm(data, k, target_k, fit_cfg)
+        sweep_wall = time.perf_counter() - t0
+        timed = res.sweep_log[1:] if len(res.sweep_log) > 1 else res.sweep_log
+        iters = sum(int(r[3]) for r in timed)
+        dt = sum(float(r[4]) for r in timed)
+        ll = res.final_loglik
+        # Event-cluster work units for the CPU comparison. Counts REAL events
+        # only: chunk padding inflates dt, but that padding is this
+        # framework's own overhead, so it is charged to our runtime rather
+        # than credited as work (keeps vs_baseline honest, if conservative).
+        sweep_extra = {
+            "sweep_wall_s": round(sweep_wall, 3),
+            "sweep_ks": len(res.sweep_log),
+            "work_units": sum(
+                int(r[3]) * n_events * int(r[0]) for r in timed),
+            "ideal_k": res.ideal_num_clusters,
+        }
+        s = state  # CPU baseline runs at the starting K's shapes
+    else:
+        cfg = GMMConfig(min_iters=bench_iters, max_iters=bench_iters,
+                        chunk_size=chunk, diag_only=diag)
+        model = GMMModel(cfg)
+        chunks, wts = chunk_events(data, cfg.chunk_size)
+        chunks, wts = jnp.asarray(chunks), jnp.asarray(wts)
+        eps = convergence_epsilon(n_events, n_dims)
 
-    t0 = time.perf_counter()
-    s, ll, iters = model.run_em(state, chunks, wts, eps)
-    jax.block_until_ready(s)
-    dt = time.perf_counter() - t0
-    iters = int(iters)
+        # Warmup/compile: 1 iteration.
+        warm_cfg = GMMConfig(min_iters=1, max_iters=1, chunk_size=chunk,
+                             diag_only=diag)
+        warm = GMMModel(warm_cfg)
+        s, ll, _ = warm.run_em(state, chunks, wts, eps)
+        jax.block_until_ready(s)
+
+        t0 = time.perf_counter()
+        s, ll, iters = model.run_em(state, chunks, wts, eps)
+        jax.block_until_ready(s)
+        dt = time.perf_counter() - t0
+        iters = int(iters)
     iters_per_sec = iters / dt
 
     # CPU baseline: identical iteration in NumPy/BLAS on a subsample, scaled
@@ -170,17 +221,25 @@ def main() -> int:
         numpy_em_iteration(xs, x2s, p0)
     t_cpu_sub = (time.perf_counter() - t0) / reps
     cpu_iters_per_sec = 1.0 / (t_cpu_sub * (n_events / n_sub))
+    if target_k:
+        # Scale the measured CPU per-(event*cluster) cost over the sweep's
+        # actual work (K shrinks as clusters merge).
+        unit_s = t_cpu_sub / (n_sub * k)
+        vs_baseline = (sweep_extra["work_units"] * unit_s) / dt
+    else:
+        vs_baseline = iters_per_sec / cpu_iters_per_sec
 
     cov = "diagonal" if diag else "full"
-    note = {}
+    note = dict(sweep_extra)
     if diag:
         note["baseline_note"] = "CPU baseline runs the full-covariance iteration"
+    kdesc = f"K={k}->{target_k}" if target_k else f"K={k}"
     result = {
-        "metric": f"EM iters/sec ({n_events}x{n_dims}, K={k}, "
+        "metric": f"EM iters/sec ({n_events}x{n_dims}, {kdesc}, "
                   f"{cov} covariance, {platform})",
         "value": round(iters_per_sec, 3),
         "unit": "iters/sec",
-        "vs_baseline": round(iters_per_sec / cpu_iters_per_sec, 2),
+        "vs_baseline": round(vs_baseline, 2),
         "loglik": float(ll),
         "wall_s_per_iter": round(dt / iters, 4),
         "cpu_baseline_iters_per_sec": round(cpu_iters_per_sec, 4),
