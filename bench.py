@@ -151,37 +151,43 @@ def main() -> int:
 
     diag = bool(spec.get("diag", False))
     state = seed_clusters_host(data, k)
-    sweep_extra = {}
-    if target_k:
-        # Model-order-search config: time the full Rissanen sweep K..target_k
-        # (gaussian.cu:479-960). The first K's entry absorbs compilation and is
-        # excluded from the throughput aggregate.
-        from cuda_gmm_mpi_tpu.models.order_search import fit_gmm
 
-        fit_cfg = GMMConfig(min_iters=bench_iters, max_iters=bench_iters,
-                            chunk_size=chunk, diag_only=diag)
-        t0 = time.perf_counter()
-        res = fit_gmm(data, k, target_k, fit_cfg)
-        sweep_wall = time.perf_counter() - t0
-        timed = res.sweep_log[1:] if len(res.sweep_log) > 1 else res.sweep_log
-        iters = sum(int(r[3]) for r in timed)
-        dt = sum(float(r[4]) for r in timed)
-        ll = res.final_loglik
-        # Event-cluster work units for the CPU comparison. Counts REAL events
-        # only: chunk padding inflates dt, but that padding is this
-        # framework's own overhead, so it is charged to our runtime rather
-        # than credited as work (keeps vs_baseline honest, if conservative).
-        sweep_extra = {
-            "sweep_wall_s": round(sweep_wall, 3),
-            "sweep_ks": len(res.sweep_log),
-            "work_units": sum(
-                int(r[3]) * n_events * int(r[0]) for r in timed),
-            "ideal_k": res.ideal_num_clusters,
-        }
-        s = state  # CPU baseline runs at the starting K's shapes
-    else:
+    def measure(use_pallas: str):
+        """(iters, dt, ll, final_state, sweep_extra) for one measured run."""
+        if target_k:
+            # Model-order-search config: time the full Rissanen sweep
+            # K..target_k (gaussian.cu:479-960). The first K's entry absorbs
+            # compilation and is excluded from the throughput aggregate.
+            from cuda_gmm_mpi_tpu.models.order_search import fit_gmm
+
+            fit_cfg = GMMConfig(min_iters=bench_iters, max_iters=bench_iters,
+                                chunk_size=chunk, diag_only=diag,
+                                use_pallas=use_pallas)
+            t0 = time.perf_counter()
+            res = fit_gmm(data, k, target_k, fit_cfg)
+            sweep_wall = time.perf_counter() - t0
+            timed = (res.sweep_log[1:] if len(res.sweep_log) > 1
+                     else res.sweep_log)
+            iters = sum(int(r[3]) for r in timed)
+            dt = sum(float(r[4]) for r in timed)
+            # Event-cluster work units for the CPU comparison. Counts REAL
+            # events only: chunk padding inflates dt, but that padding is
+            # this framework's own overhead, so it is charged to our runtime
+            # rather than credited as work (keeps vs_baseline honest, if
+            # conservative).
+            extra = {
+                "sweep_wall_s": round(sweep_wall, 3),
+                "sweep_ks": len(res.sweep_log),
+                "work_units": sum(
+                    int(r[3]) * n_events * int(r[0]) for r in timed),
+                "ideal_k": res.ideal_num_clusters,
+            }
+            # CPU baseline runs at the starting K's shapes
+            return iters, dt, res.final_loglik, state, extra
+
         cfg = GMMConfig(min_iters=bench_iters, max_iters=bench_iters,
-                        chunk_size=chunk, diag_only=diag)
+                        chunk_size=chunk, diag_only=diag,
+                        use_pallas=use_pallas)
         model = GMMModel(cfg)
         chunks, wts = chunk_events(data, cfg.chunk_size)
         chunks, wts = jnp.asarray(chunks), jnp.asarray(wts)
@@ -189,7 +195,7 @@ def main() -> int:
 
         # Warmup/compile: 1 iteration.
         warm_cfg = GMMConfig(min_iters=1, max_iters=1, chunk_size=chunk,
-                             diag_only=diag)
+                             diag_only=diag, use_pallas=use_pallas)
         warm = GMMModel(warm_cfg)
         s, ll, _ = warm.run_em(state, chunks, wts, eps)
         jax.block_until_ready(s)
@@ -198,7 +204,18 @@ def main() -> int:
         s, ll, iters = model.run_em(state, chunks, wts, eps)
         jax.block_until_ready(s)
         dt = time.perf_counter() - t0
-        iters = int(iters)
+        return int(iters), dt, float(ll), s, {}
+
+    from cuda_gmm_mpi_tpu.ops.pallas import should_use_pallas
+
+    try:
+        iters, dt, ll, s, sweep_extra = measure("auto")
+    except Exception as e:  # e.g. a Mosaic lowering rejection on new hardware
+        if not should_use_pallas(GMMConfig(diag_only=diag)):
+            raise  # the failure was in the jnp path; a retry can't help
+        print(f"bench.py: Pallas path failed ({type(e).__name__}: {e}); "
+              "retrying with use_pallas=never", file=sys.stderr)
+        iters, dt, ll, s, sweep_extra = measure("never")
     iters_per_sec = iters / dt
 
     # CPU baseline: identical iteration in NumPy/BLAS on a subsample, scaled

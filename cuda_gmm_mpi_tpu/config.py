@@ -59,6 +59,9 @@ class GMMConfig:
     center_data: bool = True
     # Pallas fused kernel for the E+M pass ('auto' uses it on TPU when available).
     use_pallas: str = "auto"  # 'auto' | 'always' | 'never'
+    # Events per Pallas grid tile (the kernel's VMEM working set is
+    # ~ block_b * D^2 floats for the outer products).
+    pallas_block_b: int = 1024
 
     # --- platform / parallelism ---
     device: Optional[str] = None  # None = JAX default platform
@@ -96,6 +99,8 @@ class GMMConfig:
             raise ValueError(f"unknown use_pallas: {self.use_pallas!r}")
         if self.chunk_size < 1:
             raise ValueError("chunk_size must be >= 1")
+        if self.pallas_block_b < 1:
+            raise ValueError("pallas_block_b must be >= 1")
 
 
 DEFAULT_CONFIG = GMMConfig()

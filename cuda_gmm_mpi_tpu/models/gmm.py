@@ -85,10 +85,9 @@ class GMMModel:
         self._kw = kw
 
         if stats_fn is None:
-            from ..ops.pallas import fused_stats_pallas, should_use_pallas
+            from ..ops.pallas import make_stats_fn
 
-            if should_use_pallas(config):
-                stats_fn = fused_stats_pallas
+            stats_fn = make_stats_fn(config)
         self.stats_fn = stats_fn
 
         self._em_run = jax.jit(
@@ -130,7 +129,7 @@ class GMMModel:
     def estep_stats(self, state, data_chunks, wts_chunks) -> SuffStats:
         return self._estep_stats(state, data_chunks, wts_chunks)
 
-    def memberships(self, state, data_chunks) -> np.ndarray:
+    def memberships(self, state, data_chunks, return_logz: bool = False):
         """Materialized posteriors [N_padded, K] -- output path only.
 
         The reference keeps the N x K memberships resident and gathers them per
@@ -138,12 +137,20 @@ class GMMModel:
         parameters (bit-identical to the last E-step's output, since the loop
         ends on an E-step) and stream chunks to host memory. Padded tail rows
         are garbage; callers slice to the true event count.
+
+        With ``return_logz`` also returns the per-event log evidence
+        [N_padded] (estep2's logZ) as a second array.
         """
-        out = []
+        w_out, z_out = [], []
         for i in range(data_chunks.shape[0]):
-            w, _ = self._posteriors(state, data_chunks[i])
-            out.append(np.asarray(jax.device_get(w)))
-        return np.concatenate(out, axis=0)
+            w, logz = self._posteriors(state, data_chunks[i])
+            w_out.append(np.asarray(jax.device_get(w)))
+            if return_logz:
+                z_out.append(np.asarray(jax.device_get(logz)))
+        w = np.concatenate(w_out, axis=0)
+        if return_logz:
+            return w, np.concatenate(z_out, axis=0)
+        return w
 
 
 def em_while_loop(

@@ -1,11 +1,16 @@
 """Pallas/Mosaic TPU kernels -- the hand-tuned hot path (SURVEY L2).
 
 ``should_use_pallas`` decides kernel-vs-jnp per config/platform: the Pallas
-fused E+M kernel needs a TPU (or interpret mode for tests), float32, full
-covariance, the expanded quadratic form, and an unsharded cluster axis.
+fused E+M kernel needs a TPU (or interpret mode for tests), float32, the
+expanded quadratic form, and an unsharded cluster axis. Full and diagonal
+covariance are both kernelized. ``make_stats_fn`` binds the config's
+covariance mode and tile size into the ``stats_fn`` hook consumed by
+``em_while_loop``.
 """
 
 from __future__ import annotations
+
+import functools
 
 import jax
 
@@ -15,7 +20,7 @@ from .fused_stats import fused_stats_pallas
 def should_use_pallas(config, cluster_sharded: bool = False) -> bool:
     if config.use_pallas == "never":
         return False
-    if config.diag_only or cluster_sharded or config.dtype != "float32":
+    if cluster_sharded or config.dtype != "float32":
         return False
     if config.use_pallas == "always":
         return True
@@ -25,4 +30,15 @@ def should_use_pallas(config, cluster_sharded: bool = False) -> bool:
         return False
 
 
-__all__ = ["fused_stats_pallas", "should_use_pallas"]
+def make_stats_fn(config, cluster_sharded: bool = False):
+    """stats_fn hook bound to the config, or None for the jnp path."""
+    if not should_use_pallas(config, cluster_sharded):
+        return None
+    return functools.partial(
+        fused_stats_pallas,
+        diag_only=config.diag_only,
+        block_b=config.pallas_block_b,
+    )
+
+
+__all__ = ["fused_stats_pallas", "make_stats_fn", "should_use_pallas"]

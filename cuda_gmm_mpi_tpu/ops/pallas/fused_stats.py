@@ -17,6 +17,11 @@ events; everything else lives in VMEM:
     w    = exp(logp - logZ) * event_mask             (never leaves VMEM)
     ll  += sum logZ;  Nk += sum w;  M1 += w^T x;  M2 += w^T x2   (MXU)
 
+Diagonal-covariance mode (the reference's DIAG_ONLY compile path,
+``gaussian_kernel.cu:215-223,430-433,621-628``) uses x2 = x*x ([B_t, D]) and
+[K, D] diagonal precision coefficients instead of the flattened outer
+products -- same kernel structure, D x cheaper contractions.
+
 Stats accumulate in VMEM scratch across the sequential TPU grid and are
 written once on the last tile. Requires an unsharded cluster axis (the
 cluster-sharded path uses the jnp implementation with collective LSE).
@@ -38,7 +43,8 @@ NEG_LARGE = -1e30  # stand-in for -inf: exp() underflows to 0, avoids inf-inf
 
 def _fused_stats_kernel(x_ref, wt_ref, A_ref, h_ref, g_ref,
                         ll_ref, nk_ref, m1_ref, m2_ref,
-                        ll_acc, nk_acc, m1_acc, m2_acc):
+                        ll_acc, nk_acc, m1_acc, m2_acc,
+                        *, diag: bool):
     i = pl.program_id(0)
     n_tiles = pl.num_programs(0)
 
@@ -53,11 +59,15 @@ def _fused_stats_kernel(x_ref, wt_ref, A_ref, h_ref, g_ref,
     wt = wt_ref[:]                    # [B_t, 1]
     bt, d = x.shape
 
-    # Flattened outer products, built in VMEM: [B_t, D*D].
-    x2 = (x[:, :, None] * x[:, None, :]).reshape(bt, d * d)
+    if diag:
+        x2 = x * x                    # [B_t, D]
+    else:
+        # Flattened outer products, built in VMEM: [B_t, D*D].
+        x2 = (x[:, :, None] * x[:, None, :]).reshape(bt, d * d)
 
     # Quadratic form as two MXU contractions (estep1's double D-loop per
-    # thread becomes one (B_t, D^2) @ (D^2, K) matmul).
+    # thread becomes one (B_t, D^2) @ (D^2, K) matmul; (B_t, D) @ (D, K)
+    # under DIAG_ONLY).
     q = jax.lax.dot_general(
         x2, A_ref[:], (((1,), (1,)), ((), ())),
         preferred_element_type=jnp.float32,
@@ -76,13 +86,14 @@ def _fused_stats_kernel(x_ref, wt_ref, A_ref, h_ref, g_ref,
     logz = (m + jnp.log(s)) * wt      # padded events contribute 0
     w = (e / s) * wt
 
-    ll_acc[0, 0] += jnp.sum(logz)
+    # Full-block (1,1) write: Mosaic rejects scalar stores to VMEM refs.
+    ll_acc[:] = ll_acc[:] + jnp.sum(logz).reshape(1, 1)
     nk_acc[:] += jnp.sum(w, axis=0, keepdims=True)          # [1, K]
     m1_acc[:] += jax.lax.dot_general(                       # [K, D]
         w, x, (((0,), (0,)), ((), ())),
         preferred_element_type=jnp.float32,
     )
-    m2_acc[:] += jax.lax.dot_general(                       # [K, D*D]
+    m2_acc[:] += jax.lax.dot_general(                       # [K, D*D] | [K, D]
         w, x2, (((0,), (0,)), ((), ())),
         preferred_element_type=jnp.float32,
     )
@@ -95,28 +106,32 @@ def _fused_stats_kernel(x_ref, wt_ref, A_ref, h_ref, g_ref,
         m2_ref[:] = m2_acc[:]
 
 
-@functools.partial(jax.jit, static_argnames=("block_b", "interpret"))
-def _fused_stats_call(x, wt, A, h, g, *, block_b: int, interpret: bool):
+@functools.partial(jax.jit,
+                   static_argnames=("block_b", "diag", "interpret"))
+def _fused_stats_call(x, wt, A, h, g, *, block_b: int, diag: bool,
+                      interpret: bool):
     n, d = x.shape
     k = A.shape[0]
+    f = A.shape[1]  # D*D (full) or D (diag)
     grid = n // block_b
     f32 = jnp.float32
     out_shapes = (
         jax.ShapeDtypeStruct((1, 1), f32),
         jax.ShapeDtypeStruct((1, k), f32),
         jax.ShapeDtypeStruct((k, d), f32),
-        jax.ShapeDtypeStruct((k, d * d), f32),
+        jax.ShapeDtypeStruct((k, f), f32),
     )
     rep = lambda *_: (0, 0)  # accumulator outputs: same block every step
+    kernel = functools.partial(_fused_stats_kernel, diag=diag)
     ll, nk, m1, m2 = pl.pallas_call(
-        _fused_stats_kernel,
+        kernel,
         grid=(grid,),
         in_specs=[
             pl.BlockSpec((block_b, d), lambda i: (i, 0),
                          memory_space=pltpu.VMEM),
             pl.BlockSpec((block_b, 1), lambda i: (i, 0),
                          memory_space=pltpu.VMEM),
-            pl.BlockSpec((k, d * d), rep, memory_space=pltpu.VMEM),
+            pl.BlockSpec((k, f), rep, memory_space=pltpu.VMEM),
             pl.BlockSpec((k, d), rep, memory_space=pltpu.VMEM),
             pl.BlockSpec((1, k), rep, memory_space=pltpu.VMEM),
         ],
@@ -124,18 +139,18 @@ def _fused_stats_call(x, wt, A, h, g, *, block_b: int, interpret: bool):
             pl.BlockSpec((1, 1), rep, memory_space=pltpu.VMEM),
             pl.BlockSpec((1, k), rep, memory_space=pltpu.VMEM),
             pl.BlockSpec((k, d), rep, memory_space=pltpu.VMEM),
-            pl.BlockSpec((k, d * d), rep, memory_space=pltpu.VMEM),
+            pl.BlockSpec((k, f), rep, memory_space=pltpu.VMEM),
         ),
         out_shape=out_shapes,
         scratch_shapes=[
             pltpu.VMEM((1, 1), f32),
             pltpu.VMEM((1, k), f32),
             pltpu.VMEM((k, d), f32),
-            pltpu.VMEM((k, d * d), f32),
+            pltpu.VMEM((k, f), f32),
         ],
         cost_estimate=pl.CostEstimate(
-            flops=4 * n * k * d * d,
-            bytes_accessed=n * d * 4 + k * d * d * 8,
+            flops=4 * n * k * f,
+            bytes_accessed=n * d * 4 + k * f * 8,
             transcendentals=2 * n,
         ),
         interpret=interpret,
@@ -148,14 +163,15 @@ def fused_stats_pallas(
     data_chunks: jax.Array,
     wts_chunks: jax.Array | None,
     *,
+    diag_only: bool = False,
     block_b: int = 1024,
     interpret: bool = False,
 ) -> SuffStats:
     """SuffStats for all chunks via the fused Pallas kernel.
 
-    Drop-in for ``accumulate_stats`` (full-covariance, unsharded cluster axis).
-    ``data_chunks`` is the [C, B, D] chunk array; it is viewed flat and gridded
-    into ``block_b``-event tiles.
+    Drop-in for ``accumulate_stats`` (unsharded cluster axis; full or diagonal
+    covariance). ``data_chunks`` is the [C, B, D] chunk array; it is viewed
+    flat and gridded into ``block_b``-event tiles.
     """
     c, b, d = data_chunks.shape
     n = c * b
@@ -176,8 +192,13 @@ def fused_stats_pallas(
     K = state.means.shape[0]
     Rinv = state.Rinv.astype(jnp.float32)
     mu = state.means.astype(jnp.float32)
-    A = Rinv.reshape(K, d * d)
-    h = jnp.einsum("kde,ke->kd", Rinv, mu)
+    if diag_only:
+        a = jnp.diagonal(Rinv, axis1=-2, axis2=-1)  # [K, D]
+        A = a
+        h = a * mu
+    else:
+        A = Rinv.reshape(K, d * d)
+        h = jnp.einsum("kde,ke->kd", Rinv, mu)
     g = (
         -0.5 * jnp.sum(h * mu, axis=-1)
         + state.constant.astype(jnp.float32)
@@ -186,12 +207,12 @@ def fused_stats_pallas(
     g = jnp.where(state.active, g, NEG_LARGE)[None, :]  # [1, K]
 
     ll, nk, m1, m2 = _fused_stats_call(
-        x, wt, A, h, g, block_b=block_b, interpret=interpret
+        x, wt, A, h, g, block_b=block_b, diag=diag_only, interpret=interpret
     )
     dt = data_chunks.dtype
     return SuffStats(
         loglik=ll[0, 0].astype(dt),
         Nk=nk[0].astype(dt),
         M1=m1.astype(dt),
-        M2=m2.reshape(K, d, d).astype(dt),
+        M2=(m2 if diag_only else m2.reshape(K, d, d)).astype(dt),
     )

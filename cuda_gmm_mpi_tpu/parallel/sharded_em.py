@@ -84,12 +84,9 @@ class ShardedGMMModel:
         )
         self._kw = kw
 
-        stats_fn = None
-        if cluster_axis is None:
-            from ..ops.pallas import fused_stats_pallas, should_use_pallas
+        from ..ops.pallas import make_stats_fn
 
-            if should_use_pallas(config):
-                stats_fn = fused_stats_pallas
+        stats_fn = make_stats_fn(config, cluster_sharded=cluster_axis is not None)
         em_fn = functools.partial(
             em_while_loop,
             reduce_stats=make_psum_reduce(DATA_AXIS),

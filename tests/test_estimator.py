@@ -1,0 +1,91 @@
+"""GaussianMixture estimator API: fit/predict/score/sample round trips."""
+
+import numpy as np
+import pytest
+
+from cuda_gmm_mpi_tpu import GaussianMixture, GMMConfig
+
+from .conftest import make_blobs
+
+
+@pytest.fixture(scope="module")
+def fitted():
+    rng = np.random.default_rng(7)
+    centers = rng.normal(scale=10.0, size=(3, 3))
+    labels = rng.integers(0, 3, size=600)
+    data = (centers[labels] + rng.normal(size=(600, 3))).astype(np.float32)
+    # Start above the true K and let the merge search reduce to 3: robust to
+    # the deterministic seeding's local optima (the reference's own recommended
+    # usage, README.txt:66-70 -- start high, give a target).
+    gm = GaussianMixture(
+        6, target_components=3, min_iters=12, max_iters=12, chunk_size=128
+    )
+    gm.fit(data)
+    return gm, data, labels
+
+
+def test_fit_attributes(fitted):
+    gm, data, _ = fitted
+    assert gm.n_components_ == 3
+    assert gm.weights_.shape == (3,)
+    np.testing.assert_allclose(gm.weights_.sum(), 1.0, rtol=1e-4)
+    assert gm.means_.shape == (3, 3)
+    assert gm.covariances_.shape == (3, 3, 3)
+    assert np.isfinite(gm.loglik_)
+    assert np.isfinite(gm.rissanen_)
+
+
+def test_predict_recovers_blobs(fitted):
+    """Hard assignments agree with ground-truth blob labels up to relabeling."""
+    gm, data, labels = fitted
+    pred = gm.predict(data)
+    assert pred.shape == (600,)
+    agree = 0
+    for c in range(3):
+        vals, counts = np.unique(pred[labels == c], return_counts=True)
+        agree += counts.max()
+    assert agree / len(labels) > 0.95
+
+
+def test_predict_proba_normalized(fitted):
+    gm, data, _ = fitted
+    w = gm.predict_proba(data[:100])
+    assert w.shape == (100, 3)
+    np.testing.assert_allclose(w.sum(axis=1), 1.0, rtol=1e-4)
+
+
+def test_score_samples_matches_loglik(fitted):
+    """sum(score_samples(train)) equals the fit's final log-likelihood."""
+    gm, data, _ = fitted
+    z = gm.score_samples(data)
+    np.testing.assert_allclose(z.sum(), gm.loglik_, rtol=1e-4)
+    assert gm.score(data) == pytest.approx(z.mean(), rel=1e-6)
+
+
+def test_sample_statistics(fitted):
+    """Samples from the fitted mixture have ~the mixture's global mean."""
+    gm, data, _ = fitted
+    xs = gm.sample(20000, seed=0)
+    assert xs.shape == (20000, 3)
+    global_mean = (gm.weights_[:, None] * gm.means_).sum(axis=0)
+    np.testing.assert_allclose(xs.mean(axis=0), global_mean, atol=0.2)
+
+
+def test_order_search_selects_k():
+    rng = np.random.default_rng(3)
+    data, _ = make_blobs(rng, n=800, d=2, k=3, dtype=np.float32)
+    gm = GaussianMixture(6, min_iters=10, max_iters=10, chunk_size=256)
+    gm.fit(data)
+    assert 1 <= gm.n_components_ <= 6
+    assert gm.result_.sweep_log  # searched multiple K
+
+
+def test_unfitted_raises():
+    gm = GaussianMixture(2)
+    with pytest.raises(RuntimeError):
+        gm.predict(np.zeros((4, 2), np.float32))
+
+
+def test_config_exclusivity():
+    with pytest.raises(ValueError):
+        GaussianMixture(2, config=GMMConfig(), min_iters=5)

@@ -99,9 +99,49 @@ def test_em_loop_with_pallas_backend(rng):
                                rtol=1e-3, atol=1e-3)
 
 
+def test_fused_stats_diag_matches_jnp(rng):
+    """DIAG_ONLY mode (gaussian_kernel.cu:215-223,430-433,621-628)."""
+    k, d, n, b = 5, 4, 256, 64
+    state = to_f32(make_state(rng, k, d))  # both paths read only diag(Rinv)
+    data = rng.normal(scale=2.0, size=(n, d)).astype(np.float32)
+    chunks = jnp.asarray(data.reshape(n // b, b, d))
+    wts = jnp.ones((n // b, b), jnp.float32)
+
+    ref = accumulate_stats(state, chunks, wts, diag_only=True,
+                           matmul_precision="highest")
+    out = pallas_interp(state, chunks, wts, diag_only=True)
+
+    assert out.M2.shape == (k, d)  # diagonal stats, like the jnp path
+    np.testing.assert_allclose(float(out.loglik), float(ref.loglik), rtol=1e-5)
+    np.testing.assert_allclose(np.asarray(out.Nk), np.asarray(ref.Nk),
+                               rtol=1e-5, atol=1e-5)
+    np.testing.assert_allclose(np.asarray(out.M1), np.asarray(ref.M1),
+                               rtol=1e-4, atol=1e-4)
+    np.testing.assert_allclose(np.asarray(out.M2), np.asarray(ref.M2),
+                               rtol=1e-4, atol=1e-3)
+
+
+def test_em_loop_with_pallas_diag_backend(rng):
+    data, _ = make_blobs(rng, n=512, d=3, k=3, dtype=np.float32)
+    cfg = GMMConfig(min_iters=4, max_iters=4, chunk_size=128, dtype="float32",
+                    diag_only=True)
+    m_ref = GMMModel(cfg)
+    m_pal = GMMModel(cfg, stats_fn=functools.partial(pallas_interp,
+                                                     diag_only=True))
+    chunks, wts = chunk_events(data, cfg.chunk_size)
+    chunks, wts = jnp.asarray(chunks), jnp.asarray(wts)
+    state = seed_clusters_host(data, 3)
+    eps = convergence_epsilon(*data.shape)
+    s_ref, ll_ref, _ = m_ref.run_em(state, chunks, wts, eps)
+    s_pal, ll_pal, _ = m_pal.run_em(state, chunks, wts, eps)
+    np.testing.assert_allclose(float(ll_pal), float(ll_ref), rtol=1e-4)
+    np.testing.assert_allclose(np.asarray(s_pal.means), np.asarray(s_ref.means),
+                               rtol=1e-3, atol=1e-3)
+
+
 def test_should_use_pallas_gating():
     assert not should_use_pallas(GMMConfig(use_pallas="never"))
-    assert not should_use_pallas(GMMConfig(use_pallas="always", diag_only=True))
+    assert should_use_pallas(GMMConfig(use_pallas="always", diag_only=True))
     assert not should_use_pallas(GMMConfig(use_pallas="always",
                                            dtype="float64"))
     assert should_use_pallas(GMMConfig(use_pallas="always"))
