@@ -1,0 +1,46 @@
+"""End-to-end example: fit a GMM with model-order search on synthetic data.
+
+Generates a well-separated mixture, fits from K=12 down with the Rissanen
+search, and prints the recovered structure. Runs on whatever platform JAX
+picks (CPU works; on TPU the Pallas fused kernel engages automatically).
+
+  PYTHONPATH=. python examples/fit_synthetic.py [--device=cpu]
+"""
+
+import sys
+
+import numpy as np
+
+from cuda_gmm_mpi_tpu import GaussianMixture
+
+
+def main() -> int:
+    device = None
+    for a in sys.argv[1:]:
+        if a.startswith("--device="):
+            device = a.split("=", 1)[1]
+
+    rng = np.random.default_rng(0)
+    true_k, d = 5, 8
+    centers = rng.normal(scale=12.0, size=(true_k, d))
+    labels = rng.integers(0, true_k, size=50_000)
+    data = (centers[labels] + rng.normal(size=(50_000, d))).astype(np.float32)
+
+    gm = GaussianMixture(
+        12,                      # start high; the merge search reduces K
+        min_iters=25, max_iters=25, chunk_size=8192, device=device,
+    ).fit(data)
+
+    print(f"selected K = {gm.n_components_} (true {true_k})")
+    print(f"rissanen   = {gm.rissanen_:.2f}")
+    print(f"mean loglik/event = {gm.score(data):.4f}")
+    dists = np.linalg.norm(
+        gm.means_[:, None, :] - centers[None, :, :], axis=2
+    ).min(axis=0)
+    print("distance from each true center to nearest recovered mean:")
+    print("  " + " ".join(f"{v:.3f}" for v in dists))
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())
