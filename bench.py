@@ -193,18 +193,30 @@ def main() -> int:
         chunks, wts = jnp.asarray(chunks), jnp.asarray(wts)
         eps = convergence_epsilon(n_events, n_dims)
 
-        # Warmup/compile: 1 iteration.
-        warm_cfg = GMMConfig(min_iters=1, max_iters=1, chunk_size=chunk,
-                             diag_only=diag, use_pallas=use_pallas)
-        warm = GMMModel(warm_cfg)
-        s, ll, _ = warm.run_em(state, chunks, wts, eps)
+        # Warmup/compile on the SAME jit instance that gets timed (a separate
+        # warm model would leave the timed call paying compilation / cache
+        # lookup for its own closure -- ~100ms+ of non-iteration overhead).
+        # min/max_iters are dynamic args, so 1 warm iteration compiles the
+        # exact executable the timed reps reuse.
+        s, ll, _ = model.run_em(state, chunks, wts, eps,
+                                min_iters=1, max_iters=1)
         jax.block_until_ready(s)
 
-        t0 = time.perf_counter()
-        s, ll, iters = model.run_em(state, chunks, wts, eps)
-        jax.block_until_ready(s)
-        dt = time.perf_counter() - t0
-        return int(iters), dt, float(ll), s, {}
+        # Timed reps: each rep gets a slightly perturbed seed state so no
+        # layer of the stack (jit, runtime, remote-TPU tunnel) can serve a
+        # cached result for a repeated identical execution, and the float()
+        # readback inside the timing region forces completion on the host.
+        times = []
+        for r in range(3):
+            sr = state.replace(
+                means=state.means * (1.0 + 1e-6 * (r + 1))
+            )
+            t0 = time.perf_counter()
+            s, ll_dev, iters = model.run_em(sr, chunks, wts, eps)
+            ll = float(ll_dev)
+            times.append(time.perf_counter() - t0)
+        dt = min(times)
+        return int(iters), dt, ll, s, {}
 
     from cuda_gmm_mpi_tpu.ops.pallas import should_use_pallas
 
@@ -232,11 +244,15 @@ def main() -> int:
         "avgvar": np.asarray(s.avgvar, np.float32)[:k],
     }
     numpy_em_iteration(xs, x2s, p0)  # warm caches
-    reps = 3
-    t0 = time.perf_counter()
-    for _ in range(reps):
+    # Direct configs: min-of-reps on BOTH sides (the accelerator loop above
+    # also takes min), best-case vs best-case. Sweep (target_k) configs time
+    # a single accelerator sweep, so their vs_baseline is conservative.
+    cpu_times = []
+    for _ in range(3):
+        t0 = time.perf_counter()
         numpy_em_iteration(xs, x2s, p0)
-    t_cpu_sub = (time.perf_counter() - t0) / reps
+        cpu_times.append(time.perf_counter() - t0)
+    t_cpu_sub = min(cpu_times)
     cpu_iters_per_sec = 1.0 / (t_cpu_sub * (n_events / n_sub))
     if target_k:
         # Scale the measured CPU per-(event*cluster) cost over the sweep's

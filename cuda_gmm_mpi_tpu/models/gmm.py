@@ -116,14 +116,22 @@ class GMMModel:
             stats = accumulate_stats(state, data_chunks, wts_chunks, **kw)
         return reduce_stats(stats) if reduce_stats else stats
 
-    def run_em(self, state, data_chunks, wts_chunks, epsilon: float):
-        """Full EM at the current active-K. Returns (state, loglik, iters)."""
+    def run_em(self, state, data_chunks, wts_chunks, epsilon: float,
+               min_iters: Optional[int] = None, max_iters: Optional[int] = None):
+        """Full EM at the current active-K. Returns (state, loglik, iters).
+
+        ``min_iters``/``max_iters`` override the config's values without
+        recompiling (they are dynamic args of the jitted loop) -- e.g. a
+        1-iteration warmup call on the same executable the real run uses.
+        """
         cfg = self.config
         return self._em_run(
             state, data_chunks, wts_chunks,
             jnp.asarray(epsilon, data_chunks.dtype),
-            jnp.asarray(cfg.min_iters, jnp.int32),
-            jnp.asarray(cfg.max_iters, jnp.int32),
+            jnp.asarray(cfg.min_iters if min_iters is None else min_iters,
+                        jnp.int32),
+            jnp.asarray(cfg.max_iters if max_iters is None else max_iters,
+                        jnp.int32),
         )
 
     def estep_stats(self, state, data_chunks, wts_chunks) -> SuffStats:

@@ -62,8 +62,11 @@ def _fused_stats_kernel(x_ref, wt_ref, A_ref, h_ref, g_ref,
     if diag:
         x2 = x * x                    # [B_t, D]
     else:
-        # Flattened outer products, built in VMEM: [B_t, D*D].
-        x2 = (x[:, :, None] * x[:, None, :]).reshape(bt, d * d)
+        # Flattened outer products, built in VMEM: [B_t, D*D]. Constructed as
+        # a lane-concat of D broadcast-scaled copies (x2[:, j*D+i] = x_i*x_j);
+        # Mosaic rejects the natural [B,D,D]->[B,D*D] reshape on hardware
+        # (sublane/lane repacking), while slice+broadcast+concat lowers fine.
+        x2 = jnp.concatenate([x * x[:, j:j + 1] for j in range(d)], axis=1)
 
     # Quadratic form as two MXU contractions (estep1's double D-loop per
     # thread becomes one (B_t, D^2) @ (D^2, K) matmul; (B_t, D) @ (D, K)

@@ -141,14 +141,17 @@ class ShardedGMMModel:
         )
         return state, chunks, wts
 
-    def run_em(self, state, data_chunks, wts_chunks, epsilon: float):
+    def run_em(self, state, data_chunks, wts_chunks, epsilon: float,
+               min_iters: Optional[int] = None, max_iters: Optional[int] = None):
         cfg = self.config
         dtype = data_chunks.dtype
         return self._em_run(
             state, data_chunks, wts_chunks,
             jnp.asarray(epsilon, dtype),
-            jnp.asarray(cfg.min_iters, jnp.int32),
-            jnp.asarray(cfg.max_iters, jnp.int32),
+            jnp.asarray(cfg.min_iters if min_iters is None else min_iters,
+                        jnp.int32),
+            jnp.asarray(cfg.max_iters if max_iters is None else max_iters,
+                        jnp.int32),
         )
 
     def memberships(self, state, data_chunks) -> np.ndarray:
