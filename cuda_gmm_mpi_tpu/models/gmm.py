@@ -40,8 +40,20 @@ from ..ops.estep import posteriors
 ReduceFn = Callable[[SuffStats], SuffStats]
 
 
+def resolve_iters(config: GMMConfig, min_iters: Optional[int],
+                  max_iters: Optional[int]):
+    """Iteration bounds as dynamic int32 args (no recompile on change)."""
+    return (
+        jnp.asarray(config.min_iters if min_iters is None else min_iters,
+                    jnp.int32),
+        jnp.asarray(config.max_iters if max_iters is None else max_iters,
+                    jnp.int32),
+    )
+
+
 def chunk_events(
-    data: np.ndarray, chunk_size: int, num_shards: int = 1
+    data: np.ndarray, chunk_size: int, num_shards: int = 1,
+    num_chunks: Optional[int] = None,
 ) -> Tuple[np.ndarray, np.ndarray]:
     """Pad and reshape events to [num_chunks, chunk_size, D] plus a 0/1 mask.
 
@@ -49,11 +61,26 @@ def chunk_events(
     (gaussian_kernel.cu:367-381) and pushes the remainder onto the last block;
     on TPU we need fully static shapes, so we pad to a whole number of chunks
     (x num_shards) and mask the tail instead.
+
+    ``num_chunks`` forces the exact padded chunk count -- multi-host loading
+    uses it so every host produces the same-shaped chunk array regardless of
+    how the event remainder fell across hosts
+    (``parallel.distributed.host_chunk_bounds``).
     """
     n, d = data.shape
-    step = chunk_size * num_shards
-    n_pad = (-n) % step
-    total = n + n_pad
+    if num_chunks is not None:
+        total = num_chunks * chunk_size
+        if total < n:
+            raise ValueError(
+                f"num_chunks={num_chunks} x chunk_size={chunk_size} < {n} events"
+            )
+        if num_chunks % max(num_shards, 1):
+            raise ValueError(
+                f"num_chunks={num_chunks} not divisible by num_shards={num_shards}"
+            )
+    else:
+        step = chunk_size * num_shards
+        total = n + ((-n) % step)
     padded = np.zeros((total, d), dtype=data.dtype)
     padded[:n] = data
     wts = np.zeros((total,), dtype=data.dtype)
@@ -124,14 +151,10 @@ class GMMModel:
         recompiling (they are dynamic args of the jitted loop) -- e.g. a
         1-iteration warmup call on the same executable the real run uses.
         """
-        cfg = self.config
+        lo, hi = resolve_iters(self.config, min_iters, max_iters)
         return self._em_run(
             state, data_chunks, wts_chunks,
-            jnp.asarray(epsilon, data_chunks.dtype),
-            jnp.asarray(cfg.min_iters if min_iters is None else min_iters,
-                        jnp.int32),
-            jnp.asarray(cfg.max_iters if max_iters is None else max_iters,
-                        jnp.int32),
+            jnp.asarray(epsilon, data_chunks.dtype), lo, hi,
         )
 
     def estep_stats(self, state, data_chunks, wts_chunks) -> SuffStats:

@@ -58,6 +58,39 @@ def host_slice(num_events: int, process_id: int, process_count: int):
     return start, stop
 
 
+def host_chunk_bounds(
+    num_events: int,
+    chunk_size: int,
+    data_axis_size: int,
+    process_id: int,
+    process_count: int,
+):
+    """(start, stop, num_chunks) for this host's slice, with EQUAL chunk
+    counts on every host.
+
+    ``host_slice`` alone lets the event remainder produce different per-host
+    padded chunk counts (host A 3 chunks, host B 2), which the global-array
+    assembly cannot reconcile. Here the GLOBAL event count is padded up to a
+    whole number of ``chunk_size`` x ``data_axis_size`` blocks first, the
+    chunk grid is split evenly across hosts, and each host pads its own tail
+    locally -- every host returns the same-shaped array by construction.
+    Requires ``process_count`` to divide ``data_axis_size`` (hosts each own
+    an equal share of the data axis).
+    """
+    if data_axis_size % process_count:
+        raise ValueError(
+            f"data axis size {data_axis_size} not divisible by "
+            f"{process_count} processes"
+        )
+    step = chunk_size * data_axis_size
+    total = num_events + ((-num_events) % step)
+    chunks_total = total // chunk_size
+    per_host = chunks_total // process_count
+    start = min(process_id * per_host * chunk_size, num_events)
+    stop = min((process_id + 1) * per_host * chunk_size, num_events)
+    return start, stop, per_host
+
+
 def sharded_chunks_from_host_data(
     mesh: Mesh,
     local_chunks: np.ndarray,

@@ -31,7 +31,7 @@ from jax import lax
 from jax.sharding import NamedSharding, PartitionSpec as P
 
 from ..config import GMMConfig
-from ..models.gmm import GMMModel, em_while_loop
+from ..models.gmm import GMMModel, em_while_loop, resolve_iters
 from ..ops.mstep import SuffStats, accumulate_stats
 from ..ops.estep import posteriors
 from .mesh import (
@@ -109,8 +109,15 @@ class ShardedGMMModel:
         # Posterior pass for output: run unsharded (output path only).
         self._plain = GMMModel(config)
 
-    def prepare(self, state, data_chunks, wts_chunks):
-        """Pad K to the cluster-axis size and place data sharded on the mesh."""
+    def prepare(self, state, data_chunks, wts_chunks, host_local: bool = False):
+        """Pad K to the cluster-axis size and place data sharded on the mesh.
+
+        ``host_local=True`` (required under ``jax.process_count() > 1``)
+        declares that ``data_chunks``/``wts_chunks`` are THIS host's slice of
+        the global chunk grid (equal-shaped across hosts, from
+        ``distributed.host_chunk_bounds``); the global sharded arrays are then
+        assembled with zero cross-host traffic.
+        """
         Kp = pad_clusters(state.num_clusters_padded, self.cluster_size)
         if Kp != state.num_clusters_padded:
             pad = Kp - state.num_clusters_padded
@@ -131,27 +138,66 @@ class ShardedGMMModel:
                 Rinv=jnp.concatenate([state.Rinv, eye]),
                 active=jnp.concatenate([state.active, jnp.zeros((pad,), bool)]),
             )
-        chunks, wts = shard_chunks(self.mesh, data_chunks, wts_chunks)
         sspec = state_pspecs()
-        state = jax.device_put(
-            state,
-            jax.tree_util.tree_map(
-                lambda s: NamedSharding(self.mesh, s), sspec
-            ),
-        )
+        if jax.process_count() > 1:
+            if not host_local:
+                raise ValueError(
+                    "multi-controller run: prepare() must receive this "
+                    "host's LOCAL chunk slice (derive it with "
+                    "parallel.distributed.host_chunk_bounds) and "
+                    "host_local=True. Passing full-dataset chunks here "
+                    "would silently duplicate every event process_count "
+                    "times. fit_gmm/GaussianMixture are single-controller "
+                    "APIs; drive ShardedGMMModel directly on multi-host "
+                    "(docs/DISTRIBUTED.md)."
+                )
+            # Multi-controller: the chunk arrays passed in are HOST-LOCAL
+            # (this host's equal-shaped slice from host_chunk_bounds);
+            # assemble the global sharded arrays with zero cross-host
+            # traffic. The state is replicated on every host; converting it
+            # likewise requires that no cluster shard spans hosts.
+            from jax.experimental import multihost_utils
+
+            from .distributed import sharded_chunks_from_host_data
+
+            local_cluster = self.mesh.local_mesh.shape[CLUSTER_AXIS]
+            if local_cluster != self.cluster_size:
+                raise NotImplementedError(
+                    "multi-host runs require the cluster mesh axis to fit "
+                    f"within one host (cluster axis {self.cluster_size}, "
+                    f"host-local extent {local_cluster}); put hosts on the "
+                    "data axis"
+                )
+            # Fail fast (with a clear error, not a shape-mismatch deadlock)
+            # if hosts chunked their slices inconsistently -- use
+            # distributed.host_chunk_bounds to guarantee equal counts.
+            multihost_utils.assert_equal(
+                np.asarray(data_chunks.shape),
+                "per-host chunk array shapes differ across hosts; derive "
+                "slices with parallel.distributed.host_chunk_bounds",
+            )
+            chunks, wts = sharded_chunks_from_host_data(
+                self.mesh, np.asarray(data_chunks), np.asarray(wts_chunks)
+            )
+            state = multihost_utils.host_local_array_to_global_array(
+                state, self.mesh, sspec
+            )
+        else:
+            chunks, wts = shard_chunks(self.mesh, data_chunks, wts_chunks)
+            state = jax.device_put(
+                state,
+                jax.tree_util.tree_map(
+                    lambda s: NamedSharding(self.mesh, s), sspec
+                ),
+            )
         return state, chunks, wts
 
     def run_em(self, state, data_chunks, wts_chunks, epsilon: float,
                min_iters: Optional[int] = None, max_iters: Optional[int] = None):
-        cfg = self.config
-        dtype = data_chunks.dtype
+        lo, hi = resolve_iters(self.config, min_iters, max_iters)
         return self._em_run(
             state, data_chunks, wts_chunks,
-            jnp.asarray(epsilon, dtype),
-            jnp.asarray(cfg.min_iters if min_iters is None else min_iters,
-                        jnp.int32),
-            jnp.asarray(cfg.max_iters if max_iters is None else max_iters,
-                        jnp.int32),
+            jnp.asarray(epsilon, data_chunks.dtype), lo, hi,
         )
 
     def memberships(self, state, data_chunks) -> np.ndarray:

@@ -1,0 +1,87 @@
+"""Worker process for the 2-process jax.distributed integration test.
+
+Each worker is one "host" of a simulated 2-host cluster (the TPU-native
+``mpirun`` rank, SURVEY.md SS2.8): it pins the CPU platform with 2 local
+devices, joins the coordination service, loads ONLY its host_slice of the
+dataset, and runs the sharded EM loop over the global 4-device mesh -- the
+full multi-controller path (jax.distributed.initialize +
+host_local_array_to_global_array + cross-process psum) that single-process
+tests cannot reach.
+
+Usage: python multihost_worker.py <process_id> <num_processes> <port>
+Prints one line: RESULT pid=<i> ll=<loglik> iters=<n> means=<csv of row 0>
+"""
+
+import sys
+
+
+def main() -> int:
+    pid, nproc, port = int(sys.argv[1]), int(sys.argv[2]), sys.argv[3]
+
+    import jax
+
+    # Must run before the backend initializes (the image's sitecustomize
+    # preloads jax pinned elsewhere; config.update is authoritative).
+    jax.config.update("jax_platforms", "cpu")
+    jax.config.update("jax_num_cpu_devices", 2)
+    jax.config.update("jax_enable_x64", True)
+
+    from cuda_gmm_mpi_tpu.parallel import distributed
+
+    got_pid, got_nproc = distributed.initialize(
+        coordinator_address=f"127.0.0.1:{port}",
+        num_processes=nproc,
+        process_id=pid,
+    )
+    assert (got_pid, got_nproc) == (pid, nproc), (got_pid, got_nproc)
+    assert len(jax.devices()) == 2 * nproc, jax.devices()
+
+    import numpy as np
+
+    from cuda_gmm_mpi_tpu.config import GMMConfig
+    from cuda_gmm_mpi_tpu.models.gmm import chunk_events
+    from cuda_gmm_mpi_tpu.ops.formulas import convergence_epsilon
+    from cuda_gmm_mpi_tpu.ops.seeding import seed_clusters_host
+    from cuda_gmm_mpi_tpu.parallel import ShardedGMMModel, make_mesh
+    from cuda_gmm_mpi_tpu.parallel.distributed import host_chunk_bounds
+
+    # Deterministic dataset, identical on every host (stands in for a shared
+    # input file); only the host's slice is chunked/uploaded. 509 events:
+    # NOT divisible by chunks/hosts/devices, so the remainder path (tail
+    # host pads + masks) is what's exercised.
+    n, d, k = 509, 3, 3
+    rng = np.random.default_rng(1234)
+    centers = rng.normal(scale=8.0, size=(k, d))
+    data = (
+        centers[rng.integers(0, k, n)] + rng.normal(size=(n, d))
+    ).astype(np.float64)
+
+    cfg = GMMConfig(min_iters=4, max_iters=4, chunk_size=64, dtype="float64")
+    mesh = make_mesh()  # all 2*nproc global devices on the data axis
+    model = ShardedGMMModel(cfg, mesh=mesh)
+
+    start, stop, num_chunks = host_chunk_bounds(
+        n, cfg.chunk_size, mesh.shape["data"], pid, nproc
+    )
+    local_chunks, local_wts = chunk_events(
+        data[start:stop], cfg.chunk_size, num_chunks=num_chunks
+    )
+    state = seed_clusters_host(data, k)  # seeding uses global moments
+    state, chunks, wts = model.prepare(state, local_chunks, local_wts,
+                                       host_local=True)
+    eps = convergence_epsilon(n, d)
+
+    s, ll, iters = model.run_em(state, chunks, wts, eps)
+    jax.block_until_ready(s)
+    means0 = np.asarray(jax.device_get(s.means))[0]
+    print(
+        f"RESULT pid={pid} ll={float(ll):.10e} iters={int(iters)} "
+        f"means={','.join(f'{v:.12e}' for v in means0)}",
+        flush=True,
+    )
+    jax.distributed.shutdown()
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())
