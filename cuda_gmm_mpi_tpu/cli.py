@@ -75,6 +75,9 @@ def build_parser() -> argparse.ArgumentParser:
                    "--mesh=4,2; default: all devices on the event axis")
     t.add_argument("--profile", action="store_true",
                    help="per-phase timing report (reference profile_t taxonomy)")
+    t.add_argument("--trace-dir", default=None,
+                   help="capture a jax.profiler trace of the fit "
+                   "(TensorBoard-viewable) into this directory")
     t.add_argument("--debug-nans", action="store_true",
                    help="trap NaN/Inf at the producing op (sanitizer mode)")
     t.add_argument("--checkpoint-dir", default=None,
@@ -153,9 +156,12 @@ def main(argv=None) -> int:
         print(f"Starting with {args.num_clusters} cluster(s), will stop at "
               f"{stop} cluster(s).")  # :226
 
-    result = fit_gmm(
-        data, args.num_clusters, args.target_num_clusters, config=config
-    )
+    from .utils.profiling import trace
+
+    with trace(args.trace_dir):
+        result = fit_gmm(
+            data, args.num_clusters, args.target_num_clusters, config=config
+        )
 
     t_out0 = time.perf_counter()
     summary_path = args.outfile + ".summary"

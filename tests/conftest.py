@@ -31,6 +31,29 @@ def rng():
     return np.random.default_rng(1234)
 
 
+def worker_env():
+    """Environment for subprocess test workers: CPU platform, fresh device
+    config (scrub this harness's 8-device forcing), repo on PYTHONPATH."""
+    env = dict(os.environ)
+    env.pop("XLA_FLAGS", None)
+    env["JAX_PLATFORMS"] = "cpu"
+    repo = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+    env["PYTHONPATH"] = repo + os.pathsep + env.get("PYTHONPATH", "")
+    return env
+
+
+def communicate_or_kill(proc, timeout):
+    """proc.communicate that never leaks a still-running worker."""
+    import subprocess
+
+    try:
+        return proc.communicate(timeout=timeout)
+    except subprocess.TimeoutExpired:
+        proc.kill()
+        proc.communicate()
+        raise
+
+
 def make_blobs(rng, n=2000, d=3, k=4, spread=8.0, dtype=np.float64):
     """Well-separated synthetic mixture with known parameters."""
     centers = rng.normal(scale=spread, size=(k, d))

@@ -1,0 +1,118 @@
+"""Failure recovery: SIGKILL a sweep mid-flight, resume from the checkpoint.
+
+The reference is fail-fast only (SURVEY.md SS5.3): a dead rank kills the MPI
+job and the entire 100-iteration x K-sweep restarts from nothing. Here the
+orbax sweep checkpoints (utils/checkpoint.py) must survive an actual
+process kill -- not just the polite same-process resume of test_aux -- and
+the resumed run must finish with the same answer as an uninterrupted one.
+"""
+
+import json
+import os
+import signal
+import subprocess
+import sys
+import time
+
+import numpy as np
+import pytest
+
+WORKER = r"""
+import json, sys
+import jax
+jax.config.update("jax_platforms", "cpu")
+jax.config.update("jax_enable_x64", True)
+import numpy as np
+from cuda_gmm_mpi_tpu.config import GMMConfig
+from cuda_gmm_mpi_tpu.models import fit_gmm
+
+ckdir = sys.argv[1]
+rng = np.random.default_rng(77)
+centers = rng.normal(scale=9.0, size=(4, 3))
+data = (centers[rng.integers(0, 4, 4000)]
+        + rng.normal(size=(4000, 3))).astype(np.float64)
+cfg = GMMConfig(min_iters=6, max_iters=6, chunk_size=512, dtype="float64",
+                checkpoint_dir=ckdir, enable_print=True)
+r = fit_gmm(data, 12, 2, config=cfg)
+print(json.dumps({
+    "ideal_k": r.ideal_num_clusters,
+    "min_rissanen": r.min_rissanen,
+    "final_loglik": r.final_loglik,
+    "means": np.asarray(r.means).tolist(),
+    "sweep_ks": [int(row[0]) for row in r.sweep_log],
+}))
+"""
+
+
+def _spawn(ckdir: str):
+    from .conftest import worker_env
+
+    return subprocess.Popen(
+        [sys.executable, "-c", WORKER, ckdir],
+        stdout=subprocess.PIPE, stderr=subprocess.PIPE, env=worker_env(),
+        text=True,
+    )
+
+
+@pytest.mark.slow
+def test_sigkill_mid_sweep_then_resume(tmp_path):
+    ck = str(tmp_path / "ck")
+    sweep_dir = os.path.join(ck, "sweep")
+
+    # Run 1: killed (SIGKILL, no cleanup chance) once >= 2 checkpoint steps
+    # exist but the 11-step sweep is far from done.
+    p = _spawn(ck)
+    deadline = time.time() + 300
+    try:
+        while time.time() < deadline:
+            steps = (
+                [d for d in os.listdir(sweep_dir) if d.isdigit()]
+                if os.path.isdir(sweep_dir) else []
+            )
+            if len(steps) >= 2:
+                break
+            if p.poll() is not None:
+                out, err = p.communicate()
+                raise AssertionError(
+                    f"worker exited before kill (rc={p.returncode}):\n"
+                    f"{out}\n{err[-3000:]}"
+                )
+            time.sleep(0.05)
+        else:
+            raise AssertionError("no checkpoint appeared within timeout")
+        os.kill(p.pid, signal.SIGKILL)
+    finally:
+        if p.poll() is None:  # error path: don't leak a live worker
+            p.kill()
+        p.wait(timeout=60)
+    assert p.returncode != 0  # really died
+
+    # Run 2: resumes from the surviving checkpoint and completes.
+    from .conftest import communicate_or_kill
+
+    p2 = _spawn(ck)
+    out, err = communicate_or_kill(p2, timeout=600)
+    assert p2.returncode == 0, f"resume failed:\n{out}\n{err[-3000:]}"
+    resumed = json.loads(out.splitlines()[-1])
+    # The combined sweep log covers all 11 Ks (restored rows + new rows)...
+    assert len(resumed["sweep_ks"]) == 11
+    # ...but THIS process must not have redone the checkpointed Ks: verbose
+    # mode prints one "K=..." line per EM run executed in-process.
+    ran_here = [l for l in out.splitlines() if l.startswith("K=")]
+    assert 0 < len(ran_here) < 11, out
+    assert resumed["ideal_k"] >= 2
+
+    # Uninterrupted reference run (fresh dir) for ground truth.
+    p3 = _spawn(str(tmp_path / "ck_ref"))
+    out3, err3 = communicate_or_kill(p3, timeout=600)
+    assert p3.returncode == 0, f"reference run failed:\n{out3}\n{err3[-3000:]}"
+    ref = json.loads(out3.splitlines()[-1])
+
+    assert resumed["ideal_k"] == ref["ideal_k"]
+    np.testing.assert_allclose(
+        resumed["min_rissanen"], ref["min_rissanen"], rtol=1e-9
+    )
+    np.testing.assert_allclose(
+        np.asarray(resumed["means"]), np.asarray(ref["means"]),
+        rtol=1e-7, atol=1e-9,
+    )

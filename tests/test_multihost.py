@@ -25,14 +25,10 @@ def _free_port() -> int:
 
 
 def _run_workers(nproc: int, timeout: float = 300.0):
+    from .conftest import worker_env
+
     port = _free_port()
-    env = dict(os.environ)
-    # Scrub the parent test harness's device-count forcing; workers pin their
-    # own platform/device config.
-    env.pop("XLA_FLAGS", None)
-    env["JAX_PLATFORMS"] = "cpu"
-    repo = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
-    env["PYTHONPATH"] = repo + os.pathsep + env.get("PYTHONPATH", "")
+    env = worker_env()
     procs = [
         subprocess.Popen(
             [sys.executable, WORKER, str(i), str(nproc), str(port)],
