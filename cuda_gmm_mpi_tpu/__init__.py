@@ -11,14 +11,15 @@ the file:line provenance cited throughout this package.
 
 from .config import DEFAULT_CONFIG, GMMConfig
 from .estimator import GaussianMixture
-from .models import GMMModel, GMMResult, compute_memberships, fit_gmm
+from .models import (GMMModel, GMMResult, compute_memberships, fit_gmm,
+                     iter_memberships)
 from .state import GMMState, compact, zeros_state
 
 __version__ = "0.1.0"
 
 __all__ = [
     "DEFAULT_CONFIG", "GMMConfig", "GaussianMixture",
-    "GMMModel", "GMMResult", "compute_memberships", "fit_gmm",
+    "GMMModel", "GMMResult", "compute_memberships", "fit_gmm", "iter_memberships",
     "GMMState", "compact", "zeros_state",
     "__version__",
 ]

@@ -101,8 +101,9 @@ def main(argv=None) -> int:
 
     # Heavy imports deferred until after platform selection.
     from .config import GMMConfig
-    from .io import read_data, write_results, write_summary
-    from .models import compute_memberships, fit_gmm
+    from .io import read_data, write_summary
+    from .io.writers import stream_results
+    from .models import fit_gmm, iter_memberships
 
     if not os.path.isfile(args.infile):
         print("Invalid infile.\n", file=sys.stderr)  # gaussian.cu:1130
@@ -167,8 +168,10 @@ def main(argv=None) -> int:
     summary_path = args.outfile + ".summary"
     write_summary(summary_path, result, enable_output=config.enable_output)
     if config.enable_output:
-        memberships = compute_memberships(result, data, config)
-        write_results(args.outfile + ".results", data, memberships)
+        # Streamed: posteriors recomputed + written chunk-by-chunk, so the
+        # N x K membership matrix never exists in host RAM.
+        stream_results(args.outfile + ".results",
+                       iter_memberships(result, data, config))
     t_out = time.perf_counter() - t_out0
 
     if config.profile:

@@ -29,10 +29,18 @@ _tried = False
 
 
 def ensure_built(force: bool = False) -> bool:
-    """Build libgmm_io.so via make if missing. Returns True on success."""
-    if os.path.exists(_LIB_PATH) and not force:
-        return True
+    """Build libgmm_io.so via make if missing or stale. Returns True on
+    success (make itself is a no-op when the .so is up to date)."""
     makefile = os.path.join(_NATIVE_DIR, "Makefile")
+    if os.path.exists(_LIB_PATH) and not force:
+        try:
+            lib_mtime = os.path.getmtime(_LIB_PATH)
+            srcs = [makefile, os.path.join(_NATIVE_DIR, "gmm_io.cpp")]
+            if all(os.path.getmtime(s) <= lib_mtime
+                   for s in srcs if os.path.exists(s)):
+                return True
+        except OSError:
+            return True  # can't stat sources; use the existing library
     if not os.path.exists(makefile):
         return False
     try:
@@ -72,6 +80,16 @@ def _load() -> Optional[ctypes.CDLL]:
             ctypes.POINTER(ctypes.c_float), ctypes.POINTER(ctypes.c_float),
             ctypes.c_int64, ctypes.c_int64, ctypes.c_int64,
         ]
+        lib.gmm_results_open.restype = ctypes.c_void_p
+        lib.gmm_results_open.argtypes = [ctypes.c_char_p]
+        lib.gmm_results_append.restype = ctypes.c_int
+        lib.gmm_results_append.argtypes = [
+            ctypes.c_void_p,
+            ctypes.POINTER(ctypes.c_float), ctypes.POINTER(ctypes.c_float),
+            ctypes.c_int64, ctypes.c_int64, ctypes.c_int64,
+        ]
+        lib.gmm_results_close.restype = ctypes.c_int
+        lib.gmm_results_close.argtypes = [ctypes.c_void_p]
         _lib = lib
         return _lib
 
@@ -96,6 +114,53 @@ def read_data(path: str) -> np.ndarray:
     finally:
         lib.gmm_free(buf)
     return arr
+
+
+class ResultsWriter:
+    """Streaming .results writer: append event blocks, bounded memory.
+
+    Context manager over the native handle API (gmm_results_open/append/
+    close); the full N x K posterior matrix never has to exist.
+    """
+
+    def __init__(self, path: str):
+        lib = _load()
+        if lib is None:
+            raise RuntimeError("native gmm_io library unavailable")
+        self._lib = lib
+        self._h = lib.gmm_results_open(path.encode())
+        if not self._h:
+            raise IOError(f"cannot open {path!r} for writing")
+        self._path = path
+
+    def append(self, data: np.ndarray, memberships: np.ndarray) -> None:
+        data = np.ascontiguousarray(data, np.float32)
+        memberships = np.ascontiguousarray(memberships, np.float32)
+        n, d = data.shape
+        k = memberships.shape[1]
+        if memberships.shape[0] != n:
+            raise ValueError("data/membership row mismatch")
+        rc = self._lib.gmm_results_append(
+            self._h,
+            data.ctypes.data_as(ctypes.POINTER(ctypes.c_float)),
+            memberships.ctypes.data_as(ctypes.POINTER(ctypes.c_float)),
+            n, d, k,
+        )
+        if rc != 0:
+            raise IOError(f"native append failed on {self._path!r} (rc={rc})")
+
+    def close(self) -> None:
+        if self._h:
+            rc = self._lib.gmm_results_close(self._h)
+            self._h = None
+            if rc != 0:
+                raise IOError(f"close failed on {self._path!r} (rc={rc})")
+
+    def __enter__(self):
+        return self
+
+    def __exit__(self, *exc):
+        self.close()
 
 
 def write_results(path: str, data: np.ndarray, memberships: np.ndarray) -> None:

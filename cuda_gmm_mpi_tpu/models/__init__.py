@@ -1,9 +1,10 @@
 """Model layer: EM driver and the Rissanen model-order search (SURVEY L4/L5)."""
 
 from .gmm import GMMModel, chunk_events, em_while_loop
-from .order_search import GMMResult, compute_memberships, fit_gmm
+from .order_search import (GMMResult, compute_memberships, fit_gmm,
+                           iter_memberships)
 
 __all__ = [
     "GMMModel", "chunk_events", "em_while_loop",
-    "GMMResult", "compute_memberships", "fit_gmm",
+    "GMMResult", "compute_memberships", "fit_gmm", "iter_memberships",
 ]

@@ -264,6 +264,34 @@ def fit_gmm(
     )
 
 
+def iter_memberships(
+    result: GMMResult, data: np.ndarray, config: GMMConfig = GMMConfig(),
+    model: Optional[GMMModel] = None,
+):
+    """Yield ``(data_block, posteriors_block)`` per chunk, original coords.
+
+    The streaming producer behind the ``.results`` output path: each block is
+    sliced, shifted, and padded individually, and its posteriors recomputed
+    from the final parameters -- peak host memory is one block's [B, D] +
+    [B, K] regardless of N (SURVEY.md SS7 "memberships at scale": the
+    reference gathers the whole N x K matrix to rank 0, gaussian.cu:783-823).
+    """
+    model = model or GMMModel(config)
+    dtype = np.dtype(config.dtype)
+    n, d = data.shape
+    B = config.chunk_size
+    shift = np.asarray(result.data_shift, dtype)[None, :]
+    state = result.state
+    for lo in range(0, n, B):
+        block = data[lo:lo + B]
+        valid = block.shape[0]
+        xb = block.astype(dtype, copy=False) - shift
+        if valid < B:  # pad the tail block to the jitted chunk shape
+            xb = np.concatenate([xb, np.zeros((B - valid, d), dtype)])
+        w, _ = model._posteriors(state, jnp.asarray(xb))
+        yield block, np.asarray(jax.device_get(w))[:valid]
+
+
 def compute_memberships(
     result: GMMResult, data: np.ndarray, config: GMMConfig = GMMConfig(),
     model: Optional[GMMModel] = None,
@@ -272,11 +300,11 @@ def compute_memberships(
 
     Bit-equivalent to the reference's saved memberships (the EM loop ends on an
     E-step, so the stored memberships ARE the posteriors of the final params;
-    gaussian.cu:713-714, 768).
+    gaussian.cu:713-714, 768). Materialized variant of ``iter_memberships``.
     """
     model = model or GMMModel(config)
-    dtype = np.dtype(config.dtype)
-    data = data.astype(dtype, copy=False) - result.data_shift[None, :]
-    chunks_np, _ = chunk_events(data, config.chunk_size)
-    w = model.memberships(result.state, jnp.asarray(chunks_np))
-    return w[: data.shape[0]]
+    blocks = [w for _, w in iter_memberships(result, data, config, model)]
+    if not blocks:
+        return np.zeros((0, result.state.num_clusters_padded),
+                        np.dtype(config.dtype))
+    return np.concatenate(blocks, axis=0)

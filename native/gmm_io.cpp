@@ -28,6 +28,12 @@ int gmm_read_data(const char* path, int64_t* n_out, int64_t* d_out,
 void gmm_free(float* p);
 int gmm_write_results(const char* path, const float* data, const float* memb,
                       int64_t n, int64_t d, int64_t k);
+// Streaming variant: open once, append event blocks, close. Bounded memory
+// for arbitrarily large N (the 10M x 128 posterior matrix never exists).
+void* gmm_results_open(const char* path);
+int gmm_results_append(void* handle, const float* data, const float* memb,
+                       int64_t n, int64_t d, int64_t k);
+int gmm_results_close(void* handle);
 
 }  // extern "C"
 
@@ -165,9 +171,13 @@ int gmm_read_data(const char* path, int64_t* n_out, int64_t* d_out,
 
 void gmm_free(float* p) { std::free(p); }
 
-int gmm_write_results(const char* path, const float* data, const float* memb,
-                      int64_t n, int64_t d, int64_t k) {
-  FILE* f = std::fopen(path, "w");
+void* gmm_results_open(const char* path) {
+  return static_cast<void*>(std::fopen(path, "w"));
+}
+
+int gmm_results_append(void* handle, const float* data, const float* memb,
+                       int64_t n, int64_t d, int64_t k) {
+  FILE* f = static_cast<FILE*>(handle);
   if (!f) return 1;
   // Worst-case per value: sign + 20 int digits + '.' + 6 decimals + sep.
   const size_t line_cap = static_cast<size_t>(d + k) * 32 + 8;
@@ -185,11 +195,23 @@ int gmm_write_results(const char* path, const float* data, const float* memb,
     }
     *out++ = '\n';
     if (std::fwrite(line.data(), 1, static_cast<size_t>(out - line.data()),
-                    f) != static_cast<size_t>(out - line.data())) {
-      std::fclose(f);
+                    f) != static_cast<size_t>(out - line.data()))
       return 1;
-    }
   }
-  std::fclose(f);
   return 0;
+}
+
+int gmm_results_close(void* handle) {
+  FILE* f = static_cast<FILE*>(handle);
+  if (!f) return 1;
+  return std::fclose(f) == 0 ? 0 : 1;
+}
+
+int gmm_write_results(const char* path, const float* data, const float* memb,
+                      int64_t n, int64_t d, int64_t k) {
+  void* h = gmm_results_open(path);
+  if (!h) return 1;
+  const int rc = gmm_results_append(h, data, memb, n, d, k);
+  const int rc2 = gmm_results_close(h);
+  return rc ? rc : rc2;
 }

@@ -82,3 +82,37 @@ def test_native_writer_matches_python(tmp_path, rng):
 def test_native_missing_file():
     with pytest.raises(ValueError):
         native.read_data("/nonexistent/file.csv")
+
+
+def test_streaming_results_byte_identical(tmp_path, rng):
+    """stream_results == write_results, native and text paths alike."""
+    from cuda_gmm_mpi_tpu.io.writers import stream_results
+
+    data = rng.normal(scale=10, size=(317, 4)).astype(np.float32)
+    memb = rng.random(size=(317, 5)).astype(np.float32)
+    memb /= memb.sum(1, keepdims=True)
+
+    def blocks():
+        for lo in range(0, 317, 64):  # uneven tail block on purpose
+            yield data[lo:lo + 64], memb[lo:lo + 64]
+
+    for mode in ["always", "never"]:  # native handle API vs text fallback
+        p_mono = tmp_path / f"mono_{mode}.results"
+        p_stream = tmp_path / f"stream_{mode}.results"
+        write_results(str(p_mono), data, memb, use_native=mode)
+        n = stream_results(str(p_stream), blocks(), use_native=mode)
+        assert n == 317
+        assert p_stream.read_bytes() == p_mono.read_bytes()
+
+
+def test_results_writer_context_manager(tmp_path, rng):
+    data = rng.normal(size=(10, 2)).astype(np.float32)
+    memb = rng.random(size=(10, 3)).astype(np.float32)
+    p = tmp_path / "w.results"
+    with native.ResultsWriter(str(p)) as w:
+        w.append(data[:6], memb[:6])
+        w.append(data[6:], memb[6:])
+    assert len(p.read_text().splitlines()) == 10
+    with pytest.raises(ValueError):
+        with native.ResultsWriter(str(tmp_path / "x.results")) as w:
+            w.append(data[:4], memb[:5])
