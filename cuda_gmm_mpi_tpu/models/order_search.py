@@ -24,7 +24,7 @@ import numpy as np
 
 from ..config import GMMConfig
 from ..ops.formulas import convergence_epsilon, rissanen_score
-from ..ops.merge import eliminate_empty, reduce_order_step
+from ..ops.merge import eliminate_and_reduce
 from ..ops.seeding import seed_clusters_host
 from ..state import GMMState, compact
 from ..utils.logging_ import get_logger, metrics_line
@@ -55,7 +55,10 @@ class GMMResult:
     num_events: int
     num_dimensions: int
     data_shift: np.ndarray  # [D] centering shift (zeros if centering disabled)
-    # per-K trajectory: (num_clusters, loglik, rissanen, em_iters, seconds)
+    # per-K trajectory: (num_clusters, loglik, rissanen, em_iters, seconds).
+    # ``seconds`` is the wall time until that K's loglik was on host: EM only
+    # when profiling is on (or on the final K); EM + the fused order-reduction
+    # dispatch/sync otherwise (the default path syncs once per K).
     sweep_log: list = dataclasses.field(default_factory=list)
     profile: Optional[dict] = None          # seconds per phase (7 categories)
     profile_report: Optional[str] = None    # formatted report
@@ -153,9 +156,10 @@ def fit_gmm(
     log.debug("epsilon=%s n=%d d=%d k=%d", epsilon, n_events, n_dims,
               num_clusters)
 
-    elim_fn = jax.jit(eliminate_empty)
-    reduce_fn = jax.jit(
-        functools.partial(reduce_order_step, diag_only=config.diag_only)
+    # One fused dispatch for the whole order-reduction step, so each K costs
+    # a single blocking device->host sync (see eliminate_and_reduce).
+    elim_reduce_fn = jax.jit(
+        functools.partial(eliminate_and_reduce, diag_only=config.diag_only)
     )
 
     sweep_log = []
@@ -187,19 +191,41 @@ def fit_gmm(
 
     while k >= stop_number:
         t0 = time.perf_counter()
+        last_k = k <= stop_number
         with phase("e_step"):  # fused E+M loop (m_step/constants folded in)
             state, ll, iters = model.run_em(state, chunks, wts, epsilon)
-            ll_f = float(ll)  # device sync
+            if timer or last_k:
+                # Block on EM here so the e_step phase (and sweep_log's
+                # seconds) measure EM alone. Profiling trades away the
+                # fused single-sync optimization below for attribution.
+                ll_f, iters_i = map(np.asarray, jax.device_get((ll, iters)))
+        if not last_k:
+            # Order reduction (gaussian.cu:857-952): dispatch the fused
+            # eliminate+scan+merge step immediately, then fetch ALL per-K
+            # decision scalars in one blocking sync (each blocking transfer
+            # is a full round trip on a remote-TPU link).
+            with phase("reduce"):
+                next_state, k_active, min_d = elim_reduce_fn(state)
+                if timer:
+                    k_active_i, min_d_f = map(
+                        np.asarray, jax.device_get((k_active, min_d))
+                    )
+                else:
+                    ll_f, iters_i, k_active_i, min_d_f = map(
+                        np.asarray,
+                        jax.device_get((ll, iters, k_active, min_d)),
+                    )
+        ll_f = float(ll_f)
         riss = rissanen_score(ll_f, k, n_events, n_dims)
         dt = time.perf_counter() - t0
         if timer:
-            timer.counts["e_step"] += int(iters) - 1  # per-iteration averages
-        sweep_log.append((k, ll_f, riss, int(iters), dt))
+            timer.counts["e_step"] += int(iters_i) - 1  # per-iter averages
+        sweep_log.append((k, ll_f, riss, int(iters_i), dt))
         if verbose:
             print(f"K={k}: loglik={ll_f:.6e} rissanen={riss:.6e} "
-                  f"iters={int(iters)} ({dt:.2f}s)")
+                  f"iters={int(iters_i)} ({dt:.2f}s)")
         metrics_line("em_done", k=k, loglik=ll_f, rissanen=riss,
-                     iters=int(iters), seconds=round(dt, 4)) if (
+                     iters=int(iters_i), seconds=round(dt, 4)) if (
                          config.enable_debug) else None
 
         if (
@@ -210,23 +236,19 @@ def fit_gmm(
             min_rissanen, ideal_k = riss, k
             best_state, best_ll = state, ll_f
 
-        if k <= stop_number:
+        if last_k:
             break
-        # Order reduction (gaussian.cu:857-952)
-        with phase("reduce"):
-            state = elim_fn(state)
-            k = int(state.num_active())
-            if k < 2:
-                break
-            if verbose:
-                print(f"non-empty clusters: {k}; merging closest pair")
-            state, _, min_d = reduce_fn(state)
-            valid_merge = bool(np.isfinite(float(min_d)))
-        if not valid_merge:
+        k = int(k_active_i)
+        if k < 2:
+            break
+        if verbose:
+            print(f"non-empty clusters: {k}; merging closest pair")
+        if not np.isfinite(float(min_d_f)):
             # No valid merge pair (degenerate covariances everywhere); stop
             # the sweep rather than corrupt the state.
             log.warning("no valid merge pair at K=%d; stopping sweep", k)
             break
+        state = next_state
         k -= 1
 
         if ckpt is not None:

@@ -50,15 +50,21 @@ def _merged_cov_row(state, i):
     return mu_m, R_m
 
 
-def pairwise_merge_distances(state, diag_only: bool = False):
+def pairwise_merge_distances(state, diag_only: bool = False,
+                             row_block: int = 32):
     """Full [K, K] merge-cost matrix; +inf on invalid pairs.
 
     Valid pairs are (i, j) with i < j in slot order and both active -- the same
     enumeration order as the reference's compacted c1 < c2 scan
     (gaussian.cu:882-894), so first-minimum tie-breaking matches.
 
-    Memory: rows are processed one at a time via lax.map, so the peak live
-    intermediate is [K, D, D] merged covariances per row, never [K, K, D, D].
+    Memory/throughput: rows are processed ``row_block`` at a time (lax.map
+    over blocks, vmap within), so the peak live intermediate is
+    [row_block, K, D, D] merged covariances -- never the full [K, K, D, D]
+    (at the MAX_CLUSTERS=512, D=32 extreme that would be 1 GB in fp32) --
+    while each step still batches row_block*K Cholesky factorizations
+    (row-at-a-time serialized K tiny-matrix launches, the dominant cost of
+    the reduce step at large K).
     """
     K, D = state.means.shape
     dtype = state.R.dtype
@@ -77,7 +83,16 @@ def pairwise_merge_distances(state, diag_only: bool = False):
         valid = ok & state.active & state.active[i] & (j > i)
         return jnp.where(valid, dist, jnp.inf).astype(dtype)
 
-    return lax.map(row, jnp.arange(K))
+    bs = max(1, min(row_block, K))
+    pad = (-K) % bs
+    rows = jnp.arange(K)
+    if pad:
+        # Pad the index range to a whole number of blocks by recomputing row
+        # 0; the padded output rows carry no marker and are dropped ONLY by
+        # the [:K] slice below.
+        rows = jnp.concatenate([rows, jnp.zeros((pad,), rows.dtype)])
+    blocks = lax.map(jax.vmap(row), rows.reshape(-1, bs))
+    return blocks.reshape(-1, K)[:K]
 
 
 def argmin_pair(dist: jax.Array):
@@ -125,6 +140,23 @@ def merge_pair(state, i, j, diag_only: bool = False):
         Rinv=state.Rinv.at[i].set(Rinv_m),
         active=state.active.at[j].set(False),
     )
+
+
+def eliminate_and_reduce(state, diag_only: bool = False):
+    """Fused empty-elimination + pair scan + merge, one device dispatch.
+
+    Returns ``(new_state, k_active_after_elim, min_distance)``. Exists so the
+    sweep driver can fetch all its per-K decision scalars in ONE host sync --
+    on a remote-TPU link every blocking transfer costs a round trip, and the
+    reference-shaped loop (eliminate, count, scan, merge as separate host
+    steps, gaussian.cu:857-907) would pay it 3-4 times per K.
+    """
+    state = eliminate_empty(state)
+    k_active = state.num_active()
+    new_state, _, min_d = reduce_order_step(state, diag_only=diag_only)
+    # A merge with < 2 active clusters is impossible; reduce_order_step
+    # already returns the state unchanged in that case (all-inf distances).
+    return new_state, k_active, min_d
 
 
 def reduce_order_step(state, diag_only: bool = False):
