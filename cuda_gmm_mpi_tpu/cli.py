@@ -68,6 +68,12 @@ def build_parser() -> argparse.ArgumentParser:
                    help="quadratic-form evaluation strategy")
     t.add_argument("--no-center", action="store_true",
                    help="disable global data centering")
+    t.add_argument("--seed-method", default="even",
+                   choices=["even", "kmeans++"],
+                   help="initial means: reference evenly-spaced rows, or "
+                   "k-means++ D^2-weighted sampling (--seed sets its RNG)")
+    t.add_argument("--seed", type=int, default=0,
+                   help="RNG seed for randomized paths (kmeans++ seeding)")
     t.add_argument("--pallas", default="auto", choices=["auto", "always", "never"],
                    help="use the Pallas fused kernel")
     t.add_argument("--mesh", default=None,
@@ -120,6 +126,8 @@ def main(argv=None) -> int:
             chunk_size=args.chunk_size,
             quad_mode=args.quad_mode,
             center_data=not args.no_center,
+            seed_method=args.seed_method,
+            seed=args.seed,
             use_pallas=args.pallas,
             device=args.device,
             mesh_shape=_parse_mesh(args.mesh),

@@ -80,6 +80,10 @@ class GMMConfig:
     profile: bool = False
     checkpoint_dir: Optional[str] = None
     seed: int = 0  # RNG seed for any randomized paths (reference is deterministic)
+    # Initial means: 'even' = the reference's evenly-spaced event rows
+    # (gaussian.cu:108-123); 'kmeans++' = D^2-weighted sampling (upgrade,
+    # deterministic given ``seed``).
+    seed_method: str = "even"
     # Numerical-sanitizer analog (SURVEY SS5.2: the reference has no race
     # detection / sanitizers; JAX's functional model removes data races, and
     # this enables the remaining useful check -- trap NaN/Inf at the op that
@@ -97,6 +101,8 @@ class GMMConfig:
             raise ValueError(f"unknown quad_mode: {self.quad_mode!r}")
         if self.use_pallas not in ("auto", "always", "never"):
             raise ValueError(f"unknown use_pallas: {self.use_pallas!r}")
+        if self.seed_method not in ("even", "kmeans++"):
+            raise ValueError(f"unknown seed_method: {self.seed_method!r}")
         if self.chunk_size < 1:
             raise ValueError("chunk_size must be >= 1")
         if self.pallas_block_b < 1:

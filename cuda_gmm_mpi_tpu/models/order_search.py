@@ -141,6 +141,7 @@ def fit_gmm(
         state = seed_clusters_host(
             data, num_clusters,
             covariance_dynamic_range=config.covariance_dynamic_range,
+            seed_method=config.seed_method, seed=config.seed,
         )
         num_shards = getattr(model, "data_size", 1)
         chunks_np, wts_np = chunk_events(data, config.chunk_size, num_shards)
@@ -199,6 +200,7 @@ def fit_gmm(
                 # seconds) measure EM alone. Profiling trades away the
                 # fused single-sync optimization below for attribution.
                 ll_f, iters_i = map(np.asarray, jax.device_get((ll, iters)))
+                dt = time.perf_counter() - t0  # EM-only (synced above)
         if not last_k:
             # Order reduction (gaussian.cu:857-952): dispatch the fused
             # eliminate+scan+merge step immediately, then fetch ALL per-K
@@ -217,7 +219,8 @@ def fit_gmm(
                     )
         ll_f = float(ll_f)
         riss = rissanen_score(ll_f, k, n_events, n_dims)
-        dt = time.perf_counter() - t0
+        if not (timer or last_k):  # fused path: EM + reduce until ll on host
+            dt = time.perf_counter() - t0
         if timer:
             timer.counts["e_step"] += int(iters_i) - 1  # per-iter averages
         sweep_log.append((k, ll_f, riss, int(iters_i), dt))

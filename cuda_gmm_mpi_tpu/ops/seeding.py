@@ -42,30 +42,71 @@ def seed_means_indices(num_events: int, num_clusters: int) -> jnp.ndarray:
     return jnp.clip(idx, 0, num_events - 1)
 
 
+def kmeanspp_indices(data, num_clusters: int, seed: int = 0,
+                     max_sample: int = 200_000):
+    """k-means++ (D^2-weighted) seeding indices -- capability upgrade over
+    the reference's evenly-spaced rows (absent there; opt-in via
+    ``GMMConfig.seed_method='kmeans++'``).
+
+    Runs on a deterministic subsample of at most ``max_sample`` events so
+    seeding stays O(K * max_sample * D) at any N; returns indices into the
+    FULL data array.
+    """
+    import numpy as np
+
+    n = data.shape[0]
+    rng = np.random.default_rng(seed)
+    if n > max_sample:
+        pool = rng.choice(n, size=max_sample, replace=False)
+    else:
+        pool = np.arange(n)
+    x = data[pool].astype(np.float64)
+    first = int(rng.integers(x.shape[0]))
+    chosen = [first]
+    d2 = ((x - x[first]) ** 2).sum(axis=1)
+    for _ in range(1, num_clusters):
+        total = d2.sum()
+        if total <= 0:  # fewer distinct points than clusters: reuse
+            chosen.append(int(rng.integers(x.shape[0])))
+            continue
+        nxt = int(rng.choice(x.shape[0], p=d2 / total))
+        chosen.append(nxt)
+        d2 = np.minimum(d2, ((x - x[nxt]) ** 2).sum(axis=1))
+    return pool[np.asarray(chosen)]
+
+
 def seed_clusters_host(
     data,
     num_clusters: int,
     num_clusters_padded: int | None = None,
     covariance_dynamic_range: float = 1e3,
     dtype=None,
+    seed_method: str = "even",
+    seed: int = 0,
 ) -> GMMState:
     """Host-side seeding from a NumPy array -- avoids shipping the full dataset
     to device a second time (the chunked copy is the only device-resident one).
 
     Only K gathered rows and two global moments are needed; moments are
-    computed in float64 on host for accuracy.
+    computed in float64 on host for accuracy. ``seed_method``: 'even' = the
+    reference's evenly-spaced rows (default); 'kmeans++' = D^2-weighted
+    sampling (upgrade, deterministic given ``seed``).
     """
     import numpy as np
 
     n_events, _ = data.shape
     dtype = dtype or data.dtype
-    if num_clusters > 1:
-        seed = (n_events - 1.0) / (num_clusters - 1.0)
+    if seed_method == "kmeans++":
+        idx = kmeanspp_indices(data, num_clusters, seed=seed)
+    elif seed_method == "even":
+        if num_clusters > 1:
+            step = (n_events - 1.0) / (num_clusters - 1.0)
+        else:
+            step = 0.0
+        idx = (np.arange(num_clusters, dtype=np.float32)
+               * np.float32(step)).astype(np.int64)
     else:
-        seed = 0.0
-    idx = (np.arange(num_clusters, dtype=np.float32) * np.float32(seed)).astype(
-        np.int64
-    )
+        raise ValueError(f"unknown seed_method {seed_method!r}")
     means = np.ascontiguousarray(data[np.clip(idx, 0, n_events - 1)])
     mean64 = data.mean(axis=0, dtype=np.float64)
     var = (data.astype(np.float64) ** 2).mean(axis=0) - mean64 * mean64

@@ -2,9 +2,12 @@
 
 import jax.numpy as jnp
 import numpy as np
+import pytest
 
 from cuda_gmm_mpi_tpu.ops.constants import LOG_2PI
 from cuda_gmm_mpi_tpu.ops.seeding import seed_clusters, seed_means_indices
+
+from .conftest import make_blobs
 
 
 def test_seed_indices_match_reference_float_math():
@@ -55,3 +58,54 @@ def test_seed_padded(rng):
     np.testing.assert_array_equal(np.asarray(state.active),
                                   [True] * k + [False] * (kp - k))
     assert np.all(np.asarray(state.N)[k:] == 0)
+
+
+def test_kmeanspp_indices_deterministic_and_spread(rng):
+    from cuda_gmm_mpi_tpu.ops.seeding import kmeanspp_indices
+
+    data, centers = make_blobs(rng, n=2000, d=3, k=4)
+    i1 = kmeanspp_indices(data, 4, seed=5)
+    i2 = kmeanspp_indices(data, 4, seed=5)
+    np.testing.assert_array_equal(i1, i2)  # deterministic given seed
+    assert len(set(i1.tolist())) == 4
+    # D^2 weighting should land one seed near each well-separated blob
+    picked = data[i1]
+    d = np.linalg.norm(picked[:, None, :] - centers[None], axis=-1).min(0)
+    assert (d < 4.0).all(), d
+
+
+def test_kmeanspp_subsample_path():
+    from cuda_gmm_mpi_tpu.ops.seeding import kmeanspp_indices
+
+    r = np.random.default_rng(0)
+    data = r.normal(size=(5000, 2))
+    idx = kmeanspp_indices(data, 8, seed=1, max_sample=1000)
+    assert len(idx) == 8 and (idx < 5000).all() and (idx >= 0).all()
+
+
+def test_kmeanspp_more_clusters_than_points():
+    from cuda_gmm_mpi_tpu.ops.seeding import kmeanspp_indices
+
+    data = np.zeros((3, 2))  # all-identical points: d2 collapses to 0
+    idx = kmeanspp_indices(data, 5, seed=0)
+    assert len(idx) == 5
+
+
+def test_seed_method_kmeanspp_end_to_end(rng):
+    from cuda_gmm_mpi_tpu.config import GMMConfig
+    from cuda_gmm_mpi_tpu.models import fit_gmm
+
+    data, centers = make_blobs(rng, n=1200, d=3, k=4)
+    cfg = GMMConfig(min_iters=8, max_iters=8, chunk_size=256, dtype="float64",
+                    seed_method="kmeans++", seed=3)
+    r = fit_gmm(data, 4, 4, config=cfg)
+    assert np.isfinite(r.final_loglik)
+    d = np.linalg.norm(r.means[:, None, :] - centers[None], axis=-1).min(0)
+    assert (d < 1.0).all(), d
+
+
+def test_seed_method_validation():
+    from cuda_gmm_mpi_tpu.config import GMMConfig
+
+    with pytest.raises(ValueError):
+        GMMConfig(seed_method="random")
