@@ -76,6 +76,9 @@ def build_parser() -> argparse.ArgumentParser:
                    help="RNG seed for randomized paths (kmeans++ seeding)")
     t.add_argument("--pallas", default="auto", choices=["auto", "always", "never"],
                    help="use the Pallas fused kernel")
+    t.add_argument("--fused-sweep", action="store_true",
+                   help="run the whole model-order sweep as one device "
+                   "program (fastest; no per-K checkpoints/profile)")
     t.add_argument("--mesh", default=None,
                    help="device mesh 'DATA[,CLUSTER]', e.g. --mesh=4 or "
                    "--mesh=4,2; default: all devices on the event axis")
@@ -129,6 +132,7 @@ def main(argv=None) -> int:
             seed_method=args.seed_method,
             seed=args.seed,
             use_pallas=args.pallas,
+            fused_sweep=args.fused_sweep,
             device=args.device,
             mesh_shape=_parse_mesh(args.mesh),
             enable_debug=args.debug,

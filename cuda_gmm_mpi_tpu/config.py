@@ -62,6 +62,12 @@ class GMMConfig:
     # Events per Pallas grid tile (the kernel's VMEM working set is
     # ~ block_b * D^2 floats for the outer products).
     pallas_block_b: int = 1024
+    # Run the ENTIRE model-order sweep as one jitted device program (zero
+    # host syncs between dispatch and final result). Opt-in fast path:
+    # incompatible with per-K checkpointing/profiling/verbose trajectories,
+    # single-controller unsharded models only (fit_gmm falls back to the
+    # host-driven sweep and warns when those are requested).
+    fused_sweep: bool = False
 
     # --- platform / parallelism ---
     device: Optional[str] = None  # None = JAX default platform

@@ -1,0 +1,141 @@
+"""The entire model-order search as ONE jitted device program.
+
+The reference's K-sweep is a host loop: per K it runs 100 EM iterations on
+the GPUs, copies the model up, scores/saves on the host, scans merge pairs
+on the host with an O(D^3) CPU inversion per pair, and broadcasts the merged
+model back (``gaussian.cu:479-960``). The host-driven sweep in
+``order_search.fit_gmm`` already collapses each of those phases into jitted
+calls with one sync per K; this module goes the rest of the way: EM loops,
+Rissanen scoring, best-model tracking, empty-cluster elimination, pair
+scans, and merges for EVERY K run inside a single ``lax.while_loop`` -- zero
+host round-trips between the initial dispatch and the final result. On a
+remote-TPU link (or any high-latency dispatch path) this removes the last
+per-K latency; the trade is no per-K logging/checkpointing, so it is the
+opt-in fast path (``GMMConfig.fused_sweep``) while the host loop remains the
+default.
+
+Semantics match the host sweep exactly (same save rule gaussian.cu:839, same
+termination conditions); parity is asserted in tests/test_fused_sweep.py.
+"""
+
+from __future__ import annotations
+
+from typing import Callable, Optional
+
+import jax
+import jax.numpy as jnp
+from jax import lax
+
+from ..ops.formulas import rissanen_score
+from ..ops.merge import eliminate_and_reduce
+from .gmm import em_while_loop
+
+
+def fused_sweep(
+    state,
+    data_chunks,
+    wts_chunks,
+    epsilon,
+    min_iters,
+    max_iters,
+    *,
+    start_k: int,
+    stop_number: int,
+    target_k: int,
+    num_events: int,
+    num_dimensions: int,
+    diag_only: bool = False,
+    quad_mode: str = "expanded",
+    matmul_precision: str = "highest",
+    cluster_axis: str | None = None,
+    stats_fn: Optional[Callable] = None,
+    reduce_stats: Optional[Callable] = None,
+):
+    """Run the whole K-sweep on device.
+
+    Returns ``(best_state, best_ll, best_riss, log, steps)`` where ``log``
+    is a [start_k, 4] array of per-K rows ``(k, loglik, rissanen, em_iters)``
+    (rows beyond ``steps`` are zero).
+    """
+    dtype = data_chunks.dtype
+
+    def riss_of(ll, k):
+        # rissanen_score is plain arithmetic + a static log: trace-safe.
+        return rissanen_score(ll, k.astype(ll.dtype), num_events,
+                              num_dimensions)
+
+    def em(s):
+        return em_while_loop(
+            s, data_chunks, wts_chunks, epsilon, min_iters, max_iters,
+            reduce_stats=reduce_stats, diag_only=diag_only,
+            quad_mode=quad_mode, matmul_precision=matmul_precision,
+            cluster_axis=cluster_axis, stats_fn=stats_fn,
+        )
+
+    zero = jnp.zeros((), dtype)
+    carry0 = dict(
+        state=state,
+        k=jnp.asarray(start_k, jnp.int32),
+        best_state=state,
+        best_ll=zero,
+        best_riss=jnp.asarray(jnp.inf, dtype),
+        log=jnp.zeros((start_k, 4), dtype),
+        step=jnp.asarray(0, jnp.int32),
+        done=jnp.asarray(False),
+    )
+
+    def cond(c):
+        return (~c["done"]) & (c["step"] < start_k)
+
+    def body(c):
+        k = c["k"]
+        s, ll, iters = em(c["state"])
+        riss = riss_of(ll, k)
+
+        # Best-model save rule (gaussian.cu:839): first K, or better rissanen
+        # with no target, or K equals the target.
+        save = (
+            (c["step"] == 0)
+            | ((riss < c["best_riss"]) & (target_k == 0))
+            | (k == target_k)
+        )
+        best_state = jax.tree_util.tree_map(
+            lambda new, old: jnp.where(save, new, old), s, c["best_state"]
+        )
+        log = c["log"].at[c["step"]].set(
+            jnp.stack([k.astype(dtype), ll.astype(dtype), riss.astype(dtype),
+                       iters.astype(dtype)])
+        )
+
+        stop_now = k <= stop_number
+        # Order reduction (dispatched unconditionally -- cheap relative to
+        # EM -- and discarded on the stop path, like the host loop).
+        next_state, k_active, min_d = eliminate_and_reduce(
+            s, diag_only=diag_only
+        )
+        k_active = k_active.astype(jnp.int32)  # x64 mode promotes the sum
+        can_merge = (k_active >= 2) & jnp.isfinite(min_d)
+        # The host loop re-checks `k >= stop_number` at the top after
+        # merging: if elimination dropped the count below the target there
+        # is no EM run at that K. Mirror it here or the fused path would run
+        # one extra EM below the target.
+        cont = (~stop_now) & can_merge & (k_active - 1 >= stop_number)
+        new_state = jax.tree_util.tree_map(
+            lambda a, b: jnp.where(cont, a, b), next_state, s
+        )
+        return dict(
+            state=new_state,
+            k=jnp.where(cont, k_active - 1, k),
+            best_state=best_state,
+            best_ll=jnp.where(save, ll.astype(dtype), c["best_ll"]),
+            best_riss=jnp.where(save, riss.astype(dtype), c["best_riss"]),
+            log=log,
+            step=c["step"] + 1,
+            done=~cont,
+        )
+
+    out = lax.while_loop(cond, body, carry0)
+    return (
+        out["best_state"], out["best_ll"], out["best_riss"],
+        out["log"], out["step"],
+    )

@@ -157,6 +157,26 @@ def fit_gmm(
     log.debug("epsilon=%s n=%d d=%d k=%d", epsilon, n_events, n_dims,
               num_clusters)
 
+    if config.fused_sweep:
+        blockers = [
+            name for name, on in [
+                ("checkpoint_dir", bool(config.checkpoint_dir)),
+                ("profile", config.profile),
+                ("mesh/sharded model", hasattr(model, "prepare")),
+            ] if on
+        ]
+        if blockers:
+            log.warning(
+                "fused_sweep disabled (%s requested); using the host-driven "
+                "sweep", ", ".join(blockers),
+            )
+        else:
+            return _run_fused_sweep(
+                model, config, state, chunks, wts, epsilon,
+                num_clusters, stop_number, target_num_clusters,
+                n_events, n_dims, shift, verbose,
+            )
+
     # One fused dispatch for the whole order-reduction step, so each K costs
     # a single blocking device->host sync (see eliminate_and_reduce).
     elim_reduce_fn = jax.jit(
@@ -286,6 +306,70 @@ def fit_gmm(
         sweep_log=sweep_log,
         profile=timer.as_dict() if timer else None,
         profile_report=timer.report() if timer else None,
+    )
+
+
+def _run_fused_sweep(model, config, state, chunks, wts, epsilon,
+                     num_clusters, stop_number, target_num_clusters,
+                     n_events, n_dims, shift, verbose):
+    """Whole-sweep-on-device path (models/fused_sweep.py): one dispatch,
+    one sync. Reconstructs the host sweep_log from the device log afterward
+    (per-K ``seconds`` are the amortized wall time -- individual K timings
+    do not exist off-device by design)."""
+    from .fused_sweep import fused_sweep
+
+    kw = model._kw
+    # Cache the jitted sweep on the model: a fresh jax.jit closure per call
+    # would retrace+recompile the whole program every fit (pass the same
+    # ``model=`` to fit_gmm to reuse the executable across fits).
+    cache = model.__dict__.setdefault("_fused_sweep_cache", {})
+    key = (num_clusters, stop_number, target_num_clusters, n_events, n_dims)
+    fused = cache.get(key)
+    if fused is None:
+        fused = cache[key] = jax.jit(functools.partial(
+            fused_sweep,
+            start_k=num_clusters, stop_number=stop_number,
+            target_k=target_num_clusters,
+            num_events=n_events, num_dimensions=n_dims,
+            stats_fn=model.stats_fn, reduce_stats=model.reduce_stats, **kw,
+        ))
+    dtype = chunks.dtype
+    t0 = time.perf_counter()
+    best_state, best_ll, best_riss, log_rows, steps = fused(
+        state, chunks, wts,
+        jnp.asarray(epsilon, dtype),
+        jnp.asarray(config.min_iters, jnp.int32),
+        jnp.asarray(config.max_iters, jnp.int32),
+    )
+    best_state, best_ll, best_riss, log_rows, steps = jax.device_get(
+        (best_state, best_ll, best_riss, log_rows, steps)
+    )
+    wall = time.perf_counter() - t0
+
+    steps = int(steps)
+    per_k = wall / max(steps, 1)
+    sweep_log = [
+        (int(row[0]), float(row[1]), float(row[2]), int(row[3]), per_k)
+        for row in np.asarray(log_rows)[:steps]
+    ]
+    if verbose:
+        for k_, ll_, riss_, it_, _ in sweep_log:
+            print(f"K={k_}: loglik={ll_:.6e} rissanen={riss_:.6e} "
+                  f"iters={it_} (fused)")
+    compact_state, n_active = compact(best_state)
+    if verbose:
+        print(f"Final rissanen score was: {float(best_riss)}, "
+              f"with {n_active} clusters.")  # gaussian.cu:962
+    return GMMResult(
+        state=compact_state,
+        ideal_num_clusters=n_active,
+        min_rissanen=float(best_riss),
+        final_loglik=float(best_ll),
+        epsilon=epsilon,
+        num_events=n_events,
+        num_dimensions=n_dims,
+        data_shift=np.asarray(shift),
+        sweep_log=sweep_log,
     )
 
 
