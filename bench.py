@@ -159,7 +159,6 @@ def main() -> int:
             # K..target_k (gaussian.cu:479-960) via the fused
             # whole-sweep-on-device program. First call compiles; the timed
             # call reuses the executable (same model => cached jit).
-            from cuda_gmm_mpi_tpu.models.gmm import GMMModel
             from cuda_gmm_mpi_tpu.models.order_search import fit_gmm
 
             fit_cfg = GMMConfig(min_iters=bench_iters, max_iters=bench_iters,

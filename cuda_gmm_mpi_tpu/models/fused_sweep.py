@@ -58,11 +58,17 @@ def fused_sweep(
     (rows beyond ``steps`` are zero).
     """
     dtype = data_chunks.dtype
+    # Score/compare in float64 when enabled so model selection matches the
+    # host loop exactly (it does this arithmetic in Python float64,
+    # order_search.py). Without x64 the comparison is best-effort float32:
+    # selection can differ from the host loop only when two Ks' Rissanen
+    # scores tie within ~1 ulp.
+    score_dtype = jnp.float64 if jax.config.jax_enable_x64 else dtype
 
     def riss_of(ll, k):
         # rissanen_score is plain arithmetic + a static log: trace-safe.
-        return rissanen_score(ll, k.astype(ll.dtype), num_events,
-                              num_dimensions)
+        return rissanen_score(ll.astype(score_dtype), k.astype(score_dtype),
+                              num_events, num_dimensions)
 
     def em(s):
         return em_while_loop(
@@ -78,7 +84,7 @@ def fused_sweep(
         k=jnp.asarray(start_k, jnp.int32),
         best_state=state,
         best_ll=zero,
-        best_riss=jnp.asarray(jnp.inf, dtype),
+        best_riss=jnp.asarray(jnp.inf, score_dtype),
         log=jnp.zeros((start_k, 4), dtype),
         step=jnp.asarray(0, jnp.int32),
         done=jnp.asarray(False),
@@ -128,7 +134,7 @@ def fused_sweep(
             k=jnp.where(cont, k_active - 1, k),
             best_state=best_state,
             best_ll=jnp.where(save, ll.astype(dtype), c["best_ll"]),
-            best_riss=jnp.where(save, riss.astype(dtype), c["best_riss"]),
+            best_riss=jnp.where(save, riss, c["best_riss"]),
             log=log,
             step=c["step"] + 1,
             done=~cont,

@@ -40,6 +40,17 @@ from ..ops.estep import posteriors
 ReduceFn = Callable[[SuffStats], SuffStats]
 
 
+def cached_fused_sweep(model, static: dict, build: Callable):
+    """Per-model memoization of the jitted whole-sweep executable (a fresh
+    jax.jit closure per fit would retrace+recompile every call)."""
+    cache = model.__dict__.setdefault("_fused_sweep_cache", {})
+    key = tuple(sorted(static.items()))
+    fn = cache.get(key)
+    if fn is None:
+        fn = cache[key] = build()
+    return fn
+
+
 def resolve_iters(config: GMMConfig, min_iters: Optional[int],
                   max_iters: Optional[int]):
     """Iteration bounds as dynamic int32 args (no recompile on change)."""
@@ -159,6 +170,18 @@ class GMMModel:
 
     def estep_stats(self, state, data_chunks, wts_chunks) -> SuffStats:
         return self._estep_stats(state, data_chunks, wts_chunks)
+
+    def make_fused_sweep(self, **static):
+        """Jitted whole-sweep-on-device callable (models/fused_sweep.py),
+        cached per static config so repeat fits reuse the executable."""
+        from .fused_sweep import fused_sweep
+
+        return cached_fused_sweep(self, static, lambda: jax.jit(
+            functools.partial(
+                fused_sweep, stats_fn=self.stats_fn,
+                reduce_stats=self.reduce_stats, **self._kw, **static,
+            )
+        ))
 
     def memberships(self, state, data_chunks, return_logz: bool = False):
         """Materialized posteriors [N_padded, K] -- output path only.

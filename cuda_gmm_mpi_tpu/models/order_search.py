@@ -162,9 +162,21 @@ def fit_gmm(
             name for name, on in [
                 ("checkpoint_dir", bool(config.checkpoint_dir)),
                 ("profile", config.profile),
-                ("mesh/sharded model", hasattr(model, "prepare")),
             ] if on
         ]
+        fused = None
+        if not blockers:
+            maker = getattr(model, "make_fused_sweep", None)
+            if maker is None:
+                blockers.append("model without fused-sweep support")
+            else:
+                fused = maker(
+                    start_k=num_clusters, stop_number=stop_number,
+                    target_k=target_num_clusters,
+                    num_events=n_events, num_dimensions=n_dims,
+                )
+                if fused is None:
+                    blockers.append("cluster-sharded mesh")
         if blockers:
             log.warning(
                 "fused_sweep disabled (%s requested); using the host-driven "
@@ -172,7 +184,7 @@ def fit_gmm(
             )
         else:
             return _run_fused_sweep(
-                model, config, state, chunks, wts, epsilon,
+                fused, config, state, chunks, wts, epsilon,
                 num_clusters, stop_number, target_num_clusters,
                 n_events, n_dims, shift, verbose,
             )
@@ -309,30 +321,15 @@ def fit_gmm(
     )
 
 
-def _run_fused_sweep(model, config, state, chunks, wts, epsilon,
+def _run_fused_sweep(fused, config, state, chunks, wts, epsilon,
                      num_clusters, stop_number, target_num_clusters,
                      n_events, n_dims, shift, verbose):
     """Whole-sweep-on-device path (models/fused_sweep.py): one dispatch,
-    one sync. Reconstructs the host sweep_log from the device log afterward
-    (per-K ``seconds`` are the amortized wall time -- individual K timings
-    do not exist off-device by design)."""
-    from .fused_sweep import fused_sweep
-
-    kw = model._kw
-    # Cache the jitted sweep on the model: a fresh jax.jit closure per call
-    # would retrace+recompile the whole program every fit (pass the same
-    # ``model=`` to fit_gmm to reuse the executable across fits).
-    cache = model.__dict__.setdefault("_fused_sweep_cache", {})
-    key = (num_clusters, stop_number, target_num_clusters, n_events, n_dims)
-    fused = cache.get(key)
-    if fused is None:
-        fused = cache[key] = jax.jit(functools.partial(
-            fused_sweep,
-            start_k=num_clusters, stop_number=stop_number,
-            target_k=target_num_clusters,
-            num_events=n_events, num_dimensions=n_dims,
-            stats_fn=model.stats_fn, reduce_stats=model.reduce_stats, **kw,
-        ))
+    one sync. ``fused`` comes from the model's ``make_fused_sweep`` (cached
+    there, so passing the same ``model=`` to fit_gmm reuses the executable).
+    Reconstructs the host sweep_log from the device log afterward (per-K
+    ``seconds`` are the amortized wall time -- individual K timings do not
+    exist off-device by design)."""
     dtype = chunks.dtype
     t0 = time.perf_counter()
     best_state, best_ll, best_riss, log_rows, steps = fused(

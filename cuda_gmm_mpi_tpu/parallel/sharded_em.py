@@ -87,6 +87,7 @@ class ShardedGMMModel:
         from ..ops.pallas import make_stats_fn
 
         stats_fn = make_stats_fn(config, cluster_sharded=cluster_axis is not None)
+        self._stats_fn = stats_fn
         em_fn = functools.partial(
             em_while_loop,
             reduce_stats=make_psum_reduce(DATA_AXIS),
@@ -199,6 +200,40 @@ class ShardedGMMModel:
             state, data_chunks, wts_chunks,
             jnp.asarray(epsilon, data_chunks.dtype), lo, hi,
         )
+
+    def make_fused_sweep(self, **static):
+        """Whole-sweep-on-device under shard_map (data-parallel meshes).
+
+        Returns None when the cluster axis is sharded: the merge machinery's
+        pair scan runs replicated per shard and would only see the local
+        cluster rows -- order reduction requires the full K-state on every
+        device (the data-parallel layout, which is also the reference's).
+        """
+        if self.cluster_size > 1:
+            return None
+        from ..models.fused_sweep import fused_sweep
+        from ..models.gmm import cached_fused_sweep
+
+        def build():
+            sweep_fn = functools.partial(
+                fused_sweep, stats_fn=self._stats_fn,
+                reduce_stats=make_psum_reduce(DATA_AXIS),
+                cluster_axis=None, **self._kw, **static,
+            )
+            sspec = state_pspecs()
+            scalar = P()
+            return jax.jit(
+                shard_map(
+                    sweep_fn,
+                    mesh=self.mesh,
+                    in_specs=(sspec, P(DATA_AXIS, None, None),
+                              P(DATA_AXIS, None), scalar, scalar, scalar),
+                    out_specs=(sspec, scalar, scalar, scalar, scalar),
+                    check_vma=False,
+                )
+            )
+
+        return cached_fused_sweep(self, static, build)
 
     def memberships(self, state, data_chunks) -> np.ndarray:
         state = jax.device_get(state)

@@ -72,3 +72,51 @@ def test_fused_parity_with_mass_elimination():
         assert rf.ideal_num_clusters == rh.ideal_num_clusters
         np.testing.assert_allclose(rf.min_rissanen, rh.min_rissanen,
                                    rtol=1e-12)
+
+
+def test_fused_sharded_data_parallel_matches_host(rng):
+    """Fused sweep under shard_map on an 8-device data mesh == plain host."""
+    data, _ = make_blobs(rng, n=1024, d=3, k=4)
+    r_host = fit_gmm(data, 6, 3, config=cfg())
+    r_fused = fit_gmm(data, 6, 3,
+                      config=cfg(fused_sweep=True, mesh_shape=(8, 1)))
+    assert r_fused.ideal_num_clusters == r_host.ideal_num_clusters
+    np.testing.assert_allclose(r_fused.min_rissanen, r_host.min_rissanen,
+                               rtol=1e-9)
+    np.testing.assert_allclose(r_fused.means, r_host.means, rtol=1e-7,
+                               atol=1e-9)
+    assert [row[0] for row in r_fused.sweep_log] == \
+           [row[0] for row in r_host.sweep_log]
+
+
+def test_fused_cluster_sharded_falls_back(rng):
+    """Cluster-axis sharding can't run the fused sweep; host path used.
+    (The package logger writes to stderr with propagate=False, so capture
+    with a temporary handler rather than caplog/capfd.)"""
+    import io
+    import logging
+
+    data, _ = make_blobs(rng, n=512, d=3, k=3)
+    buf = io.StringIO()
+    h = logging.StreamHandler(buf)
+    lg = logging.getLogger("cuda_gmm_mpi_tpu")
+    lg.addHandler(h)
+    try:
+        r = fit_gmm(data, 4, 2,
+                    config=cfg(fused_sweep=True, mesh_shape=(4, 2)))
+    finally:
+        lg.removeHandler(h)
+    assert r.ideal_num_clusters >= 2
+    assert "cluster-sharded mesh" in buf.getvalue()
+
+
+def test_fused_matches_host_float32(rng):
+    """Default-dtype (float32) parity: selection identical away from
+    Rissanen ~1-ulp ties (the documented float32 caveat in fused_sweep.py)."""
+    data, _ = make_blobs(rng, n=800, d=3, k=4, dtype=np.float32)
+    c32 = dict(min_iters=4, max_iters=4, chunk_size=256, dtype="float32")
+    rh = fit_gmm(data, 7, 0, config=GMMConfig(**c32))
+    rf = fit_gmm(data, 7, 0, config=GMMConfig(fused_sweep=True, **c32))
+    assert rf.ideal_num_clusters == rh.ideal_num_clusters
+    np.testing.assert_allclose(rf.final_loglik, rh.final_loglik, rtol=1e-6)
+    np.testing.assert_allclose(rf.means, rh.means, rtol=1e-4, atol=1e-5)
