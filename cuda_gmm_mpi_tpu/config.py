@@ -61,7 +61,7 @@ class GMMConfig:
     use_pallas: str = "auto"  # 'auto' | 'always' | 'never'
     # Events per Pallas grid tile (the kernel's VMEM working set is
     # ~ block_b * D^2 floats for the outer products).
-    pallas_block_b: int = 1024
+    pallas_block_b: int = 512  # best measured tile on v5e (docs/PERF.md)
     # Run the ENTIRE model-order sweep as one jitted device program (zero
     # host syncs between dispatch and final result). Opt-in fast path:
     # incompatible with per-K checkpointing/profiling/verbose trajectories,

@@ -167,7 +167,7 @@ def fused_stats_pallas(
     wts_chunks: jax.Array | None,
     *,
     diag_only: bool = False,
-    block_b: int = 1024,
+    block_b: int = 512,
     interpret: bool = False,
 ) -> SuffStats:
     """SuffStats for all chunks via the fused Pallas kernel.
