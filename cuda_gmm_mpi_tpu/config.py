@@ -63,10 +63,10 @@ class GMMConfig:
     # ~ block_b * D^2 floats for the outer products).
     pallas_block_b: int = 512  # best measured tile on v5e (docs/PERF.md)
     # Run the ENTIRE model-order sweep as one jitted device program (zero
-    # host syncs between dispatch and final result). Opt-in fast path:
-    # incompatible with per-K checkpointing/profiling/verbose trajectories,
-    # single-controller unsharded models only (fit_gmm falls back to the
-    # host-driven sweep and warns when those are requested).
+    # host syncs between dispatch and final result), on plain or sharded
+    # (any mesh layout) models. Opt-in fast path: incompatible with per-K
+    # checkpointing/profiling (fit_gmm falls back to the host-driven sweep
+    # and warns when those are requested).
     fused_sweep: bool = False
 
     # --- platform / parallelism ---

@@ -50,13 +50,21 @@ def fused_sweep(
     cluster_axis: str | None = None,
     stats_fn: Optional[Callable] = None,
     reduce_stats: Optional[Callable] = None,
+    reduce_order_fn: Optional[Callable] = None,
 ):
     """Run the whole K-sweep on device.
 
     Returns ``(best_state, best_ll, best_riss, log, steps)`` where ``log``
     is a [start_k, 4] array of per-K rows ``(k, loglik, rissanen, em_iters)``
     (rows beyond ``steps`` are zero).
+
+    ``reduce_order_fn(state) -> (new_state, k_active, min_d)`` overrides the
+    order-reduction step -- the hook through which the cluster-sharded path
+    substitutes an all-gather-then-reslice variant (the pair scan needs the
+    full K-state; see parallel/sharded_em.py).
     """
+    if reduce_order_fn is None:
+        reduce_order_fn = lambda s: eliminate_and_reduce(s, diag_only=diag_only)
     dtype = data_chunks.dtype
     # Score/compare in float64 when enabled so model selection matches the
     # host loop exactly (it does this arithmetic in Python float64,
@@ -116,9 +124,7 @@ def fused_sweep(
         stop_now = k <= stop_number
         # Order reduction (dispatched unconditionally -- cheap relative to
         # EM -- and discarded on the stop path, like the host loop).
-        next_state, k_active, min_d = eliminate_and_reduce(
-            s, diag_only=diag_only
-        )
+        next_state, k_active, min_d = reduce_order_fn(s)
         k_active = k_active.astype(jnp.int32)  # x64 mode promotes the sum
         can_merge = (k_active >= 2) & jnp.isfinite(min_d)
         # The host loop re-checks `k >= stop_number` at the top after

@@ -175,8 +175,6 @@ def fit_gmm(
                     target_k=target_num_clusters,
                     num_events=n_events, num_dimensions=n_dims,
                 )
-                if fused is None:
-                    blockers.append("cluster-sharded mesh")
         if blockers:
             log.warning(
                 "fused_sweep disabled (%s requested); using the host-driven "

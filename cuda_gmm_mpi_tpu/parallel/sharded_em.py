@@ -202,23 +202,48 @@ class ShardedGMMModel:
         )
 
     def make_fused_sweep(self, **static):
-        """Whole-sweep-on-device under shard_map (data-parallel meshes).
+        """Whole-sweep-on-device under shard_map, any mesh layout.
 
-        Returns None when the cluster axis is sharded: the merge machinery's
-        pair scan runs replicated per shard and would only see the local
-        cluster rows -- order reduction requires the full K-state on every
-        device (the data-parallel layout, which is also the reference's).
+        On cluster-sharded meshes the order-reduction step all-gathers the
+        K-state along the cluster axis (tiny: K x D x D), runs the
+        elimination + pair scan + merge replicated, and re-slices each
+        shard's rows -- the pair scan needs the full K-state, which each
+        device otherwise only holds 1/cluster_size of.
         """
-        if self.cluster_size > 1:
-            return None
         from ..models.fused_sweep import fused_sweep
         from ..models.gmm import cached_fused_sweep
+        from ..ops.merge import eliminate_and_reduce
+
+        cluster_axis = CLUSTER_AXIS if self.cluster_size > 1 else None
+        diag_only = self._kw["diag_only"]
+
+        reduce_order_fn = None
+        if cluster_axis is not None:
+            def reduce_order_fn(state):
+                full = jax.tree_util.tree_map(
+                    lambda a: lax.all_gather(a, cluster_axis, axis=0,
+                                            tiled=True),
+                    state,
+                )
+                new_full, k_active, min_d = eliminate_and_reduce(
+                    full, diag_only=diag_only
+                )
+                idx = lax.axis_index(cluster_axis)
+                k_local = state.N.shape[0]
+                new_local = jax.tree_util.tree_map(
+                    lambda a: lax.dynamic_slice_in_dim(
+                        a, idx * k_local, k_local, 0
+                    ),
+                    new_full,
+                )
+                return new_local, k_active, min_d
 
         def build():
             sweep_fn = functools.partial(
                 fused_sweep, stats_fn=self._stats_fn,
                 reduce_stats=make_psum_reduce(DATA_AXIS),
-                cluster_axis=None, **self._kw, **static,
+                cluster_axis=cluster_axis,
+                reduce_order_fn=reduce_order_fn, **self._kw, **static,
             )
             sspec = state_pspecs()
             scalar = P()

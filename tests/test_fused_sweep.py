@@ -89,25 +89,20 @@ def test_fused_sharded_data_parallel_matches_host(rng):
            [row[0] for row in r_host.sweep_log]
 
 
-def test_fused_cluster_sharded_falls_back(rng):
-    """Cluster-axis sharding can't run the fused sweep; host path used.
-    (The package logger writes to stderr with propagate=False, so capture
-    with a temporary handler rather than caplog/capfd.)"""
-    import io
-    import logging
-
+@pytest.mark.parametrize("mesh_shape", [(4, 2), (2, 4), (1, 8)])
+def test_fused_cluster_sharded_matches_host(rng, mesh_shape):
+    """Cluster-sharded fused sweep (all-gather order reduction) == host."""
     data, _ = make_blobs(rng, n=512, d=3, k=3)
-    buf = io.StringIO()
-    h = logging.StreamHandler(buf)
-    lg = logging.getLogger("cuda_gmm_mpi_tpu")
-    lg.addHandler(h)
-    try:
-        r = fit_gmm(data, 4, 2,
-                    config=cfg(fused_sweep=True, mesh_shape=(4, 2)))
-    finally:
-        lg.removeHandler(h)
-    assert r.ideal_num_clusters >= 2
-    assert "cluster-sharded mesh" in buf.getvalue()
+    r_host = fit_gmm(data, 5, 2, config=cfg())
+    r_fused = fit_gmm(data, 5, 2,
+                      config=cfg(fused_sweep=True, mesh_shape=mesh_shape))
+    assert r_fused.ideal_num_clusters == r_host.ideal_num_clusters
+    np.testing.assert_allclose(r_fused.min_rissanen, r_host.min_rissanen,
+                               rtol=1e-9)
+    np.testing.assert_allclose(r_fused.means, r_host.means, rtol=1e-7,
+                               atol=1e-9)
+    assert [row[0] for row in r_fused.sweep_log] == \
+           [row[0] for row in r_host.sweep_log]
 
 
 def test_fused_matches_host_float32(rng):
