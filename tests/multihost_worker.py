@@ -56,8 +56,18 @@ def main() -> int:
         centers[rng.integers(0, k, n)] + rng.normal(size=(n, d))
     ).astype(np.float64)
 
+    # Optional 4th arg selects the mesh: "data" (default, all devices on the
+    # event axis) or "2d" (data x cluster: 2-D sharding across the REAL
+    # process boundary -- each host owns one data-axis row, the cluster axis
+    # lives within a host).
+    mesh_kind = sys.argv[4] if len(sys.argv) > 4 else "data"
     cfg = GMMConfig(min_iters=4, max_iters=4, chunk_size=64, dtype="float64")
-    mesh = make_mesh()  # all 2*nproc global devices on the data axis
+    if mesh_kind == "2d":
+        mesh = make_mesh((nproc, 2))
+    elif mesh_kind == "data":
+        mesh = make_mesh()  # all 2*nproc global devices on the data axis
+    else:
+        raise ValueError(f"unknown mesh_kind {mesh_kind!r}")
     model = ShardedGMMModel(cfg, mesh=mesh)
 
     start, stop, num_chunks = host_chunk_bounds(

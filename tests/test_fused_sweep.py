@@ -89,11 +89,18 @@ def test_fused_sharded_data_parallel_matches_host(rng):
            [row[0] for row in r_host.sweep_log]
 
 
-@pytest.mark.parametrize("mesh_shape", [(4, 2), (2, 4), (1, 8)])
-def test_fused_cluster_sharded_matches_host(rng, mesh_shape):
-    """Cluster-sharded fused sweep (all-gather order reduction) == host."""
+@pytest.fixture(scope="module")
+def cluster_blob_case():
+    """Shared (data, host-path result) so the baseline fit runs once."""
+    rng = np.random.default_rng(1234)
     data, _ = make_blobs(rng, n=512, d=3, k=3)
-    r_host = fit_gmm(data, 5, 2, config=cfg())
+    return data, fit_gmm(data, 5, 2, config=cfg())
+
+
+@pytest.mark.parametrize("mesh_shape", [(4, 2), (2, 4), (1, 8)])
+def test_fused_cluster_sharded_matches_host(cluster_blob_case, mesh_shape):
+    """Cluster-sharded fused sweep (all-gather order reduction) == host."""
+    data, r_host = cluster_blob_case
     r_fused = fit_gmm(data, 5, 2,
                       config=cfg(fused_sweep=True, mesh_shape=mesh_shape))
     assert r_fused.ideal_num_clusters == r_host.ideal_num_clusters

@@ -24,14 +24,14 @@ def _free_port() -> int:
         return s.getsockname()[1]
 
 
-def _run_workers(nproc: int, timeout: float = 300.0):
+def _run_workers(nproc: int, timeout: float = 300.0, mesh_kind: str = "data"):
     from .conftest import worker_env
 
     port = _free_port()
     env = worker_env()
     procs = [
         subprocess.Popen(
-            [sys.executable, WORKER, str(i), str(nproc), str(port)],
+            [sys.executable, WORKER, str(i), str(nproc), str(port), mesh_kind],
             stdout=subprocess.PIPE, stderr=subprocess.PIPE, env=env, text=True,
         )
         for i in range(nproc)
@@ -126,3 +126,24 @@ def test_two_process_distributed_em_matches_single():
     np.testing.assert_allclose(ll0, float(ll), rtol=1e-9)
     np.testing.assert_allclose(m0, np.asarray(jax.device_get(s.means))[0],
                                rtol=1e-7, atol=1e-10)
+
+
+@pytest.mark.slow
+def test_two_process_2d_mesh_matches_data_mesh():
+    """2-D (data x cluster) sharding across a REAL process boundary: the
+    cluster axis lives within each host, the data-axis psum crosses hosts,
+    and the result must equal the pure data-parallel layout's."""
+    outs_2d = _run_workers(2, mesh_kind="2d")
+    outs_1d = _run_workers(2, mesh_kind="data")
+    results = []
+    for outs in (outs_2d, outs_1d):
+        for rc, out, err in outs:  # every rank must have succeeded
+            assert rc == 0, f"worker failed (rc={rc}):\n{out}\n{err[-3000:]}"
+        rc, out, err = outs[0]
+        lines = [l for l in out.splitlines() if l.startswith("RESULT ")]
+        assert lines, f"no RESULT line:\n{out}\n{err[-2000:]}"
+        results.append(_parse(lines[0]))
+    (ll2, it2, m2), (ll1, it1, m1) = results
+    assert it2 == it1 == 4
+    np.testing.assert_allclose(ll2, ll1, rtol=1e-9)
+    np.testing.assert_allclose(m2, m1, rtol=1e-7, atol=1e-10)
