@@ -132,6 +132,29 @@ class GaussianMixture:
         """Mean per-event log-likelihood."""
         return float(np.mean(self.score_samples(X)))
 
+    def _n_free_params(self) -> float:
+        """Free parameters actually estimated by the fitted model (diagonal
+        covariances count D, not D(D+1)/2; the weight simplex removes 1)."""
+        from .ops.formulas import n_free_params
+
+        return n_free_params(self.n_components_,
+                             self._fitted.num_dimensions,
+                             diag_only=self.config.diag_only)
+
+    def bic(self, X: np.ndarray) -> float:
+        """Bayesian information criterion on X (lower is better) -- the
+        scikit-learn-familiar sibling of the Rissanen/MDL score the order
+        search minimizes (they differ only in the reference's N*D vs N
+        sample-count convention)."""
+        n = np.asarray(X).shape[0]
+        ll = float(np.sum(self.score_samples(X)))
+        return -2.0 * ll + self._n_free_params() * float(np.log(n))
+
+    def aic(self, X: np.ndarray) -> float:
+        """Akaike information criterion on X (lower is better)."""
+        ll = float(np.sum(self.score_samples(X)))
+        return -2.0 * ll + 2.0 * self._n_free_params()
+
     def sample(self, n_samples: int, seed: Optional[int] = None) -> np.ndarray:
         """Draw events from the fitted mixture (generation -- absent from the
         reference, natural for a library estimator)."""

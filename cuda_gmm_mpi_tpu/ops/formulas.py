@@ -15,9 +15,26 @@ from __future__ import annotations
 import math
 
 
-def free_params_per_cluster(num_dimensions: int) -> float:
+def free_params_per_cluster(num_dimensions: int,
+                            diag_only: bool = False) -> float:
     d = num_dimensions
-    return 1.0 + d + 0.5 * (d + 1) * d
+    cov = float(d) if diag_only else 0.5 * (d + 1) * d
+    return 1.0 + d + cov
+
+
+def n_free_params(num_clusters, num_dimensions: int,
+                  diag_only: bool = False):
+    """Total free parameters of a K-component model: K per-cluster counts
+    minus the weight-simplex constraint (the ``-1`` in gaussian.cu:826).
+
+    Note: the reference's Rissanen formula always uses the FULL-covariance
+    per-cluster count, even in its DIAG_ONLY build -- ``rissanen_score``
+    reproduces that; information-criterion APIs that should count what the
+    model actually estimates pass ``diag_only``.
+    """
+    return num_clusters * free_params_per_cluster(
+        num_dimensions, diag_only=diag_only
+    ) - 1.0
 
 
 def convergence_epsilon(
@@ -33,6 +50,8 @@ def convergence_epsilon(
 def rissanen_score(
     loglik: float, num_clusters: int, num_events: int, num_dimensions: int
 ) -> float:
-    return -loglik + 0.5 * (
-        num_clusters * free_params_per_cluster(num_dimensions) - 1.0
+    # Always the full-covariance parameter count (reference behavior even
+    # under DIAG_ONLY; see n_free_params).
+    return -loglik + 0.5 * n_free_params(
+        num_clusters, num_dimensions
     ) * math.log(float(num_events) * num_dimensions)

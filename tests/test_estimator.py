@@ -89,3 +89,34 @@ def test_unfitted_raises():
 def test_config_exclusivity():
     with pytest.raises(ValueError):
         GaussianMixture(2, config=GMMConfig(), min_iters=5)
+
+
+def test_bic_aic(fitted):
+    from cuda_gmm_mpi_tpu.ops.formulas import n_free_params
+
+    gm, data, _ = fitted
+    n, d = data.shape
+    ll = float(np.sum(gm.score_samples(data)))
+    p = n_free_params(gm.n_components_, d)
+    np.testing.assert_allclose(gm.bic(data), -2 * ll + p * np.log(n),
+                               rtol=1e-12)
+    np.testing.assert_allclose(gm.aic(data), -2 * ll + 2 * p, rtol=1e-12)
+    # a 1-component fit of clearly multi-modal data must score worse
+    gm1 = GaussianMixture(1, 1, config=gm.config).fit(data)
+    assert gm1.bic(data) > gm.bic(data)
+
+
+def test_bic_counts_diagonal_params(fitted):
+    """Diagonal-covariance fits must count D variance params per cluster,
+    not D(D+1)/2 (sklearn's covariance_type-aware convention)."""
+    from cuda_gmm_mpi_tpu.ops.formulas import n_free_params
+
+    _, data, _ = fitted
+    n, d = data.shape
+    gm = GaussianMixture(3, 3, min_iters=6, max_iters=6, chunk_size=128,
+                         diag_only=True).fit(data)
+    ll = float(np.sum(gm.score_samples(data)))
+    p = n_free_params(3, d, diag_only=True)
+    assert p == 3 * (1 + 2 * d) - 1
+    np.testing.assert_allclose(gm.bic(data), -2 * ll + p * np.log(n),
+                               rtol=1e-12)
