@@ -74,6 +74,9 @@ def build_parser() -> argparse.ArgumentParser:
                    "k-means++ D^2-weighted sampling (--seed sets its RNG)")
     t.add_argument("--seed", type=int, default=0,
                    help="RNG seed for randomized paths (kmeans++ seeding)")
+    t.add_argument("--n-init", type=int, default=1,
+                   help="independent restarts with varied kmeans++ seeds; "
+                   "best Rissanen kept (1 = reference single-init)")
     t.add_argument("--pallas", default="auto", choices=["auto", "always", "never"],
                    help="use the Pallas fused kernel")
     t.add_argument("--fused-sweep", action="store_true",
@@ -131,6 +134,7 @@ def main(argv=None) -> int:
             center_data=not args.no_center,
             seed_method=args.seed_method,
             seed=args.seed,
+            n_init=args.n_init,
             use_pallas=args.pallas,
             fused_sweep=args.fused_sweep,
             device=args.device,

@@ -90,6 +90,12 @@ class GMMConfig:
     # (gaussian.cu:108-123); 'kmeans++' = D^2-weighted sampling (upgrade,
     # deterministic given ``seed``).
     seed_method: str = "even"
+    # Independent restarts (sklearn's n_init): fit n_init times with
+    # kmeans++ seeds seed, seed+1, ... and keep the best Rissanen score.
+    # 1 = reference behavior (single deterministic init). Restarts share the
+    # compiled executables (no recompilation); host-side data prep and the
+    # device upload repeat per restart.
+    n_init: int = 1
     # Numerical-sanitizer analog (SURVEY SS5.2: the reference has no race
     # detection / sanitizers; JAX's functional model removes data races, and
     # this enables the remaining useful check -- trap NaN/Inf at the op that
@@ -113,6 +119,8 @@ class GMMConfig:
             raise ValueError("chunk_size must be >= 1")
         if self.pallas_block_b < 1:
             raise ValueError("pallas_block_b must be >= 1")
+        if self.n_init < 1:
+            raise ValueError("n_init must be >= 1")
 
 
 DEFAULT_CONFIG = GMMConfig()

@@ -15,6 +15,8 @@ from __future__ import annotations
 import contextlib
 import dataclasses
 import functools
+import math
+import os
 import time
 from typing import Optional
 
@@ -104,10 +106,15 @@ def fit_gmm(
         # The runtime replacement for the reference's compile-time DEVICE
         # (gaussian.h:19) + the north-star --device flag. config.update (not
         # just env) because preloading sitecustomize hooks may have consumed
-        # JAX_PLATFORMS already.
+        # JAX_PLATFORMS already. Must run before ANY device discovery --
+        # including _fit_with_restarts' model/mesh construction.
         jax.config.update("jax_platforms", config.device)
     if config.debug_nans:
         jax.config.update("jax_debug_nans", True)
+
+    if config.n_init > 1:
+        return _fit_with_restarts(data, num_clusters, target_num_clusters,
+                                  config, model, verbose)
 
     log = get_logger(config)
     timer = PhaseTimer() if config.profile else None
@@ -317,6 +324,50 @@ def fit_gmm(
         profile=timer.as_dict() if timer else None,
         profile_report=timer.report() if timer else None,
     )
+
+
+def _fit_with_restarts(data, num_clusters, target_num_clusters, config,
+                       model, verbose):
+    """n_init independent fits, keep the best Rissanen (capability upgrade;
+    the reference's single deterministic init showed local-optima misses).
+
+    Restarts vary the kmeans++ seed (evenly-spaced seeding is deterministic,
+    so restarting it would be pointless); the same model instance is reused
+    across restarts so compiled executables are shared.
+    """
+    log = get_logger(config)
+    if config.seed_method != "kmeans++":
+        log.info("n_init=%d forces seed_method='kmeans++' (the 'even' "
+                 "seeding is deterministic)", config.n_init)
+    if model is None:  # one model => executables shared across restarts
+        if config.mesh_shape is not None:
+            from ..parallel import ShardedGMMModel
+
+            model = ShardedGMMModel(config)
+        else:
+            model = GMMModel(config)
+    best = None
+    for i in range(config.n_init):
+        sub = dataclasses.replace(
+            config, n_init=1, seed_method="kmeans++", seed=config.seed + i,
+            checkpoint_dir=(os.path.join(config.checkpoint_dir, f"init{i}")
+                            if config.checkpoint_dir else None),
+        )
+        r = fit_gmm(data, num_clusters, target_num_clusters, config=sub,
+                    model=model, verbose=verbose)
+        if verbose:
+            print(f"init {i}: rissanen={r.min_rissanen:.6e} "
+                  f"K={r.ideal_num_clusters}")
+        # NaN-safe best pick: a degenerate init (NaN rissanen) must never
+        # shadow later finite restarts ('finite < NaN' is False).
+        if (best is None or math.isnan(best.min_rissanen)
+                or r.min_rissanen < best.min_rissanen):
+            best = r
+    if verbose:
+        print(f"best of {config.n_init} inits: "
+              f"rissanen={best.min_rissanen:.6e} "
+              f"K={best.ideal_num_clusters}")
+    return best
 
 
 def _run_fused_sweep(fused, config, state, chunks, wts, epsilon,

@@ -63,3 +63,34 @@ def test_single_cluster(rng):
     result = fit_gmm(data, 1, 1, config=fast_cfg())
     assert result.ideal_num_clusters == 1
     np.testing.assert_allclose(result.means[0], data.mean(0), rtol=1e-5)
+
+
+def test_n_init_restarts_pick_best(rng):
+    """n_init restarts never do worse than any single init they contain,
+    and fix the local-optimum miss the single deterministic init can hit."""
+    from .conftest import make_blobs
+
+    data, _ = make_blobs(rng, n=900, d=3, k=4)
+    kw = dict(min_iters=8, max_iters=8, chunk_size=256, dtype="float64")
+    singles = [
+        fit_gmm(data, 4, 4, config=GMMConfig(
+            seed_method="kmeans++", seed=s, **kw))
+        for s in range(3)
+    ]
+    multi = fit_gmm(data, 4, 4, config=GMMConfig(n_init=3, seed=0, **kw))
+    assert multi.min_rissanen <= min(s.min_rissanen for s in singles) + 1e-9
+    # deterministic: same seeds -> same pick
+    multi2 = fit_gmm(data, 4, 4, config=GMMConfig(n_init=3, seed=0, **kw))
+    np.testing.assert_allclose(multi2.min_rissanen, multi.min_rissanen,
+                               rtol=1e-12)
+
+
+def test_n_init_with_fused_sweep(rng):
+    from .conftest import make_blobs
+
+    data, _ = make_blobs(rng, n=600, d=3, k=3)
+    kw = dict(min_iters=5, max_iters=5, chunk_size=256, dtype="float64")
+    r1 = fit_gmm(data, 5, 3, config=GMMConfig(n_init=2, **kw))
+    r2 = fit_gmm(data, 5, 3, config=GMMConfig(n_init=2, fused_sweep=True, **kw))
+    np.testing.assert_allclose(r2.min_rissanen, r1.min_rissanen, rtol=1e-10)
+    assert r2.ideal_num_clusters == r1.ideal_num_clusters
