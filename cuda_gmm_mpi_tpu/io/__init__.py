@@ -1,9 +1,12 @@
 """Data I/O layer (SURVEY L0): readers, writers, native fast paths."""
 
-from .readers import read_bin, read_csv, read_data, write_bin
+from .readers import (
+    FileSource, data_shape, read_bin, read_csv, read_data, read_rows,
+    write_bin,
+)
 from .writers import write_results, write_summary
 
 __all__ = [
-    "read_bin", "read_csv", "read_data", "write_bin",
-    "write_results", "write_summary",
+    "FileSource", "data_shape", "read_bin", "read_csv", "read_data",
+    "read_rows", "write_bin", "write_results", "write_summary",
 ]

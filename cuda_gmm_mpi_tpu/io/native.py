@@ -72,6 +72,19 @@ def _load() -> Optional[ctypes.CDLL]:
             ctypes.POINTER(ctypes.c_int64),
             ctypes.POINTER(ctypes.POINTER(ctypes.c_float)),
         ]
+        lib.gmm_data_shape.restype = ctypes.c_int
+        lib.gmm_data_shape.argtypes = [
+            ctypes.c_char_p,
+            ctypes.POINTER(ctypes.c_int64),
+            ctypes.POINTER(ctypes.c_int64),
+        ]
+        lib.gmm_read_range.restype = ctypes.c_int
+        lib.gmm_read_range.argtypes = [
+            ctypes.c_char_p, ctypes.c_int64, ctypes.c_int64,
+            ctypes.POINTER(ctypes.c_int64),
+            ctypes.POINTER(ctypes.c_int64),
+            ctypes.POINTER(ctypes.POINTER(ctypes.c_float)),
+        ]
         lib.gmm_free.restype = None
         lib.gmm_free.argtypes = [ctypes.POINTER(ctypes.c_float)]
         lib.gmm_write_results.restype = ctypes.c_int
@@ -109,6 +122,44 @@ def read_data(path: str) -> np.ndarray:
                            ctypes.byref(buf))
     if rc != 0:
         raise ValueError(f"native reader failed on {path!r} (rc={rc})")
+    try:
+        arr = np.ctypeslib.as_array(buf, shape=(n.value, d.value)).copy()
+    finally:
+        lib.gmm_free(buf)
+    return arr
+
+
+def data_shape(path: str):
+    """(num_events, num_dims) without loading the payload (BIN: header only;
+    CSV: one streaming pass, O(1) memory)."""
+    lib = _load()
+    if lib is None:
+        raise RuntimeError("native gmm_io library unavailable")
+    n = ctypes.c_int64()
+    d = ctypes.c_int64()
+    rc = lib.gmm_data_shape(path.encode(), ctypes.byref(n), ctypes.byref(d))
+    if rc != 0:
+        raise ValueError(f"native shape probe failed on {path!r} (rc={rc})")
+    return n.value, d.value
+
+
+def read_range(path: str, start: int, stop=None) -> np.ndarray:
+    """Rows [start, stop) as float32 [rows, D]; peak memory O(slice).
+    ``stop=None`` reads to the end of the file in a single pass."""
+    lib = _load()
+    if lib is None:
+        raise RuntimeError("native gmm_io library unavailable")
+    n = ctypes.c_int64()
+    d = ctypes.c_int64()
+    buf = ctypes.POINTER(ctypes.c_float)()
+    rc = lib.gmm_read_range(path.encode(), start,
+                            -1 if stop is None else stop,
+                            ctypes.byref(n), ctypes.byref(d),
+                            ctypes.byref(buf))
+    if rc != 0:
+        raise ValueError(
+            f"native range read failed on {path!r}[{start}:{stop}] (rc={rc})"
+        )
     try:
         arr = np.ctypeslib.as_array(buf, shape=(n.value, d.value)).copy()
     finally:
@@ -159,7 +210,15 @@ class ResultsWriter:
     def __enter__(self):
         return self
 
-    def __exit__(self, *exc):
+    def __exit__(self, exc_type, exc, tb):
+        if exc_type is not None:
+            # An exception is already propagating (e.g. append() failed);
+            # a failing close() must not mask it.
+            try:
+                self.close()
+            except IOError:
+                pass
+            return False
         self.close()
 
 

@@ -1,4 +1,4 @@
-"""Input data readers: CSV (with header drop) and BIN.
+"""Input data readers: CSV (with header drop) and BIN, with range support.
 
 Python/NumPy implementation of the reference's ``readData.cpp`` semantics, with
 an optional native C++ fast path (see ``cuda_gmm_mpi_tpu.io.native``) that this
@@ -13,45 +13,108 @@ Reference semantics reproduced exactly:
   LINE IS DROPPED as a header (readData.cpp:84); blank lines skipped
   (readData.cpp:61); ragged rows -> error (readData.cpp:104-107); fields parsed
   with atof semantics (invalid text parses as 0.0)
+
+Beyond the reference, every reader takes an optional ``[start, stop)`` row
+range and streams: peak memory is O(slice), never O(file). This is what makes
+the anti-``MPI_Bcast`` design real -- the reference broadcasts the ENTIRE
+dataset to every node (gaussian.cu:191-201); here each host of a
+multi-controller run reads only its contiguous slice (BIN seeks it directly,
+CSV single-pass-scans with a bounded buffer).
 """
 
 from __future__ import annotations
 
-import os
-from typing import Tuple
+from typing import Optional, Tuple
 
 import numpy as np
 
 
-def read_data(path: str, use_native: str = "auto") -> np.ndarray:
-    """Read events as a float32 [num_events, num_dimensions] array.
+def read_data(path: str, start: int = 0, stop: Optional[int] = None,
+              use_native: str = "auto") -> np.ndarray:
+    """Read events [start, stop) as a float32 [rows, num_dimensions] array.
 
-    ``use_native``: 'auto' tries the C++ reader and falls back to Python;
-    'always' requires it; 'never' forces the Python path.
+    Default range is the whole file. ``use_native``: 'auto' tries the C++
+    reader and falls back to Python; 'always' requires it; 'never' forces the
+    Python path.
+    """
+    _check_range(path, start, stop)
+    if use_native != "never":
+        from . import native
+
+        if native.available():
+            if start == 0 and stop is None:
+                return native.read_data(path)
+            return native.read_range(path, start, stop)
+        if use_native == "always":
+            raise RuntimeError("native gmm_io library unavailable "
+                               "(use_native='always')")
+    if path.endswith("bin"):
+        return read_bin(path, start, stop)
+    return read_csv(path, start, stop)
+
+
+def _check_range(path: str, start: int, stop: Optional[int]) -> None:
+    """Uniform sign/order validation so every backend (native C, Python BIN,
+    Python CSV) rejects the same inputs -- a negative stop must never reach
+    the native layer, where it would be read as the to-end sentinel."""
+    if start < 0 or (stop is not None and stop < start):
+        raise ValueError(f"{path}: invalid row range [{start}, {stop})")
+
+
+def data_shape(path: str, use_native: str = "auto") -> Tuple[int, int]:
+    """(num_events, num_dimensions) without loading the payload.
+
+    BIN reads the 8-byte header; CSV makes one streaming pass counting
+    non-blank lines (minus the header) -- O(1) memory either way.
     """
     if use_native != "never":
         from . import native
 
         if native.available():
-            return native.read_data(path)
+            return native.data_shape(path)
         if use_native == "always":
             raise RuntimeError("native gmm_io library unavailable "
                                "(use_native='always')")
     if path.endswith("bin"):
-        return read_bin(path)
-    return read_csv(path)
+        with open(path, "rb") as f:
+            header = np.fromfile(f, dtype=np.int32, count=2)
+        if header.size != 2:
+            raise ValueError(f"{path}: truncated BIN header")
+        return int(header[0]), int(header[1])
+    num_dims = None
+    count = 0
+    for _, line in _iter_csv_lines(path):
+        if num_dims is None:
+            num_dims = line.count(",") + 1
+        count += 1
+    if num_dims is None or count < 2:
+        raise ValueError(f"{path}: no data rows after header")
+    return count - 1, num_dims
 
 
-def read_bin(path: str) -> np.ndarray:
+def read_bin(path: str, start: int = 0,
+             stop: Optional[int] = None) -> np.ndarray:
+    """BIN rows [start, stop): header + one fseek + one bounded fromfile
+    (readData.cpp:35-47 layout; trivially seekable, SURVEY.md SS2.4)."""
+    _check_range(path, start, stop)
     with open(path, "rb") as f:
         header = np.fromfile(f, dtype=np.int32, count=2)
         if header.size != 2:
             raise ValueError(f"{path}: truncated BIN header")
         num_events, num_dims = int(header[0]), int(header[1])
-        data = np.fromfile(f, dtype=np.float32, count=num_events * num_dims)
-    if data.size != num_events * num_dims:
+        if stop is None:
+            stop = num_events
+        if not (0 <= start <= stop <= num_events):
+            raise ValueError(
+                f"{path}: range [{start}, {stop}) out of bounds for "
+                f"{num_events} events"
+            )
+        f.seek(8 + start * num_dims * 4)
+        rows = stop - start
+        data = np.fromfile(f, dtype=np.float32, count=rows * num_dims)
+    if data.size != rows * num_dims:
         raise ValueError(f"{path}: truncated BIN payload")
-    return data.reshape(num_events, num_dims)
+    return data.reshape(rows, num_dims)
 
 
 def _atof(s: str) -> float:
@@ -69,35 +132,184 @@ def _atof(s: str) -> float:
         return 0.0
 
 
-def read_csv(path: str) -> np.ndarray:
+def _iter_csv_lines(path: str):
+    """Yield (line_index, stripped_line) for non-blank lines; index 0 is the
+    header. Streams the file -- never holds more than one line."""
+    idx = 0
     with open(path, "r") as f:
-        lines = [ln for ln in (raw.strip("\r\n") for raw in f) if ln != ""]
-    if not lines:
-        raise ValueError(f"{path}: empty input file")
+        for raw in f:
+            line = raw.strip("\r\n")
+            if line == "":
+                continue  # blank lines skipped (readData.cpp:61)
+            yield idx, line
+            idx += 1
 
-    num_dims = len(lines[0].split(","))
-    body = lines[1:]  # first line dropped as header (readData.cpp:84)
-    num_events = len(body)
-    if num_events == 0:
-        raise ValueError(f"{path}: no data rows after header")
 
-    # Fast path: try numpy's parser; fall back to atof semantics row-by-row.
+def _parse_fields(fields, out_row):
     try:
-        data = np.genfromtxt(body, delimiter=",", dtype=np.float32)
-        data = np.atleast_2d(data)
-        if data.shape[1] != num_dims or np.isnan(data).any():
-            raise ValueError
-    except Exception:
-        data = np.empty((num_events, num_dims), np.float32)
-        for i, ln in enumerate(body):
-            fields = ln.split(",")
+        for j, s in enumerate(fields):
+            out_row[j] = float(s)
+    except ValueError:
+        for j, s in enumerate(fields):
+            out_row[j] = _atof(s)
+
+
+def read_csv(path: str, start: int = 0,
+             stop: Optional[int] = None) -> np.ndarray:
+    """CSV rows [start, stop), streaming: one pass, O(slice) peak memory.
+
+    The first non-blank line is dropped as a header (readData.cpp:84) and sets
+    the dimension count; ragged rows among those read raise (readData.cpp:
+    104-107). With a bounded ``stop`` the scan exits early at the range end.
+    """
+    _check_range(path, start, stop)
+    num_dims = None
+    data = None
+    seen = 0
+    grow = 0
+    total_rows = 0
+    for idx, line in _iter_csv_lines(path):
+        if idx == 0:
+            num_dims = line.count(",") + 1
+            continue
+        row = idx - 1
+        total_rows = row + 1
+        if row < start:
+            continue
+        if stop is not None and row >= stop:
+            break
+        fields = line.split(",")
+        if len(fields) != num_dims:
+            raise ValueError(
+                f"{path}: row {idx + 1} has {len(fields)} fields, "
+                f"expected {num_dims}"
+            )
+        if data is None:
+            # Bounded initial allocation: rows arrive from the scan, so an
+            # absurd stop errors at EOF instead of OOMing up front.
+            grow = min(stop - start, 65536) if stop is not None else 4096
+            data = np.empty((max(grow, 1), num_dims), np.float32)
+        elif seen == data.shape[0]:  # amortized doubling
+            add = data.shape[0]
+            if stop is not None:
+                add = min(add, (stop - start) - data.shape[0])
+            data = np.concatenate(
+                [data, np.empty((max(add, 1), num_dims), np.float32)]
+            )
+        _parse_fields(fields, data[seen])
+        seen += 1
+    if num_dims is None:
+        raise ValueError(f"{path}: empty input file")
+    want = None if stop is None else stop - start
+    if seen == 0 and start == 0 and want is None:
+        raise ValueError(f"{path}: no data rows after header")
+    if want is not None and seen != want:
+        raise ValueError(
+            f"{path}: range [{start}, {stop}) out of bounds "
+            f"({seen} rows available in range)"
+        )
+    if want is None and start > total_rows:
+        # Same contract as the BIN/native paths: a start past EOF is an
+        # error, not an empty shard (it would hide a sharding bug upstream).
+        raise ValueError(
+            f"{path}: range start {start} out of bounds for {total_rows} rows"
+        )
+    if data is None:
+        return np.zeros((0, num_dims), np.float32)
+    return data[:seen]
+
+
+def read_rows(path: str, indices, use_native: str = "auto") -> np.ndarray:
+    """Gather specific rows by index (order preserved, duplicates allowed).
+
+    The seeding primitive for per-host loading: evenly-spaced seed rows
+    (gaussian.cu:110-121) can be fetched without reading the dataset. BIN
+    seeks each unique row; CSV makes one streaming pass collecting the wanted
+    rows -- O(len(indices)) memory either way. The gather itself always runs
+    in Python (it is seek-bound, not parse-bound); ``use_native='always'``
+    still asserts the native library is present for deployment consistency.
+    """
+    if use_native == "always":
+        from . import native
+
+        if not native.available():
+            raise RuntimeError("native gmm_io library unavailable "
+                               "(use_native='always')")
+    indices = np.asarray(indices, np.int64)
+    if indices.size == 0:
+        n, d = data_shape(path, use_native=use_native)
+        return np.zeros((0, d), np.float32)
+    uniq = np.unique(indices)
+    if path.endswith("bin"):
+        with open(path, "rb") as f:
+            header = np.fromfile(f, dtype=np.int32, count=2)
+            if header.size != 2:
+                raise ValueError(f"{path}: truncated BIN header")
+            num_events, num_dims = int(header[0]), int(header[1])
+            if uniq[0] < 0 or uniq[-1] >= num_events:
+                raise ValueError(f"{path}: row index out of bounds")
+            rows = {}
+            for i in uniq:
+                f.seek(8 + int(i) * num_dims * 4)
+                r = np.fromfile(f, dtype=np.float32, count=num_dims)
+                if r.size != num_dims:
+                    raise ValueError(f"{path}: truncated BIN payload")
+                rows[int(i)] = r
+    else:
+        want = set(int(i) for i in uniq)
+        rows = {}
+        num_dims = None
+        for idx, line in _iter_csv_lines(path):
+            if idx == 0:
+                num_dims = line.count(",") + 1
+                continue
+            row = idx - 1
+            if row not in want:
+                continue
+            fields = line.split(",")
             if len(fields) != num_dims:
                 raise ValueError(
-                    f"{path}: row {i + 2} has {len(fields)} fields, "
+                    f"{path}: row {idx + 1} has {len(fields)} fields, "
                     f"expected {num_dims}"
                 )
-            data[i] = [_atof(fields[j]) for j in range(num_dims)]
-    return data
+            out = np.empty((num_dims,), np.float32)
+            _parse_fields(fields, out)
+            rows[row] = out
+            if len(rows) == len(want):
+                break
+        if len(rows) != len(want):
+            raise ValueError(f"{path}: row index out of bounds")
+    return np.stack([rows[int(i)] for i in indices])
+
+
+class FileSource:
+    """A dataset file as a random-access row source.
+
+    The loading interface consumed by the multi-host fit path: ``shape`` probes
+    cheaply, ``read_range``/``read_rows`` pull only what the caller needs, so a
+    host's resident footprint is its slice -- the turnkey replacement for the
+    ``read_my_rows`` recipe in docs/DISTRIBUTED.md.
+    """
+
+    def __init__(self, path: str, use_native: str = "auto"):
+        self.path = path
+        self.use_native = use_native
+        self._shape: Optional[Tuple[int, int]] = None
+
+    @property
+    def shape(self) -> Tuple[int, int]:
+        if self._shape is None:
+            self._shape = data_shape(self.path, use_native=self.use_native)
+        return self._shape
+
+    def read_range(self, start: int, stop: int) -> np.ndarray:
+        return read_data(self.path, start, stop, use_native=self.use_native)
+
+    def read_rows(self, indices) -> np.ndarray:
+        return read_rows(self.path, indices, use_native=self.use_native)
+
+    def read_all(self) -> np.ndarray:
+        return read_data(self.path, use_native=self.use_native)
 
 
 def write_bin(path: str, data: np.ndarray) -> None:

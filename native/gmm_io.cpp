@@ -25,6 +25,18 @@ extern "C" {
 // rc: 0 ok, 1 open/alloc failure, 2 malformed content
 int gmm_read_data(const char* path, int64_t* n_out, int64_t* d_out,
                   float** data_out);
+// Shape probe without loading the payload: BIN reads the 8-byte header; CSV
+// streams the file in fixed-size blocks counting non-blank lines (O(1) RAM).
+int gmm_data_shape(const char* path, int64_t* n_out, int64_t* d_out);
+// Range read of rows [start, stop): the per-host loading primitive (each host
+// of a multi-controller run reads ONLY its slice -- the anti-MPI_Bcast,
+// reference gaussian.cu:191-201 broadcast the whole dataset). BIN seeks the
+// row range directly (readData.cpp:35-47 layout); CSV streams blocks and
+// parses only in-range rows. Peak memory is O(stop-start), never O(file).
+// stop < 0 means "to the end of the file" (single pass, no prior shape
+// probe); *n_out receives the number of rows actually read.
+int gmm_read_range(const char* path, int64_t start, int64_t stop,
+                   int64_t* n_out, int64_t* d_out, float** data_out);
 void gmm_free(float* p);
 int gmm_write_results(const char* path, const float* data, const float* memb,
                       int64_t n, int64_t d, int64_t k);
@@ -38,6 +50,17 @@ int gmm_results_close(void* handle);
 }  // extern "C"
 
 namespace {
+
+// malloc for rows*d floats with explicit overflow checks (a crafted header
+// or absurd caller range must fail cleanly, not wrap the size_t multiply).
+float* alloc_rows(int64_t rows, int64_t d) {
+  if (rows < 0 || d <= 0) return nullptr;
+  const uint64_t urows = static_cast<uint64_t>(rows ? rows : 1);
+  const uint64_t ud = static_cast<uint64_t>(d);
+  if (ud > SIZE_MAX / sizeof(float)) return nullptr;
+  if (urows > SIZE_MAX / (ud * sizeof(float))) return nullptr;
+  return static_cast<float*>(std::malloc(urows * ud * sizeof(float)));
+}
 
 int read_bin(const char* path, int64_t* n_out, int64_t* d_out,
              float** data_out) {
@@ -54,7 +77,7 @@ int read_bin(const char* path, int64_t* n_out, int64_t* d_out,
     return 2;
   }
   const size_t count = static_cast<size_t>(n) * static_cast<size_t>(d);
-  float* data = static_cast<float*>(std::malloc(count * sizeof(float)));
+  float* data = alloc_rows(n, d);
   if (!data) {
     std::fclose(f);
     return 1;
@@ -77,6 +100,43 @@ int64_t count_fields(const char* p, const char* end) {
   for (; p < end; ++p)
     if (*p == ',') ++fields;
   return fields;
+}
+
+// Parse one field [q, fe) with atof prefix semantics (readData.cpp:108).
+// Bounded: strtof runs on a NUL-terminated copy, so it can never skip a
+// line's trailing empty field into the next line (strtof treats '\n' as
+// leading whitespace) or scan past a block buffer's end.
+float parse_field(const char* q, const char* fe) {
+  char tmp[64];
+  const size_t len = static_cast<size_t>(fe - q);
+  if (len == 0) return 0.0f;
+  char* next = nullptr;
+  float v;
+  if (len <= sizeof(tmp) - 1) {
+    std::memcpy(tmp, q, len);
+    tmp[len] = '\0';
+    v = std::strtof(tmp, &next);
+    return next == tmp ? 0.0f : v;
+  }
+  // Rare: a field longer than 63 chars (e.g. digit-padded mantissa whose
+  // exponent falls past any fixed cutoff) -- heap-copy so nothing truncates.
+  std::string s(q, fe);
+  v = std::strtof(s.c_str(), &next);
+  return next == s.c_str() ? 0.0f : v;
+}
+
+// Parse one CSV line [q, qe) of exactly d fields into out. Returns 0, or 2 on
+// a ragged row.
+int parse_csv_row(const char* q, const char* qe, int64_t d, float* out) {
+  if (count_fields(q, qe) != d) return 2;
+  for (int64_t j = 0; j < d; ++j) {
+    const char* comma = static_cast<const char*>(
+        std::memchr(q, ',', static_cast<size_t>(qe - q)));
+    const char* fe = comma ? comma : qe;
+    out[j] = parse_field(q, fe);
+    q = comma ? comma + 1 : qe;
+  }
+  return 0;
 }
 
 int read_csv(const char* path, int64_t* n_out, int64_t* d_out,
@@ -113,30 +173,118 @@ int read_csv(const char* path, int64_t* n_out, int64_t* d_out,
   const int64_t n = static_cast<int64_t>(lines.size()) - 1;  // header dropped
   if (n <= 0) return 2;
 
-  float* data = static_cast<float*>(
-      std::malloc(static_cast<size_t>(n) * static_cast<size_t>(d) *
-                  sizeof(float)));
+  float* data = alloc_rows(n, d);
   if (!data) return 1;
 
   for (int64_t i = 0; i < n; ++i) {
     const char* q = lines[static_cast<size_t>(i) + 1].first;
     const char* qe = lines[static_cast<size_t>(i) + 1].second;
-    if (count_fields(q, qe) != d) {
+    if (parse_csv_row(q, qe, d, data + i * d) != 0) {
       std::free(data);
       return 2;
     }
-    for (int64_t j = 0; j < d; ++j) {
-      // strtof prefix parse == atof semantics (readData.cpp:108); it stops at
-      // the comma on its own, no per-field copies needed.
-      char* next = nullptr;
-      data[i * d + j] = std::strtof(q, &next);
-      if (next == q) data[i * d + j] = 0.0f;  // non-numeric field -> 0.0
-      const char* comma = static_cast<const char*>(
-          std::memchr(q, ',', static_cast<size_t>(qe - q)));
-      q = comma ? comma + 1 : qe;
-    }
   }
   *n_out = n;
+  *d_out = d;
+  *data_out = data;
+  return 0;
+}
+
+// Stream a CSV file block-by-block, invoking fn(line_index, begin, end) for
+// every non-blank line (index 0 = the header). fn returns 0 to continue,
+// 1 to stop early (not an error), or an rc>1 to abort with that code.
+// Peak memory: one 1 MiB block + the longest single line.
+template <typename Fn>
+int scan_csv_lines(const char* path, Fn fn) {
+  FILE* f = std::fopen(path, "rb");
+  if (!f) return 1;
+  std::vector<char> block(1 << 20);
+  std::string carry;
+  int64_t line_index = 0;
+  int rc = 0;
+  for (;;) {
+    const size_t got = std::fread(block.data(), 1, block.size(), f);
+    if (got == 0) break;
+    const char* p = block.data();
+    const char* const end = p + got;
+    while (p < end) {
+      const char* nl = static_cast<const char*>(
+          std::memchr(p, '\n', static_cast<size_t>(end - p)));
+      if (!nl) {
+        carry.append(p, end);
+        break;
+      }
+      const char *lb, *le;
+      if (!carry.empty()) {
+        carry.append(p, nl);
+        lb = carry.data();
+        le = lb + carry.size();
+      } else {
+        lb = p;
+        le = nl;
+      }
+      while (le > lb && le[-1] == '\r') --le;
+      if (le > lb) {
+        rc = fn(line_index++, lb, le);
+        if (rc) break;
+      }
+      carry.clear();
+      p = nl + 1;
+    }
+    if (rc) break;
+  }
+  std::fclose(f);
+  if (rc == 0 && !carry.empty()) {  // final line without trailing newline
+    const char* lb = carry.data();
+    const char* le = lb + carry.size();
+    while (le > lb && le[-1] == '\r') --le;
+    if (le > lb) rc = fn(line_index++, lb, le);
+  }
+  return rc == 1 ? 0 : rc;  // early-stop is success
+}
+
+int bin_shape(const char* path, int64_t* n_out, int64_t* d_out) {
+  FILE* f = std::fopen(path, "rb");
+  if (!f) return 1;
+  int32_t header[2];
+  const bool ok = std::fread(header, sizeof(int32_t), 2, f) == 2;
+  std::fclose(f);
+  if (!ok || header[0] <= 0 || header[1] <= 0) return 2;
+  *n_out = header[0];
+  *d_out = header[1];
+  return 0;
+}
+
+int bin_read_range(const char* path, int64_t start, int64_t stop,
+                   int64_t* n_out, int64_t* d_out, float** data_out) {
+  int64_t n = 0, d = 0;
+  int rc = bin_shape(path, &n, &d);
+  if (rc) return rc;
+  if (stop < 0) stop = n;  // "to end" sentinel
+  if (start < 0 || stop < start || stop > n) return 2;
+  FILE* f = std::fopen(path, "rb");
+  if (!f) return 1;
+  const int64_t rows = stop - start;
+  float* data = alloc_rows(rows, d);
+  if (!data) {
+    std::fclose(f);
+    return 1;
+  }
+  const size_t count = static_cast<size_t>(rows) * static_cast<size_t>(d);
+#if defined(_WIN32)
+  const int seek_rc = _fseeki64(f, 8LL + start * d * 4, SEEK_SET);
+#else
+  const int seek_rc =
+      fseeko(f, static_cast<off_t>(8) + static_cast<off_t>(start) * d * 4,
+             SEEK_SET);
+#endif
+  if (seek_rc != 0 || std::fread(data, sizeof(float), count, f) != count) {
+    std::free(data);
+    std::fclose(f);
+    return 2;
+  }
+  std::fclose(f);
+  *n_out = rows;
   *d_out = d;
   *data_out = data;
   return 0;
@@ -169,6 +317,82 @@ int gmm_read_data(const char* path, int64_t* n_out, int64_t* d_out,
   return read_csv(path, n_out, d_out, data_out);
 }
 
+int gmm_data_shape(const char* path, int64_t* n_out, int64_t* d_out) {
+  const size_t len = std::strlen(path);
+  if (len >= 3 && std::strcmp(path + len - 3, "bin") == 0)
+    return bin_shape(path, n_out, d_out);
+  int64_t lines = 0, d = 0;
+  const int rc = scan_csv_lines(
+      path, [&](int64_t idx, const char* lb, const char* le) -> int {
+        if (idx == 0) d = count_fields(lb, le);
+        ++lines;
+        return 0;
+      });
+  if (rc) return rc;
+  if (lines < 2) return 2;  // header + at least one data row
+  *n_out = lines - 1;
+  *d_out = d;
+  return 0;
+}
+
+int gmm_read_range(const char* path, int64_t start, int64_t stop,
+                   int64_t* n_out, int64_t* d_out, float** data_out) {
+  const size_t len = std::strlen(path);
+  if (len >= 3 && std::strcmp(path + len - 3, "bin") == 0)
+    return bin_read_range(path, start, stop, n_out, d_out, data_out);
+  if (start < 0 || (stop >= 0 && stop < start)) return 2;
+  const bool to_end = stop < 0;
+  const int64_t want = to_end ? -1 : (stop - start);
+  // Initial capacity is bounded regardless of the caller's stop: rows arrive
+  // from the scan, so an absurd range fails with rc=2 at EOF instead of
+  // attempting a huge up-front allocation.
+  int64_t cap = to_end ? 4096 : (want < 4096 ? want : 4096);
+  int64_t d = 0, seen = 0, total_rows = 0;
+  float* data = nullptr;
+  int rc = scan_csv_lines(
+      path, [&](int64_t idx, const char* lb, const char* le) -> int {
+        if (idx == 0) {
+          d = count_fields(lb, le);
+          data = alloc_rows(cap, d);
+          return data ? 0 : 3;  // 3 -> alloc failure (mapped to 1 below)
+        }
+        const int64_t row = idx - 1;  // header dropped (readData.cpp:84)
+        ++total_rows;
+        if (row < start) return 0;
+        if (!to_end && row >= stop) return 1;  // early stop: rest unread
+        if (seen == cap) {  // amortized doubling, capped at the known want
+          int64_t next_cap = cap * 2;
+          if (!to_end && next_cap > want) next_cap = want;
+          if (static_cast<uint64_t>(next_cap) >
+              SIZE_MAX / (sizeof(float) * static_cast<uint64_t>(d)))
+            return 3;
+          float* grown = static_cast<float*>(std::realloc(
+              data, static_cast<size_t>(next_cap) * static_cast<size_t>(d) *
+                        sizeof(float)));
+          if (!grown) return 3;
+          data = grown;
+          cap = next_cap;
+        }
+        const int prc = parse_csv_row(lb, le, d, data + seen * d);
+        if (prc) return prc;
+        ++seen;
+        return 0;
+      });
+  // Out-of-range start (or file ending inside an explicit range) is an
+  // error, matching the BIN path -- a silently empty shard would hide a
+  // sharding bug upstream.
+  if (rc == 0 && !to_end && seen != stop - start) rc = 2;
+  if (rc == 0 && to_end && start > total_rows) rc = 2;
+  if (rc) {
+    std::free(data);
+    return rc == 3 ? 1 : rc;
+  }
+  *n_out = seen;
+  *d_out = d;
+  *data_out = data;
+  return 0;
+}
+
 void gmm_free(float* p) { std::free(p); }
 
 void* gmm_results_open(const char* path) {
@@ -179,8 +403,10 @@ int gmm_results_append(void* handle, const float* data, const float* memb,
                        int64_t n, int64_t d, int64_t k) {
   FILE* f = static_cast<FILE*>(handle);
   if (!f) return 1;
-  // Worst-case per value: sign + 20 int digits + '.' + 6 decimals + sep.
-  const size_t line_cap = static_cast<size_t>(d + k) * 32 + 8;
+  // Worst-case per value: the sprintf("%f") fallback for |v| > 9e12 emits up
+  // to ~47 chars for float32 extremes (3.4e38 -> 39 int digits + '.' + 6
+  // decimals + sign), so budget 48 per value.
+  const size_t line_cap = static_cast<size_t>(d + k) * 48 + 8;
   std::vector<char> line(line_cap);
   for (int64_t i = 0; i < n; ++i) {
     char* out = line.data();

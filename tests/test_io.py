@@ -78,3 +78,142 @@ def test_results_format(tmp_path):
     lines = p.read_text().splitlines()
     assert lines[0] == "1.000000,2.000000\t0.750000,0.250000"
     assert lines[1] == "3.000000,4.000000\t0.100000,0.900000"
+
+
+# ---------------------------------------------------------------------------
+# Range / streaming readers (per-host sharded loading, the anti-MPI_Bcast)
+# ---------------------------------------------------------------------------
+
+def _write_csv(path, data, header="a,b,c,d"):
+    with open(path, "w") as f:
+        f.write(header + "\n")
+        for row in data:
+            f.write(",".join(f"{v:.6f}" for v in row) + "\n")
+
+
+@pytest.mark.parametrize("kind", ["bin", "csv"])
+@pytest.mark.parametrize("use_native", ["never", "auto"])
+def test_range_read_matches_slice(tmp_path, rng, kind, use_native):
+    from cuda_gmm_mpi_tpu.io.readers import data_shape
+
+    data = rng.normal(size=(101, 4)).astype(np.float32)
+    p = str(tmp_path / f"x.{kind}")
+    if kind == "bin":
+        write_bin(p, data)
+    else:
+        _write_csv(p, data)
+    assert data_shape(p, use_native=use_native) == (101, 4)
+    for start, stop in [(0, 101), (0, 17), (40, 63), (97, 101), (5, 5)]:
+        out = read_data(p, start, stop, use_native=use_native)
+        np.testing.assert_allclose(out, data[start:stop], rtol=0, atol=2e-6)
+    # stop=None reads to the end
+    np.testing.assert_allclose(
+        read_data(p, 13, use_native=use_native), data[13:], rtol=0, atol=2e-6)
+
+
+@pytest.mark.parametrize("kind", ["bin", "csv"])
+def test_range_read_out_of_bounds(tmp_path, rng, kind):
+    data = rng.normal(size=(10, 3)).astype(np.float32)
+    p = str(tmp_path / f"x.{kind}")
+    if kind == "bin":
+        write_bin(p, data)
+    else:
+        _write_csv(p, data, header="a,b,c")
+    with pytest.raises(ValueError):
+        read_data(p, 5, 11, use_native="never")
+
+
+@pytest.mark.parametrize("kind", ["bin", "csv"])
+def test_read_rows(tmp_path, rng, kind):
+    from cuda_gmm_mpi_tpu.io.readers import read_rows
+
+    data = rng.normal(size=(50, 3)).astype(np.float32)
+    p = str(tmp_path / f"x.{kind}")
+    if kind == "bin":
+        write_bin(p, data)
+    else:
+        _write_csv(p, data, header="a,b,c")
+    idx = [0, 49, 7, 7, 23]  # order preserved, duplicates allowed
+    np.testing.assert_allclose(read_rows(p, idx), data[idx], rtol=0, atol=2e-6)
+    with pytest.raises(ValueError):
+        read_rows(p, [50])
+
+
+def test_file_source(tmp_path, rng):
+    from cuda_gmm_mpi_tpu.io import FileSource
+
+    data = rng.normal(size=(30, 5)).astype(np.float32)
+    p = str(tmp_path / "x.bin")
+    write_bin(p, data)
+    src = FileSource(p)
+    assert src.shape == (30, 5)
+    np.testing.assert_array_equal(src.read_range(10, 20), data[10:20])
+    np.testing.assert_array_equal(src.read_rows([3, 1]), data[[3, 1]])
+    np.testing.assert_array_equal(src.read_all(), data)
+
+
+def test_csv_streaming_no_trailing_newline(tmp_path):
+    p = tmp_path / "x.csv"
+    p.write_text("a,b\n1,2\n3,4")  # no trailing \n on the last row
+    out = read_csv(str(p))
+    np.testing.assert_allclose(out, [[1, 2], [3, 4]])
+    out = read_csv(str(p), 1, 2)
+    np.testing.assert_allclose(out, [[3, 4]])
+
+
+@pytest.mark.slow
+@pytest.mark.parametrize("use_native", ["never", "auto"])
+def test_range_read_rss_stays_o_slice(tmp_path, use_native):
+    """The anti-Bcast claim made measurable: reading a 1/8 slice of a ~160 MB
+    BIN must not buffer the whole file (VERDICT round-1 gap #2). Measured as
+    subprocess peak RSS < baseline + file_size/4 (the slice itself is 20 MB)."""
+    import subprocess
+    import sys
+
+    n, d = 1_700_000, 24  # ~163 MB payload
+    p = str(tmp_path / "big.bin")
+    with open(p, "wb") as f:
+        np.asarray([n, d], np.int32).tofile(f)
+        block = np.zeros((100_000, d), np.float32)
+        for i in range(n // 100_000):
+            block[:] = float(i)
+            block.tofile(f)
+    code = f"""
+import resource, sys
+import numpy as np
+from cuda_gmm_mpi_tpu.io.readers import read_data
+base = resource.getrusage(resource.RUSAGE_SELF).ru_maxrss
+out = read_data({p!r}, {n // 2}, {n // 2 + n // 8}, use_native={use_native!r})
+assert out.shape == ({n // 8}, {d}), out.shape
+assert float(out[0, 0]) == float({n // 2} // 100_000), out[0, 0]
+peak = resource.getrusage(resource.RUSAGE_SELF).ru_maxrss
+print("RSS", base, peak)
+sys.exit(0 if (peak - base) * 1024 < {n * d * 4} // 4 else 17)
+"""
+    from .conftest import worker_env
+
+    r = subprocess.run([sys.executable, "-c", code], env=worker_env(),
+                       capture_output=True, text=True, timeout=300)
+    assert r.returncode == 0, (r.returncode, r.stdout, r.stderr)
+
+
+@pytest.mark.parametrize("use_native", ["never", "auto"])
+def test_csv_trailing_empty_field_is_zero(tmp_path, use_native):
+    """A trailing empty field parses as 0.0 and must NOT steal the next
+    line's first value (the strtof-skips-newline pitfall)."""
+    p = tmp_path / "x.csv"
+    p.write_text("h1,h2\n1,\n2,3\n")
+    out = read_data(str(p), use_native=use_native)
+    assert out.tolist() == [[1.0, 0.0], [2.0, 3.0]]
+    out = read_data(str(p), 0, 2, use_native=use_native)
+    assert out.tolist() == [[1.0, 0.0], [2.0, 3.0]]
+
+
+@pytest.mark.parametrize("use_native", ["never", "auto"])
+def test_start_past_eof_raises(tmp_path, use_native):
+    p = tmp_path / "x.csv"
+    p.write_text("a,b\n1,2\n3,4\n")
+    with pytest.raises(ValueError):
+        read_data(str(p), 10, use_native=use_native)
+    # start == n is a valid empty slice (matches BIN [n:n])
+    assert read_data(str(p), 2, use_native=use_native).shape == (0, 2)
