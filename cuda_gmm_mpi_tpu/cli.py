@@ -38,6 +38,9 @@ def build_parser() -> argparse.ArgumentParser:
     g = p.add_argument_group("runtime config (reference gaussian.h defines)")
     g.add_argument("--device", default=None,
                    help="JAX platform: tpu | cpu | gpu (default: auto)")
+    g.add_argument("--cpu-devices", type=int, default=None,
+                   help="virtual CPU device count (validate sharded runs "
+                   "without a cluster, SURVEY.md SS4; use with --device=cpu)")
     g.add_argument("--diag-only", action="store_true",
                    help="diagonal covariance (DIAG_ONLY, gaussian.h:23)")
     g.add_argument("--min-iters", type=int, default=100,
@@ -57,7 +60,23 @@ def build_parser() -> argparse.ArgumentParser:
     g.add_argument("--debug", action="store_true",
                    help="debug prints (ENABLE_DEBUG, gaussian.h:31)")
 
+    d = p.add_argument_group(
+        "distributed (multi-controller; the reference's mpirun equivalent, "
+        "gaussian.cu:128-207 -- run the SAME command on every host)")
+    d.add_argument("--coordinator", default=None, metavar="HOST:PORT",
+                   help="coordination-service address (rank 0's); enables "
+                   "jax.distributed. On TPU pods omit all three flags and "
+                   "initialize from the environment with --num-processes=0")
+    d.add_argument("--num-processes", type=int, default=None,
+                   help="total process count (MPI world size)")
+    d.add_argument("--process-id", type=int, default=None,
+                   help="this process's rank (0-based)")
+
     t = p.add_argument_group("TPU-native tuning")
+    t.add_argument("--dtype", default="float32",
+                   choices=["float32", "float64"],
+                   help="compute dtype (float64 needs no TPU and is exact "
+                   "for oracle comparisons)")
     t.add_argument("--chunk-size", type=int, default=65536,
                    help="events per fused E+M pass")
     t.add_argument("--precision", default="highest",
@@ -110,18 +129,48 @@ def main(argv=None) -> int:
         import jax
 
         jax.config.update("jax_platforms", args.device)
+    if args.cpu_devices:
+        import jax
+
+        jax.config.update("jax_num_cpu_devices", args.cpu_devices)
+    if args.dtype == "float64":
+        import jax
+
+        jax.config.update("jax_enable_x64", True)
 
     # Heavy imports deferred until after platform selection.
+    import jax
+
     from .config import GMMConfig
-    from .io import read_data, write_summary
+    from .io import FileSource, read_data, write_summary
     from .io.writers import stream_results
     from .models import fit_gmm, iter_memberships
+
+    # MPI_Init equivalent (gaussian.cu:130-140): any distributed flag brings
+    # up the multi-controller runtime; --num-processes=0 initializes from the
+    # environment (TPU pod launchers).
+    if (args.coordinator is not None or args.num_processes is not None
+            or args.process_id is not None):
+        from .parallel import distributed
+
+        try:
+            distributed.initialize(
+                coordinator_address=args.coordinator,
+                num_processes=args.num_processes,
+                process_id=args.process_id,
+                auto=(args.num_processes == 0),
+            )
+        except ValueError as e:
+            print(str(e), file=sys.stderr)
+            return 1
+    pid, nproc = jax.process_index(), jax.process_count()
 
     if not os.path.isfile(args.infile):
         print("Invalid infile.\n", file=sys.stderr)  # gaussian.cu:1130
         return 2
     try:
         config = GMMConfig(
+            dtype=args.dtype,
             max_clusters=args.max_clusters,
             covariance_dynamic_range=args.dynamic_range,
             diag_only=args.diag_only,
@@ -159,16 +208,24 @@ def main(argv=None) -> int:
 
     t_io0 = time.perf_counter()
     try:
-        data = read_data(args.infile)
+        if nproc > 1:
+            # Per-host sharded loading: fit_gmm pulls only this host's slice
+            # through the range readers (the anti-MPI_Bcast; the reference
+            # broadcast the ENTIRE dataset, gaussian.cu:191-201).
+            fit_input = FileSource(args.infile)
+            n_events, n_dims = fit_input.shape
+        else:
+            fit_input = data = read_data(args.infile)
+            n_events, n_dims = data.shape
     except Exception as e:
         print("Error parsing input file. This could be due to an empty file "
               f"or an inconsistent number of dimensions. Aborting. ({e})",
               file=sys.stderr)  # gaussian.cu:204-205
         return 1
     t_io = time.perf_counter() - t_io0
-    if config.enable_print:
-        print(f"Number of events: {data.shape[0]}")
-        print(f"Number of dimensions: {data.shape[1]}\n")  # gaussian.cu:223-224
+    if config.enable_print and pid == 0:
+        print(f"Number of events: {n_events}")
+        print(f"Number of dimensions: {n_dims}\n")  # gaussian.cu:223-224
         stop = args.target_num_clusters or 1
         print(f"Starting with {args.num_clusters} cluster(s), will stop at "
               f"{stop} cluster(s).")  # :226
@@ -177,17 +234,38 @@ def main(argv=None) -> int:
 
     with trace(args.trace_dir):
         result = fit_gmm(
-            data, args.num_clusters, args.target_num_clusters, config=config
+            fit_input, args.num_clusters, args.target_num_clusters,
+            config=config,
         )
 
     t_out0 = time.perf_counter()
-    summary_path = args.outfile + ".summary"
-    write_summary(summary_path, result, enable_output=config.enable_output)
+    if pid == 0:
+        summary_path = args.outfile + ".summary"
+        write_summary(summary_path, result, enable_output=config.enable_output)
+        if config.enable_print:
+            _print_clusters(result)  # ENABLE_PRINT dump, gaussian.cu:1032-1039
     if config.enable_output:
         # Streamed: posteriors recomputed + written chunk-by-chunk, so the
-        # N x K membership matrix never exists in host RAM.
-        stream_results(args.outfile + ".results",
-                       iter_memberships(result, data, config))
+        # N x K membership matrix never exists in host RAM. Multi-host: each
+        # host writes its own slice's part, rank 0 assembles in order (the
+        # reference gathered all memberships over MPI_Send/Recv to rank 0,
+        # gaussian.cu:783-823; here only formatted bytes cross the local FS).
+        if nproc > 1:
+            from .parallel.distributed import barrier
+
+            start, stop_row = result.host_range
+            local = fit_input.read_range(start, stop_row)
+            part_path = f"{args.outfile}.results.part{pid:05d}"
+            stream_results(part_path, iter_memberships(result, local, config))
+            barrier("results_parts")
+            if pid == 0:
+                _assemble_parts(args.outfile + ".results",
+                                [f"{args.outfile}.results.part{i:05d}"
+                                 for i in range(nproc)])
+            barrier("results_done")
+        else:
+            stream_results(args.outfile + ".results",
+                           iter_memberships(result, data, config))
     t_out = time.perf_counter() - t_out0
 
     if config.profile:
@@ -198,6 +276,39 @@ def main(argv=None) -> int:
         print(f"EM time: {em_s * 1e3:.3f} (ms) over "
               f"{sum(r[3] for r in result.sweep_log)} iterations")
     return 0
+
+
+def _print_clusters(result) -> None:
+    """Final-model stdout dump (the reference's ENABLE_PRINT path prints
+    every saved cluster via printCluster, gaussian.cu:1032-1039, 1199-1201)."""
+    import numpy as np
+
+    from .io.writers import write_cluster
+
+    state = result.state
+    means = result.means
+    for c in range(result.ideal_num_clusters):
+        print(f"Cluster #{c}")
+        write_cluster(
+            sys.stdout,
+            float(np.asarray(state.pi)[c]), float(np.asarray(state.N)[c]),
+            means[c], np.asarray(state.R)[c],
+        )
+        print()
+
+
+def _assemble_parts(out_path: str, part_paths) -> None:
+    """Concatenate per-host .results parts (events are range-sharded in rank
+    order, so plain in-order concatenation reproduces the single-host file
+    byte for byte) and remove the parts."""
+    import shutil
+
+    with open(out_path, "wb") as out:
+        for p in part_paths:
+            with open(p, "rb") as f:
+                shutil.copyfileobj(f, out)
+    for p in part_paths:
+        os.remove(p)
 
 
 def _parse_mesh(spec):

@@ -80,6 +80,8 @@ def data_shape(path: str, use_native: str = "auto") -> Tuple[int, int]:
             header = np.fromfile(f, dtype=np.int32, count=2)
         if header.size != 2:
             raise ValueError(f"{path}: truncated BIN header")
+        if header[0] <= 0 or header[1] <= 0:  # same contract as bin_shape()
+            raise ValueError(f"{path}: malformed BIN header {header.tolist()}")
         return int(header[0]), int(header[1])
     num_dims = None
     count = 0

@@ -27,7 +27,6 @@ import numpy as np
 from ..config import GMMConfig
 from ..ops.formulas import convergence_epsilon, rissanen_score
 from ..ops.merge import eliminate_and_reduce
-from ..ops.seeding import seed_clusters_host
 from ..state import GMMState, compact
 from ..utils.logging_ import get_logger, metrics_line
 from ..utils.profiling import PhaseTimer
@@ -64,6 +63,13 @@ class GMMResult:
     sweep_log: list = dataclasses.field(default_factory=list)
     profile: Optional[dict] = None          # seconds per phase (7 categories)
     profile_report: Optional[str] = None    # formatted report
+    # [start, stop) of the events THIS host loaded (multi-host runs fit on
+    # per-host slices; single-host = (0, num_events)). The output path uses
+    # it to recompute exactly this host's memberships.
+    host_range: Optional[tuple] = None
+    # The fitted model (jitted executables already built) so the output path
+    # reuses compiled posteriors instead of building a fresh GMMModel.
+    model: Optional[object] = dataclasses.field(default=None, repr=False)
 
     @property
     def means(self) -> np.ndarray:
@@ -120,44 +126,19 @@ def fit_gmm(
     timer = PhaseTimer() if config.profile else None
     phase = timer.phase if timer else _null_phase
 
-    with phase("cpu"):
-        data = np.ascontiguousarray(data)
-        n_events, n_dims = data.shape
-        dtype = np.dtype(config.dtype)
-        data = data.astype(dtype, copy=False)
-
-        # Global centering keeps the expanded quadratic form well-conditioned
-        # (shift-equivariant: EM on x-c equals EM on x with means shifted by c).
-        if config.center_data:
-            shift = data.mean(axis=0, dtype=np.float64).astype(dtype)
-            data = data - shift[None, :]
-        else:
-            shift = np.zeros((n_dims,), dtype)
-
+    nproc = jax.process_count()
     if model is None:
-        if config.mesh_shape is not None:
+        if config.mesh_shape is not None or nproc > 1:
+            # Multi-controller runs always need the sharded model (the mesh
+            # spans all hosts' devices; default = every device on 'data').
             from ..parallel import ShardedGMMModel
 
             model = ShardedGMMModel(config)
         else:
             model = GMMModel(config)
 
-    with phase("cpu"):
-        # Host-side seeding: only K gathered rows + global moments touch the
-        # device; the chunked copy below is the only full device-resident copy.
-        state = seed_clusters_host(
-            data, num_clusters,
-            covariance_dynamic_range=config.covariance_dynamic_range,
-            seed_method=config.seed_method, seed=config.seed,
-        )
-        num_shards = getattr(model, "data_size", 1)
-        chunks_np, wts_np = chunk_events(data, config.chunk_size, num_shards)
-
-    with phase("memcpy"):
-        if hasattr(model, "prepare"):  # sharded path: pad K, place on mesh
-            state, chunks, wts = model.prepare(state, chunks_np, wts_np)
-        else:
-            chunks, wts = jnp.asarray(chunks_np), jnp.asarray(wts_np)
+    (state, chunks, wts, chunks_np, wts_np, n_events, n_dims, shift,
+     host_range) = _prepare_fit(data, num_clusters, config, model, phase, log)
     epsilon = convergence_epsilon(n_events, n_dims, config.epsilon_scale)
     if verbose:
         print(f"epsilon = {epsilon}")  # gaussian.cu:462
@@ -191,7 +172,7 @@ def fit_gmm(
             return _run_fused_sweep(
                 fused, config, state, chunks, wts, epsilon,
                 num_clusters, stop_number, target_num_clusters,
-                n_events, n_dims, shift, verbose,
+                n_events, n_dims, shift, verbose, host_range, model,
             )
 
     # One fused dispatch for the whole order-reduction step, so each K costs
@@ -207,7 +188,10 @@ def fit_gmm(
     step = 0
 
     ckpt = None
-    if config.checkpoint_dir:
+    if config.checkpoint_dir and nproc > 1:
+        log.warning("checkpointing is single-controller only; disabled for "
+                    "this %d-process run", nproc)
+    elif config.checkpoint_dir:
         from ..utils.checkpoint import SweepCheckpointer
 
         ckpt = SweepCheckpointer(config.checkpoint_dir)
@@ -323,7 +307,99 @@ def fit_gmm(
         sweep_log=sweep_log,
         profile=timer.as_dict() if timer else None,
         profile_report=timer.report() if timer else None,
+        host_range=host_range,
+        model=model,
     )
+
+
+def _prepare_fit(data, num_clusters, config, model, phase, log):
+    """Load, center, seed, chunk, and place the data -- one path for all
+    four cases (ndarray or FileSource input x single- or multi-process run).
+
+    Multi-process (the reference's MPI world, gaussian.cu:128-207): each host
+    reads ONLY its chunk-aligned slice (``host_chunk_bounds``), global moments
+    come from a chunk-ordered cross-host reduction (bit-identical for every
+    process count), seed rows are fetched identically everywhere, and the
+    global sharded arrays are assembled with zero cross-host data movement
+    (``prepare(host_local=True)``) -- replacing the reference's
+    read-on-rank-0 + MPI_Bcast-the-whole-dataset (gaussian.cu:186-207).
+    """
+    from ..ops.seeding import (
+        kmeanspp_from_pool, kmeanspp_pool, seed_means_indices,
+        seed_state_from_parts,
+    )
+    from ..parallel.distributed import global_moments, host_chunk_bounds
+
+    pid, nproc = jax.process_index(), jax.process_count()
+    source = data if hasattr(data, "read_range") else None
+    dtype = np.dtype(config.dtype)
+    if nproc > 1 and not hasattr(model, "prepare"):
+        raise ValueError(
+            "multi-controller runs require a sharded model (a mesh over all "
+            "hosts' devices); pass mesh_shape or let fit_gmm default it"
+        )
+
+    with phase("cpu"):
+        if source is not None:
+            n_events, n_dims = source.shape
+        else:
+            data = np.ascontiguousarray(data)
+            n_events, n_dims = data.shape
+        data_axis = getattr(model, "data_size", 1)
+        start, stop, num_chunks = host_chunk_bounds(
+            n_events, config.chunk_size, data_axis, pid, nproc
+        )
+        local = (source.read_range(start, stop) if source is not None
+                 else data[start:stop])
+        local = np.ascontiguousarray(local)
+
+    with phase("mpi"):  # cross-host allgather of tiny per-chunk partials
+        mean64, var64 = global_moments(local, config.chunk_size, num_chunks)
+
+    with phase("cpu"):
+        # Global centering keeps the expanded quadratic form well-conditioned
+        # (shift-equivariant: EM on x-c equals EM on x, means shifted by c).
+        if config.center_data:
+            shift = mean64.astype(dtype)
+        else:
+            shift = np.zeros((n_dims,), dtype)
+        local = local.astype(dtype, copy=False)
+        if config.center_data:
+            local = local - shift[None, :]
+
+        # Seed rows fetched in ORIGINAL coordinates, identically on every
+        # host (net reference semantics: device seeding overwritten by the
+        # host full-data reseed, gaussian.cu:108-123).
+        if config.seed_method == "kmeans++":
+            pool, rng = kmeanspp_pool(n_events, seed=config.seed)
+            x_pool = np.asarray(
+                source.read_rows(pool) if source is not None else data[pool]
+            )
+            rows = x_pool[kmeanspp_from_pool(x_pool, num_clusters, rng)]
+        else:  # 'even': float32 index math of gaussian.cu:110-121
+            idx = np.asarray(seed_means_indices(n_events, num_clusters))
+            rows = np.asarray(
+                source.read_rows(idx) if source is not None else data[idx]
+            )
+        state = seed_state_from_parts(
+            rows.astype(dtype) - shift[None, :], n_events,
+            float(var64.mean()), num_clusters,
+            covariance_dynamic_range=config.covariance_dynamic_range,
+            dtype=dtype,
+        )
+        chunks_np, wts_np = chunk_events(
+            local, config.chunk_size, num_chunks=num_chunks
+        )
+
+    with phase("memcpy"):
+        if hasattr(model, "prepare"):  # sharded path: pad K, place on mesh
+            state, chunks, wts = model.prepare(
+                state, chunks_np, wts_np, host_local=(nproc > 1)
+            )
+        else:
+            chunks, wts = jnp.asarray(chunks_np), jnp.asarray(wts_np)
+    return (state, chunks, wts, chunks_np, wts_np, n_events, n_dims,
+            np.asarray(shift), (start, stop))
 
 
 def _fit_with_restarts(data, num_clusters, target_num_clusters, config,
@@ -340,7 +416,7 @@ def _fit_with_restarts(data, num_clusters, target_num_clusters, config,
         log.info("n_init=%d forces seed_method='kmeans++' (the 'even' "
                  "seeding is deterministic)", config.n_init)
     if model is None:  # one model => executables shared across restarts
-        if config.mesh_shape is not None:
+        if config.mesh_shape is not None or jax.process_count() > 1:
             from ..parallel import ShardedGMMModel
 
             model = ShardedGMMModel(config)
@@ -372,7 +448,8 @@ def _fit_with_restarts(data, num_clusters, target_num_clusters, config,
 
 def _run_fused_sweep(fused, config, state, chunks, wts, epsilon,
                      num_clusters, stop_number, target_num_clusters,
-                     n_events, n_dims, shift, verbose):
+                     n_events, n_dims, shift, verbose,
+                     host_range=None, model=None):
     """Whole-sweep-on-device path (models/fused_sweep.py): one dispatch,
     one sync. ``fused`` comes from the model's ``make_fused_sweep`` (cached
     there, so passing the same ``model=`` to fit_gmm reuses the executable).
@@ -416,7 +493,18 @@ def _run_fused_sweep(fused, config, state, chunks, wts, epsilon,
         num_dimensions=n_dims,
         data_shift=np.asarray(shift),
         sweep_log=sweep_log,
+        host_range=host_range,
+        model=model,
     )
+
+
+def _posterior_model(result):
+    """The plain (unsharded) model behind a fit result, if it carries one --
+    the output path runs per-host/per-block on local devices."""
+    model = getattr(result, "model", None)
+    if model is None:
+        return None
+    return getattr(model, "_plain", model)  # ShardedGMMModel wraps one
 
 
 def iter_memberships(
@@ -430,8 +518,12 @@ def iter_memberships(
     from the final parameters -- peak host memory is one block's [B, D] +
     [B, K] regardless of N (SURVEY.md SS7 "memberships at scale": the
     reference gathers the whole N x K matrix to rank 0, gaussian.cu:783-823).
+
+    Reuses the fitted model carried on ``result`` (already-compiled
+    posteriors executable) when no ``model`` is passed; only a result from a
+    foreign source pays a fresh compilation here.
     """
-    model = model or GMMModel(config)
+    model = model or _posterior_model(result) or GMMModel(config)
     dtype = np.dtype(config.dtype)
     n, d = data.shape
     B = config.chunk_size
@@ -457,7 +549,7 @@ def compute_memberships(
     E-step, so the stored memberships ARE the posteriors of the final params;
     gaussian.cu:713-714, 768). Materialized variant of ``iter_memberships``.
     """
-    model = model or GMMModel(config)
+    model = model or _posterior_model(result) or GMMModel(config)
     blocks = [w for _, w in iter_memberships(result, data, config, model)]
     if not blocks:
         return np.zeros((0, result.state.num_clusters_padded),

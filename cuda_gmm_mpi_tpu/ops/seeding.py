@@ -42,25 +42,27 @@ def seed_means_indices(num_events: int, num_clusters: int) -> jnp.ndarray:
     return jnp.clip(idx, 0, num_events - 1)
 
 
-def kmeanspp_indices(data, num_clusters: int, seed: int = 0,
-                     max_sample: int = 200_000):
-    """k-means++ (D^2-weighted) seeding indices -- capability upgrade over
-    the reference's evenly-spaced rows (absent there; opt-in via
-    ``GMMConfig.seed_method='kmeans++'``).
-
-    Runs on a deterministic subsample of at most ``max_sample`` events so
-    seeding stays O(K * max_sample * D) at any N; returns indices into the
-    FULL data array.
-    """
+def kmeanspp_pool(num_events: int, seed: int = 0, max_sample: int = 200_000):
+    """Deterministic candidate-pool indices for k-means++ and the RNG to
+    continue with (split out so per-host loaders can fetch the pool rows
+    from a file instead of holding the full dataset)."""
     import numpy as np
 
-    n = data.shape[0]
     rng = np.random.default_rng(seed)
-    if n > max_sample:
-        pool = rng.choice(n, size=max_sample, replace=False)
+    if num_events > max_sample:
+        pool = rng.choice(num_events, size=max_sample, replace=False)
     else:
-        pool = np.arange(n)
-    x = data[pool].astype(np.float64)
+        pool = np.arange(num_events)
+    return pool, rng
+
+
+def kmeanspp_from_pool(x_pool, num_clusters: int, rng):
+    """k-means++ (D^2-weighted) selection over a candidate matrix; returns
+    indices INTO THE POOL. ``rng`` continues the stream from
+    ``kmeanspp_pool`` so results are deterministic given the seed."""
+    import numpy as np
+
+    x = x_pool.astype(np.float64)
     first = int(rng.integers(x.shape[0]))
     chosen = [first]
     d2 = ((x - x[first]) ** 2).sum(axis=1)
@@ -72,7 +74,22 @@ def kmeanspp_indices(data, num_clusters: int, seed: int = 0,
         nxt = int(rng.choice(x.shape[0], p=d2 / total))
         chosen.append(nxt)
         d2 = np.minimum(d2, ((x - x[nxt]) ** 2).sum(axis=1))
-    return pool[np.asarray(chosen)]
+    return np.asarray(chosen)
+
+
+def kmeanspp_indices(data, num_clusters: int, seed: int = 0,
+                     max_sample: int = 200_000):
+    """k-means++ (D^2-weighted) seeding indices -- capability upgrade over
+    the reference's evenly-spaced rows (absent there; opt-in via
+    ``GMMConfig.seed_method='kmeans++'``).
+
+    Runs on a deterministic subsample of at most ``max_sample`` events so
+    seeding stays O(K * max_sample * D) at any N; returns indices into the
+    FULL data array.
+    """
+    pool, rng = kmeanspp_pool(data.shape[0], seed=seed, max_sample=max_sample)
+    chosen = kmeanspp_from_pool(data[pool], num_clusters, rng)
+    return pool[chosen]
 
 
 def seed_clusters_host(
@@ -114,6 +131,36 @@ def seed_clusters_host(
         jnp.asarray(means, dtype), n_events, num_clusters,
         num_clusters_padded or num_clusters,
         jnp.asarray(var.mean() / covariance_dynamic_range, dtype),
+        jnp.dtype(dtype),
+    )
+
+
+def seed_state_from_parts(
+    means_rows,
+    n_events: int,
+    data_var_mean: float,
+    num_clusters: int,
+    num_clusters_padded: int | None = None,
+    covariance_dynamic_range: float = 1e3,
+    dtype=None,
+) -> GMMState:
+    """Initial state from precomputed pieces: the K seed rows and the global
+    per-dim-variance mean.
+
+    The multi-host seeding entry point: each host fetches the seed rows from
+    the input file (``io.read_rows``) and the variance comes from a cross-host
+    moment reduction (``parallel.distributed.global_moments``) -- no host ever
+    needs the full dataset. Identical inputs on every host produce the
+    identical replicated state.
+    """
+    import numpy as np
+
+    means_rows = np.ascontiguousarray(means_rows)
+    dtype = dtype or means_rows.dtype
+    return _build_seed_state(
+        jnp.asarray(means_rows, dtype), n_events, num_clusters,
+        num_clusters_padded or num_clusters,
+        jnp.asarray(data_var_mean / covariance_dynamic_range, dtype),
         jnp.dtype(dtype),
     )
 

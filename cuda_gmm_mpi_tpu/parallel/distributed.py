@@ -27,21 +27,81 @@ def initialize(
     coordinator_address: Optional[str] = None,
     num_processes: Optional[int] = None,
     process_id: Optional[int] = None,
+    auto: bool = False,
 ) -> Tuple[int, int]:
     """Initialize the multi-controller runtime; returns (process_id, count).
 
-    No-op on single-process runs (the reference likewise runs under plain
-    ``./gaussianMPI`` without mpirun). With arguments (or the standard cluster
-    env vars), brings up jax.distributed -- the MPI_Init/rank/size equivalent
-    (gaussian.cu:133-139).
+    No-op with no arguments (the reference likewise runs under plain
+    ``./gaussianMPI`` without mpirun). ``auto=True`` initializes from the
+    environment (TPU pod launchers). Explicit bring-up requires ALL of
+    coordinator_address/num_processes/process_id -- a partial set raises
+    instead of silently running single-process with wrong results. This is
+    the MPI_Init/rank/size equivalent (gaussian.cu:133-139).
     """
-    if coordinator_address is not None or num_processes is not None:
+    if auto:
+        jax.distributed.initialize()
+    elif (coordinator_address is not None or num_processes is not None
+          or process_id is not None):
+        if (coordinator_address is None or num_processes is None
+                or process_id is None):
+            raise ValueError(
+                "distributed bring-up needs ALL of coordinator_address, "
+                "num_processes, and process_id (or auto=True for "
+                "environment-driven initialization)"
+            )
         jax.distributed.initialize(
             coordinator_address=coordinator_address,
             num_processes=num_processes,
             process_id=process_id,
         )
     return jax.process_index(), jax.process_count()
+
+
+def global_moments(local_data: np.ndarray, chunk_size: int, num_chunks: int):
+    """Global per-dimension (mean, E[x^2]-E[x]^2) from per-host slices,
+    bit-identical for every process count.
+
+    Each host computes per-chunk (count, sum, sum-of-squares) float64
+    partials for its ``num_chunks`` chunk slots (``host_chunk_bounds``
+    guarantees chunk-aligned, equal-count slices; missing tail chunks
+    contribute zeros). The [nproc * num_chunks, 1+2D] partial matrix --
+    whose rows are in GLOBAL chunk order by construction -- is then reduced
+    the same way on every host, so a 1-process and an N-process run of the
+    same problem produce the exact same bits. This is the distributed
+    version of the seeding moments (averageVariance,
+    gaussian_kernel.cu:71-102, computed there from one GPU's shard; here
+    from ALL data). Returns (mean[D], var[D]) as float64.
+    """
+    d = local_data.shape[1]
+    parts = np.zeros((num_chunks, 1 + 2 * d), np.float64)
+    for j in range(num_chunks):
+        block = local_data[j * chunk_size:(j + 1) * chunk_size]
+        if block.shape[0] == 0:
+            continue
+        parts[j, 0] = block.shape[0]
+        parts[j, 1:1 + d] = block.sum(axis=0, dtype=np.float64)
+        parts[j, 1 + d:] = (block.astype(np.float64) ** 2).sum(axis=0)
+    if jax.process_count() > 1:
+        from jax.experimental import multihost_utils
+
+        gathered = np.asarray(multihost_utils.process_allgather(parts))
+        parts = gathered.reshape(-1, 1 + 2 * d)
+    total = parts.sum(axis=0)
+    n = total[0]
+    if n <= 0:
+        raise ValueError("no events across all hosts")
+    mean = total[1:1 + d] / n
+    var = total[1 + d:] / n - mean * mean
+    return mean, var
+
+
+def barrier(name: str = "gmm_barrier") -> None:
+    """Cross-host sync point (the MPI_Barrier analog -- needed only at host
+    filesystem rendezvous like output assembly, never inside compute)."""
+    if jax.process_count() > 1:
+        from jax.experimental import multihost_utils
+
+        multihost_utils.sync_global_devices(name)
 
 
 def host_slice(num_events: int, process_id: int, process_count: int):

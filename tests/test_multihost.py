@@ -147,3 +147,69 @@ def test_two_process_2d_mesh_matches_data_mesh():
     assert it2 == it1 == 4
     np.testing.assert_allclose(ll2, ll1, rtol=1e-9)
     np.testing.assert_allclose(m2, m1, rtol=1e-7, atol=1e-10)
+
+
+@pytest.mark.slow
+def test_two_process_cli_byte_identical(tmp_path):
+    """The reference's end-to-end story -- ``mpirun -np 2 gaussianMPI K in
+    out`` producing .summary/.results -- run through THIS CLI: the same
+    command on 2 processes (2 CPU devices each, per-host sharded file
+    loading, cross-process collectives, rank-0 output assembly) must produce
+    byte-identical outputs to a single-process run on the same 4-device
+    mesh. Matches gaussian.cu:128-207, 998-1061."""
+    from .conftest import worker_env
+
+    rng = np.random.default_rng(99)
+    k, d, n = 3, 4, 600
+    centers = rng.normal(scale=10.0, size=(k, d))
+    data = (centers[rng.integers(0, k, n)]
+            + rng.normal(size=(n, d))).astype(np.float32)
+    infile = str(tmp_path / "events.csv")
+    with open(infile, "w") as f:
+        f.write(",".join(f"c{j}" for j in range(d)) + "\n")
+        for row in data:
+            f.write(",".join(f"{v:.6f}" for v in row) + "\n")
+
+    common = [
+        "6", infile, None, "2", "--device=cpu", "--dtype=float64",
+        "--mesh=4", "--chunk-size=64", "--min-iters=5", "--max-iters=5",
+    ]
+    env = worker_env()
+
+    def run_cli(outbase, extra, ndev):
+        argv = list(common)
+        argv[2] = outbase
+        cmd = [sys.executable, "-m", "cuda_gmm_mpi_tpu.cli",
+               *argv, f"--cpu-devices={ndev}", *extra]
+        return subprocess.Popen(cmd, stdout=subprocess.PIPE,
+                                stderr=subprocess.PIPE, env=env, text=True)
+
+    # Single-process reference run: all 4 devices local.
+    p = run_cli(str(tmp_path / "single"), [], 4)
+    out, err = p.communicate(timeout=300)
+    assert p.returncode == 0, f"single-proc CLI failed:\n{out}\n{err[-3000:]}"
+
+    # Two processes x 2 devices over a localhost coordination service.
+    port = _free_port()
+    procs = [
+        run_cli(str(tmp_path / "multi"),
+                [f"--coordinator=127.0.0.1:{port}", "--num-processes=2",
+                 f"--process-id={i}"], 2)
+        for i in range(2)
+    ]
+    for i, p in enumerate(procs):
+        out, err = p.communicate(timeout=300)
+        assert p.returncode == 0, \
+            f"rank {i} CLI failed:\n{out}\n{err[-3000:]}"
+
+    single_summary = (tmp_path / "single.summary").read_bytes()
+    multi_summary = (tmp_path / "multi.summary").read_bytes()
+    assert len(single_summary) > 100
+    assert multi_summary == single_summary
+
+    single_results = (tmp_path / "single.results").read_bytes()
+    multi_results = (tmp_path / "multi.results").read_bytes()
+    assert single_results.count(b"\n") == n
+    assert multi_results == single_results
+    # parts were cleaned up after assembly
+    assert not list(tmp_path.glob("multi.results.part*"))
