@@ -146,25 +146,10 @@ def main(argv=None) -> int:
     from .io.writers import stream_results
     from .models import fit_gmm, iter_memberships
 
-    # MPI_Init equivalent (gaussian.cu:130-140): any distributed flag brings
-    # up the multi-controller runtime; --num-processes=0 initializes from the
-    # environment (TPU pod launchers).
-    if (args.coordinator is not None or args.num_processes is not None
-            or args.process_id is not None):
-        from .parallel import distributed
-
-        try:
-            distributed.initialize(
-                coordinator_address=args.coordinator,
-                num_processes=args.num_processes,
-                process_id=args.process_id,
-                auto=(args.num_processes == 0),
-            )
-        except ValueError as e:
-            print(str(e), file=sys.stderr)
-            return 1
-    pid, nproc = jax.process_index(), jax.process_count()
-
+    # Argument validation BEFORE any backend/runtime initialization
+    # (validateArguments runs before MPI work in the reference too,
+    # gaussian.cu:169): a wedged or absent accelerator must not turn an
+    # arg error's exit code into a backend crash.
     if not os.path.isfile(args.infile):
         print("Invalid infile.\n", file=sys.stderr)  # gaussian.cu:1130
         return 2
@@ -205,6 +190,25 @@ def main(argv=None) -> int:
         print("target_num_clusters must be less than equal to num_clusters\n",
               file=sys.stderr)  # :1150
         return 4
+
+    # MPI_Init equivalent (gaussian.cu:130-140): any distributed flag brings
+    # up the multi-controller runtime; --num-processes=0 initializes from the
+    # environment (TPU pod launchers).
+    if (args.coordinator is not None or args.num_processes is not None
+            or args.process_id is not None):
+        from .parallel import distributed
+
+        try:
+            distributed.initialize(
+                coordinator_address=args.coordinator,
+                num_processes=args.num_processes,
+                process_id=args.process_id,
+                auto=(args.num_processes == 0),
+            )
+        except ValueError as e:
+            print(str(e), file=sys.stderr)
+            return 1
+    pid, nproc = jax.process_index(), jax.process_count()
 
     t_io0 = time.perf_counter()
     try:
