@@ -67,7 +67,11 @@ class GaussianMixture:
         self.result_ = fit_gmm(
             X, self.n_components, self.target_components, config=self.config
         )
-        self._model = GMMModel(self.config)
+        # Inference reuses the FITTED model: a sharded fit keeps its sharded
+        # posterior pass (all local devices in parallel) for
+        # predict/predict_proba/score too, instead of funneling through one
+        # device via a fresh plain model.
+        self._model = self.result_.model or GMMModel(self.config)
         return self
 
     @property
@@ -108,9 +112,10 @@ class GaussianMixture:
         dtype = np.dtype(self.config.dtype)
         X = np.asarray(X, dtype) - res.data_shift[None, :].astype(dtype)
         chunks, _ = chunk_events(X, self.config.chunk_size)
-        w, logz = self._model.memberships(
-            res.state, jnp.asarray(chunks), return_logz=True
-        )
+        # Host chunks passed through: each model places its own blocks (the
+        # sharded model puts them per-shard; an eager jnp.asarray here would
+        # upload the whole dataset to one device first).
+        w, logz = self._model.memberships(res.state, chunks, return_logz=True)
         n = X.shape[0]
         return w[:n], logz[:n]
 

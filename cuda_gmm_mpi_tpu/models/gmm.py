@@ -183,6 +183,16 @@ class GMMModel:
             )
         ))
 
+    @property
+    def inference_block(self) -> int:
+        """Events per output-path dispatch (uniform interface with the
+        sharded model, whose block covers all local devices)."""
+        return self.config.chunk_size
+
+    def infer_posteriors(self, state, xb):
+        """(w [B, K], logZ [B]) for one [inference_block, D] event block."""
+        return self._posteriors(state, jnp.asarray(xb))
+
     def memberships(self, state, data_chunks, return_logz: bool = False):
         """Materialized posteriors [N_padded, K] -- output path only.
 

@@ -12,6 +12,7 @@ rissanen improves and no target K was requested, or when K equals the target.
 
 from __future__ import annotations
 
+import collections
 import contextlib
 import dataclasses
 import functools
@@ -407,14 +408,16 @@ def _fit_with_restarts(data, num_clusters, target_num_clusters, config,
     """n_init independent fits, keep the best Rissanen (capability upgrade;
     the reference's single deterministic init showed local-optima misses).
 
-    Restarts vary the kmeans++ seed (evenly-spaced seeding is deterministic,
-    so restarting it would be pointless); the same model instance is reused
-    across restarts so compiled executables are shared.
+    Init 0 runs with the user's ``seed_method`` (so the deterministic
+    reference init stays in the candidate pool and n_init strictly dominates
+    a single-init run); restarts 1..n-1 vary the kmeans++ seed (restarting
+    the deterministic 'even' seeding would repeat init 0). The same model
+    instance is reused across restarts so compiled executables are shared.
     """
     log = get_logger(config)
     if config.seed_method != "kmeans++":
-        log.info("n_init=%d forces seed_method='kmeans++' (the 'even' "
-                 "seeding is deterministic)", config.n_init)
+        log.info("n_init=%d: init 0 uses seed_method=%r, restarts use "
+                 "'kmeans++'", config.n_init, config.seed_method)
     if model is None:  # one model => executables shared across restarts
         if config.mesh_shape is not None or jax.process_count() > 1:
             from ..parallel import ShardedGMMModel
@@ -425,7 +428,9 @@ def _fit_with_restarts(data, num_clusters, target_num_clusters, config,
     best = None
     for i in range(config.n_init):
         sub = dataclasses.replace(
-            config, n_init=1, seed_method="kmeans++", seed=config.seed + i,
+            config, n_init=1,
+            seed_method=(config.seed_method if i == 0 else "kmeans++"),
+            seed=config.seed + i,
             checkpoint_dir=(os.path.join(config.checkpoint_dir, f"init{i}")
                             if config.checkpoint_dir else None),
         )
@@ -498,13 +503,23 @@ def _run_fused_sweep(fused, config, state, chunks, wts, epsilon,
     )
 
 
-def _posterior_model(result):
-    """The plain (unsharded) model behind a fit result, if it carries one --
-    the output path runs per-host/per-block on local devices."""
-    model = getattr(result, "model", None)
+_fallback_model_cache: "collections.OrderedDict" = collections.OrderedDict()
+
+
+def _fallback_model(config: GMMConfig) -> GMMModel:
+    """Per-config LRU cache (8 slots) for the bare-``config`` output path, so
+    a result that carries no fitted model (e.g. unpickled) pays the
+    posteriors jit once per config instead of once per ``iter_memberships``
+    call -- bounded so a config sweep cannot pin executables forever."""
+    cache = _fallback_model_cache
+    model = cache.get(config)
     if model is None:
-        return None
-    return getattr(model, "_plain", model)  # ShardedGMMModel wraps one
+        model = cache[config] = GMMModel(config)
+        while len(cache) > 8:
+            cache.popitem(last=False)
+    else:
+        cache.move_to_end(config)
+    return model
 
 
 def iter_memberships(
@@ -520,22 +535,27 @@ def iter_memberships(
     reference gathers the whole N x K matrix to rank 0, gaussian.cu:783-823).
 
     Reuses the fitted model carried on ``result`` (already-compiled
-    posteriors executable) when no ``model`` is passed; only a result from a
-    foreign source pays a fresh compilation here.
+    posteriors executable) when no ``model`` is passed; a result from a
+    foreign source gets a per-config cached fallback model.
     """
-    model = model or _posterior_model(result) or GMMModel(config)
+    model = model or getattr(result, "model", None) or _fallback_model(config)
     dtype = np.dtype(config.dtype)
     n, d = data.shape
-    B = config.chunk_size
+    # Sharded models process one chunk PER LOCAL DEVICE per dispatch.
+    B = getattr(model, "inference_block", config.chunk_size)
     shift = np.asarray(result.data_shift, dtype)[None, :]
     state = result.state
     for lo in range(0, n, B):
         block = data[lo:lo + B]
         valid = block.shape[0]
         xb = block.astype(dtype, copy=False) - shift
-        if valid < B:  # pad the tail block to the jitted chunk shape
+        if valid < B:  # pad the tail block to the jitted block shape
             xb = np.concatenate([xb, np.zeros((B - valid, d), dtype)])
-        w, _ = model._posteriors(state, jnp.asarray(xb))
+        # Pass the host block straight through: infer_posteriors does its own
+        # placement (a sharded model device_puts with the data-axis sharding;
+        # an eager jnp.asarray here would commit to one device first and pay
+        # a second device->device reshard).
+        w, _ = model.infer_posteriors(state, xb)
         yield block, np.asarray(jax.device_get(w))[:valid]
 
 
@@ -549,7 +569,7 @@ def compute_memberships(
     E-step, so the stored memberships ARE the posteriors of the final params;
     gaussian.cu:713-714, 768). Materialized variant of ``iter_memberships``.
     """
-    model = model or _posterior_model(result) or GMMModel(config)
+    model = model or getattr(result, "model", None) or _fallback_model(config)
     blocks = [w for _, w in iter_memberships(result, data, config, model)]
     if not blocks:
         return np.zeros((0, result.state.num_clusters_padded),

@@ -1,11 +1,16 @@
 """Pallas/Mosaic TPU kernels -- the hand-tuned hot path (SURVEY L2).
 
 ``should_use_pallas`` decides kernel-vs-jnp per config/platform: the Pallas
-fused E+M kernel needs a TPU (or interpret mode for tests), float32, the
-expanded quadratic form, and an unsharded cluster axis. Full and diagonal
-covariance are both kernelized. ``make_stats_fn`` binds the config's
-covariance mode and tile size into the ``stats_fn`` hook consumed by
-``em_while_loop``.
+fused E+M kernels need a TPU (or interpret mode for tests) and float32. Full
+and diagonal covariance are both kernelized. On cluster-sharded meshes the
+two-pass kernel (per-shard LSE in-kernel, pmax/psum outside -- the
+cross-device generalization of estep1's per-cluster grid axis,
+``gaussian_kernel.cu:383``) is used for DIAGONAL covariance, where the
+kernel's HBM savings dominate; full covariance there stays on the jnp path,
+whose single logp evaluation beats the kernel's two matmul passes (the
+matmul-bound regime where XLA already sits at the roofline, docs/PERF.md).
+``make_stats_fn`` binds the config's covariance mode, tile size, and mesh
+axis into the ``stats_fn`` hook consumed by ``em_while_loop``.
 """
 
 from __future__ import annotations
@@ -14,13 +19,18 @@ import functools
 
 import jax
 
-from .fused_stats import fused_stats_pallas
+from .fused_stats import fused_stats_pallas, fused_stats_pallas_sharded
 
 
 def should_use_pallas(config, cluster_sharded: bool = False) -> bool:
     if config.use_pallas == "never":
         return False
-    if cluster_sharded or config.dtype != "float32":
+    if config.dtype != "float32":
+        return False
+    if cluster_sharded and not config.diag_only:
+        # Full covariance is matmul-bound: the 2-pass sharded kernel would
+        # evaluate the (B, D^2) @ (D^2, K) contraction twice, while the jnp
+        # collective-LSE path does it once at the XLA roofline.
         return False
     if config.use_pallas == "always":
         return True
@@ -30,10 +40,20 @@ def should_use_pallas(config, cluster_sharded: bool = False) -> bool:
         return False
 
 
-def make_stats_fn(config, cluster_sharded: bool = False):
+def make_stats_fn(config, cluster_sharded: bool = False,
+                  cluster_axis: str | None = None):
     """stats_fn hook bound to the config, or None for the jnp path."""
     if not should_use_pallas(config, cluster_sharded):
         return None
+    if cluster_sharded:
+        from ...parallel.mesh import CLUSTER_AXIS
+
+        return functools.partial(
+            fused_stats_pallas_sharded,
+            cluster_axis=cluster_axis or CLUSTER_AXIS,
+            diag_only=config.diag_only,
+            block_b=config.pallas_block_b,
+        )
     return functools.partial(
         fused_stats_pallas,
         diag_only=config.diag_only,
@@ -41,4 +61,5 @@ def make_stats_fn(config, cluster_sharded: bool = False):
     )
 
 
-__all__ = ["fused_stats_pallas", "make_stats_fn", "should_use_pallas"]
+__all__ = ["fused_stats_pallas", "fused_stats_pallas_sharded",
+           "make_stats_fn", "should_use_pallas"]

@@ -161,21 +161,226 @@ def _fused_stats_call(x, wt, A, h, g, *, block_b: int, diag: bool,
     return ll, nk, m1, m2
 
 
-def fused_stats_pallas(
+def _logp_tile(x, A_ref, h_ref, g_ref, diag: bool):
+    """Per-tile unnormalized log posteriors [B_t, K] (shared by both passes)."""
+    bt, d = x.shape
+    if diag:
+        x2 = x * x                    # [B_t, D]
+    else:
+        # Flattened outer products, built in VMEM (see _fused_stats_kernel).
+        x2 = jnp.concatenate([x * x[:, j:j + 1] for j in range(d)], axis=1)
+    q = jax.lax.dot_general(
+        x2, A_ref[:], (((1,), (1,)), ((), ())),
+        preferred_element_type=jnp.float32,
+    )  # [B_t, K]
+    q = q - 2.0 * jax.lax.dot_general(
+        x, h_ref[:], (((1,), (1,)), ((), ())),
+        preferred_element_type=jnp.float32,
+    )
+    return -0.5 * q + g_ref[:], x2    # g broadcasts from [1, K]
+
+
+def _local_lse_kernel(x_ref, A_ref, h_ref, g_ref, m_ref, s_ref, *, diag: bool):
+    """Pass 1 of the cluster-sharded kernel: per-event LOCAL max and shifted
+    exponential sum over this shard's clusters.
+
+    The cross-shard combination (pmax of maxima, psum of rescaled sums --
+    estep2's log-sum-exp generalized across devices, the collective analog of
+    gaussian_kernel.cu:483-494) happens OUTSIDE the kernel in the shard_map
+    body; only [B, 1]-shaped per-event scalars ever leave VMEM.
+    """
+    logp, _ = _logp_tile(x_ref[:], A_ref, h_ref, g_ref, diag)
+    m = jnp.max(logp, axis=1, keepdims=True)      # [B_t, 1]; NEG_LARGE if the
+    e = jnp.exp(logp - m)                         # whole shard is masked (then
+    s = jnp.sum(e, axis=1, keepdims=True)         # exp(m - M) == 0 outside)
+    m_ref[:] = m
+    s_ref[:] = s
+
+
+def _stats_logz_kernel(x_ref, wt_ref, logz_ref, A_ref, h_ref, g_ref,
+                       ll_ref, nk_ref, m1_ref, m2_ref,
+                       ll_acc, nk_acc, m1_acc, m2_acc,
+                       *, diag: bool):
+    """Pass 2 of the cluster-sharded kernel: responsibilities from the GLOBAL
+    per-event evidence (logz) and the same fused M-step accumulation as the
+    single-shard kernel."""
+    i = pl.program_id(0)
+    n_tiles = pl.num_programs(0)
+
+    @pl.when(i == 0)
+    def _init():
+        ll_acc[:] = jnp.zeros_like(ll_acc)
+        nk_acc[:] = jnp.zeros_like(nk_acc)
+        m1_acc[:] = jnp.zeros_like(m1_acc)
+        m2_acc[:] = jnp.zeros_like(m2_acc)
+
+    x = x_ref[:]
+    wt = wt_ref[:]                    # [B_t, 1]
+    logz = logz_ref[:]                # [B_t, 1], replicated across shards
+    logp, x2 = _logp_tile(x, A_ref, h_ref, g_ref, diag)
+
+    # w = exp(logp - logZ): all-masked shards give exp(NEG_LARGE - logz) == 0.
+    w = jnp.exp(logp - logz) * wt
+
+    # loglik = sum logZ over valid events -- identical on every cluster shard
+    # (it is NOT psum'd over the cluster axis, matching the jnp path).
+    ll_acc[:] = ll_acc[:] + jnp.sum(logz * wt).reshape(1, 1)
+    nk_acc[:] += jnp.sum(w, axis=0, keepdims=True)          # [1, K]
+    m1_acc[:] += jax.lax.dot_general(                       # [K, D]
+        w, x, (((0,), (0,)), ((), ())),
+        preferred_element_type=jnp.float32,
+    )
+    m2_acc[:] += jax.lax.dot_general(                       # [K, D*D] | [K, D]
+        w, x2, (((0,), (0,)), ((), ())),
+        preferred_element_type=jnp.float32,
+    )
+
+    @pl.when(i == n_tiles - 1)
+    def _flush():
+        ll_ref[:] = ll_acc[:]
+        nk_ref[:] = nk_acc[:]
+        m1_ref[:] = m1_acc[:]
+        m2_ref[:] = m2_acc[:]
+
+
+@functools.partial(jax.jit, static_argnames=("block_b", "diag", "interpret"))
+def _local_lse_call(x, A, h, g, *, block_b: int, diag: bool, interpret: bool):
+    n, d = x.shape
+    k = A.shape[0]
+    f = A.shape[1]
+    grid = n // block_b
+    f32 = jnp.float32
+    kernel = functools.partial(_local_lse_kernel, diag=diag)
+    row = lambda i: (i, 0)
+    rep = lambda *_: (0, 0)
+    return pl.pallas_call(
+        kernel,
+        grid=(grid,),
+        in_specs=[
+            pl.BlockSpec((block_b, d), row, memory_space=pltpu.VMEM),
+            pl.BlockSpec((k, f), rep, memory_space=pltpu.VMEM),
+            pl.BlockSpec((k, d), rep, memory_space=pltpu.VMEM),
+            pl.BlockSpec((1, k), rep, memory_space=pltpu.VMEM),
+        ],
+        out_specs=(
+            pl.BlockSpec((block_b, 1), row, memory_space=pltpu.VMEM),
+            pl.BlockSpec((block_b, 1), row, memory_space=pltpu.VMEM),
+        ),
+        out_shape=(
+            jax.ShapeDtypeStruct((n, 1), f32),
+            jax.ShapeDtypeStruct((n, 1), f32),
+        ),
+        cost_estimate=pl.CostEstimate(
+            flops=2 * n * k * f,
+            bytes_accessed=n * d * 4 + k * f * 4 + n * 8,
+            transcendentals=n,
+        ),
+        interpret=interpret,
+    )(x, A, h, g)
+
+
+@functools.partial(jax.jit, static_argnames=("block_b", "diag", "interpret"))
+def _stats_logz_call(x, wt, logz, A, h, g, *, block_b: int, diag: bool,
+                     interpret: bool):
+    n, d = x.shape
+    k = A.shape[0]
+    f = A.shape[1]
+    grid = n // block_b
+    f32 = jnp.float32
+    out_shapes = (
+        jax.ShapeDtypeStruct((1, 1), f32),
+        jax.ShapeDtypeStruct((1, k), f32),
+        jax.ShapeDtypeStruct((k, d), f32),
+        jax.ShapeDtypeStruct((k, f), f32),
+    )
+    row = lambda i: (i, 0)
+    rep = lambda *_: (0, 0)
+    kernel = functools.partial(_stats_logz_kernel, diag=diag)
+    return pl.pallas_call(
+        kernel,
+        grid=(grid,),
+        in_specs=[
+            pl.BlockSpec((block_b, d), row, memory_space=pltpu.VMEM),
+            pl.BlockSpec((block_b, 1), row, memory_space=pltpu.VMEM),
+            pl.BlockSpec((block_b, 1), row, memory_space=pltpu.VMEM),
+            pl.BlockSpec((k, f), rep, memory_space=pltpu.VMEM),
+            pl.BlockSpec((k, d), rep, memory_space=pltpu.VMEM),
+            pl.BlockSpec((1, k), rep, memory_space=pltpu.VMEM),
+        ],
+        out_specs=(
+            pl.BlockSpec((1, 1), rep, memory_space=pltpu.VMEM),
+            pl.BlockSpec((1, k), rep, memory_space=pltpu.VMEM),
+            pl.BlockSpec((k, d), rep, memory_space=pltpu.VMEM),
+            pl.BlockSpec((k, f), rep, memory_space=pltpu.VMEM),
+        ),
+        out_shape=out_shapes,
+        scratch_shapes=[
+            pltpu.VMEM((1, 1), f32),
+            pltpu.VMEM((1, k), f32),
+            pltpu.VMEM((k, d), f32),
+            pltpu.VMEM((k, f), f32),
+        ],
+        cost_estimate=pl.CostEstimate(
+            flops=4 * n * k * f,
+            bytes_accessed=n * d * 4 + k * f * 8 + n * 8,
+            transcendentals=n,
+        ),
+        interpret=interpret,
+    )(x, wt, logz, A, h, g)
+
+
+def fused_stats_pallas_sharded(
     state,
     data_chunks: jax.Array,
     wts_chunks: jax.Array | None,
     *,
+    cluster_axis: str,
     diag_only: bool = False,
     block_b: int = 512,
     interpret: bool = False,
 ) -> SuffStats:
-    """SuffStats for all chunks via the fused Pallas kernel.
+    """Cluster-sharded SuffStats: two Pallas passes + collective LSE between.
 
-    Drop-in for ``accumulate_stats`` (unsharded cluster axis; full or diagonal
-    covariance). ``data_chunks`` is the [C, B, D] chunk array; it is viewed
-    flat and gridded into ``block_b``-event tiles.
+    The cross-device generalization of the reference's per-cluster grid axis
+    (estep1's blockIdx.y, ``gaussian_kernel.cu:383``): each device holds a
+    K/cluster_size shard of the model and ALL of its data shard's events.
+    Pass 1 computes each shard's per-event (max, shifted-sum); a pmax+psum
+    pair combines them into the global per-event evidence logZ; pass 2 forms
+    the globally-normalized responsibilities and accumulates this shard's
+    M-step statistics. Only [N, 1] per-event scalars cross HBM between
+    passes -- the [N, K] posteriors still never exist.
+
+    Must be called inside ``shard_map`` with ``cluster_axis`` a live mesh
+    axis name (parallel/sharded_em.py binds it).
     """
+    c, b, d = data_chunks.shape
+    K = state.means.shape[0]
+    x, wt, A, h, g = _prep_inputs(state, data_chunks, wts_chunks, block_b,
+                                  diag_only)
+    m, s = _local_lse_call(x, A, h, g, block_b=block_b, diag=diag_only,
+                           interpret=interpret)
+    # Collective log-sum-exp across cluster shards (outside the kernel):
+    # logZ = M + log(sum_shards exp(m_s - M) * s_s). An all-masked shard has
+    # m_s == NEG_LARGE, so exp(m_s - M) underflows to exactly 0.
+    M = jax.lax.pmax(m, cluster_axis)
+    S = jax.lax.psum(jnp.exp(m - M) * s, cluster_axis)
+    logz = M + jnp.log(S)
+    ll, nk, m1, m2 = _stats_logz_call(
+        x, wt, logz, A, h, g, block_b=block_b, diag=diag_only,
+        interpret=interpret,
+    )
+    dt = data_chunks.dtype
+    return SuffStats(
+        loglik=ll[0, 0].astype(dt),
+        Nk=nk[0].astype(dt),
+        M1=m1.astype(dt),
+        M2=(m2 if diag_only else m2.reshape(K, d, d)).astype(dt),
+    )
+
+
+def _prep_inputs(state, data_chunks, wts_chunks, block_b, diag_only):
+    """Flatten chunks to tile-padded [N, D] and build the per-cluster
+    linear/constant terms (A, h, g) for logp = -0.5 (x2.A - 2 x.h) + g."""
     c, b, d = data_chunks.shape
     n = c * b
     x = data_chunks.reshape(n, d).astype(jnp.float32)
@@ -190,8 +395,6 @@ def fused_stats_pallas(
         x = jnp.concatenate([x, jnp.zeros((pad, d), x.dtype)])
         wt = jnp.concatenate([wt, jnp.zeros((pad, 1), wt.dtype)])
 
-    # Per-cluster linear/constant terms, computed once outside the kernel:
-    # logp = -0.5 (x2.A - 2 x.h) + g
     K = state.means.shape[0]
     Rinv = state.Rinv.astype(jnp.float32)
     mu = state.means.astype(jnp.float32)
@@ -208,7 +411,28 @@ def fused_stats_pallas(
         + jnp.log(jnp.maximum(state.pi.astype(jnp.float32), 1e-37))
     )
     g = jnp.where(state.active, g, NEG_LARGE)[None, :]  # [1, K]
+    return x, wt, A, h, g
 
+
+def fused_stats_pallas(
+    state,
+    data_chunks: jax.Array,
+    wts_chunks: jax.Array | None,
+    *,
+    diag_only: bool = False,
+    block_b: int = 512,
+    interpret: bool = False,
+) -> SuffStats:
+    """SuffStats for all chunks via the fused Pallas kernel.
+
+    Drop-in for ``accumulate_stats`` (unsharded cluster axis; full or diagonal
+    covariance). ``data_chunks`` is the [C, B, D] chunk array; it is viewed
+    flat and gridded into ``block_b``-event tiles.
+    """
+    c, b, d = data_chunks.shape
+    K = state.means.shape[0]
+    x, wt, A, h, g = _prep_inputs(state, data_chunks, wts_chunks, block_b,
+                                  diag_only)
     ll, nk, m1, m2 = _fused_stats_call(
         x, wt, A, h, g, block_b=block_b, diag=diag_only, interpret=interpret
     )

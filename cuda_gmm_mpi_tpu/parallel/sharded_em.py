@@ -31,7 +31,7 @@ from jax import lax
 from jax.sharding import NamedSharding, PartitionSpec as P
 
 from ..config import GMMConfig
-from ..models.gmm import GMMModel, em_while_loop, resolve_iters
+from ..models.gmm import em_while_loop, resolve_iters
 from ..ops.mstep import SuffStats, accumulate_stats
 from ..ops.estep import posteriors
 from .mesh import (
@@ -45,6 +45,30 @@ try:  # jax>=0.4.35 exposes shard_map at top level; fall back to experimental
     shard_map = _shard_map_mod  # pragma: no cover
 except ImportError:  # pragma: no cover
     from jax.experimental.shard_map import shard_map
+
+
+def pad_state_clusters(state, cluster_size: int):
+    """Pad the state's K axis to a multiple of the cluster-axis size with
+    inert (inactive, identity-R) slots. No-op when already aligned."""
+    Kp = pad_clusters(state.num_clusters_padded, cluster_size)
+    if Kp == state.num_clusters_padded:
+        return state
+    pad = Kp - state.num_clusters_padded
+    D = state.num_dimensions
+    eye = jnp.broadcast_to(jnp.eye(D, dtype=state.R.dtype), (pad, D, D))
+    zk = jnp.zeros((pad,), state.N.dtype)
+    return state.replace(
+        N=jnp.concatenate([state.N, zk]),
+        pi=jnp.concatenate([state.pi, zk]),
+        constant=jnp.concatenate([state.constant, zk]),
+        avgvar=jnp.concatenate([state.avgvar, zk]),
+        means=jnp.concatenate(
+            [state.means, jnp.zeros((pad, D), state.means.dtype)]
+        ),
+        R=jnp.concatenate([state.R, eye]),
+        Rinv=jnp.concatenate([state.Rinv, eye]),
+        active=jnp.concatenate([state.active, jnp.zeros((pad,), bool)]),
+    )
 
 
 def make_psum_reduce(data_axis: str = DATA_AXIS):
@@ -70,7 +94,8 @@ class ShardedGMMModel:
     bespoke MPI/OpenMP plumbing through every step of main()).
     """
 
-    def __init__(self, config: GMMConfig = GMMConfig(), mesh=None):
+    def __init__(self, config: GMMConfig = GMMConfig(), mesh=None,
+                 stats_fn=None):
         self.config = config
         self.mesh = mesh if mesh is not None else make_mesh(config.mesh_shape)
         self.data_size = self.mesh.shape[DATA_AXIS]
@@ -86,7 +111,11 @@ class ShardedGMMModel:
 
         from ..ops.pallas import make_stats_fn
 
-        stats_fn = make_stats_fn(config, cluster_sharded=cluster_axis is not None)
+        if stats_fn is None:
+            stats_fn = make_stats_fn(
+                config, cluster_sharded=cluster_axis is not None,
+                cluster_axis=cluster_axis,
+            )
         self._stats_fn = stats_fn
         em_fn = functools.partial(
             em_while_loop,
@@ -107,8 +136,30 @@ class ShardedGMMModel:
                 check_vma=False,
             )
         )
-        # Posterior pass for output: run unsharded (output path only).
-        self._plain = GMMModel(config)
+
+        # Posterior pass for output/inference: ALL local devices in parallel
+        # (the reference computes final memberships on every GPU and gathers,
+        # gaussian.cu:768-823; round-1/2 funneled this through one device).
+        # Multi-host runs use the host-local submesh so each host's output
+        # pass is collective-free across hosts.
+        self._inference_mesh = (
+            self.mesh if jax.process_count() == 1 else self.mesh.local_mesh
+        )
+        self._inference_data_size = self._inference_mesh.shape[DATA_AXIS]
+        post_fn = functools.partial(posteriors, cluster_axis=cluster_axis,
+                                    **kw)
+        self._post_sharded = jax.jit(
+            shard_map(
+                lambda s, x: post_fn(s, x),
+                mesh=self._inference_mesh,
+                in_specs=(sspec, P(DATA_AXIS, None)),
+                out_specs=(P(DATA_AXIS, CLUSTER_AXIS), P(DATA_AXIS)),
+                check_vma=False,
+            )
+        )
+        self._x_sharding = NamedSharding(self._inference_mesh,
+                                         P(DATA_AXIS, None))
+        self._inference_cache = None  # one-slot (id(state) -> prepared)
 
     def prepare(self, state, data_chunks, wts_chunks, host_local: bool = False):
         """Pad K to the cluster-axis size and place data sharded on the mesh.
@@ -119,26 +170,7 @@ class ShardedGMMModel:
         ``distributed.host_chunk_bounds``); the global sharded arrays are then
         assembled with zero cross-host traffic.
         """
-        Kp = pad_clusters(state.num_clusters_padded, self.cluster_size)
-        if Kp != state.num_clusters_padded:
-            pad = Kp - state.num_clusters_padded
-            D = state.num_dimensions
-            eye = jnp.broadcast_to(
-                jnp.eye(D, dtype=state.R.dtype), (pad, D, D)
-            )
-            zk = jnp.zeros((pad,), state.N.dtype)
-            state = state.replace(
-                N=jnp.concatenate([state.N, zk]),
-                pi=jnp.concatenate([state.pi, zk]),
-                constant=jnp.concatenate([state.constant, zk]),
-                avgvar=jnp.concatenate([state.avgvar, zk]),
-                means=jnp.concatenate(
-                    [state.means, jnp.zeros((pad, D), state.means.dtype)]
-                ),
-                R=jnp.concatenate([state.R, eye]),
-                Rinv=jnp.concatenate([state.Rinv, eye]),
-                active=jnp.concatenate([state.active, jnp.zeros((pad,), bool)]),
-            )
+        state = pad_state_clusters(state, self.cluster_size)
         sspec = state_pspecs()
         if jax.process_count() > 1:
             if not host_local:
@@ -260,6 +292,68 @@ class ShardedGMMModel:
 
         return cached_fused_sweep(self, static, build)
 
-    def memberships(self, state, data_chunks) -> np.ndarray:
-        state = jax.device_get(state)
-        return self._plain.memberships(state, np.asarray(data_chunks))
+    @property
+    def inference_block(self) -> int:
+        """Events per output-path block: one chunk per local data shard."""
+        return self.config.chunk_size * self._inference_data_size
+
+    def _prepare_inference(self, state):
+        """(placed_state, K_columns): pad K to the cluster axis and place on
+        the inference mesh. One-slot cache keyed on the state's identity so a
+        streamed output pass prepares once."""
+        cached = self._inference_cache
+        if cached is not None and cached[0] is state:
+            return cached[1], cached[2]
+        k_cols = int(np.asarray(state.N).shape[0])
+        prepared = pad_state_clusters(
+            jax.tree_util.tree_map(jnp.asarray, state), self.cluster_size
+        )
+        sspec = state_pspecs()
+        prepared = jax.device_put(
+            prepared,
+            jax.tree_util.tree_map(
+                lambda s: NamedSharding(self._inference_mesh, s), sspec
+            ),
+        )
+        # Hold the state object itself (not id()): the strong reference pins
+        # it, so a recycled address can never serve a stale prepared state.
+        self._inference_cache = (state, prepared, k_cols)
+        return prepared, k_cols
+
+    def infer_posteriors(self, state, xb):
+        """(w [B, K], logZ [B]) for one [inference_block, D] event block,
+        computed on all local devices in parallel. ``state`` is the plain
+        (compacted, unpadded) fit result state."""
+        prepared, k_cols = self._prepare_inference(state)
+        # device_put straight from the host buffer: one per-shard placement,
+        # no intermediate default-device commit.
+        xb = jax.device_put(xb, self._x_sharding)
+        w, logz = self._post_sharded(prepared, xb)
+        return w[:, :k_cols], logz
+
+    def memberships(self, state, data_chunks, return_logz: bool = False):
+        """Materialized posteriors [N_padded, K] -- output path only.
+
+        Same contract as GMMModel.memberships, but each block of
+        ``_inference_data_size`` chunks is evaluated in ONE sharded dispatch
+        across the host's local devices (the within-host half of the
+        reference's all-GPU membership recompute, gaussian.cu:768-823).
+        """
+        chunks = np.asarray(data_chunks)
+        C, B, D = chunks.shape
+        S = self._inference_data_size
+        w_out, z_out = [], []
+        for i in range(0, C, S):
+            blk = chunks[i:i + S]
+            nvalid = blk.shape[0]
+            if nvalid < S:  # pad the tail to a whole sharded block
+                blk = np.concatenate(
+                    [blk, np.zeros((S - nvalid, B, D), blk.dtype)])
+            w, logz = self.infer_posteriors(state, blk.reshape(S * B, D))
+            w_out.append(np.asarray(jax.device_get(w))[:nvalid * B])
+            if return_logz:
+                z_out.append(np.asarray(jax.device_get(logz))[:nvalid * B])
+        w = np.concatenate(w_out, axis=0)
+        if return_logz:
+            return w, np.concatenate(z_out, axis=0)
+        return w

@@ -53,6 +53,27 @@ def test_cli_bin_input(tmp_path, rng):
     assert (tmp_path / "o.summary").exists()
 
 
+def test_cli_mesh_byte_identical(csv_file, tmp_path):
+    """A --mesh=8 run (sharded fit + sharded output pass over all 8 fake
+    devices) produces byte-identical .summary/.results to the single-device
+    run -- the within-host analog of the 2-process byte-identity test."""
+    args = ["3", csv_file, None, "3", "--min-iters=3", "--max-iters=3",
+            "--chunk-size=64", "--dtype=float64"]
+    a1, a8 = list(args), list(args)
+    a1[2] = str(tmp_path / "m1")
+    a8[2] = str(tmp_path / "m8")
+    a8.append("--mesh=8")
+    assert run_cli(a1) == 0
+    assert run_cli(a8) == 0
+    assert ((tmp_path / "m8.summary").read_bytes()
+            == (tmp_path / "m1.summary").read_bytes())
+    with open(csv_file) as f:
+        n_events = len(f.read().splitlines()) - 1  # minus header
+    r1 = (tmp_path / "m1.results").read_bytes()
+    assert r1.count(b"\n") == n_events
+    assert (tmp_path / "m8.results").read_bytes() == r1
+
+
 def test_cli_invalid_infile(tmp_path):
     rc = run_cli(["3", str(tmp_path / "missing.csv"), "out"])
     assert rc == 2  # gaussian.cu:1132

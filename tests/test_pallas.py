@@ -16,7 +16,9 @@ from cuda_gmm_mpi_tpu.models.gmm import GMMModel, chunk_events
 from cuda_gmm_mpi_tpu.ops.formulas import convergence_epsilon
 from cuda_gmm_mpi_tpu.ops.mstep import accumulate_stats
 from cuda_gmm_mpi_tpu.ops.pallas import should_use_pallas
-from cuda_gmm_mpi_tpu.ops.pallas.fused_stats import fused_stats_pallas
+from cuda_gmm_mpi_tpu.ops.pallas.fused_stats import (
+    fused_stats_pallas, fused_stats_pallas_sharded,
+)
 from cuda_gmm_mpi_tpu.ops.seeding import seed_clusters_host
 
 from .conftest import make_blobs
@@ -145,7 +147,87 @@ def test_should_use_pallas_gating():
     assert not should_use_pallas(GMMConfig(use_pallas="always",
                                            dtype="float64"))
     assert should_use_pallas(GMMConfig(use_pallas="always"))
+    # Cluster-sharded: the 2-pass kernel covers diagonal covariance; full
+    # covariance stays on the jnp collective-LSE path (matmul-bound).
+    assert should_use_pallas(GMMConfig(use_pallas="always", diag_only=True),
+                             cluster_sharded=True)
     assert not should_use_pallas(GMMConfig(use_pallas="always"),
                                  cluster_sharded=True)
     # auto on CPU -> False
     assert not should_use_pallas(GMMConfig(use_pallas="auto"))
+
+
+sharded_interp = functools.partial(
+    fused_stats_pallas_sharded, block_b=64, interpret=True,
+    cluster_axis="cluster",
+)
+
+
+@pytest.mark.parametrize("mesh_shape", [(2, 4), (1, 8)])
+@pytest.mark.parametrize("diag", [True, False])
+def test_sharded_kernel_matches_single(rng, mesh_shape, diag):
+    """2-pass cluster-sharded kernel under shard_map == unsharded EM.
+
+    The cross-device generalization of estep1's per-cluster grid axis
+    (gaussian_kernel.cu:383): parity on (2,4) and (1,8) meshes, full and
+    diagonal covariance, through a real multi-iteration EM loop.
+    """
+    from cuda_gmm_mpi_tpu.parallel import ShardedGMMModel, make_mesh
+
+    data, _ = make_blobs(rng, n=1024, d=3, k=5, dtype=np.float32)
+    k = 5
+    cfg32 = GMMConfig(min_iters=4, max_iters=4, chunk_size=128,
+                      dtype="float32", diag_only=diag)
+
+    # Unsharded reference (jnp path, float32 to match the kernel dtype).
+    m_ref = GMMModel(cfg32)
+    chunks, wts = chunk_events(data, cfg32.chunk_size)
+    state = seed_clusters_host(data, k)
+    eps = convergence_epsilon(*data.shape)
+    s_ref, ll_ref, _ = m_ref.run_em(
+        state, jnp.asarray(chunks), jnp.asarray(wts), eps)
+
+    cfg_mesh = GMMConfig(min_iters=4, max_iters=4, chunk_size=128,
+                         dtype="float32", diag_only=diag,
+                         mesh_shape=mesh_shape)
+    model = ShardedGMMModel(
+        cfg_mesh, stats_fn=functools.partial(sharded_interp, diag_only=diag))
+    chunks_s, wts_s = chunk_events(data, cfg_mesh.chunk_size, model.data_size)
+    state_s = seed_clusters_host(data, k)
+    state_s, chunks_s, wts_s = model.prepare(state_s, chunks_s, wts_s)
+    s_sh, ll_sh, _ = model.run_em(state_s, chunks_s, wts_s, eps)
+
+    np.testing.assert_allclose(float(ll_sh), float(ll_ref), rtol=1e-5)
+    kp = np.asarray(s_ref.means).shape[0]
+    np.testing.assert_allclose(np.asarray(s_sh.means)[:kp],
+                               np.asarray(s_ref.means), rtol=1e-3, atol=1e-3)
+    np.testing.assert_allclose(np.asarray(s_sh.N)[:kp], np.asarray(s_ref.N),
+                               rtol=1e-4, atol=1e-3)
+
+
+def test_sharded_kernel_padded_clusters(rng):
+    """K not divisible by the cluster axis: the padded shard's all-masked
+    tail must contribute exactly nothing through the collective LSE."""
+    from cuda_gmm_mpi_tpu.parallel import ShardedGMMModel
+
+    data, _ = make_blobs(rng, n=512, d=3, k=3, dtype=np.float32)
+    cfg = GMMConfig(min_iters=3, max_iters=3, chunk_size=128,
+                    dtype="float32", mesh_shape=(1, 8), diag_only=True)
+    model = ShardedGMMModel(
+        cfg, stats_fn=functools.partial(sharded_interp, diag_only=True))
+    chunks, wts = chunk_events(data, cfg.chunk_size, model.data_size)
+    state = seed_clusters_host(data, 3)  # K=3 padded to 8
+    state, chunks, wts = model.prepare(state, chunks, wts)
+    eps = convergence_epsilon(*data.shape)
+    s_sh, ll_sh, _ = model.run_em(state, chunks, wts, eps)
+
+    m_ref = GMMModel(GMMConfig(min_iters=3, max_iters=3, chunk_size=128,
+                               dtype="float32", diag_only=True))
+    chunks_r, wts_r = chunk_events(data, 128)
+    s_ref, ll_ref, _ = m_ref.run_em(
+        seed_clusters_host(data, 3), jnp.asarray(chunks_r),
+        jnp.asarray(wts_r), eps)
+    np.testing.assert_allclose(float(ll_sh), float(ll_ref), rtol=1e-5)
+    act = np.asarray(s_sh.active)
+    assert act[:3].all() and not act[3:].any()
+    assert np.asarray(s_sh.N)[3:].max() == 0.0
