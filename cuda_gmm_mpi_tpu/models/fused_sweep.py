@@ -23,6 +23,7 @@ from __future__ import annotations
 from typing import Callable, Optional
 
 import jax
+import jax.experimental
 import jax.numpy as jnp
 from jax import lax
 
@@ -38,6 +39,7 @@ def fused_sweep(
     epsilon,
     min_iters,
     max_iters,
+    resume=None,
     *,
     start_k: int,
     stop_number: int,
@@ -51,6 +53,7 @@ def fused_sweep(
     stats_fn: Optional[Callable] = None,
     reduce_stats: Optional[Callable] = None,
     reduce_order_fn: Optional[Callable] = None,
+    emit_cb: Optional[Callable] = None,
 ):
     """Run the whole K-sweep on device.
 
@@ -62,6 +65,15 @@ def fused_sweep(
     order-reduction step -- the hook through which the cluster-sharded path
     substitutes an all-gather-then-reslice variant (the pair scan needs the
     full K-state; see parallel/sharded_em.py).
+
+    ``emit_cb(payload)`` is an optional HOST callback invoked (via ordered
+    ``io_callback``) once per completed K with the sweep position -- the hook
+    through which --fused-sweep composes with per-K checkpointing without
+    giving up the one-dispatch design. ``resume`` restores a mid-sweep
+    position emitted by a previous run's ``emit_cb``: a dict with
+    ``best_state`` (pytree like ``state``), ``k``, ``step``, ``best_ll``,
+    ``best_riss``, ``log`` -- all dynamic values, so resuming reuses the
+    compiled executable.
     """
     if reduce_order_fn is None:
         reduce_order_fn = lambda s: eliminate_and_reduce(s, diag_only=diag_only)
@@ -97,6 +109,15 @@ def fused_sweep(
         step=jnp.asarray(0, jnp.int32),
         done=jnp.asarray(False),
     )
+    if resume is not None:
+        carry0.update(
+            best_state=resume["best_state"],
+            k=jnp.asarray(resume["k"], jnp.int32),
+            best_ll=jnp.asarray(resume["best_ll"], dtype),
+            best_riss=jnp.asarray(resume["best_riss"], score_dtype),
+            log=jnp.asarray(resume["log"], dtype),
+            step=jnp.asarray(resume["step"], jnp.int32),
+        )
 
     def cond(c):
         return (~c["done"]) & (c["step"] < start_k)
@@ -135,7 +156,7 @@ def fused_sweep(
         new_state = jax.tree_util.tree_map(
             lambda a, b: jnp.where(cont, a, b), next_state, s
         )
-        return dict(
+        new_carry = dict(
             state=new_state,
             k=jnp.where(cont, k_active - 1, k),
             best_state=best_state,
@@ -145,6 +166,24 @@ def fused_sweep(
             step=c["step"] + 1,
             done=~cont,
         )
+        if emit_cb is not None:
+            # Per-K host emission (checkpoint payload + log row): ordered so
+            # a checkpoint for step s is durable before step s+1's runs.
+            jax.experimental.io_callback(
+                emit_cb, None,
+                dict(
+                    step=c["step"], k=k, ll=ll, riss=riss, iters=iters,
+                    state=new_carry["state"],
+                    best_state=best_state,
+                    best_ll=new_carry["best_ll"],
+                    best_riss=new_carry["best_riss"],
+                    log=log,
+                    next_k=new_carry["k"],
+                    done=new_carry["done"],
+                ),
+                ordered=True,
+            )
+        return new_carry
 
     out = lax.while_loop(cond, body, carry0)
     return (

@@ -109,11 +109,17 @@ class GMMModel:
     departure from the reference's realloc/compact design (SURVEY.md SS7.3).
     """
 
+    # The plain model's fused sweep supports per-K host emission (the
+    # io_callback checkpoint hook); the sharded model's does not (callbacks
+    # under shard_map observe per-device shards).
+    supports_fused_emit = True
+
     def __init__(self, config: GMMConfig = GMMConfig(),
                  reduce_stats: Optional[ReduceFn] = None,
                  stats_fn: Optional[Callable] = None):
         self.config = config
         self.reduce_stats = reduce_stats
+        self._emit_target = None  # host sink for fused-sweep per-K emission
 
         kw = dict(
             diag_only=config.diag_only,
@@ -171,17 +177,31 @@ class GMMModel:
     def estep_stats(self, state, data_chunks, wts_chunks) -> SuffStats:
         return self._estep_stats(state, data_chunks, wts_chunks)
 
-    def make_fused_sweep(self, **static):
+    def make_fused_sweep(self, with_emit: bool = False, **static):
         """Jitted whole-sweep-on-device callable (models/fused_sweep.py),
-        cached per static config so repeat fits reuse the executable."""
+        cached per static config so repeat fits reuse the executable.
+
+        ``with_emit=True`` compiles in the per-K ordered io_callback; the
+        actual host sink is read from ``self._emit_target`` at call time, so
+        the cached executable is reused across fits with different
+        checkpointers."""
         from .fused_sweep import fused_sweep
 
-        return cached_fused_sweep(self, static, lambda: jax.jit(
-            functools.partial(
-                fused_sweep, stats_fn=self.stats_fn,
-                reduce_stats=self.reduce_stats, **self._kw, **static,
-            )
-        ))
+        emit_cb = None
+        if with_emit:
+            def emit_cb(payload):
+                target = self._emit_target
+                if target is not None:
+                    target(payload)
+
+        return cached_fused_sweep(
+            self, dict(static, with_emit=with_emit), lambda: jax.jit(
+                functools.partial(
+                    fused_sweep, stats_fn=self.stats_fn,
+                    reduce_stats=self.reduce_stats, emit_cb=emit_cb,
+                    **self._kw, **static,
+                )
+            ))
 
     @property
     def inference_block(self) -> int:

@@ -146,34 +146,47 @@ def fit_gmm(
     log.debug("epsilon=%s n=%d d=%d k=%d", epsilon, n_events, n_dims,
               num_clusters)
 
+    ckpt = None
+    if config.checkpoint_dir:
+        from ..utils.checkpoint import SweepCheckpointer
+
+        # All ranks construct and call the checkpointer; orbax coordinates
+        # multi-process saves (primary host writes). Multi-host runs require
+        # checkpoint_dir on a filesystem every rank can read (on TPU pods
+        # that is GCS/NFS by construction; docs/DISTRIBUTED.md).
+        ckpt = SweepCheckpointer(config.checkpoint_dir)
+
     if config.fused_sweep:
-        blockers = [
-            name for name, on in [
-                ("checkpoint_dir", bool(config.checkpoint_dir)),
-                ("profile", config.profile),
-            ] if on
-        ]
-        fused = None
-        if not blockers:
-            maker = getattr(model, "make_fused_sweep", None)
-            if maker is None:
-                blockers.append("model without fused-sweep support")
-            else:
-                fused = maker(
-                    start_k=num_clusters, stop_number=stop_number,
-                    target_k=target_num_clusters,
-                    num_events=n_events, num_dimensions=n_dims,
-                )
+        blockers = []
+        if config.profile:
+            blockers.append("profile")
+        if ckpt is not None and nproc > 1:
+            blockers.append("checkpointing on a multi-controller run")
+        maker = getattr(model, "make_fused_sweep", None)
+        if maker is None:
+            blockers.append("model without fused-sweep support")
+        elif (ckpt is not None and nproc == 1
+              and not getattr(model, "supports_fused_emit", False)):
+            blockers.append("per-K checkpoint emission on this model")
         if blockers:
             log.warning(
                 "fused_sweep disabled (%s requested); using the host-driven "
                 "sweep", ", ".join(blockers),
             )
         else:
+            kwargs = dict(
+                start_k=num_clusters, stop_number=stop_number,
+                target_k=target_num_clusters,
+                num_events=n_events, num_dimensions=n_dims,
+            )
+            if ckpt is not None:
+                kwargs["with_emit"] = True
+            fused = maker(**kwargs)
             return _run_fused_sweep(
                 fused, config, state, chunks, wts, epsilon,
                 num_clusters, stop_number, target_num_clusters,
                 n_events, n_dims, shift, verbose, host_range, model,
+                ckpt=ckpt, log=log,
             )
 
     # One fused dispatch for the whole order-reduction step, so each K costs
@@ -188,19 +201,22 @@ def fit_gmm(
     k = num_clusters
     step = 0
 
-    ckpt = None
-    if config.checkpoint_dir and nproc > 1:
-        log.warning("checkpointing is single-controller only; disabled for "
-                    "this %d-process run", nproc)
-    elif config.checkpoint_dir:
-        from ..utils.checkpoint import SweepCheckpointer
-
-        ckpt = SweepCheckpointer(config.checkpoint_dir)
+    if ckpt is not None:
         restored = ckpt.restore()
+        if restored is not None and "fused_log" in restored:
+            log.warning("found a fused-sweep checkpoint; the host-driven "
+                        "sweep cannot resume it -- starting fresh")
+            restored = None
         if restored is not None and int(restored["num_clusters"]) == num_clusters:
             state = restored["state"]
-            if hasattr(model, "prepare"):
-                state, _, _ = model.prepare(state, chunks_np, wts_np)
+            if hasattr(model, "prepare_state"):
+                # Place ONLY the restored state on the mesh (the data chunks
+                # were already prepared above; re-preparing them would pay a
+                # second full host->device upload). Multi-host: every rank
+                # restored the identical host-local state (shared checkpoint
+                # FS); re-assembly is local.
+                state = model.prepare_state(
+                    jax.tree_util.tree_map(jnp.asarray, state))
             best_state = restored["best_state"]
             min_rissanen = float(restored["min_rissanen"])
             ideal_k = int(restored["ideal_k"])
@@ -279,8 +295,8 @@ def fit_gmm(
         if ckpt is not None:
             with phase("cpu"):
                 ckpt.save(step, {
-                    "state": jax.device_get(state),
-                    "best_state": jax.device_get(best_state),
+                    "state": _host_state(state, model),
+                    "best_state": _host_state(best_state, model),
                     "min_rissanen": float(min_rissanen),
                     "ideal_k": int(ideal_k),
                     "best_ll": float(best_ll),
@@ -311,6 +327,31 @@ def fit_gmm(
         host_range=host_range,
         model=model,
     )
+
+
+def _host_state(state, model):
+    """Fully host-local numpy copy of a (possibly multi-host global) state.
+
+    Under a multi-controller runtime the EM state is a global sharded array
+    (replicated across the data axis, cluster axis within one host), which
+    ``jax.device_get`` cannot fetch directly; convert each host's view to a
+    host-local array first. Already-host trees (a restored checkpoint) pass
+    through untouched.
+    """
+    leaves = jax.tree_util.tree_leaves(state)
+    needs_convert = jax.process_count() > 1 and any(
+        isinstance(l, jax.Array) and not l.is_fully_addressable
+        for l in leaves
+    )
+    if needs_convert:
+        from jax.experimental import multihost_utils
+
+        from ..parallel.mesh import state_pspecs
+
+        state = multihost_utils.global_array_to_host_local_array(
+            state, model.mesh, state_pspecs()
+        )
+    return jax.device_get(state)
 
 
 def _prepare_fit(data, num_clusters, config, model, phase, log):
@@ -454,24 +495,77 @@ def _fit_with_restarts(data, num_clusters, target_num_clusters, config,
 def _run_fused_sweep(fused, config, state, chunks, wts, epsilon,
                      num_clusters, stop_number, target_num_clusters,
                      n_events, n_dims, shift, verbose,
-                     host_range=None, model=None):
+                     host_range=None, model=None, ckpt=None, log=None):
     """Whole-sweep-on-device path (models/fused_sweep.py): one dispatch,
     one sync. ``fused`` comes from the model's ``make_fused_sweep`` (cached
     there, so passing the same ``model=`` to fit_gmm reuses the executable).
     Reconstructs the host sweep_log from the device log afterward (per-K
     ``seconds`` are the amortized wall time -- individual K timings do not
-    exist off-device by design)."""
+    exist off-device by design).
+
+    With ``ckpt`` set, each completed K emits its sweep position to the host
+    through the fused program's ordered ``io_callback`` hook and is saved as
+    a checkpoint; a surviving checkpoint resumes mid-sweep with dynamic
+    resume args (same compiled executable shape)."""
     dtype = chunks.dtype
+
+    resume = None
+    if ckpt is not None:
+        restored = ckpt.restore()
+        if (restored is not None
+                and int(restored.get("num_clusters", -1)) == num_clusters):
+            if "fused_log" not in restored:
+                if log:
+                    log.warning("found a host-sweep checkpoint; the fused "
+                                "sweep cannot resume it -- starting fresh")
+            else:
+                state = restored["state"]
+                resume = dict(
+                    best_state=restored["best_state"],
+                    k=int(restored["k"]),
+                    step=int(restored["step"]) + 1,
+                    best_ll=float(restored["best_ll"]),
+                    best_riss=float(restored["best_riss"]),
+                    log=np.asarray(restored["fused_log"]),
+                )
+                if log:
+                    log.info("resumed fused sweep from checkpoint: next "
+                             "K=%d (step %d)", resume["k"], resume["step"])
+                if verbose:
+                    print(f"resumed fused sweep at K={resume['k']}")
+
+        def emit(payload):
+            if bool(payload["done"]):
+                return  # the run returns its result right after this step
+            ckpt.save(int(payload["step"]), {
+                "state": payload["state"],
+                "best_state": payload["best_state"],
+                "k": int(payload["next_k"]),
+                "best_ll": float(payload["best_ll"]),
+                "best_riss": float(payload["best_riss"]),
+                "fused_log": np.asarray(payload["log"]),
+                "num_clusters": int(num_clusters),
+            })
+
+        model._emit_target = emit
+
     t0 = time.perf_counter()
-    best_state, best_ll, best_riss, log_rows, steps = fused(
+    args = [
         state, chunks, wts,
         jnp.asarray(epsilon, dtype),
         jnp.asarray(config.min_iters, jnp.int32),
         jnp.asarray(config.max_iters, jnp.int32),
-    )
-    best_state, best_ll, best_riss, log_rows, steps = jax.device_get(
-        (best_state, best_ll, best_riss, log_rows, steps)
-    )
+    ]
+    if ckpt is not None:
+        args.append(resume)
+    try:
+        best_state, best_ll, best_riss, log_rows, steps = fused(*args)
+        best_state, best_ll, best_riss, log_rows, steps = jax.device_get(
+            (best_state, best_ll, best_riss, log_rows, steps)
+        )
+    finally:
+        if ckpt is not None:
+            model._emit_target = None
     wall = time.perf_counter() - t0
 
     steps = int(steps)

@@ -170,8 +170,6 @@ class ShardedGMMModel:
         ``distributed.host_chunk_bounds``); the global sharded arrays are then
         assembled with zero cross-host traffic.
         """
-        state = pad_state_clusters(state, self.cluster_size)
-        sspec = state_pspecs()
         if jax.process_count() > 1:
             if not host_local:
                 raise ValueError(
@@ -187,20 +185,11 @@ class ShardedGMMModel:
             # Multi-controller: the chunk arrays passed in are HOST-LOCAL
             # (this host's equal-shaped slice from host_chunk_bounds);
             # assemble the global sharded arrays with zero cross-host
-            # traffic. The state is replicated on every host; converting it
-            # likewise requires that no cluster shard spans hosts.
+            # traffic.
             from jax.experimental import multihost_utils
 
             from .distributed import sharded_chunks_from_host_data
 
-            local_cluster = self.mesh.local_mesh.shape[CLUSTER_AXIS]
-            if local_cluster != self.cluster_size:
-                raise NotImplementedError(
-                    "multi-host runs require the cluster mesh axis to fit "
-                    f"within one host (cluster axis {self.cluster_size}, "
-                    f"host-local extent {local_cluster}); put hosts on the "
-                    "data axis"
-                )
             # Fail fast (with a clear error, not a shape-mismatch deadlock)
             # if hosts chunked their slices inconsistently -- use
             # distributed.host_chunk_bounds to guarantee equal counts.
@@ -212,18 +201,38 @@ class ShardedGMMModel:
             chunks, wts = sharded_chunks_from_host_data(
                 self.mesh, np.asarray(data_chunks), np.asarray(wts_chunks)
             )
-            state = multihost_utils.host_local_array_to_global_array(
-                state, self.mesh, sspec
-            )
         else:
             chunks, wts = shard_chunks(self.mesh, data_chunks, wts_chunks)
-            state = jax.device_put(
-                state,
-                jax.tree_util.tree_map(
-                    lambda s: NamedSharding(self.mesh, s), sspec
-                ),
+        return self.prepare_state(state), chunks, wts
+
+    def prepare_state(self, state):
+        """Pad the state's K axis to the cluster mesh axis and place it on
+        the mesh -- WITHOUT touching any data chunks (the checkpoint-restore
+        path uses this so resuming never re-uploads the dataset). The state
+        is replicated on every host; converting it requires that no cluster
+        shard spans hosts."""
+        state = pad_state_clusters(state, self.cluster_size)
+        sspec = state_pspecs()
+        if jax.process_count() > 1:
+            from jax.experimental import multihost_utils
+
+            local_cluster = self.mesh.local_mesh.shape[CLUSTER_AXIS]
+            if local_cluster != self.cluster_size:
+                raise NotImplementedError(
+                    "multi-host runs require the cluster mesh axis to fit "
+                    f"within one host (cluster axis {self.cluster_size}, "
+                    f"host-local extent {local_cluster}); put hosts on the "
+                    "data axis"
+                )
+            return multihost_utils.host_local_array_to_global_array(
+                state, self.mesh, sspec
             )
-        return state, chunks, wts
+        return jax.device_put(
+            state,
+            jax.tree_util.tree_map(
+                lambda s: NamedSharding(self.mesh, s), sspec
+            ),
+        )
 
     def run_em(self, state, data_chunks, wts_chunks, epsilon: float,
                min_iters: Optional[int] = None, max_iters: Optional[int] = None):

@@ -1,0 +1,65 @@
+"""Worker for the 2-process distributed checkpoint/resume test.
+
+Each worker is one "host" of a simulated 2-host cluster running the full
+fit_gmm sweep with checkpointing enabled -- the configuration the reference
+actually deployed (MPI cluster, README.txt:18) where its only recovery story
+was a full restart (SURVEY.md SS5.3). Checkpoints are written through the
+multi-process orbax path (every rank calls save, primary writes) and a
+restarted pair of workers must resume mid-sweep.
+
+Usage: python multihost_ckpt_worker.py <pid> <nproc> <port> <ckdir>
+Prints one line: RESULT {json}
+"""
+
+import json
+import sys
+
+
+def main() -> int:
+    pid, nproc, port, ckdir = (
+        int(sys.argv[1]), int(sys.argv[2]), sys.argv[3], sys.argv[4],
+    )
+
+    import jax
+
+    jax.config.update("jax_platforms", "cpu")
+    jax.config.update("jax_num_cpu_devices", 2)
+    jax.config.update("jax_enable_x64", True)
+
+    from cuda_gmm_mpi_tpu.parallel import distributed
+
+    distributed.initialize(
+        coordinator_address=f"127.0.0.1:{port}",
+        num_processes=nproc,
+        process_id=pid,
+    )
+
+    import numpy as np
+
+    from cuda_gmm_mpi_tpu.config import GMMConfig
+    from cuda_gmm_mpi_tpu.models import fit_gmm
+
+    # Deterministic dataset, identical on every host (stands in for a shared
+    # input file); fit_gmm's multi-host path slices per host internally.
+    rng = np.random.default_rng(77)
+    centers = rng.normal(scale=9.0, size=(4, 3))
+    data = (centers[rng.integers(0, 4, 2048)]
+            + rng.normal(size=(2048, 3))).astype(np.float64)
+
+    cfg = GMMConfig(min_iters=5, max_iters=5, chunk_size=64, dtype="float64",
+                    checkpoint_dir=ckdir, enable_print=True)
+    r = fit_gmm(data, 10, 2, config=cfg)
+    print("RESULT " + json.dumps({
+        "pid": pid,
+        "ideal_k": r.ideal_num_clusters,
+        "min_rissanen": r.min_rissanen,
+        "final_loglik": r.final_loglik,
+        "means": np.asarray(r.means).tolist(),
+        "sweep_ks": [int(row[0]) for row in r.sweep_log],
+    }), flush=True)
+    jax.distributed.shutdown()
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -21,6 +21,10 @@ WORKER = r"""
 import json, sys
 import jax
 jax.config.update("jax_platforms", "cpu")
+mesh = sys.argv[2] if len(sys.argv) > 2 else ""
+fused = len(sys.argv) > 3 and sys.argv[3] == "fused"
+if mesh:
+    jax.config.update("jax_num_cpu_devices", 8)
 jax.config.update("jax_enable_x64", True)
 import numpy as np
 from cuda_gmm_mpi_tpu.config import GMMConfig
@@ -32,7 +36,10 @@ centers = rng.normal(scale=9.0, size=(4, 3))
 data = (centers[rng.integers(0, 4, 4000)]
         + rng.normal(size=(4000, 3))).astype(np.float64)
 cfg = GMMConfig(min_iters=6, max_iters=6, chunk_size=512, dtype="float64",
-                checkpoint_dir=ckdir, enable_print=True)
+                checkpoint_dir=ckdir, enable_print=True,
+                fused_sweep=fused,
+                mesh_shape=(tuple(int(x) for x in mesh.split(","))
+                            if mesh else None))
 r = fit_gmm(data, 12, 2, config=cfg)
 print(json.dumps({
     "ideal_k": r.ideal_num_clusters,
@@ -44,24 +51,29 @@ print(json.dumps({
 """
 
 
-def _spawn(ckdir: str):
+def _spawn(ckdir: str, mesh: str = "", fused: bool = False):
     from .conftest import worker_env
 
+    extra = [mesh, "fused"] if fused else ([mesh] if mesh else [])
     return subprocess.Popen(
-        [sys.executable, "-c", WORKER, ckdir],
+        [sys.executable, "-c", WORKER, ckdir, *extra],
         stdout=subprocess.PIPE, stderr=subprocess.PIPE, env=worker_env(),
         text=True,
     )
 
 
 @pytest.mark.slow
-def test_sigkill_mid_sweep_then_resume(tmp_path):
+@pytest.mark.parametrize("mesh", ["", "4,2"])
+def test_sigkill_mid_sweep_then_resume(tmp_path, mesh):
+    """Kill/resume for the host-driven sweep -- plain single device AND a
+    (4,2) sharded mesh (the deployment shape the reference ran on; round-3
+    closure of 'no kill/resume test exists with mesh_shape set')."""
     ck = str(tmp_path / "ck")
     sweep_dir = os.path.join(ck, "sweep")
 
     # Run 1: killed (SIGKILL, no cleanup chance) once >= 2 checkpoint steps
     # exist but the 11-step sweep is far from done.
-    p = _spawn(ck)
+    p = _spawn(ck, mesh)
     deadline = time.time() + 300
     try:
         while time.time() < deadline:
@@ -90,7 +102,7 @@ def test_sigkill_mid_sweep_then_resume(tmp_path):
     # Run 2: resumes from the surviving checkpoint and completes.
     from .conftest import communicate_or_kill
 
-    p2 = _spawn(ck)
+    p2 = _spawn(ck, mesh)
     out, err = communicate_or_kill(p2, timeout=600)
     assert p2.returncode == 0, f"resume failed:\n{out}\n{err[-3000:]}"
     resumed = json.loads(out.splitlines()[-1])
@@ -103,10 +115,161 @@ def test_sigkill_mid_sweep_then_resume(tmp_path):
     assert resumed["ideal_k"] >= 2
 
     # Uninterrupted reference run (fresh dir) for ground truth.
-    p3 = _spawn(str(tmp_path / "ck_ref"))
+    p3 = _spawn(str(tmp_path / "ck_ref"), mesh)
     out3, err3 = communicate_or_kill(p3, timeout=600)
     assert p3.returncode == 0, f"reference run failed:\n{out3}\n{err3[-3000:]}"
     ref = json.loads(out3.splitlines()[-1])
+
+    assert resumed["ideal_k"] == ref["ideal_k"]
+    np.testing.assert_allclose(
+        resumed["min_rissanen"], ref["min_rissanen"], rtol=1e-9
+    )
+    np.testing.assert_allclose(
+        np.asarray(resumed["means"]), np.asarray(ref["means"]),
+        rtol=1e-7, atol=1e-9,
+    )
+
+
+@pytest.mark.slow
+def test_sigkill_fused_sweep_then_resume(tmp_path):
+    """Kill/resume against the FUSED whole-sweep-on-device path: per-K
+    checkpoints are emitted from inside the single device program via the
+    ordered io_callback hook (--fused-sweep --checkpoint-dir, round-3
+    composability item)."""
+    from .conftest import communicate_or_kill
+
+    ck = str(tmp_path / "ck")
+    sweep_dir = os.path.join(ck, "sweep")
+
+    p = _spawn(ck, fused=True)
+    deadline = time.time() + 300
+    try:
+        while time.time() < deadline:
+            steps = (
+                [d for d in os.listdir(sweep_dir) if d.isdigit()]
+                if os.path.isdir(sweep_dir) else []
+            )
+            if len(steps) >= 2:
+                break
+            if p.poll() is not None:
+                out, err = p.communicate()
+                raise AssertionError(
+                    f"worker exited before kill (rc={p.returncode}):\n"
+                    f"{out}\n{err[-3000:]}"
+                )
+            time.sleep(0.05)
+        else:
+            raise AssertionError("no checkpoint appeared within timeout")
+        os.kill(p.pid, signal.SIGKILL)
+    finally:
+        if p.poll() is None:
+            p.kill()
+        p.wait(timeout=60)
+    assert p.returncode != 0
+
+    p2 = _spawn(ck, fused=True)
+    out, err = communicate_or_kill(p2, timeout=600)
+    assert p2.returncode == 0, f"fused resume failed:\n{out}\n{err[-3000:]}"
+    resumed = json.loads(out.splitlines()[-1])
+    assert len(resumed["sweep_ks"]) == 11
+    assert resumed["sweep_ks"][0] == 12  # restored rows kept
+
+    p3 = _spawn(str(tmp_path / "ck_ref"), fused=True)
+    out3, err3 = communicate_or_kill(p3, timeout=600)
+    assert p3.returncode == 0, f"reference run failed:\n{out3}\n{err3[-3000:]}"
+    ref = json.loads(out3.splitlines()[-1])
+
+    assert resumed["ideal_k"] == ref["ideal_k"]
+    np.testing.assert_allclose(
+        resumed["min_rissanen"], ref["min_rissanen"], rtol=1e-9
+    )
+    np.testing.assert_allclose(
+        np.asarray(resumed["means"]), np.asarray(ref["means"]),
+        rtol=1e-7, atol=1e-9,
+    )
+
+
+CKPT_WORKER = os.path.join(os.path.dirname(__file__),
+                           "multihost_ckpt_worker.py")
+
+
+@pytest.mark.slow
+def test_two_process_kill_one_rank_then_restart_both(tmp_path):
+    """Distributed fault tolerance on the reference's actual deployment
+    shape (MPI cluster, README.txt:18): SIGKILL ONE rank mid-sweep (the
+    other is taken down too, as a dead rank kills an MPI job), restart BOTH
+    ranks, and the resumed multi-host run must reproduce the uninterrupted
+    answer."""
+    import socket
+
+    from .conftest import communicate_or_kill, worker_env
+
+    def spawn_pair(ckdir):
+        with socket.socket() as s:
+            s.bind(("127.0.0.1", 0))
+            port = s.getsockname()[1]
+        return [
+            subprocess.Popen(
+                [sys.executable, CKPT_WORKER, str(i), "2", str(port), ckdir],
+                stdout=subprocess.PIPE, stderr=subprocess.PIPE,
+                env=worker_env(), text=True,
+            )
+            for i in range(2)
+        ]
+
+    ck = str(tmp_path / "ck")
+    sweep_dir = os.path.join(ck, "sweep")
+    procs = spawn_pair(ck)
+    deadline = time.time() + 300
+    try:
+        while time.time() < deadline:
+            steps = (
+                [d for d in os.listdir(sweep_dir) if d.isdigit()]
+                if os.path.isdir(sweep_dir) else []
+            )
+            if len(steps) >= 2:
+                break
+            for i, p in enumerate(procs):
+                if p.poll() is not None:
+                    out, err = p.communicate()
+                    raise AssertionError(
+                        f"rank {i} exited before kill (rc={p.returncode}):\n"
+                        f"{out}\n{err[-3000:]}"
+                    )
+            time.sleep(0.05)
+        else:
+            raise AssertionError("no checkpoint appeared within timeout")
+        os.kill(procs[1].pid, signal.SIGKILL)  # one rank dies...
+        time.sleep(1.0)
+        os.kill(procs[0].pid, signal.SIGKILL)  # ...taking the job with it
+    finally:
+        for p in procs:
+            if p.poll() is None:
+                p.kill()
+            p.wait(timeout=60)
+
+    # Restart BOTH ranks (fresh coordinator port): resume and complete.
+    procs2 = spawn_pair(ck)
+    outs = [communicate_or_kill(p, timeout=600) for p in procs2]
+    for i, (p, (out, err)) in enumerate(zip(procs2, outs)):
+        assert p.returncode == 0, \
+            f"restarted rank {i} failed:\n{out}\n{err[-3000:]}"
+    out0 = outs[0][0]
+    line = [l for l in out0.splitlines() if l.startswith("RESULT ")][0]
+    resumed = json.loads(line[len("RESULT "):])
+    assert len(resumed["sweep_ks"]) == 9  # K=10..2
+    ran_here = [l for l in out0.splitlines() if l.startswith("K=")]
+    assert 0 < len(ran_here) < 9, out0
+
+    # Ground truth: uninterrupted 2-process run in a fresh dir.
+    procs3 = spawn_pair(str(tmp_path / "ck_ref"))
+    outs3 = [communicate_or_kill(p, timeout=600) for p in procs3]
+    for i, (p, (out, err)) in enumerate(zip(procs3, outs3)):
+        assert p.returncode == 0, \
+            f"reference rank {i} failed:\n{out}\n{err[-3000:]}"
+    line3 = [l for l in outs3[0][0].splitlines()
+             if l.startswith("RESULT ")][0]
+    ref = json.loads(line3[len("RESULT "):])
 
     assert resumed["ideal_k"] == ref["ideal_k"]
     np.testing.assert_allclose(
