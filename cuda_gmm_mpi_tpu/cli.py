@@ -255,18 +255,20 @@ def main(argv=None) -> int:
         # reference gathered all memberships over MPI_Send/Recv to rank 0,
         # gaussian.cu:783-823; here only formatted bytes cross the local FS).
         if nproc > 1:
-            from .parallel.distributed import barrier
+            from .parallel.distributed import (
+                assemble_results_multihost, results_part_path,
+            )
 
             start, stop_row = result.host_range
             local = fit_input.read_range(start, stop_row)
-            part_path = f"{args.outfile}.results.part{pid:05d}"
+            out_path = args.outfile + ".results"
+            part_path = results_part_path(out_path)
             stream_results(part_path, iter_memberships(result, local, config))
-            barrier("results_parts")
-            if pid == 0:
-                _assemble_parts(args.outfile + ".results",
-                                [f"{args.outfile}.results.part{i:05d}"
-                                 for i in range(nproc)])
-            barrier("results_done")
+            # Assembles on rank 0 via the shared-FS fast path when the parts
+            # are visible there, else a chunked byte-gather over the runtime
+            # (the MPI_Send/Recv membership gather, gaussian.cu:798-817 --
+            # no shared filesystem assumed).
+            assemble_results_multihost(out_path, part_path)
         else:
             stream_results(args.outfile + ".results",
                            iter_memberships(result, data, config))
@@ -299,20 +301,6 @@ def _print_clusters(result) -> None:
             means[c], np.asarray(state.R)[c],
         )
         print()
-
-
-def _assemble_parts(out_path: str, part_paths) -> None:
-    """Concatenate per-host .results parts (events are range-sharded in rank
-    order, so plain in-order concatenation reproduces the single-host file
-    byte for byte) and remove the parts."""
-    import shutil
-
-    with open(out_path, "wb") as out:
-        for p in part_paths:
-            with open(p, "rb") as f:
-                shutil.copyfileobj(f, out)
-    for p in part_paths:
-        os.remove(p)
 
 
 def _parse_mesh(spec):

@@ -149,6 +149,73 @@ def test_two_process_2d_mesh_matches_data_mesh():
     np.testing.assert_allclose(m2, m1, rtol=1e-7, atol=1e-10)
 
 
+GATHER_WORKER = r"""
+import os, sys
+pid, nproc, port, outdir = int(sys.argv[1]), int(sys.argv[2]), sys.argv[3], sys.argv[4]
+import jax
+jax.config.update("jax_platforms", "cpu")
+jax.config.update("jax_num_cpu_devices", 1)
+jax.distributed.initialize(coordinator_address=f"127.0.0.1:{port}",
+                           num_processes=nproc, process_id=pid)
+from cuda_gmm_mpi_tpu.parallel.distributed import (
+    assemble_results_multihost, results_part_path,
+)
+
+def content(i):  # deterministic, different sizes per rank
+    return "".join(f"rank{i} row {j} " + "x" * (17 + i) + "\n"
+                   for j in range(1500 + 700 * i)).encode()
+
+out_path = os.path.join(outdir, "final.results")
+private = os.path.join(outdir, f"private_rank{pid}")  # NOT visible as a
+os.makedirs(private, exist_ok=True)                   # sibling of out_path
+part = results_part_path(out_path, part_dir=private)
+with open(part, "wb") as f:
+    f.write(content(pid))
+# Small chunk forces multiple gather rounds.
+assemble_results_multihost(out_path, part, chunk_bytes=4096)
+assert not os.path.exists(part), "part not cleaned up"
+if pid == 0:
+    got = open(out_path, "rb").read()
+    want = b"".join(content(i) for i in range(nproc))
+    assert got == want, (len(got), len(want))
+    print("GATHER_OK", flush=True)
+jax.distributed.shutdown()
+"""
+
+
+@pytest.mark.slow
+def test_results_gather_without_shared_fs(tmp_path):
+    """Per-rank parts in rank-PRIVATE directories (simulating per-host local
+    disks on a pod): assembly must take the chunked byte-gather over the
+    runtime -- the MPI_Send/Recv membership gather equivalence,
+    gaussian.cu:798-817 -- and produce rank-ordered byte-exact output."""
+    from .conftest import worker_env
+
+    port = _free_port()
+    env = worker_env()
+    procs = [
+        subprocess.Popen(
+            [sys.executable, "-c", GATHER_WORKER, str(i), "2", str(port),
+             str(tmp_path)],
+            stdout=subprocess.PIPE, stderr=subprocess.PIPE, env=env,
+            text=True,
+        )
+        for i in range(2)
+    ]
+    outs = []
+    try:
+        for p in procs:
+            out, err = p.communicate(timeout=300)
+            outs.append((p.returncode, out, err))
+    finally:
+        for p in procs:
+            if p.poll() is None:
+                p.kill()
+    for i, (rc, out, err) in enumerate(outs):
+        assert rc == 0, f"rank {i} failed (rc={rc}):\n{out}\n{err[-3000:]}"
+    assert "GATHER_OK" in outs[0][1]
+
+
 @pytest.mark.slow
 def test_two_process_cli_byte_identical(tmp_path):
     """The reference's end-to-end story -- ``mpirun -np 2 gaussianMPI K in
