@@ -116,13 +116,16 @@ def numpy_em_iteration_diag(x, x2, params):
 
 
 CONFIGS = {
-    # BASELINE.md benchmark config matrix (1-5); "north" = the north-star.
+    # BASELINE.md benchmark config matrix (1-5); "north" = the north-star;
+    # 6 = the reference's first-class envelope (MAX_CLUSTERS=512,
+    # NUM_DIMENSIONS=32 -- gaussian.h:10,16).
     "north": dict(n=1_000_000, d=24, k=100, diag=False),
     "1": dict(n=10_000, d=4, k=8, diag=False),
     "2": dict(n=100_000, d=21, k=64, diag=False),
     "3": dict(n=1_000_000, d=24, k=256, diag=True),
     "4": dict(n=500_000, d=16, k=100, diag=False, target_k=10),
     "5": dict(n=10_000_000, d=24, k=128, diag=False),
+    "6": dict(n=1_000_000, d=32, k=512, diag=False),
 }
 
 
@@ -191,6 +194,16 @@ def main() -> int:
     diag = bool(spec.get("diag", False))
     state = seed_clusters_host(data, k)
 
+    # Matmul precision: full-covariance configs run 'high' (bf16_3x) -- the
+    # round-3 matched-precision study (docs/PERF.md) measured ~1.4-1.8x over
+    # true fp32 ('highest') with final means inside reduction-order noise;
+    # diagonal configs keep 'highest' (where 'high' is both slower AND less
+    # accurate). GMM_BENCH_PRECISION overrides; loglik is recorded so the
+    # accuracy of the benched configuration is auditable.
+    precision = os.environ.get("GMM_BENCH_PRECISION") or (
+        "highest" if diag else "high"
+    )
+
     def measure(use_pallas: str):
         """(iters, dt, ll, final_state, sweep_extra) for one measured run."""
         if target_k:
@@ -202,6 +215,7 @@ def main() -> int:
 
             fit_cfg = GMMConfig(min_iters=bench_iters, max_iters=bench_iters,
                                 chunk_size=chunk, diag_only=diag,
+                                matmul_precision=precision,
                                 use_pallas=use_pallas, fused_sweep=True)
             fit_model = GMMModel(fit_cfg)
             fit_gmm(data, k, target_k, fit_cfg, model=fit_model)  # warm
@@ -228,6 +242,7 @@ def main() -> int:
 
         cfg = GMMConfig(min_iters=bench_iters, max_iters=bench_iters,
                         chunk_size=chunk, diag_only=diag,
+                        matmul_precision=precision,
                         use_pallas=use_pallas)
         model = GMMModel(cfg)
         chunks, wts = chunk_events(data, cfg.chunk_size)
@@ -259,16 +274,9 @@ def main() -> int:
         dt = min(times)
         return int(iters), dt, ll, s, {}
 
-    from cuda_gmm_mpi_tpu.ops.pallas import should_use_pallas
-
-    try:
-        iters, dt, ll, s, sweep_extra = measure("auto")
-    except Exception as e:  # e.g. a Mosaic lowering rejection on new hardware
-        if not should_use_pallas(GMMConfig(diag_only=diag)):
-            raise  # the failure was in the jnp path; a retry can't help
-        print(f"bench.py: Pallas path failed ({type(e).__name__}: {e}); "
-              "retrying with use_pallas=never", file=sys.stderr)
-        iters, dt, ll, s, sweep_extra = measure("never")
+    # 'auto' is the XLA path everywhere since the round-3 precision study
+    # (docs/PERF.md); no Pallas fallback needed.
+    iters, dt, ll, s, sweep_extra = measure("auto")
     iters_per_sec = iters / dt
 
     # CPU baseline: identical iteration in NumPy/BLAS on a subsample, scaled
@@ -324,6 +332,7 @@ def main() -> int:
         "loglik": float(ll),
         "wall_s_per_iter": round(dt / iters, 4),
         "cpu_baseline_iters_per_sec": round(cpu_iters_per_sec, 4),
+        "precision": precision,
         **note,
     }
     print(json.dumps(result))

@@ -1,29 +1,36 @@
-"""Pallas/Mosaic TPU kernels -- the hand-tuned hot path (SURVEY L2).
+"""Pallas/Mosaic TPU kernels -- the hand-written L2 device kernels.
 
-``should_use_pallas`` decides kernel-vs-jnp per config/platform: the Pallas
-fused E+M kernels need a TPU (or interpret mode for tests) and float32. Full
-and diagonal covariance are both kernelized. On cluster-sharded meshes the
-two-pass kernel (per-shard LSE in-kernel, pmax/psum outside -- the
-cross-device generalization of estep1's per-cluster grid axis,
-``gaussian_kernel.cu:383``) is used for DIAGONAL covariance, where the
-kernel's HBM savings dominate; full covariance there stays on the jnp path,
-whose single logp evaluation beats the kernel's two matmul passes (the
-matmul-bound regime where XLA already sits at the roofline, docs/PERF.md).
-``make_stats_fn`` binds the config's covariance mode, tile size, and mesh
-axis into the ``stats_fn`` hook consumed by ``em_while_loop``.
+``should_use_pallas`` decides kernel-vs-jnp per config. Since the round-3
+matched-precision study (docs/PERF.md), 'auto' resolves to the jnp/XLA path
+everywhere -- the kernel's earlier measured wins were an artifact of Mosaic
+lowering precision-unannotated dots at DEFAULT (bf16); at honest precision
+XLA meets or beats the kernel at every measured shape. The kernels stay
+available under ``use_pallas='always'`` (fp32; precision 'highest' or
+'default' -- Mosaic rejects 'high' in kernel dots), correct and tested:
+the single-shard fused E+M kernel (full + diagonal covariance) and the
+two-pass cluster-sharded variant (per-shard LSE in-kernel, pmax/psum
+outside -- the cross-device generalization of estep1's per-cluster grid
+axis, ``gaussian_kernel.cu:383``; diagonal covariance only).
+``make_stats_fn`` binds the config's covariance mode, tile size, precision,
+and mesh axis into the ``stats_fn`` hook consumed by ``em_while_loop``.
 """
 
 from __future__ import annotations
 
 import functools
 
-import jax
-
 from .fused_stats import fused_stats_pallas, fused_stats_pallas_sharded
 
 
 def should_use_pallas(config, cluster_sharded: bool = False) -> bool:
-    if config.use_pallas == "never":
+    if config.use_pallas != "always":
+        # 'auto' resolves to the jnp/XLA path everywhere. The round-3
+        # matched-precision study (docs/PERF.md) showed the kernel's earlier
+        # measured wins were an artifact of Mosaic lowering its precision-
+        # unannotated dots at DEFAULT (bf16) while the jnp path ran true
+        # fp32; with precision now plumbed through both paths, XLA meets or
+        # beats the kernel at every measured shape. The kernel stays
+        # available ('always') and tested.
         return False
     if config.dtype != "float32":
         return False
@@ -32,12 +39,7 @@ def should_use_pallas(config, cluster_sharded: bool = False) -> bool:
         # evaluate the (B, D^2) @ (D^2, K) contraction twice, while the jnp
         # collective-LSE path does it once at the XLA roofline.
         return False
-    if config.use_pallas == "always":
-        return True
-    try:
-        return jax.devices()[0].platform == "tpu"
-    except Exception:
-        return False
+    return True
 
 
 def make_stats_fn(config, cluster_sharded: bool = False,
@@ -53,11 +55,13 @@ def make_stats_fn(config, cluster_sharded: bool = False,
             cluster_axis=cluster_axis or CLUSTER_AXIS,
             diag_only=config.diag_only,
             block_b=config.pallas_block_b,
+            precision=config.matmul_precision,
         )
     return functools.partial(
         fused_stats_pallas,
         diag_only=config.diag_only,
         block_b=config.pallas_block_b,
+        precision=config.matmul_precision,
     )
 
 

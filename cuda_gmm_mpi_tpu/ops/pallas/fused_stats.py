@@ -23,8 +23,10 @@ Diagonal-covariance mode (the reference's DIAG_ONLY compile path,
 products -- same kernel structure, D x cheaper contractions.
 
 Stats accumulate in VMEM scratch across the sequential TPU grid and are
-written once on the last tile. Requires an unsharded cluster axis (the
-cluster-sharded path uses the jnp implementation with collective LSE).
+written once on the last tile. ``fused_stats_pallas`` requires an unsharded
+cluster axis; ``fused_stats_pallas_sharded`` (below) is the two-pass
+cluster-sharded variant. Kernel dots accept precision 'highest'/'default'
+only (Mosaic rejects HIGH; bf16_3x is an XLA-path-only option).
 """
 
 from __future__ import annotations
@@ -36,6 +38,7 @@ import jax.numpy as jnp
 from jax.experimental import pallas as pl
 from jax.experimental.pallas import tpu as pltpu
 
+from ..estep import _precision
 from ..mstep import SuffStats
 
 NEG_LARGE = -1e30  # stand-in for -inf: exp() underflows to 0, avoids inf-inf
@@ -44,7 +47,7 @@ NEG_LARGE = -1e30  # stand-in for -inf: exp() underflows to 0, avoids inf-inf
 def _fused_stats_kernel(x_ref, wt_ref, A_ref, h_ref, g_ref,
                         ll_ref, nk_ref, m1_ref, m2_ref,
                         ll_acc, nk_acc, m1_acc, m2_acc,
-                        *, diag: bool):
+                        *, diag: bool, precision):
     i = pl.program_id(0)
     n_tiles = pl.num_programs(0)
 
@@ -73,11 +76,11 @@ def _fused_stats_kernel(x_ref, wt_ref, A_ref, h_ref, g_ref,
     # under DIAG_ONLY).
     q = jax.lax.dot_general(
         x2, A_ref[:], (((1,), (1,)), ((), ())),
-        preferred_element_type=jnp.float32,
+        preferred_element_type=jnp.float32, precision=precision,
     )  # [B_t, K]
     q = q - 2.0 * jax.lax.dot_general(
         x, h_ref[:], (((1,), (1,)), ((), ())),
-        preferred_element_type=jnp.float32,
+        preferred_element_type=jnp.float32, precision=precision,
     )
     logp = -0.5 * q + g_ref[:]        # [B_t, K]; g broadcasts from [1, K]
 
@@ -94,11 +97,11 @@ def _fused_stats_kernel(x_ref, wt_ref, A_ref, h_ref, g_ref,
     nk_acc[:] += jnp.sum(w, axis=0, keepdims=True)          # [1, K]
     m1_acc[:] += jax.lax.dot_general(                       # [K, D]
         w, x, (((0,), (0,)), ((), ())),
-        preferred_element_type=jnp.float32,
+        preferred_element_type=jnp.float32, precision=precision,
     )
     m2_acc[:] += jax.lax.dot_general(                       # [K, D*D] | [K, D]
         w, x2, (((0,), (0,)), ((), ())),
-        preferred_element_type=jnp.float32,
+        preferred_element_type=jnp.float32, precision=precision,
     )
 
     @pl.when(i == n_tiles - 1)
@@ -110,9 +113,10 @@ def _fused_stats_kernel(x_ref, wt_ref, A_ref, h_ref, g_ref,
 
 
 @functools.partial(jax.jit,
-                   static_argnames=("block_b", "diag", "interpret"))
+                   static_argnames=("block_b", "diag", "interpret",
+                                   "precision"))
 def _fused_stats_call(x, wt, A, h, g, *, block_b: int, diag: bool,
-                      interpret: bool):
+                      interpret: bool, precision: str = "highest"):
     n, d = x.shape
     k = A.shape[0]
     f = A.shape[1]  # D*D (full) or D (diag)
@@ -125,7 +129,8 @@ def _fused_stats_call(x, wt, A, h, g, *, block_b: int, diag: bool,
         jax.ShapeDtypeStruct((k, f), f32),
     )
     rep = lambda *_: (0, 0)  # accumulator outputs: same block every step
-    kernel = functools.partial(_fused_stats_kernel, diag=diag)
+    kernel = functools.partial(_fused_stats_kernel, diag=diag,
+                               precision=_precision(precision))
     ll, nk, m1, m2 = pl.pallas_call(
         kernel,
         grid=(grid,),
@@ -161,7 +166,7 @@ def _fused_stats_call(x, wt, A, h, g, *, block_b: int, diag: bool,
     return ll, nk, m1, m2
 
 
-def _logp_tile(x, A_ref, h_ref, g_ref, diag: bool):
+def _logp_tile(x, A_ref, h_ref, g_ref, diag: bool, precision):
     """Per-tile unnormalized log posteriors [B_t, K] (shared by both passes)."""
     bt, d = x.shape
     if diag:
@@ -171,16 +176,16 @@ def _logp_tile(x, A_ref, h_ref, g_ref, diag: bool):
         x2 = jnp.concatenate([x * x[:, j:j + 1] for j in range(d)], axis=1)
     q = jax.lax.dot_general(
         x2, A_ref[:], (((1,), (1,)), ((), ())),
-        preferred_element_type=jnp.float32,
+        preferred_element_type=jnp.float32, precision=precision,
     )  # [B_t, K]
     q = q - 2.0 * jax.lax.dot_general(
         x, h_ref[:], (((1,), (1,)), ((), ())),
-        preferred_element_type=jnp.float32,
+        preferred_element_type=jnp.float32, precision=precision,
     )
     return -0.5 * q + g_ref[:], x2    # g broadcasts from [1, K]
 
 
-def _local_lse_kernel(x_ref, A_ref, h_ref, g_ref, m_ref, s_ref, *, diag: bool):
+def _local_lse_kernel(x_ref, A_ref, h_ref, g_ref, m_ref, s_ref, *, diag: bool, precision):
     """Pass 1 of the cluster-sharded kernel: per-event LOCAL max and shifted
     exponential sum over this shard's clusters.
 
@@ -189,7 +194,7 @@ def _local_lse_kernel(x_ref, A_ref, h_ref, g_ref, m_ref, s_ref, *, diag: bool):
     gaussian_kernel.cu:483-494) happens OUTSIDE the kernel in the shard_map
     body; only [B, 1]-shaped per-event scalars ever leave VMEM.
     """
-    logp, _ = _logp_tile(x_ref[:], A_ref, h_ref, g_ref, diag)
+    logp, _ = _logp_tile(x_ref[:], A_ref, h_ref, g_ref, diag, precision)
     m = jnp.max(logp, axis=1, keepdims=True)      # [B_t, 1]; NEG_LARGE if the
     e = jnp.exp(logp - m)                         # whole shard is masked (then
     s = jnp.sum(e, axis=1, keepdims=True)         # exp(m - M) == 0 outside)
@@ -200,7 +205,7 @@ def _local_lse_kernel(x_ref, A_ref, h_ref, g_ref, m_ref, s_ref, *, diag: bool):
 def _stats_logz_kernel(x_ref, wt_ref, logz_ref, A_ref, h_ref, g_ref,
                        ll_ref, nk_ref, m1_ref, m2_ref,
                        ll_acc, nk_acc, m1_acc, m2_acc,
-                       *, diag: bool):
+                       *, diag: bool, precision):
     """Pass 2 of the cluster-sharded kernel: responsibilities from the GLOBAL
     per-event evidence (logz) and the same fused M-step accumulation as the
     single-shard kernel."""
@@ -217,7 +222,7 @@ def _stats_logz_kernel(x_ref, wt_ref, logz_ref, A_ref, h_ref, g_ref,
     x = x_ref[:]
     wt = wt_ref[:]                    # [B_t, 1]
     logz = logz_ref[:]                # [B_t, 1], replicated across shards
-    logp, x2 = _logp_tile(x, A_ref, h_ref, g_ref, diag)
+    logp, x2 = _logp_tile(x, A_ref, h_ref, g_ref, diag, precision)
 
     # w = exp(logp - logZ): all-masked shards give exp(NEG_LARGE - logz) == 0.
     w = jnp.exp(logp - logz) * wt
@@ -228,11 +233,11 @@ def _stats_logz_kernel(x_ref, wt_ref, logz_ref, A_ref, h_ref, g_ref,
     nk_acc[:] += jnp.sum(w, axis=0, keepdims=True)          # [1, K]
     m1_acc[:] += jax.lax.dot_general(                       # [K, D]
         w, x, (((0,), (0,)), ((), ())),
-        preferred_element_type=jnp.float32,
+        preferred_element_type=jnp.float32, precision=precision,
     )
     m2_acc[:] += jax.lax.dot_general(                       # [K, D*D] | [K, D]
         w, x2, (((0,), (0,)), ((), ())),
-        preferred_element_type=jnp.float32,
+        preferred_element_type=jnp.float32, precision=precision,
     )
 
     @pl.when(i == n_tiles - 1)
@@ -243,14 +248,17 @@ def _stats_logz_kernel(x_ref, wt_ref, logz_ref, A_ref, h_ref, g_ref,
         m2_ref[:] = m2_acc[:]
 
 
-@functools.partial(jax.jit, static_argnames=("block_b", "diag", "interpret"))
-def _local_lse_call(x, A, h, g, *, block_b: int, diag: bool, interpret: bool):
+@functools.partial(jax.jit, static_argnames=("block_b", "diag", "interpret",
+                                             "precision"))
+def _local_lse_call(x, A, h, g, *, block_b: int, diag: bool, interpret: bool,
+                    precision: str = "highest"):
     n, d = x.shape
     k = A.shape[0]
     f = A.shape[1]
     grid = n // block_b
     f32 = jnp.float32
-    kernel = functools.partial(_local_lse_kernel, diag=diag)
+    kernel = functools.partial(_local_lse_kernel, diag=diag,
+                               precision=_precision(precision))
     row = lambda i: (i, 0)
     rep = lambda *_: (0, 0)
     return pl.pallas_call(
@@ -279,9 +287,10 @@ def _local_lse_call(x, A, h, g, *, block_b: int, diag: bool, interpret: bool):
     )(x, A, h, g)
 
 
-@functools.partial(jax.jit, static_argnames=("block_b", "diag", "interpret"))
+@functools.partial(jax.jit, static_argnames=("block_b", "diag", "interpret",
+                                             "precision"))
 def _stats_logz_call(x, wt, logz, A, h, g, *, block_b: int, diag: bool,
-                     interpret: bool):
+                     interpret: bool, precision: str = "highest"):
     n, d = x.shape
     k = A.shape[0]
     f = A.shape[1]
@@ -295,7 +304,8 @@ def _stats_logz_call(x, wt, logz, A, h, g, *, block_b: int, diag: bool,
     )
     row = lambda i: (i, 0)
     rep = lambda *_: (0, 0)
-    kernel = functools.partial(_stats_logz_kernel, diag=diag)
+    kernel = functools.partial(_stats_logz_kernel, diag=diag,
+                               precision=_precision(precision))
     return pl.pallas_call(
         kernel,
         grid=(grid,),
@@ -338,6 +348,7 @@ def fused_stats_pallas_sharded(
     diag_only: bool = False,
     block_b: int = 512,
     interpret: bool = False,
+    precision: str = "highest",
 ) -> SuffStats:
     """Cluster-sharded SuffStats: two Pallas passes + collective LSE between.
 
@@ -358,7 +369,7 @@ def fused_stats_pallas_sharded(
     x, wt, A, h, g = _prep_inputs(state, data_chunks, wts_chunks, block_b,
                                   diag_only)
     m, s = _local_lse_call(x, A, h, g, block_b=block_b, diag=diag_only,
-                           interpret=interpret)
+                           interpret=interpret, precision=precision)
     # Collective log-sum-exp across cluster shards (outside the kernel):
     # logZ = M + log(sum_shards exp(m_s - M) * s_s). An all-masked shard has
     # m_s == NEG_LARGE, so exp(m_s - M) underflows to exactly 0.
@@ -367,7 +378,7 @@ def fused_stats_pallas_sharded(
     logz = M + jnp.log(S)
     ll, nk, m1, m2 = _stats_logz_call(
         x, wt, logz, A, h, g, block_b=block_b, diag=diag_only,
-        interpret=interpret,
+        interpret=interpret, precision=precision,
     )
     dt = data_chunks.dtype
     return SuffStats(
@@ -422,6 +433,7 @@ def fused_stats_pallas(
     diag_only: bool = False,
     block_b: int = 512,
     interpret: bool = False,
+    precision: str = "highest",
 ) -> SuffStats:
     """SuffStats for all chunks via the fused Pallas kernel.
 
@@ -434,7 +446,8 @@ def fused_stats_pallas(
     x, wt, A, h, g = _prep_inputs(state, data_chunks, wts_chunks, block_b,
                                   diag_only)
     ll, nk, m1, m2 = _fused_stats_call(
-        x, wt, A, h, g, block_b=block_b, diag=diag_only, interpret=interpret
+        x, wt, A, h, g, block_b=block_b, diag=diag_only, interpret=interpret,
+        precision=precision,
     )
     dt = data_chunks.dtype
     return SuffStats(

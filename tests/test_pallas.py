@@ -153,8 +153,16 @@ def test_should_use_pallas_gating():
                              cluster_sharded=True)
     assert not should_use_pallas(GMMConfig(use_pallas="always"),
                                  cluster_sharded=True)
-    # auto on CPU -> False
+    # 'auto' resolves to the jnp/XLA path everywhere: at matched matmul
+    # precision XLA meets or beats the kernel at every measured shape
+    # (docs/PERF.md round-3 precision study).
     assert not should_use_pallas(GMMConfig(use_pallas="auto"))
+    assert not should_use_pallas(GMMConfig(use_pallas="auto",
+                                           diag_only=True))
+    # Mosaic rejects precision=HIGH in kernel dots: the config refuses the
+    # combination up front instead of dying at compile time.
+    with pytest.raises(ValueError, match="bf16_3x"):
+        GMMConfig(use_pallas="always", matmul_precision="high")
 
 
 sharded_interp = functools.partial(

@@ -91,3 +91,20 @@ def test_single_cluster_k1():
     assert r.ideal_num_clusters == 1
     np.testing.assert_allclose(r.means[0], data.mean(0), atol=0.05)
     np.testing.assert_allclose(r.weights[0], 1.0, atol=1e-6)
+
+
+@pytest.mark.slow
+def test_reference_envelope_k512_d32():
+    """The reference's first-class supported envelope -- MAX_CLUSTERS=512,
+    NUM_DIMENSIONS=32 (gaussian.h:10,16) -- exercised end to end at small N
+    on CPU: fit at K=512 plus one merge-scan step (target 511 forces the
+    O(K^2) pair scan + merge through the full K=512 state). The TPU-scale
+    characterization (1M events) is bench.py --config=6 / docs/PERF.md."""
+    rng = np.random.default_rng(3)
+    data = rng.normal(size=(2048, 32)).astype(np.float32)
+    r = fit_gmm(data, 512, 511,
+                config=cfg(min_iters=1, max_iters=1, chunk_size=512,
+                           dtype="float32"))
+    assert_finite_result(r)
+    assert r.ideal_num_clusters == 511
+    assert r.state.means.shape[1] == 32
