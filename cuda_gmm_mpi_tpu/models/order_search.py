@@ -39,6 +39,13 @@ def _null_phase(_name):
     yield
 
 
+def _rebuild_result(state: dict) -> "GMMResult":
+    """Unpickle hook for GMMResult (model dropped at pickle time)."""
+    r = GMMResult.__new__(GMMResult)
+    r.__dict__.update(state)
+    return r
+
+
 @dataclasses.dataclass
 class GMMResult:
     """Final fit: the best (lowest-Rissanen) configuration across the sweep.
@@ -71,6 +78,32 @@ class GMMResult:
     # The fitted model (jitted executables already built) so the output path
     # reuses compiled posteriors instead of building a fresh GMMModel.
     model: Optional[object] = dataclasses.field(default=None, repr=False)
+
+    def __reduce__(self):
+        # Pickling drops the fitted model (jitted executables: unpicklable
+        # and process-bound); an unpickled result's output path falls back
+        # to the per-config cached model (_fallback_model). In-process
+        # copy/deepcopy keep the model (see __copy__/__deepcopy__ below).
+        state = dict(self.__dict__)
+        state["model"] = None
+        return (_rebuild_result, (state,))
+
+    def __copy__(self):
+        new = GMMResult.__new__(GMMResult)
+        new.__dict__.update(self.__dict__)
+        return new
+
+    def __deepcopy__(self, memo):
+        import copy
+
+        new = GMMResult.__new__(GMMResult)
+        memo[id(self)] = new
+        for k, v in self.__dict__.items():
+            # The model is shared, not copied: it is an immutable-config
+            # compiled-executable holder, and deep-copying it is both
+            # impossible (jit closures) and pointless.
+            new.__dict__[k] = v if k == "model" else copy.deepcopy(v, memo)
+        return new
 
     @property
     def means(self) -> np.ndarray:

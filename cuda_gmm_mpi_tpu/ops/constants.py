@@ -28,6 +28,37 @@ import jax.numpy as jnp
 LOG_2PI = math.log(2.0 * math.pi)
 
 
+def _chol_ok(R: jax.Array):
+    """Batched Cholesky factor + per-matrix PD flag (NaN rows = not PD)."""
+    L = jax.lax.linalg.cholesky(R)
+    ok = jnp.all(jnp.isfinite(L.reshape(L.shape[0], -1)), axis=-1)
+    return L, ok
+
+
+def _logdet_from_chol(L: jax.Array, ok: jax.Array):
+    diag = jnp.abs(jnp.diagonal(L, axis1=-2, axis2=-1))
+    diag = jnp.where(ok[:, None], diag, 1.0)  # failed rows -> log_det 0
+    return 2.0 * jnp.sum(jnp.log(diag), axis=-1)
+
+
+def chol_logdet(R: jax.Array, diag_only: bool = False):
+    """Batched log-determinant + PD check WITHOUT the inverse.
+
+    The merge pair scan (ops/merge.py::pairwise_merge_distances) evaluates
+    O(K^2) candidate covariances but consumes only each one's log|R| for the
+    merged constant -- computing the inverse there (two triangular solves +
+    a [D,D]x[D,D] product per candidate) was pure waste. Returns
+    ``(log_det [K], ok [K])``. Single source of truth for the log-det/PD
+    semantics; chol_inverse_logdet builds on the same helpers.
+    """
+    if diag_only:
+        d = jnp.diagonal(R, axis1=-2, axis2=-1)  # [K, D]
+        ok = jnp.all(d > 0, axis=-1)
+        return jnp.sum(jnp.log(jnp.where(d > 0, d, 1.0)), axis=-1), ok
+    L, ok = _chol_ok(R)
+    return _logdet_from_chol(L, ok), ok
+
+
 def chol_inverse_logdet(R: jax.Array, diag_only: bool = False):
     """Batched inverse + log-determinant of covariance matrices.
 
@@ -44,25 +75,42 @@ def chol_inverse_logdet(R: jax.Array, diag_only: bool = False):
     K, D, _ = R.shape
     if diag_only:
         d = jnp.diagonal(R, axis1=-2, axis2=-1)  # [K, D]
-        ok = jnp.all(d > 0, axis=-1)
+        log_det, ok = chol_logdet(R, diag_only=True)
         safe = jnp.where(d > 0, d, 1.0)
-        log_det = jnp.sum(jnp.log(safe), axis=-1)
         Rinv = jnp.zeros_like(R)
         Rinv = Rinv.at[..., jnp.arange(D), jnp.arange(D)].set(1.0 / safe)
         return Rinv, log_det, ok
 
-    L = jax.lax.linalg.cholesky(R)  # [K, D, D], NaN rows where not PD
-    ok = jnp.all(jnp.isfinite(L.reshape(K, -1)), axis=-1)
+    L, ok = _chol_ok(R)
+    log_det = _logdet_from_chol(L, ok)
     eyeK = jnp.broadcast_to(jnp.eye(D, dtype=R.dtype), R.shape)
     L_safe = jnp.where(ok[:, None, None], L, eyeK)
-    diag = jnp.diagonal(L_safe, axis1=-2, axis2=-1)
-    log_det = 2.0 * jnp.sum(jnp.log(jnp.abs(diag)), axis=-1)
     # Rinv = L^-T L^-1 via two batched triangular solves against I.
     Linv = jax.lax.linalg.triangular_solve(
         L_safe, eyeK, left_side=True, lower=True
     )
     Rinv = jnp.einsum("kji,kjl->kil", Linv, Linv)  # L^-T @ L^-1
     return Rinv, log_det, ok
+
+
+def chol_logdet(R: jax.Array, diag_only: bool = False):
+    """Batched log-determinant + PD check WITHOUT the inverse.
+
+    The merge pair scan (ops/merge.py::pairwise_merge_distances) evaluates
+    O(K^2) candidate covariances but consumes only each one's log|R| for the
+    merged constant -- computing the inverse there (two triangular solves +
+    a [D,D]x[D,D] product per candidate) was pure waste. Returns
+    ``(log_det [K], ok [K])``.
+    """
+    if diag_only:
+        d = jnp.diagonal(R, axis1=-2, axis2=-1)  # [K, D]
+        ok = jnp.all(d > 0, axis=-1)
+        return jnp.sum(jnp.log(jnp.where(d > 0, d, 1.0)), axis=-1), ok
+    L = jax.lax.linalg.cholesky(R)  # NaN rows where not PD
+    ok = jnp.all(jnp.isfinite(L.reshape(L.shape[0], -1)), axis=-1)
+    diag = jnp.abs(jnp.diagonal(L, axis1=-2, axis2=-1))
+    diag = jnp.where(ok[:, None], diag, 1.0)
+    return 2.0 * jnp.sum(jnp.log(diag), axis=-1), ok
 
 
 def compute_constants(state, diag_only: bool = False,

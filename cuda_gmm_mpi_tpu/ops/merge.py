@@ -27,7 +27,7 @@ import jax
 import jax.numpy as jnp
 from jax import lax
 
-from .constants import LOG_2PI, chol_inverse_logdet
+from .constants import LOG_2PI, chol_inverse_logdet, chol_logdet
 
 
 def eliminate_empty(state):
@@ -71,7 +71,9 @@ def pairwise_merge_distances(state, diag_only: bool = False,
 
     def row(i):
         _, R_m = _merged_cov_row(state, i)
-        _, log_det, ok = chol_inverse_logdet(R_m, diag_only=diag_only)
+        # log-det only: the scan never consumes the candidates' inverses
+        # (merge_pair recomputes the winner's Rinv once).
+        log_det, ok = chol_logdet(R_m, diag_only=diag_only)
         const_m = (-D * 0.5) * LOG_2PI - 0.5 * log_det
         N_m = state.N[i] + state.N
         dist = (

@@ -120,3 +120,23 @@ def test_bic_counts_diagonal_params(fitted):
     assert p == 3 * (1 + 2 * d) - 1
     np.testing.assert_allclose(gm.bic(data), -2 * ll + p * np.log(n),
                                rtol=1e-12)
+
+
+def test_estimator_with_mesh_matches_plain(rng):
+    """A mesh-sharded fit keeps its sharded model for inference: predict/
+    predict_proba/score run on all local devices and match the plain
+    estimator (round-3 closure of 'GaussianMixture.fit builds a plain
+    GMMModel for all inference regardless of mesh_shape')."""
+    from cuda_gmm_mpi_tpu.parallel import ShardedGMMModel
+
+    data, _ = make_blobs(rng, n=640, d=3, k=3, dtype=np.float64)
+    kw = dict(min_iters=4, max_iters=4, chunk_size=64, dtype="float64")
+    gm_p = GaussianMixture(3, target_components=3, **kw).fit(data)
+    gm_s = GaussianMixture(3, target_components=3, mesh_shape=(4, 2),
+                           **kw).fit(data)
+    assert isinstance(gm_s._model, ShardedGMMModel)
+    np.testing.assert_allclose(gm_s.predict_proba(data),
+                               gm_p.predict_proba(data),
+                               rtol=1e-9, atol=1e-12)
+    np.testing.assert_array_equal(gm_s.predict(data), gm_p.predict(data))
+    np.testing.assert_allclose(gm_s.score(data), gm_p.score(data), rtol=1e-10)

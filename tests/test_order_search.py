@@ -94,3 +94,27 @@ def test_n_init_with_fused_sweep(rng):
     r2 = fit_gmm(data, 5, 3, config=GMMConfig(n_init=2, fused_sweep=True, **kw))
     np.testing.assert_allclose(r2.min_rissanen, r1.min_rissanen, rtol=1e-10)
     assert r2.ideal_num_clusters == r1.ideal_num_clusters
+
+
+def test_result_pickles_without_model(rng, tmp_path):
+    """GMMResult serializes (the carried fitted model holds process-bound
+    jitted executables and is dropped); a restored result still produces
+    memberships via the per-config fallback model."""
+    import pickle
+
+    from cuda_gmm_mpi_tpu.models.order_search import compute_memberships
+
+    data, _ = make_blobs(rng, n=256, d=3, k=2)
+    cfg = GMMConfig(min_iters=2, max_iters=2, chunk_size=128, dtype="float64")
+    r = fit_gmm(data, 2, 2, config=cfg)
+    assert r.model is not None
+    r2 = pickle.loads(pickle.dumps(r))
+    assert r2.model is None
+    w1 = compute_memberships(r, data, cfg)
+    w2 = compute_memberships(r2, data, cfg)
+    np.testing.assert_array_equal(w1, w2)
+    # In-process copies KEEP the fitted model (only pickling drops it).
+    import copy
+
+    assert copy.copy(r).model is r.model
+    assert copy.deepcopy(r).model is r.model
