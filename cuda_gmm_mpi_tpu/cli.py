@@ -71,6 +71,11 @@ def build_parser() -> argparse.ArgumentParser:
                    help="total process count (MPI world size)")
     d.add_argument("--process-id", type=int, default=None,
                    help="this process's rank (0-based)")
+    d.add_argument("--part-dir", default=None,
+                   help="rank-local scratch dir for .results parts (pods "
+                   "whose output dir is not writable everywhere); assembly "
+                   "byte-gathers to rank 0 over the runtime when parts are "
+                   "not on a shared filesystem")
 
     t = p.add_argument_group("TPU-native tuning")
     t.add_argument("--dtype", default="float32",
@@ -262,7 +267,7 @@ def main(argv=None) -> int:
             start, stop_row = result.host_range
             local = fit_input.read_range(start, stop_row)
             out_path = args.outfile + ".results"
-            part_path = results_part_path(out_path)
+            part_path = results_part_path(out_path, part_dir=args.part_dir)
             stream_results(part_path, iter_memberships(result, local, config))
             # Assembles on rank 0 via the shared-FS fast path when the parts
             # are visible there, else a chunked byte-gather over the runtime

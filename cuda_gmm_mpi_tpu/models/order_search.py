@@ -567,7 +567,13 @@ def _run_fused_sweep(fused, config, state, chunks, wts, epsilon,
                 if verbose:
                     print(f"resumed fused sweep at K={resume['k']}")
 
+        emit_times = {}
+
         def emit(payload):
+            # Arrival time of each per-K emission: real per-K wall seconds
+            # for the sweep log (the checkpoint-free fused path can only
+            # amortize; individual K timings don't exist off-device there).
+            emit_times[int(payload["step"])] = time.perf_counter()
             if bool(payload["done"]):
                 return  # the run returns its result right after this step
             ckpt.save(int(payload["step"]), {
@@ -603,9 +609,22 @@ def _run_fused_sweep(fused, config, state, chunks, wts, epsilon,
 
     steps = int(steps)
     per_k = wall / max(steps, 1)
+    # With checkpoint emission on, each step's host arrival time gives REAL
+    # per-K seconds (delta from the previous emission; the first new step
+    # is measured from dispatch). Restored/amortized steps keep per_k.
+    step_secs = {}
+    if ckpt is not None:
+        # Drain the ordered io_callback queue before reading emit_times:
+        # device_get blocks on the ARRAYS, not on host-callback completion.
+        jax.effects_barrier()
+        prev = t0
+        for s in sorted(emit_times):
+            step_secs[s] = emit_times[s] - prev
+            prev = emit_times[s]
     sweep_log = [
-        (int(row[0]), float(row[1]), float(row[2]), int(row[3]), per_k)
-        for row in np.asarray(log_rows)[:steps]
+        (int(row[0]), float(row[1]), float(row[2]), int(row[3]),
+         step_secs.get(i, per_k))
+        for i, row in enumerate(np.asarray(log_rows)[:steps])
     ]
     if verbose:
         for k_, ll_, riss_, it_, _ in sweep_log:

@@ -158,6 +158,7 @@ def results_part_path(out_path: str, part_dir: Optional[str] = None) -> str:
     (enables the shared-FS zero-copy assembly fast path); ``part_dir``
     relocates it (e.g. rank-local scratch on pods without a shared FS)."""
     d = part_dir or os.path.dirname(os.path.abspath(out_path))
+    os.makedirs(d, exist_ok=True)  # scratch dirs need not pre-exist
     return os.path.join(
         d, os.path.basename(out_path) + f".part{jax.process_index():05d}"
     )

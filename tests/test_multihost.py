@@ -257,11 +257,16 @@ def test_two_process_cli_byte_identical(tmp_path):
     assert p.returncode == 0, f"single-proc CLI failed:\n{out}\n{err[-3000:]}"
 
     # Two processes x 2 devices over a localhost coordination service.
+    # Each rank spools its .results part in a PRIVATE --part-dir, so the
+    # assembly must take the byte-gather path (no shared-FS assumption).
     port = _free_port()
+    for i in range(2):
+        (tmp_path / f"scratch{i}").mkdir(exist_ok=True)
     procs = [
         run_cli(str(tmp_path / "multi"),
                 [f"--coordinator=127.0.0.1:{port}", "--num-processes=2",
-                 f"--process-id={i}"], 2)
+                 f"--process-id={i}",
+                 f"--part-dir={tmp_path / ('scratch%d' % i)}"], 2)
         for i in range(2)
     ]
     for i, p in enumerate(procs):
