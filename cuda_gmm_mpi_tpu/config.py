@@ -68,9 +68,10 @@ class GMMConfig:
     pallas_block_b: int = 512  # best measured tile on v5e (docs/PERF.md)
     # Run the ENTIRE model-order sweep as one jitted device program (zero
     # host syncs between dispatch and final result), on plain or sharded
-    # (any mesh layout) models. Opt-in fast path: incompatible with per-K
-    # checkpointing/profiling (fit_gmm falls back to the host-driven sweep
-    # and warns when those are requested).
+    # (any mesh layout) models. Opt-in fast path. Composes with per-K
+    # checkpointing (ordered io_callback emission; plain model,
+    # single-controller); per-phase profiling and the remaining
+    # combinations fall back to the host-driven sweep with a warning.
     fused_sweep: bool = False
 
     # --- platform / parallelism ---

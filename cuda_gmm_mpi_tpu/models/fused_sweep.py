@@ -10,8 +10,10 @@ Rissanen scoring, best-model tracking, empty-cluster elimination, pair
 scans, and merges for EVERY K run inside a single ``lax.while_loop`` -- zero
 host round-trips between the initial dispatch and the final result. On a
 remote-TPU link (or any high-latency dispatch path) this removes the last
-per-K latency; the trade is no per-K logging/checkpointing, so it is the
-opt-in fast path (``GMMConfig.fused_sweep``) while the host loop remains the
+per-K latency. Per-K checkpointing composes via the ordered ``io_callback``
+emission hook (``emit_cb``/``resume``, round 3); per-phase profiling does
+not (attribution needs host-observed phase boundaries), so it is the opt-in
+fast path (``GMMConfig.fused_sweep``) while the host loop remains the
 default.
 
 Semantics match the host sweep exactly (same save rule gaussian.cu:839, same
