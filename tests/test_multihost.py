@@ -184,23 +184,25 @@ jax.distributed.shutdown()
 
 
 @pytest.mark.slow
-def test_results_gather_without_shared_fs(tmp_path):
+@pytest.mark.parametrize("nproc", [2, 3])
+def test_results_gather_without_shared_fs(tmp_path, nproc):
     """Per-rank parts in rank-PRIVATE directories (simulating per-host local
     disks on a pod): assembly must take the chunked byte-gather over the
     runtime -- the MPI_Send/Recv membership gather equivalence,
-    gaussian.cu:798-817 -- and produce rank-ordered byte-exact output."""
+    gaussian.cu:798-817 -- and produce rank-ordered byte-exact output.
+    3 ranks exercise unequal part sizes across >2 gather participants."""
     from .conftest import worker_env
 
     port = _free_port()
     env = worker_env()
     procs = [
         subprocess.Popen(
-            [sys.executable, "-c", GATHER_WORKER, str(i), "2", str(port),
-             str(tmp_path)],
+            [sys.executable, "-c", GATHER_WORKER, str(i), str(nproc),
+             str(port), str(tmp_path)],
             stdout=subprocess.PIPE, stderr=subprocess.PIPE, env=env,
             text=True,
         )
-        for i in range(2)
+        for i in range(nproc)
     ]
     outs = []
     try:
