@@ -105,8 +105,9 @@ def build_parser() -> argparse.ArgumentParser:
                    help="use the Pallas fused kernel")
     t.add_argument("--fused-sweep", action="store_true",
                    help="run the whole model-order sweep as one device "
-                   "program (fastest; composes with --checkpoint-dir via "
-                   "per-K emission, not with --profile)")
+                   "program (fastest; composes with --checkpoint-dir and "
+                   "--profile via per-K emission -- profile attribution is "
+                   "coarse: whole-K spans land in e_step)")
     t.add_argument("--mesh", default=None,
                    help="device mesh 'DATA[,CLUSTER]', e.g. --mesh=4 or "
                    "--mesh=4,2; default: all devices on the event axis")

@@ -69,9 +69,10 @@ class GMMConfig:
     # Run the ENTIRE model-order sweep as one jitted device program (zero
     # host syncs between dispatch and final result), on plain or sharded
     # (any mesh layout) models. Opt-in fast path. Composes with per-K
-    # checkpointing (ordered io_callback emission; plain model,
-    # single-controller); per-phase profiling and the remaining
-    # combinations fall back to the host-driven sweep with a warning.
+    # checkpointing AND profiling via ordered io_callback emission (plain
+    # model, single-controller; profile attribution is coarse -- whole-K
+    # spans land in e_step); other combinations fall back to the
+    # host-driven sweep with a warning.
     fused_sweep: bool = False
 
     # --- platform / parallelism ---

@@ -10,11 +10,11 @@ Rissanen scoring, best-model tracking, empty-cluster elimination, pair
 scans, and merges for EVERY K run inside a single ``lax.while_loop`` -- zero
 host round-trips between the initial dispatch and the final result. On a
 remote-TPU link (or any high-latency dispatch path) this removes the last
-per-K latency. Per-K checkpointing composes via the ordered ``io_callback``
-emission hook (``emit_cb``/``resume``, round 3); per-phase profiling does
-not (attribution needs host-observed phase boundaries), so it is the opt-in
-fast path (``GMMConfig.fused_sweep``) while the host loop remains the
-default.
+per-K latency. Per-K checkpointing and (coarse) profiling compose via the
+ordered ``io_callback`` emission hook (``emit_cb``/``resume``, round 3) --
+whole-K spans are attributed to e_step, since finer phase boundaries are
+not host-observable inside one device program. Opt-in fast path
+(``GMMConfig.fused_sweep``); the host loop remains the default.
 
 Semantics match the host sweep exactly (same save rule gaussian.cu:839, same
 termination conditions); parity is asserted in tests/test_fused_sweep.py.
@@ -56,6 +56,7 @@ def fused_sweep(
     reduce_stats: Optional[Callable] = None,
     reduce_order_fn: Optional[Callable] = None,
     emit_cb: Optional[Callable] = None,
+    emit_light: bool = False,
 ):
     """Run the whole K-sweep on device.
 
@@ -171,9 +172,12 @@ def fused_sweep(
         if emit_cb is not None:
             # Per-K host emission (checkpoint payload + log row): ordered so
             # a checkpoint for step s is durable before step s+1's runs.
-            jax.experimental.io_callback(
-                emit_cb, None,
-                dict(
+            # ``emit_light`` ships only the scalars (profiling wants just
+            # the arrival timestamp -- no per-K state transfer).
+            if emit_light:
+                payload = dict(step=c["step"], done=new_carry["done"])
+            else:
+                payload = dict(
                     step=c["step"], k=k, ll=ll, riss=riss, iters=iters,
                     state=new_carry["state"],
                     best_state=best_state,
@@ -182,9 +186,9 @@ def fused_sweep(
                     log=log,
                     next_k=new_carry["k"],
                     done=new_carry["done"],
-                ),
-                ordered=True,
-            )
+                )
+            jax.experimental.io_callback(emit_cb, None, payload,
+                                         ordered=True)
         return new_carry
 
     out = lax.while_loop(cond, body, carry0)

@@ -177,14 +177,16 @@ class GMMModel:
     def estep_stats(self, state, data_chunks, wts_chunks) -> SuffStats:
         return self._estep_stats(state, data_chunks, wts_chunks)
 
-    def make_fused_sweep(self, with_emit: bool = False, **static):
+    def make_fused_sweep(self, with_emit: bool = False,
+                         emit_light: bool = False, **static):
         """Jitted whole-sweep-on-device callable (models/fused_sweep.py),
         cached per static config so repeat fits reuse the executable.
 
         ``with_emit=True`` compiles in the per-K ordered io_callback; the
         actual host sink is read from ``self._emit_target`` at call time, so
         the cached executable is reused across fits with different
-        checkpointers."""
+        checkpointers. ``emit_light`` emits only the step scalars
+        (profiling-only runs skip the per-K state transfer)."""
         from .fused_sweep import fused_sweep
 
         emit_cb = None
@@ -195,11 +197,12 @@ class GMMModel:
                     target(payload)
 
         return cached_fused_sweep(
-            self, dict(static, with_emit=with_emit), lambda: jax.jit(
+            self, dict(static, with_emit=with_emit, emit_light=emit_light),
+            lambda: jax.jit(
                 functools.partial(
                     fused_sweep, stats_fn=self.stats_fn,
                     reduce_stats=self.reduce_stats, emit_cb=emit_cb,
-                    **self._kw, **static,
+                    emit_light=emit_light, **self._kw, **static,
                 )
             ))
 

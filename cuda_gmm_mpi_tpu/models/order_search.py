@@ -67,7 +67,10 @@ class GMMResult:
     # per-K trajectory: (num_clusters, loglik, rissanen, em_iters, seconds).
     # ``seconds`` is the wall time until that K's loglik was on host: EM only
     # when profiling is on (or on the final K); EM + the fused order-reduction
-    # dispatch/sync otherwise (the default path syncs once per K).
+    # dispatch/sync otherwise (the default path syncs once per K). Fused
+    # sweeps with emission (checkpoint/profile) record each K's whole span
+    # (EM + order reduction + emit; first new step includes compile) from
+    # emission arrival deltas; emission-free fused sweeps amortize wall/steps.
     sweep_log: list = dataclasses.field(default_factory=list)
     profile: Optional[dict] = None          # seconds per phase (7 categories)
     profile_report: Optional[str] = None    # formatted report
@@ -190,17 +193,20 @@ def fit_gmm(
         ckpt = SweepCheckpointer(config.checkpoint_dir)
 
     if config.fused_sweep:
+        # Checkpointing AND profiling both ride the per-K io_callback
+        # emission (plain single-controller models); other combinations
+        # fall back to the host-driven sweep.
+        want_emit = ckpt is not None or timer is not None
         blockers = []
-        if config.profile:
-            blockers.append("profile")
         if ckpt is not None and nproc > 1:
             blockers.append("checkpointing on a multi-controller run")
         maker = getattr(model, "make_fused_sweep", None)
         if maker is None:
             blockers.append("model without fused-sweep support")
-        elif (ckpt is not None and nproc == 1
-              and not getattr(model, "supports_fused_emit", False)):
-            blockers.append("per-K checkpoint emission on this model")
+        elif want_emit and not getattr(model, "supports_fused_emit", False):
+            blockers.append("per-K checkpoint emission on this model"
+                            if ckpt is not None else
+                            "per-K profile emission on this model")
         if blockers:
             log.warning(
                 "fused_sweep disabled (%s requested); using the host-driven "
@@ -212,14 +218,16 @@ def fit_gmm(
                 target_k=target_num_clusters,
                 num_events=n_events, num_dimensions=n_dims,
             )
-            if ckpt is not None:
+            if want_emit:
                 kwargs["with_emit"] = True
+                # Profiling-only emission needs just the step scalars.
+                kwargs["emit_light"] = ckpt is None
             fused = maker(**kwargs)
             return _run_fused_sweep(
                 fused, config, state, chunks, wts, epsilon,
                 num_clusters, stop_number, target_num_clusters,
                 n_events, n_dims, shift, verbose, host_range, model,
-                ckpt=ckpt, log=log,
+                ckpt=ckpt, log=log, timer=timer,
             )
 
     # One fused dispatch for the whole order-reduction step, so each K costs
@@ -528,7 +536,8 @@ def _fit_with_restarts(data, num_clusters, target_num_clusters, config,
 def _run_fused_sweep(fused, config, state, chunks, wts, epsilon,
                      num_clusters, stop_number, target_num_clusters,
                      n_events, n_dims, shift, verbose,
-                     host_range=None, model=None, ckpt=None, log=None):
+                     host_range=None, model=None, ckpt=None, log=None,
+                     timer=None):
     """Whole-sweep-on-device path (models/fused_sweep.py): one dispatch,
     one sync. ``fused`` comes from the model's ``make_fused_sweep`` (cached
     there, so passing the same ``model=`` to fit_gmm reuses the executable).
@@ -567,15 +576,16 @@ def _run_fused_sweep(fused, config, state, chunks, wts, epsilon,
                 if verbose:
                     print(f"resumed fused sweep at K={resume['k']}")
 
-        emit_times = {}
-
+    with_emit = ckpt is not None or timer is not None
+    emit_times = {}
+    if with_emit:
         def emit(payload):
             # Arrival time of each per-K emission: real per-K wall seconds
-            # for the sweep log (the checkpoint-free fused path can only
-            # amortize; individual K timings don't exist off-device there).
+            # for the sweep log / profile (the emission-free fused path can
+            # only amortize; individual K timings don't exist off-device).
             emit_times[int(payload["step"])] = time.perf_counter()
-            if bool(payload["done"]):
-                return  # the run returns its result right after this step
+            if ckpt is None or bool(payload["done"]):
+                return  # a finished run returns its result right after
             ckpt.save(int(payload["step"]), {
                 "state": payload["state"],
                 "best_state": payload["best_state"],
@@ -595,7 +605,7 @@ def _run_fused_sweep(fused, config, state, chunks, wts, epsilon,
         jnp.asarray(config.min_iters, jnp.int32),
         jnp.asarray(config.max_iters, jnp.int32),
     ]
-    if ckpt is not None:
+    if with_emit:
         args.append(resume)
     try:
         best_state, best_ll, best_riss, log_rows, steps = fused(*args)
@@ -603,17 +613,18 @@ def _run_fused_sweep(fused, config, state, chunks, wts, epsilon,
             (best_state, best_ll, best_riss, log_rows, steps)
         )
     finally:
-        if ckpt is not None:
+        if with_emit:
             model._emit_target = None
     wall = time.perf_counter() - t0
 
     steps = int(steps)
     per_k = wall / max(steps, 1)
-    # With checkpoint emission on, each step's host arrival time gives REAL
-    # per-K seconds (delta from the previous emission; the first new step
-    # is measured from dispatch). Restored/amortized steps keep per_k.
+    # With emission on, each step's host arrival time gives REAL per-K
+    # seconds (delta from the previous emission; the first new step is
+    # measured from dispatch, which includes any compile). Restored steps
+    # keep the amortized per_k.
     step_secs = {}
-    if ckpt is not None:
+    if with_emit:
         # Drain the ordered io_callback queue before reading emit_times:
         # device_get blocks on the ARRAYS, not on host-callback completion.
         jax.effects_barrier()
@@ -634,6 +645,21 @@ def _run_fused_sweep(fused, config, state, chunks, wts, epsilon,
     if verbose:
         print(f"Final rissanen score was: {float(best_riss)}, "
               f"with {n_active} clusters.")  # gaussian.cu:962
+
+    profile = profile_report = None
+    if timer is not None:
+        # Fused attribution: each K's whole span (EM + its order-reduction)
+        # lands in e_step; the finer 7-category split needs host-observed
+        # phase boundaries, which a single device program doesn't have.
+        rows = np.asarray(log_rows)
+        for i, dt in sorted(step_secs.items()):
+            timer.add("e_step", dt, count=int(rows[i][3]))
+        profile = timer.as_dict()
+        profile_report = (
+            timer.report()
+            + "\n  (fused sweep: whole-K spans attributed to e_step)"
+        )
+
     return GMMResult(
         state=compact_state,
         ideal_num_clusters=n_active,
@@ -644,6 +670,8 @@ def _run_fused_sweep(fused, config, state, chunks, wts, epsilon,
         num_dimensions=n_dims,
         data_shift=np.asarray(shift),
         sweep_log=sweep_log,
+        profile=profile,
+        profile_report=profile_report,
         host_range=host_range,
         model=model,
     )

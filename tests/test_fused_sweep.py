@@ -164,3 +164,15 @@ def test_fused_matches_host_float32(rng):
     assert rf.ideal_num_clusters == rh.ideal_num_clusters
     np.testing.assert_allclose(rf.final_loglik, rh.final_loglik, rtol=1e-6)
     np.testing.assert_allclose(rf.means, rh.means, rtol=1e-4, atol=1e-5)
+
+
+def test_fused_with_profile_emits_per_k(rng):
+    """--fused-sweep + --profile stays on the fused path: per-K emission
+    arrival times fill the e_step category (coarse whole-K attribution) and
+    real per-K seconds land in the sweep log."""
+    data, _ = make_blobs(rng, n=300, d=2, k=2)
+    r = fit_gmm(data, 4, 2, config=cfg(fused_sweep=True, profile=True))
+    assert r.profile is not None
+    assert r.profile["e_step"] > 0.0
+    assert "fused sweep" in r.profile_report
+    assert len({round(row[4], 9) for row in r.sweep_log}) > 1
