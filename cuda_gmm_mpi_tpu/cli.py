@@ -88,8 +88,9 @@ def build_parser() -> argparse.ArgumentParser:
                    choices=["highest", "high", "default"],
                    help="matmul precision on MXU")
     t.add_argument("--quad-mode", default="expanded",
-                   choices=["expanded", "centered"],
-                   help="quadratic-form evaluation strategy")
+                   choices=["expanded", "packed", "centered"],
+                   help="quadratic-form evaluation strategy (packed = "
+                   "symmetric-half features, ~0.52x the dominant MACs)")
     t.add_argument("--no-center", action="store_true",
                    help="disable global data centering")
     t.add_argument("--seed-method", default="even",

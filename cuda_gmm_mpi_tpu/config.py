@@ -115,7 +115,7 @@ class GMMConfig:
             )
         if self.max_clusters < 1:
             raise ValueError("max_clusters must be >= 1")
-        if self.quad_mode not in ("expanded", "centered"):
+        if self.quad_mode not in ("expanded", "packed", "centered"):
             raise ValueError(f"unknown quad_mode: {self.quad_mode!r}")
         if self.use_pallas not in ("auto", "always", "never"):
             raise ValueError(f"unknown use_pallas: {self.use_pallas!r}")

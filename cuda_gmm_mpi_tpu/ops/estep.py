@@ -23,10 +23,11 @@ the log-sum-exp -- the mask-based replacement for the reference's compaction.
 
 from __future__ import annotations
 
-from functools import partial
+from functools import lru_cache, partial
 
 import jax
 import jax.numpy as jnp
+import numpy as np
 from jax import lax
 
 NEG_INF = -jnp.inf
@@ -38,6 +39,62 @@ def _precision(name: str):
         "high": lax.Precision.HIGH,
         "default": lax.Precision.DEFAULT,
     }[name]
+
+
+@lru_cache(maxsize=None)
+def _tri(D: int):
+    """Static upper-triangle index machinery for symmetric packing.
+
+    Returns (iu0, iu1, fullmap): row/col indices of the D(D+1)/2 upper-triangle
+    entries, and a [D*D] map from full (i, j) position to packed index (used to
+    expand a packed symmetric matrix with one gather).
+    """
+    iu0, iu1 = np.triu_indices(D)
+    fullmap = np.zeros((D, D), dtype=np.int32)
+    fullmap[iu0, iu1] = np.arange(iu0.size, dtype=np.int32)
+    fullmap = np.maximum(fullmap, fullmap.T).reshape(-1)
+    return iu0, iu1, fullmap
+
+
+def pack_features(x: jax.Array) -> jax.Array:
+    """[B, D] events -> [B, D(D+1)/2] upper-triangle products x_i * x_j (i<=j).
+
+    The packed replacement for the flattened outer products in ``expanded``
+    mode: since Rinv and the M2 accumulator are symmetric, the lower triangle
+    of x xT carries no information -- dropping it cuts the two dominant MXU
+    contractions (q and M2, SURVEY.md SS3.3) from D^2 to D(D+1)/2 columns
+    (~0.52x the MACs at D=24/32).
+
+    Built from D broadcast-multiplied row slices (x_i * x[i:]) rather than a
+    [B, F] gather -- gathers on the minor axis are slow on TPU; slices and
+    concat lower to pure layout ops. The concat order (rows of the upper
+    triangle) matches ``np.triu_indices`` exactly.
+    """
+    D = x.shape[-1]
+    return jnp.concatenate(
+        [x[:, i:] * x[:, i:i + 1] for i in range(D)], axis=1)
+
+
+def pack_sym_weighted(A: jax.Array) -> jax.Array:
+    """[K, D, D] symmetric -> [K, D(D+1)/2] with off-diagonal entries doubled.
+
+    Packs Rinv so that packed_features . packed_Rinv reproduces the full
+    quadratic form: sum_ij x_i x_j Rinv_ij = sum_{i<=j} c_ij x_i x_j Rinv_ij
+    with c = 1 on the diagonal and 2 off it.
+    """
+    iu0, iu1, _ = _tri(A.shape[-1])
+    coef = jnp.asarray(np.where(iu0 == iu1, 1.0, 2.0), A.dtype)
+    return A[:, iu0, iu1] * coef
+
+
+def unpack_sym(P: jax.Array, D: int) -> jax.Array:
+    """[K, D(D+1)/2] packed upper triangle -> [K, D, D] symmetric (one gather).
+
+    Used to expand the packed M2 accumulator; both mirror entries come from
+    the same packed value, so the result is exactly symmetric.
+    """
+    _, _, fullmap = _tri(D)
+    return P[:, fullmap].reshape(P.shape[0], D, D)
 
 
 def log_densities(
@@ -52,8 +109,10 @@ def log_densities(
     """Unnormalized log posteriors: [B, K] = -0.5*q + constant + ln(pi).
 
     Matches estep1's output (gaussian_kernel.cu:442), vectorized over clusters.
-    ``xouter`` optionally supplies the precomputed [B, D*D] flattened outer
-    products so the fused E+M pass computes them once per chunk.
+    ``xouter`` optionally supplies the precomputed per-event quadratic
+    features so the fused E+M pass computes them once per chunk; its packing
+    must match ``quad_mode`` -- [B, D*D] flattened outer products for
+    ``expanded``, [B, D(D+1)/2] upper-triangle products for ``packed``.
     """
     prec = _precision(matmul_precision)
     mu, Rinv, = state.means, state.Rinv
@@ -70,14 +129,23 @@ def log_densities(
             - 2.0 * jnp.einsum("nd,kd->nk", x, a * mu, precision=prec)
             + jnp.sum(a * mu * mu, axis=-1)[None, :]
         )
-    elif quad_mode == "expanded":
-        # xx^T flattened once per chunk; shared with the M-step accumulator.
+    elif quad_mode in ("expanded", "packed"):
+        # Features shared with the M-step accumulator, computed once per chunk:
+        # full flattened xx^T (expanded) or its upper triangle (packed; the
+        # symmetric-half saving on the dominant contraction).
         if xouter is None:
-            xouter = (x[:, :, None] * x[:, None, :]).reshape(B, D * D)
+            xouter = (
+                pack_features(x) if quad_mode == "packed"
+                else (x[:, :, None] * x[:, None, :]).reshape(B, D * D)
+            )
+        A = (
+            pack_sym_weighted(Rinv) if quad_mode == "packed"
+            else Rinv.reshape(K, D * D)
+        )
         b = jnp.einsum("kde,ke->kd", Rinv, mu, precision=prec)  # Rinv mu
         c = jnp.sum(b * mu, axis=-1)  # mu^T Rinv mu
         q = (
-            jnp.einsum("nf,kf->nk", xouter, Rinv.reshape(K, D * D), precision=prec)
+            jnp.einsum("nf,kf->nk", xouter, A, precision=prec)
             - 2.0 * jnp.einsum("nd,kd->nk", x, b, precision=prec)
             + c[None, :]
         )

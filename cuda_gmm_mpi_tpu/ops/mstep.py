@@ -35,7 +35,7 @@ import jax
 import jax.numpy as jnp
 from jax import lax
 
-from .estep import posteriors, _precision
+from .estep import posteriors, _precision, pack_features, unpack_sym
 from .constants import compute_constants
 
 
@@ -97,7 +97,9 @@ def chunk_stats(
     prec = _precision(matmul_precision)
 
     xouter = None
-    if not diag_only and quad_mode == "expanded":
+    if not diag_only and quad_mode == "packed":
+        xouter = pack_features(x)
+    elif not diag_only and quad_mode == "expanded":
         xouter = (x[:, :, None] * x[:, None, :]).reshape(B, D * D)
 
     w, logZ = posteriors(
@@ -114,6 +116,11 @@ def chunk_stats(
     M1 = jnp.einsum("nk,nd->kd", w, x, precision=prec)
     if diag_only:
         M2 = jnp.einsum("nk,nd->kd", w, x * x, precision=prec)
+    elif quad_mode == "packed":
+        # Accumulate only the upper triangle (xouter holds the packed
+        # features, built above), then mirror with one static gather --
+        # exact symmetry by construction.
+        M2 = unpack_sym(jnp.einsum("nk,nt->kt", w, xouter, precision=prec), D)
     else:
         if xouter is None:
             xouter = (x[:, :, None] * x[:, None, :]).reshape(B, D * D)

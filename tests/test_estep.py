@@ -31,7 +31,7 @@ def make_state(rng, k, d, dtype=jnp.float64, inactive=()):
     )
 
 
-@pytest.mark.parametrize("quad_mode", ["expanded", "centered"])
+@pytest.mark.parametrize("quad_mode", ["expanded", "packed", "centered"])
 def test_log_densities_vs_scipy(rng, quad_mode):
     k, d, n = 4, 3, 50
     state = make_state(rng, k, d)

@@ -36,6 +36,54 @@ def test_chunk_stats_match_oracle(rng):
     np.testing.assert_allclose(np.asarray(stats.M2), M2, rtol=1e-8, atol=1e-10)
 
 
+def test_packed_quad_mode_matches_expanded(rng):
+    """quad_mode='packed' (symmetric-half features) is exact vs 'expanded'.
+
+    The packed path computes each x_i x_j product once (doubled off-diagonal
+    Rinv weights in q; mirrored-by-gather M2), so in float64 it must agree
+    with the full outer-product path to reduction-order tolerance, and its
+    M2 must be exactly symmetric by construction.
+    """
+    k, d, n = 5, 7, 300
+    state = make_state(rng, k, d)
+    x = rng.normal(scale=2.0, size=(n, d))
+    a = chunk_stats(state, jnp.asarray(x), quad_mode="expanded")
+    b = chunk_stats(state, jnp.asarray(x), quad_mode="packed")
+    np.testing.assert_allclose(float(b.loglik), float(a.loglik), rtol=1e-12)
+    for name in ("Nk", "M1", "M2"):
+        np.testing.assert_allclose(
+            np.asarray(getattr(b, name)), np.asarray(getattr(a, name)),
+            rtol=1e-10, atol=1e-12,
+        )
+    M2 = np.asarray(b.M2)
+    assert np.array_equal(M2, M2.transpose(0, 2, 1))
+
+
+def test_sym_packing_roundtrip(rng):
+    """pack_features ordering matches triu_indices; unpack_sym inverts it."""
+    from cuda_gmm_mpi_tpu.ops.estep import (
+        pack_features, pack_sym_weighted, unpack_sym,
+    )
+
+    d, n, k = 6, 20, 3
+    x = rng.normal(size=(n, d))
+    iu = np.triu_indices(d)
+    xt = np.asarray(pack_features(jnp.asarray(x)))
+    np.testing.assert_array_equal(xt, x[:, iu[0]] * x[:, iu[1]])
+
+    A = np.stack([np.diag(np.full(d, 2.0)) + rng.normal(size=(d, d))
+                  for _ in range(k)])
+    A = (A + A.transpose(0, 2, 1)) / 2
+    packed = np.asarray(pack_sym_weighted(jnp.asarray(A)))
+    # packed_features . packed_A reproduces the full quadratic form
+    q_full = np.einsum("ni,nj,kij->nk", x, x, A)
+    np.testing.assert_allclose(xt @ packed.T, q_full, rtol=1e-12)
+    # unpack of the undoubled triangle restores the symmetric matrix
+    tri = np.stack([a[iu] for a in A])
+    np.testing.assert_array_equal(
+        np.asarray(unpack_sym(jnp.asarray(tri), d)), A)
+
+
 def test_accumulate_equals_single_chunk(rng):
     k, d, n, b = 3, 4, 96, 32
     state = make_state(rng, k, d)
