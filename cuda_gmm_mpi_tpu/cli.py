@@ -89,8 +89,9 @@ def build_parser() -> argparse.ArgumentParser:
                    help="matmul precision on MXU")
     t.add_argument("--quad-mode", default="expanded",
                    choices=["expanded", "packed", "centered"],
-                   help="quadratic-form evaluation strategy (packed = "
-                   "symmetric-half features, ~0.52x the dominant MACs)")
+                   help="quadratic-form evaluation strategy; 'packed' halves "
+                   "the dominant MACs but measures SLOWER on XLA/TPU "
+                   "(layout-bound, see docs/PERF.md) -- kept for study")
     t.add_argument("--no-center", action="store_true",
                    help="disable global data centering")
     t.add_argument("--seed-method", default="even",

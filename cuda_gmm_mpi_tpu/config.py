@@ -57,11 +57,10 @@ class GMMConfig:
     quad_mode: str = "expanded"
     # Center data at fit() time (shift-equivariant; outputs are shifted back).
     center_data: bool = True
-    # Pallas fused kernel for the E+M pass. 'auto' resolves to the jnp/XLA
-    # path everywhere: at matched matmul precision XLA meets or beats the
-    # hand kernel at every measured shape (docs/PERF.md round-3 precision
-    # study). 'always' forces the kernel (fp32 only; precision 'highest' or
-    # 'default' -- Mosaic rejects 'high' inside kernel dots).
+    # Pallas fused kernel for the E+M pass; 'always' forces it, 'auto'
+    # resolves per the measured matrix in docs/PERF.md. All precisions are
+    # supported in-kernel ('high' is a manual 3-dot bf16_3x decomposition,
+    # since Mosaic rejects native Precision.HIGH).
     use_pallas: str = "auto"  # 'auto' | 'always' | 'never'
     # Events per Pallas grid tile (the kernel's VMEM working set is
     # ~ block_b * D^2 floats for the outer products).
@@ -119,14 +118,6 @@ class GMMConfig:
             raise ValueError(f"unknown quad_mode: {self.quad_mode!r}")
         if self.use_pallas not in ("auto", "always", "never"):
             raise ValueError(f"unknown use_pallas: {self.use_pallas!r}")
-        if self.use_pallas == "always" and self.matmul_precision == "high":
-            raise ValueError(
-                "use_pallas='always' with matmul_precision='high' cannot "
-                "compile: Mosaic rejects precision=HIGH in kernel dots "
-                "(bf16_3x is an XLA-path-only option, docs/PERF.md). Use "
-                "the XLA path for 'high', or 'highest'/'default' with the "
-                "kernel."
-            )
         if self.seed_method not in ("even", "kmeans++"):
             raise ValueError(f"unknown seed_method: {self.seed_method!r}")
         if self.chunk_size < 1:

@@ -5,12 +5,13 @@ matched-precision study (docs/PERF.md), 'auto' resolves to the jnp/XLA path
 everywhere -- the kernel's earlier measured wins were an artifact of Mosaic
 lowering precision-unannotated dots at DEFAULT (bf16); at honest precision
 XLA meets or beats the kernel at every measured shape. The kernels stay
-available under ``use_pallas='always'`` (fp32; precision 'highest' or
-'default' -- Mosaic rejects 'high' in kernel dots), correct and tested:
-the single-shard fused E+M kernel (full + diagonal covariance) and the
-two-pass cluster-sharded variant (per-shard LSE in-kernel, pmax/psum
-outside -- the cross-device generalization of estep1's per-cluster grid
-axis, ``gaussian_kernel.cu:383``; diagonal covariance only).
+available under ``use_pallas='always'`` (fp32; all precisions -- 'high' is
+a manual 3-dot bf16_3x decomposition since Mosaic rejects native
+Precision.HIGH), correct and tested: the single-shard fused E+M kernel
+(full + diagonal covariance) and the two-pass cluster-sharded variant
+(per-shard LSE in-kernel, pmax/psum outside -- the cross-device
+generalization of estep1's per-cluster grid axis,
+``gaussian_kernel.cu:383``; diagonal covariance only).
 ``make_stats_fn`` binds the config's covariance mode, tile size, precision,
 and mesh axis into the ``stats_fn`` hook consumed by ``em_while_loop``.
 """
@@ -47,6 +48,12 @@ def make_stats_fn(config, cluster_sharded: bool = False,
     """stats_fn hook bound to the config, or None for the jnp path."""
     if not should_use_pallas(config, cluster_sharded):
         return None
+    import jax
+
+    # Mosaic compiles on TPU only; on any other backend run the kernel in
+    # interpret mode so use_pallas='always' works (slowly) everywhere --
+    # the same code path the kernel test suite exercises.
+    interpret = jax.default_backend() != "tpu"
     if cluster_sharded:
         from ...parallel.mesh import CLUSTER_AXIS
 
@@ -56,12 +63,14 @@ def make_stats_fn(config, cluster_sharded: bool = False,
             diag_only=config.diag_only,
             block_b=config.pallas_block_b,
             precision=config.matmul_precision,
+            interpret=interpret,
         )
     return functools.partial(
         fused_stats_pallas,
         diag_only=config.diag_only,
         block_b=config.pallas_block_b,
         precision=config.matmul_precision,
+        interpret=interpret,
     )
 
 

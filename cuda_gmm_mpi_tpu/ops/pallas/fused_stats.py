@@ -25,8 +25,15 @@ products -- same kernel structure, D x cheaper contractions.
 Stats accumulate in VMEM scratch across the sequential TPU grid and are
 written once on the last tile. ``fused_stats_pallas`` requires an unsharded
 cluster axis; ``fused_stats_pallas_sharded`` (below) is the two-pass
-cluster-sharded variant. Kernel dots accept precision 'highest'/'default'
-only (Mosaic rejects HIGH; bf16_3x is an XLA-path-only option).
+cluster-sharded variant.
+
+Precision: 'highest' and 'default' map to Mosaic's native MXU modes.
+'high' (bf16_3x) is NOT accepted by Mosaic's dot lowering -- the kernel
+implements it MANUALLY as the standard 3-dot decomposition (split each fp32
+operand into a bf16 high part and a bf16 residual; a.b ~= ah.bh + ah.bl +
+al.bh, accumulated in fp32). This is the same arithmetic XLA emits for
+``lax.Precision.HIGH``, so the kernel can run the bench's chosen precision
+with zero xouter HBM traffic.
 """
 
 from __future__ import annotations
@@ -42,6 +49,35 @@ from ..estep import _precision
 from ..mstep import SuffStats
 
 NEG_LARGE = -1e30  # stand-in for -inf: exp() underflows to 0, avoids inf-inf
+
+
+def _kdot(a, b, dims, precision: str):
+    """dot_general with fp32 accumulation; 'high' = manual 3-dot bf16_3x.
+
+    Mosaic rejects lax.Precision.HIGH inside kernels, so bf16_3x is spelled
+    out: ah.bh + ah.bl + al.bh where xh = bf16(x), xl = bf16(x - xh). The
+    dropped al.bl term is O(2^-16) relative -- identical to XLA's HIGH.
+    """
+    if precision == "high":
+        f32 = jnp.float32
+        ah = a.astype(jnp.bfloat16)
+        al = (a - ah.astype(f32)).astype(jnp.bfloat16)
+        bh = b.astype(jnp.bfloat16)
+        bl = (b - bh.astype(f32)).astype(jnp.bfloat16)
+        d = functools.partial(
+            jax.lax.dot_general, dimension_numbers=dims,
+            preferred_element_type=f32,
+            precision=jax.lax.Precision.DEFAULT,
+        )
+        return d(ah, bh) + d(ah, bl) + d(al, bh)
+    return jax.lax.dot_general(
+        a, b, dims, preferred_element_type=jnp.float32,
+        precision=_precision(precision),
+    )
+
+
+_NT = (((1,), (0,)), ((), ()))  # [M, C] . [C, N] -> [M, N] (natural layout)
+_TT = (((0,), (0,)), ((), ()))  # [C, M] . [C, N] -> [M, N] (event reduce)
 
 
 def _fused_stats_kernel(x_ref, wt_ref, A_ref, h_ref, g_ref,
@@ -73,15 +109,10 @@ def _fused_stats_kernel(x_ref, wt_ref, A_ref, h_ref, g_ref,
 
     # Quadratic form as two MXU contractions (estep1's double D-loop per
     # thread becomes one (B_t, D^2) @ (D^2, K) matmul; (B_t, D) @ (D, K)
-    # under DIAG_ONLY).
-    q = jax.lax.dot_general(
-        x2, A_ref[:], (((1,), (1,)), ((), ())),
-        preferred_element_type=jnp.float32, precision=precision,
-    )  # [B_t, K]
-    q = q - 2.0 * jax.lax.dot_general(
-        x, h_ref[:], (((1,), (1,)), ((), ())),
-        preferred_element_type=jnp.float32, precision=precision,
-    )
+    # under DIAG_ONLY). A and h arrive pre-transposed ([F, K] / [D, K]) so
+    # the dots are in natural layout -- no per-tile operand transposes.
+    q = _kdot(x2, A_ref[:], _NT, precision)   # [B_t, K]
+    q = q - 2.0 * _kdot(x, h_ref[:], _NT, precision)
     logp = -0.5 * q + g_ref[:]        # [B_t, K]; g broadcasts from [1, K]
 
     # estep2: max-shifted log-sum-exp + normalized responsibilities.
@@ -95,14 +126,8 @@ def _fused_stats_kernel(x_ref, wt_ref, A_ref, h_ref, g_ref,
     # Full-block (1,1) write: Mosaic rejects scalar stores to VMEM refs.
     ll_acc[:] = ll_acc[:] + jnp.sum(logz).reshape(1, 1)
     nk_acc[:] += jnp.sum(w, axis=0, keepdims=True)          # [1, K]
-    m1_acc[:] += jax.lax.dot_general(                       # [K, D]
-        w, x, (((0,), (0,)), ((), ())),
-        preferred_element_type=jnp.float32, precision=precision,
-    )
-    m2_acc[:] += jax.lax.dot_general(                       # [K, D*D] | [K, D]
-        w, x2, (((0,), (0,)), ((), ())),
-        preferred_element_type=jnp.float32, precision=precision,
-    )
+    m1_acc[:] += _kdot(w, x, _TT, precision)                # [K, D]
+    m2_acc[:] += _kdot(w, x2, _TT, precision)               # [K, D*D] | [K, D]
 
     @pl.when(i == n_tiles - 1)
     def _flush():
@@ -118,8 +143,8 @@ def _fused_stats_kernel(x_ref, wt_ref, A_ref, h_ref, g_ref,
 def _fused_stats_call(x, wt, A, h, g, *, block_b: int, diag: bool,
                       interpret: bool, precision: str = "highest"):
     n, d = x.shape
-    k = A.shape[0]
-    f = A.shape[1]  # D*D (full) or D (diag)
+    k = A.shape[1]  # A arrives transposed: [F, K]
+    f = A.shape[0]  # D*D (full) or D (diag)
     grid = n // block_b
     f32 = jnp.float32
     out_shapes = (
@@ -130,7 +155,7 @@ def _fused_stats_call(x, wt, A, h, g, *, block_b: int, diag: bool,
     )
     rep = lambda *_: (0, 0)  # accumulator outputs: same block every step
     kernel = functools.partial(_fused_stats_kernel, diag=diag,
-                               precision=_precision(precision))
+                               precision=precision)
     ll, nk, m1, m2 = pl.pallas_call(
         kernel,
         grid=(grid,),
@@ -139,8 +164,8 @@ def _fused_stats_call(x, wt, A, h, g, *, block_b: int, diag: bool,
                          memory_space=pltpu.VMEM),
             pl.BlockSpec((block_b, 1), lambda i: (i, 0),
                          memory_space=pltpu.VMEM),
-            pl.BlockSpec((k, f), rep, memory_space=pltpu.VMEM),
-            pl.BlockSpec((k, d), rep, memory_space=pltpu.VMEM),
+            pl.BlockSpec((f, k), rep, memory_space=pltpu.VMEM),
+            pl.BlockSpec((d, k), rep, memory_space=pltpu.VMEM),
             pl.BlockSpec((1, k), rep, memory_space=pltpu.VMEM),
         ],
         out_specs=(
@@ -174,14 +199,8 @@ def _logp_tile(x, A_ref, h_ref, g_ref, diag: bool, precision):
     else:
         # Flattened outer products, built in VMEM (see _fused_stats_kernel).
         x2 = jnp.concatenate([x * x[:, j:j + 1] for j in range(d)], axis=1)
-    q = jax.lax.dot_general(
-        x2, A_ref[:], (((1,), (1,)), ((), ())),
-        preferred_element_type=jnp.float32, precision=precision,
-    )  # [B_t, K]
-    q = q - 2.0 * jax.lax.dot_general(
-        x, h_ref[:], (((1,), (1,)), ((), ())),
-        preferred_element_type=jnp.float32, precision=precision,
-    )
+    q = _kdot(x2, A_ref[:], _NT, precision)   # [B_t, K]; A is [F, K]
+    q = q - 2.0 * _kdot(x, h_ref[:], _NT, precision)
     return -0.5 * q + g_ref[:], x2    # g broadcasts from [1, K]
 
 
@@ -231,14 +250,8 @@ def _stats_logz_kernel(x_ref, wt_ref, logz_ref, A_ref, h_ref, g_ref,
     # (it is NOT psum'd over the cluster axis, matching the jnp path).
     ll_acc[:] = ll_acc[:] + jnp.sum(logz * wt).reshape(1, 1)
     nk_acc[:] += jnp.sum(w, axis=0, keepdims=True)          # [1, K]
-    m1_acc[:] += jax.lax.dot_general(                       # [K, D]
-        w, x, (((0,), (0,)), ((), ())),
-        preferred_element_type=jnp.float32, precision=precision,
-    )
-    m2_acc[:] += jax.lax.dot_general(                       # [K, D*D] | [K, D]
-        w, x2, (((0,), (0,)), ((), ())),
-        preferred_element_type=jnp.float32, precision=precision,
-    )
+    m1_acc[:] += _kdot(w, x, _TT, precision)                # [K, D]
+    m2_acc[:] += _kdot(w, x2, _TT, precision)               # [K, D*D] | [K, D]
 
     @pl.when(i == n_tiles - 1)
     def _flush():
@@ -253,12 +266,12 @@ def _stats_logz_kernel(x_ref, wt_ref, logz_ref, A_ref, h_ref, g_ref,
 def _local_lse_call(x, A, h, g, *, block_b: int, diag: bool, interpret: bool,
                     precision: str = "highest"):
     n, d = x.shape
-    k = A.shape[0]
-    f = A.shape[1]
+    k = A.shape[1]  # A arrives transposed: [F, K]
+    f = A.shape[0]
     grid = n // block_b
     f32 = jnp.float32
     kernel = functools.partial(_local_lse_kernel, diag=diag,
-                               precision=_precision(precision))
+                               precision=precision)
     row = lambda i: (i, 0)
     rep = lambda *_: (0, 0)
     return pl.pallas_call(
@@ -266,8 +279,8 @@ def _local_lse_call(x, A, h, g, *, block_b: int, diag: bool, interpret: bool,
         grid=(grid,),
         in_specs=[
             pl.BlockSpec((block_b, d), row, memory_space=pltpu.VMEM),
-            pl.BlockSpec((k, f), rep, memory_space=pltpu.VMEM),
-            pl.BlockSpec((k, d), rep, memory_space=pltpu.VMEM),
+            pl.BlockSpec((f, k), rep, memory_space=pltpu.VMEM),
+            pl.BlockSpec((d, k), rep, memory_space=pltpu.VMEM),
             pl.BlockSpec((1, k), rep, memory_space=pltpu.VMEM),
         ],
         out_specs=(
@@ -292,8 +305,8 @@ def _local_lse_call(x, A, h, g, *, block_b: int, diag: bool, interpret: bool,
 def _stats_logz_call(x, wt, logz, A, h, g, *, block_b: int, diag: bool,
                      interpret: bool, precision: str = "highest"):
     n, d = x.shape
-    k = A.shape[0]
-    f = A.shape[1]
+    k = A.shape[1]  # A arrives transposed: [F, K]
+    f = A.shape[0]
     grid = n // block_b
     f32 = jnp.float32
     out_shapes = (
@@ -305,7 +318,7 @@ def _stats_logz_call(x, wt, logz, A, h, g, *, block_b: int, diag: bool,
     row = lambda i: (i, 0)
     rep = lambda *_: (0, 0)
     kernel = functools.partial(_stats_logz_kernel, diag=diag,
-                               precision=_precision(precision))
+                               precision=precision)
     return pl.pallas_call(
         kernel,
         grid=(grid,),
@@ -313,8 +326,8 @@ def _stats_logz_call(x, wt, logz, A, h, g, *, block_b: int, diag: bool,
             pl.BlockSpec((block_b, d), row, memory_space=pltpu.VMEM),
             pl.BlockSpec((block_b, 1), row, memory_space=pltpu.VMEM),
             pl.BlockSpec((block_b, 1), row, memory_space=pltpu.VMEM),
-            pl.BlockSpec((k, f), rep, memory_space=pltpu.VMEM),
-            pl.BlockSpec((k, d), rep, memory_space=pltpu.VMEM),
+            pl.BlockSpec((f, k), rep, memory_space=pltpu.VMEM),
+            pl.BlockSpec((d, k), rep, memory_space=pltpu.VMEM),
             pl.BlockSpec((1, k), rep, memory_space=pltpu.VMEM),
         ],
         out_specs=(
@@ -391,7 +404,10 @@ def fused_stats_pallas_sharded(
 
 def _prep_inputs(state, data_chunks, wts_chunks, block_b, diag_only):
     """Flatten chunks to tile-padded [N, D] and build the per-cluster
-    linear/constant terms (A, h, g) for logp = -0.5 (x2.A - 2 x.h) + g."""
+    linear/constant terms (A [F, K], h [D, K], g [1, K]) for
+    logp = -0.5 (x2.A - 2 x.h) + g. A and h are emitted PRE-TRANSPOSED so
+    every kernel dot runs in natural [M, C] . [C, N] layout (the transpose
+    happens once per iteration here, not once per event tile)."""
     c, b, d = data_chunks.shape
     n = c * b
     x = data_chunks.reshape(n, d).astype(jnp.float32)
@@ -422,7 +438,7 @@ def _prep_inputs(state, data_chunks, wts_chunks, block_b, diag_only):
         + jnp.log(jnp.maximum(state.pi.astype(jnp.float32), 1e-37))
     )
     g = jnp.where(state.active, g, NEG_LARGE)[None, :]  # [1, K]
-    return x, wt, A, h, g
+    return x, wt, A.T, h.T, g
 
 
 def fused_stats_pallas(

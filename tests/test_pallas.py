@@ -159,10 +159,33 @@ def test_should_use_pallas_gating():
     assert not should_use_pallas(GMMConfig(use_pallas="auto"))
     assert not should_use_pallas(GMMConfig(use_pallas="auto",
                                            diag_only=True))
-    # Mosaic rejects precision=HIGH in kernel dots: the config refuses the
-    # combination up front instead of dying at compile time.
-    with pytest.raises(ValueError, match="bf16_3x"):
-        GMMConfig(use_pallas="always", matmul_precision="high")
+    # 'high' + kernel is a supported combination (manual 3-dot bf16_3x
+    # decomposition in _kdot; Mosaic rejects only native Precision.HIGH).
+    GMMConfig(use_pallas="always", matmul_precision="high")
+
+
+def test_fused_stats_manual_bf16_3x_matches_xla_high(rng):
+    """Kernel precision='high' (manual split dots) ~= XLA Precision.HIGH.
+
+    Both compute ah.bh + ah.bl + al.bh in fp32, so they agree to bf16_3x
+    rounding (~2^-16 relative) while 'default' (1-pass bf16) would be ~2^-8
+    off -- the tolerance below separates the two regimes.
+    """
+    k, d, n, b = 5, 4, 256, 64
+    state = to_f32(make_state(rng, k, d))
+    data = rng.normal(scale=2.0, size=(n, d)).astype(np.float32)
+    chunks = jnp.asarray(data.reshape(n // b, b, d))
+    wts = jnp.ones((n // b, b), jnp.float32)
+
+    exact = accumulate_stats(state, chunks, wts, matmul_precision="highest")
+    out = fused_stats_pallas(state, chunks, wts, block_b=64, interpret=True,
+                             precision="high")
+    np.testing.assert_allclose(float(out.loglik), float(exact.loglik),
+                               rtol=1e-4)
+    np.testing.assert_allclose(np.asarray(out.M1), np.asarray(exact.M1),
+                               rtol=5e-4, atol=5e-4)
+    np.testing.assert_allclose(np.asarray(out.M2), np.asarray(exact.M2),
+                               rtol=5e-4, atol=5e-3)
 
 
 sharded_interp = functools.partial(
