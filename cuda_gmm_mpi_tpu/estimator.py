@@ -74,11 +74,29 @@ class GaussianMixture:
         self._model = self.result_.model or GMMModel(self.config)
         return self
 
+    def fit_predict(self, X: np.ndarray) -> np.ndarray:
+        """Fit and return the hard cluster assignment of X (sklearn surface)."""
+        return self.fit(X).predict(X)
+
     @property
     def _fitted(self) -> GMMResult:
         if self.result_ is None:
             raise RuntimeError("estimator is not fitted; call fit(X) first")
         return self.result_
+
+    @property
+    def n_iter_(self) -> int:
+        """EM iterations run at the selected K (from the sweep log).
+
+        Note the reference's shipped semantics pin min_iters == max_iters ==
+        100 (gaussian.h:26-27), which short-circuits the convergence test --
+        under those defaults this is always max_iters.
+        """
+        res = self._fitted
+        for row in res.sweep_log:
+            if int(row[0]) == res.ideal_num_clusters:
+                return int(row[3])
+        return 0
 
     @property
     def weights_(self) -> np.ndarray:

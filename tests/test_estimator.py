@@ -47,6 +47,18 @@ def test_predict_recovers_blobs(fitted):
     assert agree / len(labels) > 0.95
 
 
+def test_fit_predict_and_n_iter(fitted):
+    gm, data, _ = fitted
+    # n_iter_ reads the selected K's row of the sweep log; with min==max
+    # iters the loop runs exactly that many (reference semantics).
+    assert gm.n_iter_ == 12
+    gm2 = GaussianMixture(3, target_components=3, min_iters=6, max_iters=6,
+                          chunk_size=128)
+    pred = gm2.fit_predict(data)
+    assert pred.shape == (len(data),)
+    np.testing.assert_array_equal(pred, gm2.predict(data))
+
+
 def test_predict_proba_normalized(fitted):
     gm, data, _ = fitted
     w = gm.predict_proba(data[:100])
