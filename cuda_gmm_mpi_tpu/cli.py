@@ -42,7 +42,13 @@ def build_parser() -> argparse.ArgumentParser:
                    help="virtual CPU device count (validate sharded runs "
                    "without a cluster, SURVEY.md SS4; use with --device=cpu)")
     g.add_argument("--diag-only", action="store_true",
-                   help="diagonal covariance (DIAG_ONLY, gaussian.h:23)")
+                   help="diagonal covariance (DIAG_ONLY, gaussian.h:23); "
+                   "shorthand for --covariance-type=diag")
+    g.add_argument("--covariance-type", default="full",
+                   choices=["full", "diag", "spherical", "tied"],
+                   help="covariance family: the reference's full/diag plus "
+                   "spherical (sigma^2 I per cluster) and tied (one shared "
+                   "covariance) as capability upgrades")
     g.add_argument("--min-iters", type=int, default=100,
                    help="MIN_ITERS (gaussian.h:27)")
     g.add_argument("--max-iters", type=int, default=100,
@@ -168,6 +174,7 @@ def main(argv=None) -> int:
             max_clusters=args.max_clusters,
             covariance_dynamic_range=args.dynamic_range,
             diag_only=args.diag_only,
+            covariance_type=args.covariance_type,
             min_iters=args.min_iters,
             max_iters=args.max_iters,
             epsilon_scale=args.epsilon_scale,

@@ -34,6 +34,14 @@ class GMMConfig:
     max_clusters: int = 512
     covariance_dynamic_range: float = 1e3
     diag_only: bool = False
+    # Covariance family: 'full' (reference default) | 'diag' (reference
+    # DIAG_ONLY; equivalent to diag_only=True) | 'spherical' (sigma^2 I per
+    # cluster; diagonal statistics path) | 'tied' (one shared D x D
+    # covariance; full statistics path). The two extra families are a
+    # capability upgrade over the reference's two compile-time modes; the
+    # order-search merge machinery scores merges with the unconstrained
+    # pooled covariance and EM re-imposes the constraint each K.
+    covariance_type: str = "full"
     min_iters: int = 100
     max_iters: int = 100
     # Convergence threshold scale: epsilon = nparams_per_cluster * ln(N*D) * scale
@@ -116,6 +124,19 @@ class GMMConfig:
             raise ValueError("max_clusters must be >= 1")
         if self.quad_mode not in ("expanded", "packed", "centered"):
             raise ValueError(f"unknown quad_mode: {self.quad_mode!r}")
+        if self.covariance_type not in ("full", "diag", "spherical", "tied"):
+            raise ValueError(
+                f"unknown covariance_type: {self.covariance_type!r}")
+        # diag_only (the reference's DIAG_ONLY flag) and covariance_type are
+        # one setting: keep them coherent whichever way the user spells it.
+        if self.diag_only and self.covariance_type == "full":
+            object.__setattr__(self, "covariance_type", "diag")
+        elif self.covariance_type in ("diag", "spherical"):
+            object.__setattr__(self, "diag_only", True)
+        elif self.diag_only and self.covariance_type == "tied":
+            raise ValueError(
+                "covariance_type='tied' needs full-covariance statistics; "
+                "it cannot combine with diag_only=True")
         if self.use_pallas not in ("auto", "always", "never"):
             raise ValueError(f"unknown use_pallas: {self.use_pallas!r}")
         if self.seed_method not in ("even", "kmeans++"):

@@ -157,12 +157,13 @@ class GaussianMixture:
 
     def _n_free_params(self) -> float:
         """Free parameters actually estimated by the fitted model (diagonal
-        covariances count D, not D(D+1)/2; the weight simplex removes 1)."""
+        covariances count D, spherical 1, tied one shared D(D+1)/2; the
+        weight simplex removes 1)."""
         from .ops.formulas import n_free_params
 
         return n_free_params(self.n_components_,
                              self._fitted.num_dimensions,
-                             diag_only=self.config.diag_only)
+                             covariance_type=self.config.covariance_type)
 
     def bic(self, X: np.ndarray) -> float:
         """Bayesian information criterion on X (lower is better) -- the

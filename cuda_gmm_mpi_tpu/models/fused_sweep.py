@@ -52,6 +52,7 @@ def fused_sweep(
     quad_mode: str = "expanded",
     matmul_precision: str = "highest",
     cluster_axis: str | None = None,
+    covariance_type: str | None = None,
     stats_fn: Optional[Callable] = None,
     reduce_stats: Optional[Callable] = None,
     reduce_order_fn: Optional[Callable] = None,
@@ -99,6 +100,7 @@ def fused_sweep(
             reduce_stats=reduce_stats, diag_only=diag_only,
             quad_mode=quad_mode, matmul_precision=matmul_precision,
             cluster_axis=cluster_axis, stats_fn=stats_fn,
+            covariance_type=covariance_type,
         )
 
     zero = jnp.zeros((), dtype)

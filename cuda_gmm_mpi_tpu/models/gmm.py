@@ -136,7 +136,8 @@ class GMMModel:
 
         self._em_run = jax.jit(
             functools.partial(em_while_loop, reduce_stats=reduce_stats,
-                              stats_fn=stats_fn, **kw)
+                              stats_fn=stats_fn,
+                              covariance_type=config.covariance_type, **kw)
         )
         self._estep_stats = jax.jit(
             functools.partial(self._estep_stats_impl, reduce_stats=reduce_stats,
@@ -202,7 +203,9 @@ class GMMModel:
                 functools.partial(
                     fused_sweep, stats_fn=self.stats_fn,
                     reduce_stats=self.reduce_stats, emit_cb=emit_cb,
-                    emit_light=emit_light, **self._kw, **static,
+                    emit_light=emit_light,
+                    covariance_type=self.config.covariance_type,
+                    **self._kw, **static,
                 )
             ))
 
@@ -254,12 +257,15 @@ def em_while_loop(
     matmul_precision: str = "highest",
     cluster_axis: str | None = None,
     stats_fn: Optional[Callable] = None,
+    covariance_type: str | None = None,
 ):
     """The whole per-K EM algorithm as one traced program.
 
     ``stats_fn(state, data_chunks, wts_chunks) -> SuffStats`` overrides the
     jnp fused pass -- the hook through which the Pallas TPU kernel
     (ops/pallas/fused_stats.py) replaces XLA-generated code on the hot path.
+    ``covariance_type`` selects the M-step covariance constraint
+    (ops/mstep.py apply_mstep); the E-step/statistics path is shared.
     """
     kw = dict(diag_only=diag_only, quad_mode=quad_mode,
               matmul_precision=matmul_precision, cluster_axis=cluster_axis)
@@ -284,7 +290,8 @@ def em_while_loop(
     def body(carry):
         s, stats, ll_old, _, iters = carry
         s = apply_mstep(s, stats, diag_only=diag_only,
-                        cluster_axis=cluster_axis)  # :541-701
+                        cluster_axis=cluster_axis,
+                        covariance_type=covariance_type)  # :541-701
         stats_new = estep(s)  # :713-741
         ll = stats_new.loglik
         return (s, stats_new, ll, ll - ll_old, iters + 1)  # :748-751

@@ -23,18 +23,26 @@ def free_params_per_cluster(num_dimensions: int,
 
 
 def n_free_params(num_clusters, num_dimensions: int,
-                  diag_only: bool = False):
+                  diag_only: bool = False,
+                  covariance_type: str | None = None):
     """Total free parameters of a K-component model: K per-cluster counts
     minus the weight-simplex constraint (the ``-1`` in gaussian.cu:826).
 
     Note: the reference's Rissanen formula always uses the FULL-covariance
     per-cluster count, even in its DIAG_ONLY build -- ``rissanen_score``
     reproduces that; information-criterion APIs that should count what the
-    model actually estimates pass ``diag_only``.
+    model actually estimates pass ``diag_only`` / ``covariance_type``
+    ('spherical' = one variance per cluster; 'tied' = one shared D(D+1)/2
+    covariance across clusters).
     """
-    return num_clusters * free_params_per_cluster(
-        num_dimensions, diag_only=diag_only
-    ) - 1.0
+    k, d = num_clusters, num_dimensions
+    if covariance_type is None:
+        covariance_type = "diag" if diag_only else "full"
+    if covariance_type == "tied":
+        return k * (1.0 + d) + 0.5 * (d + 1) * d - 1.0
+    cov = {"full": 0.5 * (d + 1) * d, "diag": float(d),
+           "spherical": 1.0}[covariance_type]
+    return k * (1.0 + d + cov) - 1.0
 
 
 def convergence_epsilon(

@@ -163,13 +163,28 @@ def accumulate_stats(
 
 
 def apply_mstep(state, stats: SuffStats, *, diag_only: bool = False,
-                cluster_axis: str | None = None):
+                cluster_axis: str | None = None,
+                covariance_type: str | None = None):
     """Parameter update from (globally reduced) sufficient statistics.
 
     Reproduces the reference's host-side division/guard sequence and the
     subsequent constants_kernel (gaussian.cu:611-701). Returns the new state
     with N, means, R, Rinv, constant, pi updated.
+
+    ``covariance_type`` extends the reference's two families (full /
+    DIAG_ONLY) with the other two standard GMM constraints:
+      'full'      per-cluster D x D            (reference default)
+      'diag'      per-cluster diagonal         (reference DIAG_ONLY; requires
+                  diag_only=True -- same E-step/statistics path)
+      'spherical' per-cluster sigma^2 I (the diag update with the MLE tie
+                  var_k = mean_d var_kd; requires diag_only=True)
+      'tied'      one shared D x D covariance: the Nk-weighted pool of the
+                  per-cluster MLE covariances (full-path statistics; when the
+                  cluster axis is sharded the pool is a psum over it)
+    None resolves to 'diag'/'full' from ``diag_only``.
     """
+    if covariance_type is None:
+        covariance_type = "diag" if diag_only else "full"
     dtype = state.R.dtype
     K, D = state.means.shape
     Nk = stats.Nk
@@ -182,18 +197,51 @@ def apply_mstep(state, stats: SuffStats, *, diag_only: bool = False,
         cov_sum = jnp.where((Nk >= 1.0)[:, None], cov_sum, 0.0)  # gaussian_kernel.cu:658-668
         cov_sum = cov_sum + state.avgvar[:, None]  # diagonal loading (:673-675)
         var = jnp.where(nonempty[:, None], cov_sum / jnp.maximum(Nk, 1e-30)[:, None], 1.0)
+        if covariance_type == "spherical":
+            # MLE under sigma^2 I: the mean of the per-dim variances. Empty
+            # clusters stay at var == 1 (the mean of ones).
+            var = jnp.mean(var, axis=1, keepdims=True) + jnp.zeros_like(var)
         R = jnp.zeros((K, D, D), dtype).at[:, jnp.arange(D), jnp.arange(D)].set(var)
     else:
         mmT = means[:, :, None] * means[:, None, :]
         cov_sum = stats.M2 - Nk[:, None, None] * mmT
         cov_sum = jnp.where((Nk >= 1.0)[:, None, None], cov_sum, 0.0)
         eye = jnp.eye(D, dtype=dtype)
-        cov_sum = cov_sum + state.avgvar[:, None, None] * eye[None]
-        R = jnp.where(
-            nonempty[:, None, None],
-            cov_sum / jnp.maximum(Nk, 1e-30)[:, None, None],
-            eye[None],
-        )  # empty clusters -> identity (gaussian.cu:669-678)
+        if covariance_type == "tied":
+            # Shared-covariance MLE: pool the centered scatter over clusters
+            # and divide by the pooled count; diagonal loading applied once.
+            # Inactive/empty clusters contribute zero, with the SAME Nk >= 1
+            # threshold masking both the scatter (zeroed above) and the count
+            # -- a cluster in the (0.5, 1) dead zone must not dilute the
+            # pool it contributed nothing to. Cluster-sharded meshes pool
+            # with a psum.
+            counted = state.active & (Nk >= 1.0)
+            pool = jnp.sum(
+                jnp.where(state.active[:, None, None], cov_sum, 0.0), axis=0)
+            cnt = jnp.sum(jnp.where(counted, Nk, 0.0))
+            if cluster_axis is not None:
+                pool = lax.psum(pool, cluster_axis)
+                cnt = lax.psum(cnt, cluster_axis)
+            avg = jnp.max(jnp.where(state.active, state.avgvar, 0.0))
+            if cluster_axis is not None:
+                avg = lax.pmax(avg, cluster_axis)
+            # All-clusters-empty: identity fallback, the tied analog of the
+            # per-cluster reset (gaussian.cu:669-678).
+            shared = jnp.where(
+                cnt >= 1.0, (pool + avg * eye) / jnp.maximum(cnt, 1e-30), eye)
+            # K identical copies feed the batched constants/Cholesky below;
+            # the redundant K x D^3/3 factorization work is ~1e-6 of one
+            # E-step at any supported shape, and keeping the state contract
+            # uniform ([K, D, D] everywhere) is worth far more than removing
+            # it.
+            R = jnp.broadcast_to(shared[None], (K, D, D))
+        else:
+            cov_sum = cov_sum + state.avgvar[:, None, None] * eye[None]
+            R = jnp.where(
+                nonempty[:, None, None],
+                cov_sum / jnp.maximum(Nk, 1e-30)[:, None, None],
+                eye[None],
+            )  # empty clusters -> identity (gaussian.cu:669-678)
 
     # Inactive clusters keep inert placeholder params.
     act = state.active

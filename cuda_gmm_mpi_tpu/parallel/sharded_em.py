@@ -122,6 +122,7 @@ class ShardedGMMModel:
             reduce_stats=make_psum_reduce(DATA_AXIS),
             cluster_axis=cluster_axis,
             stats_fn=stats_fn,
+            covariance_type=config.covariance_type,
             **kw,
         )
         sspec = state_pspecs()
@@ -284,6 +285,7 @@ class ShardedGMMModel:
                 fused_sweep, stats_fn=self._stats_fn,
                 reduce_stats=make_psum_reduce(DATA_AXIS),
                 cluster_axis=cluster_axis,
+                covariance_type=self.config.covariance_type,
                 reduce_order_fn=reduce_order_fn, **self._kw, **static,
             )
             sspec = state_pspecs()

@@ -1,0 +1,173 @@
+"""Covariance families beyond the reference's full/DIAG_ONLY pair.
+
+'spherical' (sigma^2 I per cluster) and 'tied' (one shared D x D covariance)
+are capability upgrades; these tests pin their M-step semantics against
+NumPy-computed MLE formulas, their structural invariants end-to-end, and
+(for tied, whose pooling crosses the cluster mesh axis) sharded-vs-plain
+parity.
+"""
+
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from cuda_gmm_mpi_tpu import GaussianMixture, GMMConfig
+from cuda_gmm_mpi_tpu.models.order_search import fit_gmm
+from cuda_gmm_mpi_tpu.ops.formulas import n_free_params
+from cuda_gmm_mpi_tpu.ops.mstep import apply_mstep, chunk_stats
+
+from .conftest import make_blobs
+from .test_estep import make_state
+
+
+def test_config_coupling():
+    assert GMMConfig(diag_only=True).covariance_type == "diag"
+    assert GMMConfig(covariance_type="diag").diag_only is True
+    assert GMMConfig(covariance_type="spherical").diag_only is True
+    assert GMMConfig(covariance_type="tied").diag_only is False
+    with pytest.raises(ValueError, match="tied"):
+        GMMConfig(covariance_type="tied", diag_only=True)
+    with pytest.raises(ValueError, match="covariance_type"):
+        GMMConfig(covariance_type="oblong")
+
+
+def test_spherical_mstep_is_mean_of_diag_variances(rng):
+    k, d, n = 4, 5, 400
+    state = make_state(rng, k, d)
+    x = rng.normal(scale=2.0, size=(n, d))
+    stats = chunk_stats(state, jnp.asarray(x), diag_only=True)
+    s_diag = apply_mstep(state, stats, diag_only=True)
+    s_sph = apply_mstep(state, stats, diag_only=True,
+                        covariance_type="spherical")
+    var_diag = np.diagonal(np.asarray(s_diag.R), axis1=1, axis2=2)
+    var_sph = np.diagonal(np.asarray(s_sph.R), axis1=1, axis2=2)
+    # sigma^2_k = mean_d var_kd, identical across dims
+    np.testing.assert_allclose(
+        var_sph,
+        np.broadcast_to(var_diag.mean(axis=1, keepdims=True), var_sph.shape),
+        rtol=1e-12)
+    assert np.ptp(var_sph, axis=1).max() == 0.0
+    # means unaffected by the covariance constraint
+    np.testing.assert_array_equal(np.asarray(s_sph.means),
+                                  np.asarray(s_diag.means))
+
+
+def test_tied_mstep_is_pooled_full_covariance(rng):
+    k, d, n = 3, 4, 500
+    state = make_state(rng, k, d)
+    x = rng.normal(scale=2.0, size=(n, d))
+    stats = chunk_stats(state, jnp.asarray(x))
+    s_tied = apply_mstep(state, stats, covariance_type="tied")
+    R = np.asarray(s_tied.R)
+    # every cluster shares one covariance
+    for c in range(1, k):
+        np.testing.assert_array_equal(R[c], R[0])
+    # and it equals the NumPy pooled MLE with one avgvar loading
+    Nk = np.asarray(stats.Nk)
+    mu = np.asarray(stats.M1) / Nk[:, None]
+    scatter = (np.asarray(stats.M2)
+               - Nk[:, None, None] * mu[:, :, None] * mu[:, None, :])
+    avg = float(np.asarray(state.avgvar)[0])
+    expect = (scatter.sum(0) + avg * np.eye(d)) / Nk.sum()
+    np.testing.assert_allclose(R[0], expect, rtol=1e-10, atol=1e-12)
+
+
+def test_tied_degenerate_guards(rng):
+    """Dead-zone clusters (0.5 < Nk < 1) neither scatter nor count, and an
+    all-empty pool falls back to the identity (the tied analog of
+    gaussian.cu:669-678)."""
+    k, d, n = 3, 4, 300
+    state = make_state(rng, k, d)
+    x = rng.normal(scale=2.0, size=(n, d))
+    stats = chunk_stats(state, jnp.asarray(x))
+    # Force cluster 2 into the dead zone: its scatter is zeroed by the
+    # Nk >= 1 guard, so the pooled count must exclude its Nk too.
+    import dataclasses
+    Nk = np.asarray(stats.Nk).copy()
+    Nk[2] = 0.7
+    stats_dz = dataclasses.replace(stats, Nk=jnp.asarray(Nk))
+    s = apply_mstep(state, stats_dz, covariance_type="tied")
+    Nk_live = Nk[:2]
+    mu = np.asarray(stats.M1)[:2] / Nk_live[:, None]
+    scatter = (np.asarray(stats.M2)[:2]
+               - Nk_live[:, None, None] * mu[:, :, None] * mu[:, None, :])
+    avg = float(np.asarray(state.avgvar)[0])
+    expect = (scatter.sum(0) + avg * np.eye(d)) / Nk_live.sum()
+    np.testing.assert_allclose(np.asarray(s.R)[0], expect,
+                               rtol=1e-10, atol=1e-12)
+    # All clusters empty -> identity shared covariance, not avgvar/1e-30.
+    stats_empty = dataclasses.replace(
+        stats, Nk=jnp.zeros_like(stats.Nk))
+    s0 = apply_mstep(state, stats_empty, covariance_type="tied")
+    np.testing.assert_array_equal(np.asarray(s0.R)[0], np.eye(d))
+
+
+@pytest.mark.parametrize("ct", ["spherical", "tied"])
+def test_fit_end_to_end(rng, ct):
+    centers = rng.normal(scale=8.0, size=(3, 3))
+    labels = rng.integers(0, 3, size=1200)
+    data = centers[labels] + rng.normal(size=(1200, 3))
+    gm = GaussianMixture(3, target_components=3, covariance_type=ct,
+                         min_iters=15, max_iters=15, chunk_size=256,
+                         dtype="float64").fit(data)
+    cov = gm.covariances_
+    if ct == "spherical":
+        for c in range(3):
+            diag = np.diag(cov[c])
+            assert np.ptp(diag) == 0.0
+            np.testing.assert_array_equal(cov[c], np.diag(diag))
+    else:
+        for c in range(1, 3):
+            np.testing.assert_array_equal(cov[c], cov[0])
+    # blob recovery still works under the constrained families
+    pred = gm.predict(data)
+    agree = sum(
+        np.bincount(pred[labels == c]).max() for c in range(3)
+    )
+    assert agree / len(labels) > 0.95
+    assert np.isfinite(gm.loglik_)
+
+
+def test_monotone_loglik_under_constraints(rng):
+    """EM's monotonicity guarantee holds for the constrained M-steps too
+    (both are exact MLEs of their family given the responsibilities)."""
+    data, _ = make_blobs(rng, n=800, d=3, k=3, dtype=np.float64)
+    for ct in ("spherical", "tied"):
+        lls = []
+        for iters in (2, 6, 12):
+            r = fit_gmm(data, 3, 3,
+                        GMMConfig(covariance_type=ct, min_iters=iters,
+                                  max_iters=iters, chunk_size=256,
+                                  dtype="float64"))
+            lls.append(r.final_loglik)
+        assert lls[0] <= lls[1] + 1e-9 <= lls[2] + 2e-9, (ct, lls)
+
+
+def test_tied_sharded_matches_plain(rng):
+    """Tied pooling crosses the cluster mesh axis via psum: a (2, 2) mesh fit
+    must reproduce the single-device tied fit."""
+    data, _ = make_blobs(rng, n=640, d=3, k=4, dtype=np.float64)
+    kw = dict(covariance_type="tied", min_iters=5, max_iters=5,
+              chunk_size=64, dtype="float64")
+    r_plain = fit_gmm(data, 4, 4, GMMConfig(**kw))
+    r_shard = fit_gmm(data, 4, 4, GMMConfig(mesh_shape=(2, 2), **kw))
+    np.testing.assert_allclose(r_shard.final_loglik, r_plain.final_loglik,
+                               rtol=1e-9)
+    np.testing.assert_allclose(np.sort(r_shard.means, 0),
+                               np.sort(r_plain.means, 0),
+                               rtol=1e-8, atol=1e-10)
+    np.testing.assert_allclose(r_shard.covariances, r_plain.covariances,
+                               rtol=1e-8, atol=1e-10)
+
+
+def test_n_free_params_by_family():
+    k, d = 5, 4
+    full = k * (1 + d + d * (d + 1) / 2) - 1
+    assert n_free_params(k, d) == full
+    assert n_free_params(k, d, covariance_type="diag") == k * (1 + 2 * d) - 1
+    assert n_free_params(k, d, covariance_type="spherical") == k * (2 + d) - 1
+    assert n_free_params(k, d, covariance_type="tied") == (
+        k * (1 + d) + d * (d + 1) / 2 - 1
+    )
+    # legacy kwarg still works
+    assert n_free_params(k, d, diag_only=True) == k * (1 + 2 * d) - 1
