@@ -1,0 +1,99 @@
+"""Measure the Pallas kernel (incl. manual bf16_3x) vs the XLA path on TPU.
+
+Round-3 follow-up to docs/PERF.md's precision study: the kernel now supports
+'high' via the manual 3-dot decomposition (ops/pallas/fused_stats.py _kdot)
+and natural operand layouts. This script produces the decision data for
+whether `use_pallas='auto'` should route any config to the kernel.
+
+Usage:  python examples/bench_kernel_precision.py [north|envelope|diag] ...
+Prints one line per (backend, precision) combination; add block_b values
+with --blocks=256,512,1024.
+"""
+
+from __future__ import annotations
+
+import sys
+import time
+
+import numpy as np
+
+
+SHAPES = {
+    "north": dict(n=1_000_000, d=24, k=100, diag=False),
+    "envelope": dict(n=1_000_000, d=32, k=512, diag=False),
+    "diag": dict(n=1_000_000, d=24, k=256, diag=True),
+}
+
+
+def main() -> int:
+    names = [a for a in sys.argv[1:] if not a.startswith("--")] or ["north"]
+    blocks = [512]
+    iters = 20
+    for a in sys.argv[1:]:
+        if a.startswith("--blocks="):
+            blocks = [int(v) for v in a.split("=", 1)[1].split(",")]
+        if a.startswith("--iters="):
+            iters = int(a.split("=", 1)[1])
+
+    import jax
+    import jax.numpy as jnp
+
+    from cuda_gmm_mpi_tpu.config import GMMConfig
+    from cuda_gmm_mpi_tpu.models.gmm import GMMModel, chunk_events
+    from cuda_gmm_mpi_tpu.ops.formulas import convergence_epsilon
+    from cuda_gmm_mpi_tpu.ops.pallas.fused_stats import fused_stats_pallas
+    from cuda_gmm_mpi_tpu.ops.seeding import seed_clusters_host
+    import functools
+
+    print(f"platform: {jax.devices()[0].platform}", flush=True)
+
+    for name in names:
+        spec = SHAPES[name]
+        n, d, k, diag = spec["n"], spec["d"], spec["k"], spec["diag"]
+        rng = np.random.default_rng(42)
+        centers = rng.normal(scale=8.0, size=(k, d))
+        data = (centers[rng.integers(0, k, n)]
+                + rng.normal(size=(n, d))).astype(np.float32)
+        state = seed_clusters_host(data, k)
+        eps = convergence_epsilon(n, d)
+
+        def run(tag, cfg, stats_fn=None):
+            model = GMMModel(cfg, stats_fn=stats_fn)
+            chunks, wts = chunk_events(data, cfg.chunk_size)
+            chunks, wts = jnp.asarray(chunks), jnp.asarray(wts)
+            s, ll, _ = model.run_em(state, chunks, wts, eps,
+                                    min_iters=1, max_iters=1)
+            jax.block_until_ready(s)
+            times = []
+            for r in range(3):
+                sr = state.replace(means=state.means * (1.0 + 1e-6 * (r + 1)))
+                t0 = time.perf_counter()
+                s, ll_dev, it = model.run_em(sr, chunks, wts, eps)
+                ll = float(ll_dev)
+                times.append(time.perf_counter() - t0)
+            dt = min(times) / int(it)
+            print(f"{name:9s} {tag:26s} {dt*1e3:8.2f} ms/iter  "
+                  f"loglik={ll:.0f}", flush=True)
+
+        for prec in ("high", "highest", "default"):
+            cfg = GMMConfig(min_iters=iters, max_iters=iters,
+                            chunk_size=131072, diag_only=diag,
+                            matmul_precision=prec)
+            run(f"xla {prec}", cfg)
+            for bb in blocks:
+                kcfg = GMMConfig(min_iters=iters, max_iters=iters,
+                                 chunk_size=131072, diag_only=diag,
+                                 matmul_precision=prec, use_pallas="always",
+                                 pallas_block_b=bb)
+                sf = functools.partial(fused_stats_pallas, diag_only=diag,
+                                       block_b=bb, precision=prec)
+                try:
+                    run(f"kernel {prec} b={bb}", kcfg, stats_fn=sf)
+                except Exception as e:  # Mosaic compile failures are data too
+                    print(f"{name:9s} kernel {prec} b={bb}: FAILED "
+                          f"{type(e).__name__}: {str(e)[:120]}", flush=True)
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -160,6 +160,21 @@ def test_tied_sharded_matches_plain(rng):
                                rtol=1e-8, atol=1e-10)
 
 
+@pytest.mark.parametrize("ct", ["spherical", "tied"])
+def test_fused_sweep_matches_host_sweep(rng, ct):
+    """covariance_type reaches the fused whole-sweep-on-device path too."""
+    data, _ = make_blobs(rng, n=600, d=3, k=3, dtype=np.float64)
+    kw = dict(covariance_type=ct, min_iters=4, max_iters=4, chunk_size=128,
+              dtype="float64")
+    r_host = fit_gmm(data, 5, 2, GMMConfig(**kw))
+    r_fused = fit_gmm(data, 5, 2, GMMConfig(fused_sweep=True, **kw))
+    assert r_fused.ideal_num_clusters == r_host.ideal_num_clusters
+    np.testing.assert_allclose(r_fused.final_loglik, r_host.final_loglik,
+                               rtol=1e-12)
+    np.testing.assert_allclose(r_fused.covariances, r_host.covariances,
+                               rtol=1e-10, atol=1e-12)
+
+
 def test_n_free_params_by_family():
     k, d = 5, 4
     full = k * (1 + d + d * (d + 1) / 2) - 1
