@@ -78,6 +78,53 @@ class GaussianMixture:
         """Fit and return the hard cluster assignment of X (sklearn surface)."""
         return self.fit(X).predict(X)
 
+    @classmethod
+    def from_summary(cls, path: str, config: Optional[GMMConfig] = None,
+                     **config_overrides) -> "GaussianMixture":
+        """Rebuild a fitted estimator from a ``.summary`` model file.
+
+        Accepts this framework's output or the reference's own (same format,
+        gaussian.cu:1180-1197; the reference never reads these back). Means
+        and covariances carry the format's 3-decimal precision, so
+        predictions are approximate relative to the in-process fitted model;
+        pickle the estimator's ``result_`` for exact persistence.
+        """
+        from .io.readers import read_summary
+        from .ops.constants import compute_constants
+        from .state import GMMState
+
+        m = read_summary(path)
+        k, d = m["means"].shape
+        gm = cls(k, target_components=k, config=config, **config_overrides)
+        dtype = jnp.float64 if gm.config.dtype == "float64" else jnp.float32
+        eye = jnp.broadcast_to(jnp.eye(d, dtype=dtype), (k, d, d))
+        state = GMMState(
+            N=jnp.asarray(m["N"], dtype),
+            pi=jnp.asarray(m["pi"], dtype),
+            constant=jnp.zeros((k,), dtype),
+            avgvar=jnp.zeros((k,), dtype),
+            means=jnp.asarray(m["means"], dtype),
+            R=jnp.asarray(m["R"], dtype),
+            Rinv=eye,  # placeholder; compute_constants derives it from R
+            active=jnp.ones((k,), bool),
+        )
+        # Recompute Rinv/constant/pi coherently from R and N (the summary's
+        # pi is printf-rounded; constants_kernel semantics, including the
+        # identity reset of clusters whose 3-decimal R rounded non-PD).
+        state = compute_constants(state, diag_only=gm.config.diag_only)
+        gm.result_ = GMMResult(
+            state=state,
+            ideal_num_clusters=k,
+            min_rissanen=float("nan"),
+            final_loglik=float("nan"),
+            epsilon=float("nan"),
+            num_events=0,
+            num_dimensions=d,
+            data_shift=np.zeros((d,), np.float64),
+        )
+        gm._model = GMMModel(gm.config)
+        return gm
+
     @property
     def _fitted(self) -> GMMResult:
         if self.result_ is None:

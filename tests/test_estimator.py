@@ -47,6 +47,25 @@ def test_predict_recovers_blobs(fitted):
     assert agree / len(labels) > 0.95
 
 
+def test_from_summary_roundtrip(fitted, tmp_path):
+    """.summary is a round-trippable model interchange: write the fitted
+    model, reload it, and reproduce the hard assignments (means carry the
+    format's 3-decimal precision, so posteriors agree approximately and
+    well-separated hard labels exactly)."""
+    from cuda_gmm_mpi_tpu.io.writers import write_summary
+
+    gm, data, _ = fitted
+    path = str(tmp_path / "model.summary")
+    write_summary(path, gm.result_)
+    gm2 = GaussianMixture.from_summary(path, chunk_size=128)
+    assert gm2.n_components_ == gm.n_components_
+    np.testing.assert_allclose(gm2.means_, gm.means_, atol=5e-4)
+    np.testing.assert_allclose(gm2.weights_, gm.weights_, atol=1e-5)
+    np.testing.assert_array_equal(gm2.predict(data), gm.predict(data))
+    np.testing.assert_allclose(gm2.predict_proba(data),
+                               gm.predict_proba(data), atol=5e-3)
+
+
 def test_fit_predict_and_n_iter(fitted):
     gm, data, _ = fitted
     # n_iter_ reads the selected K's row of the sweep log; with min==max
