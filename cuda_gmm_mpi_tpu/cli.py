@@ -129,6 +129,11 @@ def build_parser() -> argparse.ArgumentParser:
     t.add_argument("--checkpoint-dir", default=None,
                    help="orbax checkpoint directory for the K-sweep (resume "
                    "with the same path)")
+    t.add_argument("--predict-from", default=None, metavar="MODEL.summary",
+                   help="skip fitting: load a saved .summary model (this "
+                   "framework's or the reference's own output) and write "
+                   "<outfile>.results memberships for infile under it; the "
+                   "num_clusters positional is ignored")
     return p
 
 
@@ -199,6 +204,17 @@ def main(argv=None) -> int:
     except ValueError as e:
         print(str(e), file=sys.stderr)
         return 1
+    distributed_flags = (args.coordinator is not None
+                         or args.num_processes is not None
+                         or args.process_id is not None)
+    if args.predict_from is not None:
+        # Inference-only mode: K comes from the model file, so the fit-mode
+        # cluster-count validations don't apply (the positional is ignored,
+        # as --help documents).
+        if distributed_flags:
+            print("--predict-from is a single-process mode", file=sys.stderr)
+            return 1
+        return _predict_main(args, config)
     if not (1 <= args.num_clusters <= config.max_clusters):
         print("Invalid number of starting clusters\n", file=sys.stderr)  # :1122
         return 1
@@ -210,8 +226,7 @@ def main(argv=None) -> int:
     # MPI_Init equivalent (gaussian.cu:130-140): any distributed flag brings
     # up the multi-controller runtime; --num-processes=0 initializes from the
     # environment (TPU pod launchers).
-    if (args.coordinator is not None or args.num_processes is not None
-            or args.process_id is not None):
+    if distributed_flags:
         from .parallel import distributed
 
         try:
@@ -297,6 +312,57 @@ def main(argv=None) -> int:
         print(f"I/O time: {(t_io + t_out) * 1e3:.3f} (ms)")  # :1093
         print(f"EM time: {em_s * 1e3:.3f} (ms) over "
               f"{sum(r[3] for r in result.sweep_log)} iterations")
+    return 0
+
+
+def _predict_main(args, config) -> int:
+    """Inference-only mode: memberships for infile under a saved model.
+
+    The reference has no analog (its .summary is write-only; re-scoring data
+    meant a full re-fit) -- this closes the loop on the model file as an
+    interchange format. Output is the standard ``<outfile>.results`` plus a
+    ``.summary`` echo of the model used.
+    """
+    from .estimator import GaussianMixture
+    from .io import read_data, write_summary
+    from .io.writers import stream_results
+    from .models import iter_memberships
+    from .utils.profiling import trace
+
+    t0 = time.perf_counter()
+    # Model first: a bad model path must fail in milliseconds, not after
+    # parsing a multi-GB infile.
+    try:
+        gm = GaussianMixture.from_summary(args.predict_from, config=config)
+    except (OSError, ValueError) as e:
+        print(f"Cannot load model {args.predict_from!r}: {e}",
+              file=sys.stderr)
+        return 1
+    try:
+        data = read_data(args.infile)
+    except Exception as e:
+        print("Error parsing input file. This could be due to an empty file "
+              f"or an inconsistent number of dimensions. Aborting. ({e})",
+              file=sys.stderr)
+        return 1
+    d_model = gm.result_.num_dimensions
+    if data.shape[1] != d_model:
+        print(f"Model has {d_model} dimensions but {args.infile!r} has "
+              f"{data.shape[1]}.", file=sys.stderr)
+        return 1
+    if config.enable_print:
+        print(f"Number of events: {data.shape[0]}")
+        print(f"Scoring under {gm.n_components_}-cluster model "
+              f"{args.predict_from!r}.")
+        _print_clusters(gm.result_)
+    write_summary(args.outfile + ".summary", gm.result_,
+                  enable_output=config.enable_output)
+    if config.enable_output:
+        with trace(args.trace_dir):
+            stream_results(args.outfile + ".results",
+                           iter_memberships(gm.result_, data, config))
+    if config.profile:
+        print(f"Inference time: {(time.perf_counter() - t0) * 1e3:.3f} (ms)")
     return 0
 
 

@@ -43,6 +43,37 @@ def test_cli_end_to_end(csv_file, tmp_path):
     assert len(memb_part.split(",")) == 3
 
 
+def test_cli_predict_from(csv_file, tmp_path):
+    """Inference-only mode: .results under a saved model reproduce the fit
+    run's memberships; error paths for bad model / dim mismatch."""
+    out = str(tmp_path / "fit")
+    assert run_cli(["3", csv_file, out, "3", "--min-iters=4", "--max-iters=4",
+                    "--chunk-size=256"]) == 0
+    pred = str(tmp_path / "pred")
+    # the K positional is genuinely ignored (out-of-range placeholder is fine)
+    rc = run_cli(["600", csv_file, pred, "--chunk-size=256",
+                  f"--predict-from={out}.summary"])
+    assert rc == 0
+    fit_rows = (tmp_path / "fit.results").read_text().splitlines()
+    pred_rows = (tmp_path / "pred.results").read_text().splitlines()
+    assert len(pred_rows) == len(fit_rows)
+    # 3-decimal model precision: argmax memberships must agree
+    for a, b in zip(fit_rows, pred_rows):
+        wa = np.argmax([float(v) for v in a.split("\t")[1].split(",")])
+        wb = np.argmax([float(v) for v in b.split("\t")[1].split(",")])
+        assert wa == wb
+    # model echo written
+    assert (tmp_path / "pred.summary").read_text().count("Cluster #") == 3
+    # missing model file
+    assert run_cli(["1", csv_file, pred,
+                    f"--predict-from={tmp_path}/nope.summary"]) == 1
+    # dimension mismatch
+    d2 = tmp_path / "d2.csv"
+    d2.write_text("a,b\n1.0,2.0\n3.0,4.0\n")
+    assert run_cli(["1", str(d2), pred,
+                    f"--predict-from={out}.summary"]) == 1
+
+
 def test_cli_bin_input(tmp_path, rng):
     data, _ = make_blobs(rng, n=300, d=2, k=2, dtype=np.float32)
     p = tmp_path / "events.bin"
