@@ -242,21 +242,23 @@ def main(argv=None) -> int:
     pid, nproc = jax.process_index(), jax.process_count()
 
     t_io0 = time.perf_counter()
-    try:
-        if nproc > 1:
-            # Per-host sharded loading: fit_gmm pulls only this host's slice
-            # through the range readers (the anti-MPI_Bcast; the reference
-            # broadcast the ENTIRE dataset, gaussian.cu:191-201).
-            fit_input = FileSource(args.infile)
-            n_events, n_dims = fit_input.shape
-        else:
-            fit_input = data = read_data(args.infile)
-            n_events, n_dims = data.shape
-    except Exception as e:
-        print("Error parsing input file. This could be due to an empty file "
-              f"or an inconsistent number of dimensions. Aborting. ({e})",
-              file=sys.stderr)  # gaussian.cu:204-205
-        return 1
+    if nproc > 1:
+        # Per-host sharded loading: fit_gmm pulls only this host's slice
+        # through the range readers (the anti-MPI_Bcast; the reference
+        # broadcast the ENTIRE dataset, gaussian.cu:191-201).
+        def _open_source(path):
+            src = FileSource(path)
+            src.shape  # force the header/shape parse inside the error guard
+            return src
+        fit_input = _read_events_or_none(_open_source, args.infile)
+        if fit_input is None:
+            return 1
+        n_events, n_dims = fit_input.shape
+    else:
+        fit_input = data = _read_events_or_none(read_data, args.infile)
+        if data is None:
+            return 1
+        n_events, n_dims = data.shape
     t_io = time.perf_counter() - t_io0
     if config.enable_print and pid == 0:
         print(f"Number of events: {n_events}")
@@ -338,12 +340,8 @@ def _predict_main(args, config) -> int:
         print(f"Cannot load model {args.predict_from!r}: {e}",
               file=sys.stderr)
         return 1
-    try:
-        data = read_data(args.infile)
-    except Exception as e:
-        print("Error parsing input file. This could be due to an empty file "
-              f"or an inconsistent number of dimensions. Aborting. ({e})",
-              file=sys.stderr)
+    data = _read_events_or_none(read_data, args.infile)
+    if data is None:
         return 1
     d_model = gm.result_.num_dimensions
     if data.shape[1] != d_model:
@@ -355,8 +353,16 @@ def _predict_main(args, config) -> int:
         print(f"Scoring under {gm.n_components_}-cluster model "
               f"{args.predict_from!r}.")
         _print_clusters(gm.result_)
-    write_summary(args.outfile + ".summary", gm.result_,
-                  enable_output=config.enable_output)
+    echo_path = args.outfile + ".summary"
+    if (os.path.exists(echo_path)
+            and os.path.samefile(echo_path, args.predict_from)):
+        # The echo is a re-derived (pi-from-N, non-PD-reset) copy, not a
+        # byte copy -- never let it clobber the model it was loaded from.
+        print(f"outfile would overwrite the loaded model {echo_path!r}; "
+              "skipping the .summary echo", file=sys.stderr)
+    else:
+        write_summary(echo_path, gm.result_,
+                      enable_output=config.enable_output)
     if config.enable_output:
         with trace(args.trace_dir):
             stream_results(args.outfile + ".results",
@@ -364,6 +370,18 @@ def _predict_main(args, config) -> int:
     if config.profile:
         print(f"Inference time: {(time.perf_counter() - t0) * 1e3:.3f} (ms)")
     return 0
+
+
+def _read_events_or_none(reader, path):
+    """Shared input-parse guard (gaussian.cu:204-205 message): returns the
+    reader's value, or None after printing the reference's abort message."""
+    try:
+        return reader(path)
+    except Exception as e:
+        print("Error parsing input file. This could be due to an empty file "
+              f"or an inconsistent number of dimensions. Aborting. ({e})",
+              file=sys.stderr)
+        return None
 
 
 def _print_clusters(result) -> None:

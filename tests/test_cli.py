@@ -64,6 +64,11 @@ def test_cli_predict_from(csv_file, tmp_path):
         assert wa == wb
     # model echo written
     assert (tmp_path / "pred.summary").read_text().count("Cluster #") == 3
+    # outfile colliding with the model: the echo must not clobber the model
+    before = (tmp_path / "fit.summary").read_bytes()
+    assert run_cli(["1", csv_file, str(tmp_path / "fit"),
+                    f"--predict-from={out}.summary", "--chunk-size=256"]) == 0
+    assert (tmp_path / "fit.summary").read_bytes() == before
     # missing model file
     assert run_cli(["1", csv_file, pred,
                     f"--predict-from={tmp_path}/nope.summary"]) == 1
