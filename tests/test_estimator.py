@@ -66,6 +66,20 @@ def test_from_summary_roundtrip(fitted, tmp_path):
                                gm.predict_proba(data), atol=5e-3)
 
 
+def test_from_summary_malformed(tmp_path):
+    from cuda_gmm_mpi_tpu.io.readers import read_summary
+
+    p = tmp_path / "bad.summary"
+    p.write_text("this is not a model\n")
+    with pytest.raises(ValueError, match="well-formed"):
+        read_summary(str(p))
+    # truncated block: Means present but R rows missing
+    p.write_text("Cluster #0\nProbability: 0.5\nN: 10.0\n"
+                 "Means: 1.000 2.000 \n\nR Matrix:\n1.000 0.000 \n")
+    with pytest.raises(ValueError, match="R blocks"):
+        read_summary(str(p))
+
+
 def test_fit_predict_and_n_iter(fitted):
     gm, data, _ = fitted
     # n_iter_ reads the selected K's row of the sweep log; with min==max

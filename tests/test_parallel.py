@@ -58,6 +58,20 @@ def test_sharded_matches_single(rng, mesh_shape):
     np.testing.assert_allclose(np.asarray(s1.N)[:kp], s0.N, rtol=1e-8)
 
 
+def test_sharded_packed_quad_mode_matches_single(rng):
+    """quad_mode='packed' composes with 2-D (data x cluster) sharding: the
+    packed features/Rinv are built per cluster shard."""
+    data, _ = make_blobs(rng, n=1024, d=3, k=4)
+    r0 = fit_gmm(data, 4, 4, config=GMMConfig(
+        min_iters=4, max_iters=4, chunk_size=128, dtype="float64",
+        quad_mode="packed"))
+    r1 = fit_gmm(data, 4, 4, config=GMMConfig(
+        min_iters=4, max_iters=4, chunk_size=128, dtype="float64",
+        quad_mode="packed", mesh_shape=(4, 2)))
+    np.testing.assert_allclose(r1.final_loglik, r0.final_loglik, rtol=1e-9)
+    np.testing.assert_allclose(r1.means, r0.means, rtol=1e-7, atol=1e-9)
+
+
 def test_cluster_padding(rng):
     """K not divisible by the cluster axis: padded slots stay inactive."""
     data, _ = make_blobs(rng, n=512, d=3, k=3)
