@@ -23,7 +23,7 @@ the log-sum-exp -- the mask-based replacement for the reference's compaction.
 
 from __future__ import annotations
 
-from functools import lru_cache, partial
+from functools import lru_cache
 
 import jax
 import jax.numpy as jnp

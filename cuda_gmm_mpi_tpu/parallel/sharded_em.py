@@ -32,7 +32,7 @@ from jax.sharding import NamedSharding, PartitionSpec as P
 
 from ..config import GMMConfig
 from ..models.gmm import em_while_loop, resolve_iters
-from ..ops.mstep import SuffStats, accumulate_stats
+from ..ops.mstep import SuffStats
 from ..ops.estep import posteriors
 from .mesh import (
     CLUSTER_AXIS, DATA_AXIS, make_mesh, pad_clusters, shard_chunks,

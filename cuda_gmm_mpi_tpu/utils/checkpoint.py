@@ -17,7 +17,6 @@ from __future__ import annotations
 import os
 from typing import Any, Dict, Optional
 
-import jax
 import numpy as np
 
 from ..state import GMMState

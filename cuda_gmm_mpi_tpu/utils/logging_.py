@@ -19,7 +19,7 @@ import json
 import logging
 import sys
 import time
-from typing import Any, Dict, Optional
+from typing import Any, Dict
 
 _LOGGER_NAME = "cuda_gmm_mpi_tpu"
 
