@@ -129,6 +129,11 @@ def build_parser() -> argparse.ArgumentParser:
     t.add_argument("--checkpoint-dir", default=None,
                    help="orbax checkpoint directory for the K-sweep (resume "
                    "with the same path)")
+    t.add_argument("--sweep-log", default=None, metavar="FILE.jsonl",
+                   help="write the per-K sweep trajectory (num_clusters, "
+                   "loglik, rissanen, em_iters, seconds) as JSON lines "
+                   "(rank 0; machine-readable sibling of the -v per-K "
+                   "prints)")
     t.add_argument("--predict-from", default=None, metavar="MODEL.summary",
                    help="skip fitting: load a saved .summary model (this "
                    "framework's or the reference's own output) and write "
@@ -173,6 +178,17 @@ def main(argv=None) -> int:
     if not os.path.isfile(args.infile):
         print("Invalid infile.\n", file=sys.stderr)  # gaussian.cu:1130
         return 2
+    if args.sweep_log:
+        # Fail-fast like the infile check: an unwritable log path must not
+        # surface as a crash AFTER an hours-long fit (and take the .results
+        # write down with it).
+        try:
+            with open(args.sweep_log, "a"):
+                pass
+        except OSError as e:
+            print(f"Cannot write --sweep-log={args.sweep_log!r}: {e}",
+                  file=sys.stderr)
+            return 1
     try:
         config = GMMConfig(
             dtype=args.dtype,
@@ -281,6 +297,16 @@ def main(argv=None) -> int:
         write_summary(summary_path, result, enable_output=config.enable_output)
         if config.enable_print:
             _print_clusters(result)  # ENABLE_PRINT dump, gaussian.cu:1032-1039
+        if args.sweep_log:
+            import json
+
+            with open(args.sweep_log, "w") as f:
+                for k, ll, riss, iters, secs in result.sweep_log:
+                    f.write(json.dumps({
+                        "num_clusters": int(k), "loglik": float(ll),
+                        "rissanen": float(riss), "em_iters": int(iters),
+                        "seconds": float(secs),
+                    }) + "\n")
     if config.enable_output:
         # Streamed: posteriors recomputed + written chunk-by-chunk, so the
         # N x K membership matrix never exists in host RAM. Multi-host: each

@@ -43,6 +43,23 @@ def test_cli_end_to_end(csv_file, tmp_path):
     assert len(memb_part.split(",")) == 3
 
 
+def test_cli_sweep_log(csv_file, tmp_path):
+    import json
+
+    log = tmp_path / "sweep.jsonl"
+    rc = run_cli(["4", csv_file, str(tmp_path / "o"), "2",
+                  "--min-iters=2", "--max-iters=2", "--chunk-size=256",
+                  f"--sweep-log={log}"])
+    assert rc == 0
+    rows = [json.loads(l) for l in log.read_text().splitlines()]
+    assert [r["num_clusters"] for r in rows] == [4, 3, 2]
+    assert all(r["em_iters"] == 2 and np.isfinite(r["loglik"])
+               and np.isfinite(r["rissanen"]) for r in rows)
+    # unwritable path fails fast, before any fitting
+    assert run_cli(["4", csv_file, str(tmp_path / "o2"), "2",
+                    f"--sweep-log={tmp_path}/no/such/dir/s.jsonl"]) == 1
+
+
 def test_cli_predict_from(csv_file, tmp_path):
     """Inference-only mode: .results under a saved model reproduce the fit
     run's memberships; error paths for bad model / dim mismatch."""
