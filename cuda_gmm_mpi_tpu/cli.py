@@ -126,6 +126,8 @@ def build_parser() -> argparse.ArgumentParser:
                    "(TensorBoard-viewable) into this directory")
     t.add_argument("--debug-nans", action="store_true",
                    help="trap NaN/Inf at the producing op (sanitizer mode)")
+    t.add_argument("--no-validate-input", action="store_true",
+                   help="skip the NaN/Inf input-row check at load")
     t.add_argument("--checkpoint-dir", default=None,
                    help="orbax checkpoint directory for the K-sweep (resume "
                    "with the same path)")
@@ -170,6 +172,7 @@ def main(argv=None) -> int:
     from .io import FileSource, read_data, write_summary
     from .io.writers import stream_results
     from .models import fit_gmm, iter_memberships
+    from .models.order_search import InvalidInputError
 
     # Argument validation BEFORE any backend/runtime initialization
     # (validateArguments runs before MPI work in the reference too,
@@ -178,17 +181,6 @@ def main(argv=None) -> int:
     if not os.path.isfile(args.infile):
         print("Invalid infile.\n", file=sys.stderr)  # gaussian.cu:1130
         return 2
-    if args.sweep_log:
-        # Fail-fast like the infile check: an unwritable log path must not
-        # surface as a crash AFTER an hours-long fit (and take the .results
-        # write down with it).
-        try:
-            with open(args.sweep_log, "a"):
-                pass
-        except OSError as e:
-            print(f"Cannot write --sweep-log={args.sweep_log!r}: {e}",
-                  file=sys.stderr)
-            return 1
     try:
         config = GMMConfig(
             dtype=args.dtype,
@@ -216,6 +208,7 @@ def main(argv=None) -> int:
             profile=args.profile,
             checkpoint_dir=args.checkpoint_dir,
             debug_nans=args.debug_nans,
+            validate_input=not args.no_validate_input,
         )
     except ValueError as e:
         print(str(e), file=sys.stderr)
@@ -229,6 +222,12 @@ def main(argv=None) -> int:
         # as --help documents).
         if distributed_flags:
             print("--predict-from is a single-process mode", file=sys.stderr)
+            return 1
+        if args.sweep_log:
+            # No sweep happens in this mode; rejecting beats leaving an
+            # empty log that downstream tooling would misread.
+            print("--sweep-log has no effect with --predict-from",
+                  file=sys.stderr)
             return 1
         return _predict_main(args, config)
     if not (1 <= args.num_clusters <= config.max_clusters):
@@ -256,6 +255,30 @@ def main(argv=None) -> int:
             print(str(e), file=sys.stderr)
             return 1
     pid, nproc = jax.process_index(), jax.process_count()
+
+    if args.sweep_log:
+        # Fail-fast (an unwritable log path must not surface as a crash
+        # AFTER an hours-long fit), but only once the runtime is up: only
+        # rank 0 writes the log, and in multi-host runs every rank must
+        # reach the same proceed/abort decision or the others hang in the
+        # first collective.
+        ok = True
+        if pid == 0:
+            try:
+                with open(args.sweep_log, "a"):
+                    pass
+            except OSError as e:
+                print(f"Cannot write --sweep-log={args.sweep_log!r}: {e}",
+                      file=sys.stderr)
+                ok = False
+        if nproc > 1:
+            import numpy as _np
+            from jax.experimental import multihost_utils
+
+            ok = bool(_np.asarray(multihost_utils.process_allgather(
+                _np.asarray([ok]))).all())
+        if not ok:
+            return 1
 
     t_io0 = time.perf_counter()
     if nproc > 1:
@@ -286,10 +309,17 @@ def main(argv=None) -> int:
     from .utils.profiling import trace
 
     with trace(args.trace_dir):
-        result = fit_gmm(
-            fit_input, args.num_clusters, args.target_num_clusters,
-            config=config,
-        )
+        try:
+            result = fit_gmm(
+                fit_input, args.num_clusters, args.target_num_clusters,
+                config=config,
+            )
+        except InvalidInputError as e:
+            # Data-content errors (non-finite rows from the input validator)
+            # get the reference's abort style; genuine internal ValueErrors
+            # still crash loudly with their tracebacks.
+            print(str(e), file=sys.stderr)
+            return 1
 
     t_out0 = time.perf_counter()
     if pid == 0:

@@ -114,6 +114,10 @@ class GMMConfig:
     # this enables the remaining useful check -- trap NaN/Inf at the op that
     # produced it).
     debug_nans: bool = False
+    # Reject NaN/Inf event rows at load (one cheap host pass per slice). The
+    # reference's atof-based reader admits them silently and they poison
+    # every statistic; opt out with --no-validate-input for raw-speed runs.
+    validate_input: bool = True
 
     def __post_init__(self):
         if self.min_iters > self.max_iters:

@@ -34,6 +34,44 @@ from ..utils.profiling import PhaseTimer
 from .gmm import GMMModel, chunk_events
 
 
+class InvalidInputError(ValueError):
+    """The input data itself is unusable (e.g. non-finite event rows).
+
+    A dedicated type so callers (the CLI) can give data-content problems the
+    reference's one-line abort style while letting genuine internal
+    ValueErrors crash loudly with their tracebacks."""
+
+
+def _validate_finite(local: np.ndarray, start: int, nproc: int) -> None:
+    """Reject NaN/Inf rows; in multi-host runs, agree collectively first.
+
+    Every rank must reach the same raise/continue decision: a lone rank
+    raising before ``global_moments``'s allgather would leave the clean
+    ranks blocked in the collective forever. The validity flags are
+    exchanged with the same allgather primitive the moments use.
+    """
+    finite = np.isfinite(local).all(axis=1)
+    bad = np.flatnonzero(~finite)
+    n_bad = int(bad.size)
+    first_bad = start + int(bad[0]) if n_bad else -1
+    if nproc > 1:
+        from jax.experimental import multihost_utils
+
+        counts = np.asarray(multihost_utils.process_allgather(
+            np.asarray([n_bad, first_bad], np.int64)))
+        n_bad = int(counts[:, 0].sum())
+        firsts = counts[:, 1][counts[:, 1] >= 0]
+        first_bad = int(firsts.min()) if firsts.size else -1
+    if n_bad:
+        raise InvalidInputError(
+            f"input contains {n_bad} non-finite event row(s) "
+            f"(first at global row {first_bad}); NaN/Inf events silently "
+            "poison every statistic the reference computes -- clean the "
+            "data or pass validate_input=False/--no-validate-input to "
+            "proceed anyway"
+        )
+
+
 @contextlib.contextmanager
 def _null_phase(_name):
     yield
@@ -435,6 +473,8 @@ def _prepare_fit(data, num_clusters, config, model, phase, log):
         local = (source.read_range(start, stop) if source is not None
                  else data[start:stop])
         local = np.ascontiguousarray(local)
+    if config.validate_input:
+        _validate_finite(local, start, nproc)
 
     with phase("mpi"):  # cross-host allgather of tiny per-chunk partials
         mean64, var64 = global_moments(local, config.chunk_size, num_chunks)

@@ -43,6 +43,17 @@ def test_cli_end_to_end(csv_file, tmp_path):
     assert len(memb_part.split(",")) == 3
 
 
+def test_cli_rejects_nonfinite_input(tmp_path):
+    p = tmp_path / "bad.csv"
+    p.write_text("a,b\n1.0,2.0\nnan,3.0\n4.0,5.0\n")
+    assert run_cli(["2", str(p), str(tmp_path / "o"), "2",
+                    "--min-iters=2", "--max-iters=2"]) == 1
+    # opt-out proceeds (the reference's silent-atof behavior)
+    assert run_cli(["2", str(p), str(tmp_path / "o"), "2",
+                    "--min-iters=2", "--max-iters=2",
+                    "--no-validate-input"]) == 0
+
+
 def test_cli_sweep_log(csv_file, tmp_path):
     import json
 
@@ -58,6 +69,10 @@ def test_cli_sweep_log(csv_file, tmp_path):
     # unwritable path fails fast, before any fitting
     assert run_cli(["4", csv_file, str(tmp_path / "o2"), "2",
                     f"--sweep-log={tmp_path}/no/such/dir/s.jsonl"]) == 1
+    # meaningless with --predict-from: rejected, not silently ignored
+    assert run_cli(["4", csv_file, str(tmp_path / "o2"),
+                    f"--predict-from={tmp_path}/o.summary",
+                    f"--sweep-log={log}"]) == 1
 
 
 def test_cli_predict_from(csv_file, tmp_path):
