@@ -273,10 +273,10 @@ def main(argv=None) -> int:
                 ok = False
         if nproc > 1:
             import numpy as _np
-            from jax.experimental import multihost_utils
 
-            ok = bool(_np.asarray(multihost_utils.process_allgather(
-                _np.asarray([ok]))).all())
+            from .parallel.distributed import allgather_host
+
+            ok = bool(allgather_host(_np.asarray([ok])).all())
         if not ok:
             return 1
 
@@ -399,6 +399,16 @@ def _predict_main(args, config) -> int:
     data = _read_events_or_none(read_data, args.infile)
     if data is None:
         return 1
+    if config.validate_input:
+        import numpy as np
+
+        from .models.order_search import InvalidInputError, _validate_finite
+
+        try:
+            _validate_finite(data, dtype=np.dtype(config.dtype))
+        except InvalidInputError as e:
+            print(str(e), file=sys.stderr)
+            return 1
     d_model = gm.result_.num_dimensions
     if data.shape[1] != d_model:
         print(f"Model has {d_model} dimensions but {args.infile!r} has "

@@ -48,6 +48,7 @@ class GaussianMixture:
         n_components: int,
         target_components: int = 0,
         config: Optional[GMMConfig] = None,
+        means_init: Optional[np.ndarray] = None,
         **config_overrides,
     ):
         if config is not None and config_overrides:
@@ -55,6 +56,10 @@ class GaussianMixture:
         self.n_components = n_components
         self.target_components = target_components
         self.config = config or GMMConfig(**config_overrides)
+        # sklearn's means_init: [K, D] starting means in data coordinates,
+        # overriding the seeding policy (covariances/weights still start
+        # from the reference seed recipe).
+        self.means_init = means_init
         self.result_: Optional[GMMResult] = None
         self._model: Optional[GMMModel] = None
 
@@ -65,7 +70,8 @@ class GaussianMixture:
         if X.ndim != 2:
             raise ValueError(f"X must be [n_events, n_dims], got {X.shape}")
         self.result_ = fit_gmm(
-            X, self.n_components, self.target_components, config=self.config
+            X, self.n_components, self.target_components, config=self.config,
+            init_means=self.means_init,
         )
         # Inference reuses the FITTED model: a sharded fit keeps its sharded
         # posterior pass (all local devices in parallel) for

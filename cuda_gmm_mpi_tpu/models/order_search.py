@@ -42,23 +42,29 @@ class InvalidInputError(ValueError):
     ValueErrors crash loudly with their tracebacks."""
 
 
-def _validate_finite(local: np.ndarray, start: int, nproc: int) -> None:
-    """Reject NaN/Inf rows; in multi-host runs, agree collectively first.
+def _validate_finite(local: np.ndarray, start: int = 0,
+                     collective: bool = False, dtype=None) -> None:
+    """Reject rows that are (or will become) non-finite; collective-safe.
 
     Every rank must reach the same raise/continue decision: a lone rank
     raising before ``global_moments``'s allgather would leave the clean
-    ranks blocked in the collective forever. The validity flags are
-    exchanged with the same allgather primitive the moments use.
+    ranks blocked in the collective forever (``allgather_host`` is the
+    shared primitive). ``dtype`` names the COMPUTE dtype: a value like 1e39
+    is finite in the reader's float64 but overflows to Inf when cast to
+    float32, which is exactly the poisoning this guards against -- checked
+    by magnitude so the raw data needn't be cast first.
     """
-    finite = np.isfinite(local).all(axis=1)
+    finite = np.isfinite(local)
+    if dtype is not None and np.dtype(dtype).itemsize < local.dtype.itemsize:
+        finite &= np.abs(local) <= np.finfo(dtype).max
+    finite = finite.all(axis=1)
     bad = np.flatnonzero(~finite)
     n_bad = int(bad.size)
     first_bad = start + int(bad[0]) if n_bad else -1
-    if nproc > 1:
-        from jax.experimental import multihost_utils
+    if collective:
+        from ..parallel.distributed import allgather_host
 
-        counts = np.asarray(multihost_utils.process_allgather(
-            np.asarray([n_bad, first_bad], np.int64)))
+        counts = allgather_host(np.asarray([n_bad, first_bad], np.int64))
         n_bad = int(counts[:, 0].sum())
         firsts = counts[:, 1][counts[:, 1] >= 0]
         first_bad = int(firsts.min()) if firsts.size else -1
@@ -166,13 +172,17 @@ def fit_gmm(
     config: GMMConfig = GMMConfig(),
     model: Optional[GMMModel] = None,
     verbose: Optional[bool] = None,
+    init_means: Optional[np.ndarray] = None,
 ) -> GMMResult:
     """Full GMM fit with model-order search -- the library entry point.
 
     Args mirror the reference CLI (gaussian.cu:1111-1178): ``num_clusters`` is
     the starting K (1..max_clusters), ``target_num_clusters`` = 0 means search
     all the way down to 1 keeping the best Rissanen score (stop_number logic,
-    gaussian.cu:177-181).
+    gaussian.cu:177-181). ``init_means`` ([K, D], original coordinates)
+    overrides the seeding policy with user-supplied starting means
+    (sklearn's means_init); with ``n_init > 1`` it seeds init 0 and the
+    kmeans++ restarts still run.
     """
     if not (1 <= num_clusters <= config.max_clusters):
         raise ValueError(
@@ -195,7 +205,8 @@ def fit_gmm(
 
     if config.n_init > 1:
         return _fit_with_restarts(data, num_clusters, target_num_clusters,
-                                  config, model, verbose)
+                                  config, model, verbose,
+                                  init_means=init_means)
 
     log = get_logger(config)
     timer = PhaseTimer() if config.profile else None
@@ -213,7 +224,8 @@ def fit_gmm(
             model = GMMModel(config)
 
     (state, chunks, wts, chunks_np, wts_np, n_events, n_dims, shift,
-     host_range) = _prepare_fit(data, num_clusters, config, model, phase, log)
+     host_range) = _prepare_fit(data, num_clusters, config, model, phase, log,
+                                init_means=init_means)
     epsilon = convergence_epsilon(n_events, n_dims, config.epsilon_scale)
     if verbose:
         print(f"epsilon = {epsilon}")  # gaussian.cu:462
@@ -433,9 +445,16 @@ def _host_state(state, model):
     return jax.device_get(state)
 
 
-def _prepare_fit(data, num_clusters, config, model, phase, log):
+def _prepare_fit(data, num_clusters, config, model, phase, log,
+                 init_means=None):
     """Load, center, seed, chunk, and place the data -- one path for all
     four cases (ndarray or FileSource input x single- or multi-process run).
+
+    ``init_means`` ([K, D], original data coordinates) overrides the seeding
+    policy with user-supplied starting means (sklearn's means_init; composes
+    with ``GaussianMixture.from_summary`` to refine a saved model with more
+    EM). Covariances/weights still start from the reference's seed recipe
+    (identity-scale R, uniform pi).
 
     Multi-process (the reference's MPI world, gaussian.cu:128-207): each host
     reads ONLY its chunk-aligned slice (``host_chunk_bounds``), global moments
@@ -473,8 +492,11 @@ def _prepare_fit(data, num_clusters, config, model, phase, log):
         local = (source.read_range(start, stop) if source is not None
                  else data[start:stop])
         local = np.ascontiguousarray(local)
+    # Before ANY arithmetic touches the data (the moments would just launder
+    # NaNs into the shift): reject rows non-finite now or after the cast to
+    # the compute dtype.
     if config.validate_input:
-        _validate_finite(local, start, nproc)
+        _validate_finite(local, start, collective=nproc > 1, dtype=dtype)
 
     with phase("mpi"):  # cross-host allgather of tiny per-chunk partials
         mean64, var64 = global_moments(local, config.chunk_size, num_chunks)
@@ -493,7 +515,13 @@ def _prepare_fit(data, num_clusters, config, model, phase, log):
         # Seed rows fetched in ORIGINAL coordinates, identically on every
         # host (net reference semantics: device seeding overwritten by the
         # host full-data reseed, gaussian.cu:108-123).
-        if config.seed_method == "kmeans++":
+        if init_means is not None:
+            rows = np.asarray(init_means, dtype)
+            if rows.shape != (num_clusters, n_dims):
+                raise ValueError(
+                    f"init_means must be [{num_clusters}, {n_dims}], got "
+                    f"{rows.shape}")
+        elif config.seed_method == "kmeans++":
             pool, rng = kmeanspp_pool(n_events, seed=config.seed)
             x_pool = np.asarray(
                 source.read_rows(pool) if source is not None else data[pool]
@@ -526,7 +554,7 @@ def _prepare_fit(data, num_clusters, config, model, phase, log):
 
 
 def _fit_with_restarts(data, num_clusters, target_num_clusters, config,
-                       model, verbose):
+                       model, verbose, init_means=None):
     """n_init independent fits, keep the best Rissanen (capability upgrade;
     the reference's single deterministic init showed local-optima misses).
 
@@ -557,7 +585,8 @@ def _fit_with_restarts(data, num_clusters, target_num_clusters, config,
                             if config.checkpoint_dir else None),
         )
         r = fit_gmm(data, num_clusters, target_num_clusters, config=sub,
-                    model=model, verbose=verbose)
+                    model=model, verbose=verbose,
+                    init_means=(init_means if i == 0 else None))
         if verbose:
             print(f"init {i}: rissanen={r.min_rissanen:.6e} "
                   f"K={r.ideal_num_clusters}")

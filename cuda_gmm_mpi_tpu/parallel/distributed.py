@@ -97,6 +97,23 @@ def global_moments(local_data: np.ndarray, chunk_size: int, num_chunks: int):
     return mean, var
 
 
+def allgather_host(values: np.ndarray) -> np.ndarray:
+    """Gather a small host array from every process: [nproc, *values.shape].
+
+    Single-process: returns ``values[None]`` without touching the runtime.
+    The shared primitive behind every collectively-agreed abort (input
+    validation, writability prechecks): all ranks exchange their local
+    verdicts and reach the SAME proceed/raise decision, so one bad rank can
+    never strand the others in a later collective.
+    """
+    values = np.asarray(values)
+    if jax.process_count() == 1:
+        return values[None]
+    from jax.experimental import multihost_utils
+
+    return np.asarray(multihost_utils.process_allgather(values))
+
+
 def barrier(name: str = "gmm_barrier") -> None:
     """Cross-host sync point (the MPI_Barrier analog -- needed only at host
     filesystem rendezvous like output assembly, never inside compute)."""

@@ -48,9 +48,28 @@ def test_cli_rejects_nonfinite_input(tmp_path):
     p.write_text("a,b\n1.0,2.0\nnan,3.0\n4.0,5.0\n")
     assert run_cli(["2", str(p), str(tmp_path / "o"), "2",
                     "--min-iters=2", "--max-iters=2"]) == 1
+    # values finite in the reader's float64 but Inf in compute float32 are
+    # caught too (validation runs after the dtype cast)
+    p2 = tmp_path / "overflow.csv"
+    p2.write_text("a,b\n1.0,2.0\n1e39,3.0\n4.0,5.0\n")
+    assert run_cli(["2", str(p2), str(tmp_path / "o"), "2",
+                    "--min-iters=2", "--max-iters=2"]) == 1
     # opt-out proceeds (the reference's silent-atof behavior)
     assert run_cli(["2", str(p), str(tmp_path / "o"), "2",
                     "--min-iters=2", "--max-iters=2",
+                    "--no-validate-input"]) == 0
+
+
+def test_cli_predict_from_validates_input(tmp_path, csv_file):
+    out = str(tmp_path / "m")
+    assert run_cli(["3", csv_file, out, "3", "--min-iters=2",
+                    "--max-iters=2", "--chunk-size=256"]) == 0
+    bad = tmp_path / "bad.csv"
+    bad.write_text("a,b,c\n1.0,2.0,3.0\ninf,0.0,1.0\n")
+    assert run_cli(["1", str(bad), str(tmp_path / "p"),
+                    f"--predict-from={out}.summary"]) == 1
+    assert run_cli(["1", str(bad), str(tmp_path / "p"),
+                    f"--predict-from={out}.summary",
                     "--no-validate-input"]) == 0
 
 

@@ -66,6 +66,29 @@ def test_from_summary_roundtrip(fitted, tmp_path):
                                gm.predict_proba(data), atol=5e-3)
 
 
+def test_means_init(rng):
+    """User-supplied starting means (sklearn means_init): seeded exactly
+    (modulo centering) and dominant over the seeding policy."""
+    from cuda_gmm_mpi_tpu.models.order_search import fit_gmm
+
+    centers = rng.normal(scale=8.0, size=(3, 4))
+    data = (centers[rng.integers(0, 3, 600)]
+            + rng.normal(size=(600, 4))).astype(np.float64)
+    # 0 EM iterations isn't allowed by min_iters>=... use 1 iteration and
+    # check convergence to the right assignment instead of exact means.
+    gm = GaussianMixture(3, target_components=3, means_init=centers,
+                         min_iters=8, max_iters=8, chunk_size=128,
+                         dtype="float64").fit(data)
+    # Means initialized at the true centers must stay matched to them
+    # (no label permutation ambiguity to resolve).
+    np.testing.assert_allclose(gm.means_, centers, atol=0.5)
+    # shape mismatch is a clear error
+    with pytest.raises(ValueError, match="init_means"):
+        fit_gmm(data, 3, 3, GMMConfig(min_iters=1, max_iters=1,
+                                      chunk_size=128, dtype="float64"),
+                init_means=centers[:2])
+
+
 def test_from_summary_malformed(tmp_path):
     from cuda_gmm_mpi_tpu.io.readers import read_summary
 
