@@ -49,6 +49,11 @@ def build_parser() -> argparse.ArgumentParser:
                    help="covariance family: the reference's full/diag plus "
                    "spherical (sigma^2 I per cluster) and tied (one shared "
                    "covariance) as capability upgrades")
+    g.add_argument("--criterion", default="rissanen",
+                   choices=["rissanen", "bic", "aic"],
+                   help="model-order selection score: the reference's "
+                   "Rissanen/MDL (gaussian.cu:826), or BIC/AIC with "
+                   "family-correct parameter counts")
     g.add_argument("--min-iters", type=int, default=100,
                    help="MIN_ITERS (gaussian.h:27)")
     g.add_argument("--max-iters", type=int, default=100,
@@ -133,9 +138,9 @@ def build_parser() -> argparse.ArgumentParser:
                    "with the same path)")
     t.add_argument("--sweep-log", default=None, metavar="FILE.jsonl",
                    help="write the per-K sweep trajectory (num_clusters, "
-                   "loglik, rissanen, em_iters, seconds) as JSON lines "
-                   "(rank 0; machine-readable sibling of the -v per-K "
-                   "prints)")
+                   "loglik, score, criterion, em_iters, seconds) as JSON "
+                   "lines (rank 0; machine-readable sibling of the -v "
+                   "per-K prints)")
     t.add_argument("--predict-from", default=None, metavar="MODEL.summary",
                    help="skip fitting: load a saved .summary model (this "
                    "framework's or the reference's own output) and write "
@@ -172,7 +177,7 @@ def main(argv=None) -> int:
     from .io import FileSource, read_data, write_summary
     from .io.writers import stream_results
     from .models import fit_gmm, iter_memberships
-    from .models.order_search import InvalidInputError
+    from .validation import InvalidInputError
 
     # Argument validation BEFORE any backend/runtime initialization
     # (validateArguments runs before MPI work in the reference too,
@@ -190,6 +195,7 @@ def main(argv=None) -> int:
             covariance_type=args.covariance_type,
             min_iters=args.min_iters,
             max_iters=args.max_iters,
+            criterion=args.criterion,
             epsilon_scale=args.epsilon_scale,
             matmul_precision=args.precision,
             chunk_size=args.chunk_size,
@@ -334,7 +340,9 @@ def main(argv=None) -> int:
                 for k, ll, riss, iters, secs in result.sweep_log:
                     f.write(json.dumps({
                         "num_clusters": int(k), "loglik": float(ll),
-                        "rissanen": float(riss), "em_iters": int(iters),
+                        "score": float(riss),
+                        "criterion": config.criterion,
+                        "em_iters": int(iters),
                         "seconds": float(secs),
                     }) + "\n")
     if config.enable_output:
@@ -402,10 +410,10 @@ def _predict_main(args, config) -> int:
     if config.validate_input:
         import numpy as np
 
-        from .models.order_search import InvalidInputError, _validate_finite
+        from .validation import InvalidInputError, validate_finite
 
         try:
-            _validate_finite(data, dtype=np.dtype(config.dtype))
+            validate_finite(data, dtype=np.dtype(config.dtype))
         except InvalidInputError as e:
             print(str(e), file=sys.stderr)
             return 1

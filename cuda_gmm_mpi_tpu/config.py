@@ -44,6 +44,10 @@ class GMMConfig:
     covariance_type: str = "full"
     min_iters: int = 100
     max_iters: int = 100
+    # Model-order selection criterion: 'rissanen' = the reference's MDL
+    # score exactly (gaussian.cu:826); 'bic'/'aic' count family-correct
+    # free parameters and use the conventional sample count N (upgrade).
+    criterion: str = "rissanen"
     # Convergence threshold scale: epsilon = nparams_per_cluster * ln(N*D) * scale
     # (gaussian.cu:458). Runtime-tunable here.
     epsilon_scale: float = 0.01
@@ -131,6 +135,8 @@ class GMMConfig:
         if self.covariance_type not in ("full", "diag", "spherical", "tied"):
             raise ValueError(
                 f"unknown covariance_type: {self.covariance_type!r}")
+        if self.criterion not in ("rissanen", "bic", "aic"):
+            raise ValueError(f"unknown criterion: {self.criterion!r}")
         # diag_only (the reference's DIAG_ONLY flag) and covariance_type are
         # one setting: keep them coherent whichever way the user spells it.
         if self.diag_only and self.covariance_type == "full":

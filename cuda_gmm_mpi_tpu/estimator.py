@@ -179,9 +179,16 @@ class GaussianMixture:
 
     def _posteriors_and_evidence(self, X: np.ndarray):
         """(w [N, K], logZ [N]) for arbitrary data under the fitted model."""
+        from .validation import validate_finite
+
         res = self._fitted
         dtype = np.dtype(self.config.dtype)
-        X = np.asarray(X, dtype) - res.data_shift[None, :].astype(dtype)
+        X = np.asarray(X, dtype)
+        if self.config.validate_input:
+            # Same promise on inference as on fit: NaN/Inf rows abort with
+            # a clear message instead of silently emitting NaN posteriors.
+            validate_finite(X)
+        X = X - res.data_shift[None, :].astype(dtype)
         chunks, _ = chunk_events(X, self.config.chunk_size)
         # Host chunks passed through: each model places its own blocks (the
         # sharded model puts them per-shard; an eager jnp.asarray here would

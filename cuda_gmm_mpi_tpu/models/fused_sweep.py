@@ -29,7 +29,7 @@ import jax.experimental
 import jax.numpy as jnp
 from jax import lax
 
-from ..ops.formulas import rissanen_score
+from ..ops.formulas import model_score
 from ..ops.merge import eliminate_and_reduce
 from .gmm import em_while_loop
 
@@ -53,6 +53,7 @@ def fused_sweep(
     matmul_precision: str = "highest",
     cluster_axis: str | None = None,
     covariance_type: str | None = None,
+    criterion: str = "rissanen",
     stats_fn: Optional[Callable] = None,
     reduce_stats: Optional[Callable] = None,
     reduce_order_fn: Optional[Callable] = None,
@@ -90,9 +91,10 @@ def fused_sweep(
     score_dtype = jnp.float64 if jax.config.jax_enable_x64 else dtype
 
     def riss_of(ll, k):
-        # rissanen_score is plain arithmetic + a static log: trace-safe.
-        return rissanen_score(ll.astype(score_dtype), k.astype(score_dtype),
-                              num_events, num_dimensions)
+        # model_score is plain arithmetic + a static log: trace-safe.
+        return model_score(ll.astype(score_dtype), k.astype(score_dtype),
+                           num_events, num_dimensions, criterion=criterion,
+                           covariance_type=covariance_type)
 
     def em(s):
         return em_while_loop(

@@ -205,6 +205,7 @@ class GMMModel:
                     reduce_stats=self.reduce_stats, emit_cb=emit_cb,
                     emit_light=emit_light,
                     covariance_type=self.config.covariance_type,
+                    criterion=self.config.criterion,
                     **self._kw, **static,
                 )
             ))

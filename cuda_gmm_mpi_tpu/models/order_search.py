@@ -26,7 +26,8 @@ import jax.numpy as jnp
 import numpy as np
 
 from ..config import GMMConfig
-from ..ops.formulas import convergence_epsilon, rissanen_score
+from ..ops.formulas import convergence_epsilon, model_score
+from ..validation import InvalidInputError, validate_finite
 from ..ops.merge import eliminate_and_reduce
 from ..state import GMMState, compact
 from ..utils.logging_ import get_logger, metrics_line
@@ -34,48 +35,15 @@ from ..utils.profiling import PhaseTimer
 from .gmm import GMMModel, chunk_events
 
 
-class InvalidInputError(ValueError):
-    """The input data itself is unusable (e.g. non-finite event rows).
-
-    A dedicated type so callers (the CLI) can give data-content problems the
-    reference's one-line abort style while letting genuine internal
-    ValueErrors crash loudly with their tracebacks."""
+# Orbax's standard handler holds arrays/numbers only, so the selection
+# criterion rides checkpoints as an int code.
+_CRITERION_CODE = {"rissanen": 0, "bic": 1, "aic": 2}
+_CRITERION_NAME = {v: k for k, v in _CRITERION_CODE.items()}
 
 
-def _validate_finite(local: np.ndarray, start: int = 0,
-                     collective: bool = False, dtype=None) -> None:
-    """Reject rows that are (or will become) non-finite; collective-safe.
-
-    Every rank must reach the same raise/continue decision: a lone rank
-    raising before ``global_moments``'s allgather would leave the clean
-    ranks blocked in the collective forever (``allgather_host`` is the
-    shared primitive). ``dtype`` names the COMPUTE dtype: a value like 1e39
-    is finite in the reader's float64 but overflows to Inf when cast to
-    float32, which is exactly the poisoning this guards against -- checked
-    by magnitude so the raw data needn't be cast first.
-    """
-    finite = np.isfinite(local)
-    if dtype is not None and np.dtype(dtype).itemsize < local.dtype.itemsize:
-        finite &= np.abs(local) <= np.finfo(dtype).max
-    finite = finite.all(axis=1)
-    bad = np.flatnonzero(~finite)
-    n_bad = int(bad.size)
-    first_bad = start + int(bad[0]) if n_bad else -1
-    if collective:
-        from ..parallel.distributed import allgather_host
-
-        counts = allgather_host(np.asarray([n_bad, first_bad], np.int64))
-        n_bad = int(counts[:, 0].sum())
-        firsts = counts[:, 1][counts[:, 1] >= 0]
-        first_bad = int(firsts.min()) if firsts.size else -1
-    if n_bad:
-        raise InvalidInputError(
-            f"input contains {n_bad} non-finite event row(s) "
-            f"(first at global row {first_bad}); NaN/Inf events silently "
-            "poison every statistic the reference computes -- clean the "
-            "data or pass validate_input=False/--no-validate-input to "
-            "proceed anyway"
-        )
+def _restored_criterion(restored) -> str:
+    return _CRITERION_NAME.get(int(restored.get("criterion_code", 0)),
+                               "rissanen")
 
 
 @contextlib.contextmanager
@@ -298,6 +266,16 @@ def fit_gmm(
             log.warning("found a fused-sweep checkpoint; the host-driven "
                         "sweep cannot resume it -- starting fresh")
             restored = None
+        if (restored is not None
+                and _restored_criterion(restored) != config.criterion):
+            # Scores saved under a different criterion live on a different
+            # scale; comparing them against fresh ones would pick a wrong
+            # best model silently.
+            log.warning(
+                "checkpoint was written under criterion=%r but this run "
+                "uses %r; starting fresh",
+                _restored_criterion(restored), config.criterion)
+            restored = None
         if restored is not None and int(restored["num_clusters"]) == num_clusters:
             state = restored["state"]
             if hasattr(model, "prepare_state"):
@@ -347,16 +325,19 @@ def fit_gmm(
                         jax.device_get((ll, iters, k_active, min_d)),
                     )
         ll_f = float(ll_f)
-        riss = rissanen_score(ll_f, k, n_events, n_dims)
+        riss = model_score(ll_f, k, n_events, n_dims,
+                           criterion=config.criterion,
+                           covariance_type=config.covariance_type)
         if not (timer or last_k):  # fused path: EM + reduce until ll on host
             dt = time.perf_counter() - t0
         if timer:
             timer.counts["e_step"] += int(iters_i) - 1  # per-iter averages
         sweep_log.append((k, ll_f, riss, int(iters_i), dt))
         if verbose:
-            print(f"K={k}: loglik={ll_f:.6e} rissanen={riss:.6e} "
+            print(f"K={k}: loglik={ll_f:.6e} {config.criterion}={riss:.6e} "
                   f"iters={int(iters_i)} ({dt:.2f}s)")
-        metrics_line("em_done", k=k, loglik=ll_f, rissanen=riss,
+        metrics_line("em_done", k=k, loglik=ll_f, score=riss,
+                     criterion=config.criterion,
                      iters=int(iters_i), seconds=round(dt, 4)) if (
                          config.enable_debug) else None
 
@@ -393,6 +374,7 @@ def fit_gmm(
                     "best_ll": float(best_ll),
                     "k": int(k),
                     "num_clusters": int(num_clusters),
+                    "criterion_code": _CRITERION_CODE[config.criterion],
                     "sweep_log": np.asarray(sweep_log, np.float64),
                 })
         step += 1
@@ -400,8 +382,9 @@ def fit_gmm(
     with phase("memcpy"):
         compact_state, n_active = compact(best_state)
     if verbose:
-        print(f"Final rissanen score was: {min_rissanen}, "
-              f"with {ideal_k} clusters.")  # gaussian.cu:962
+        # Exact reference wording for the default criterion (gaussian.cu:962).
+        print(f"Final {config.criterion} score was: {min_rissanen}, "
+              f"with {ideal_k} clusters.")
 
     return GMMResult(
         state=compact_state,
@@ -496,7 +479,7 @@ def _prepare_fit(data, num_clusters, config, model, phase, log,
     # NaNs into the shift): reject rows non-finite now or after the cast to
     # the compute dtype.
     if config.validate_input:
-        _validate_finite(local, start, collective=nproc > 1, dtype=dtype)
+        validate_finite(local, start, collective=nproc > 1, dtype=dtype)
 
     with phase("mpi"):  # cross-host allgather of tiny per-chunk partials
         mean64, var64 = global_moments(local, config.chunk_size, num_chunks)
@@ -588,7 +571,7 @@ def _fit_with_restarts(data, num_clusters, target_num_clusters, config,
                     model=model, verbose=verbose,
                     init_means=(init_means if i == 0 else None))
         if verbose:
-            print(f"init {i}: rissanen={r.min_rissanen:.6e} "
+            print(f"init {i}: {config.criterion}={r.min_rissanen:.6e} "
                   f"K={r.ideal_num_clusters}")
         # NaN-safe best pick: a degenerate init (NaN rissanen) must never
         # shadow later finite restarts ('finite < NaN' is False).
@@ -597,7 +580,7 @@ def _fit_with_restarts(data, num_clusters, target_num_clusters, config,
             best = r
     if verbose:
         print(f"best of {config.n_init} inits: "
-              f"rissanen={best.min_rissanen:.6e} "
+              f"{config.criterion}={best.min_rissanen:.6e} "
               f"K={best.ideal_num_clusters}")
     return best
 
@@ -623,6 +606,14 @@ def _run_fused_sweep(fused, config, state, chunks, wts, epsilon,
     resume = None
     if ckpt is not None:
         restored = ckpt.restore()
+        if (restored is not None
+                and _restored_criterion(restored) != config.criterion):
+            if log:
+                log.warning(
+                    "checkpoint was written under criterion=%r but this run "
+                    "uses %r; starting fresh",
+                    _restored_criterion(restored), config.criterion)
+            restored = None
         if (restored is not None
                 and int(restored.get("num_clusters", -1)) == num_clusters):
             if "fused_log" not in restored:
@@ -663,6 +654,7 @@ def _run_fused_sweep(fused, config, state, chunks, wts, epsilon,
                 "best_riss": float(payload["best_riss"]),
                 "fused_log": np.asarray(payload["log"]),
                 "num_clusters": int(num_clusters),
+                "criterion_code": _CRITERION_CODE[config.criterion],
             })
 
         model._emit_target = emit
@@ -708,7 +700,7 @@ def _run_fused_sweep(fused, config, state, chunks, wts, epsilon,
     ]
     if verbose:
         for k_, ll_, riss_, it_, _ in sweep_log:
-            print(f"K={k_}: loglik={ll_:.6e} rissanen={riss_:.6e} "
+            print(f"K={k_}: loglik={ll_:.6e} {config.criterion}={riss_:.6e} "
                   f"iters={it_} (fused)")
     compact_state, n_active = compact(best_state)
     if verbose:

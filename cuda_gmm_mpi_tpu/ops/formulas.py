@@ -63,3 +63,34 @@ def rissanen_score(
     return -loglik + 0.5 * n_free_params(
         num_clusters, num_dimensions
     ) * math.log(float(num_events) * num_dimensions)
+
+
+def model_score(
+    loglik,
+    num_clusters,
+    num_events: int,
+    num_dimensions: int,
+    criterion: str = "rissanen",
+    covariance_type: str | None = None,
+):
+    """Order-selection score for one K (lower is better); trace-safe.
+
+    'rissanen' is the reference's MDL formula exactly (gaussian.cu:826,
+    full-covariance parameter count even under DIAG_ONLY). 'bic'
+    (-2 loglik + p ln N) and 'aic' (-2 loglik + 2p) are upgrades that count
+    the parameters the model actually estimates (family-aware via
+    ``covariance_type``) and use the conventional sample count N rather
+    than the reference's N*D. All three are plain arithmetic in
+    ``num_clusters`` plus a static log, so the fused on-device sweep can
+    trace them with K dynamic.
+    """
+    if criterion == "rissanen":
+        return rissanen_score(loglik, num_clusters, num_events,
+                              num_dimensions)
+    p = n_free_params(num_clusters, num_dimensions,
+                      covariance_type=covariance_type)
+    if criterion == "bic":
+        return -2.0 * loglik + p * math.log(float(num_events))
+    if criterion == "aic":
+        return -2.0 * loglik + 2.0 * p
+    raise ValueError(f"unknown criterion: {criterion!r}")

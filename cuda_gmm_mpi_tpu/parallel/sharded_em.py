@@ -286,6 +286,7 @@ class ShardedGMMModel:
                 reduce_stats=make_psum_reduce(DATA_AXIS),
                 cluster_axis=cluster_axis,
                 covariance_type=self.config.covariance_type,
+                criterion=self.config.criterion,
                 reduce_order_fn=reduce_order_fn, **self._kw, **static,
             )
             sspec = state_pspecs()

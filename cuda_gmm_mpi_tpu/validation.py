@@ -1,0 +1,55 @@
+"""Input-data validation shared by the fit, inference, and CLI paths.
+
+The reference's ``atof``-based reader (readData.cpp:49-129) admits NaN/Inf
+values silently, and they poison every statistic downstream. This module is
+the single home of the rejection logic so the promise of
+``GMMConfig.validate_input`` holds on every path that consumes event data.
+"""
+
+from __future__ import annotations
+
+import numpy as np
+
+
+class InvalidInputError(ValueError):
+    """The input data itself is unusable (e.g. non-finite event rows).
+
+    A dedicated type so callers (the CLI) can give data-content problems the
+    reference's one-line abort style while letting genuine internal
+    ValueErrors crash loudly with their tracebacks."""
+
+
+def validate_finite(local: np.ndarray, start: int = 0,
+                    collective: bool = False, dtype=None) -> None:
+    """Reject rows that are (or will become) non-finite; collective-safe.
+
+    With ``collective``, every rank must reach the same raise/continue
+    decision: a lone rank raising before a later collective would leave the
+    clean ranks blocked in it forever (``parallel.distributed.allgather_host``
+    is the shared primitive). ``dtype`` names the COMPUTE dtype: a value like
+    1e39 is finite in the reader's float64 but overflows to Inf when cast to
+    float32, which is exactly the poisoning this guards against -- checked
+    by magnitude so the raw data needn't be cast first.
+    """
+    finite = np.isfinite(local)
+    if dtype is not None and np.dtype(dtype).itemsize < local.dtype.itemsize:
+        finite &= np.abs(local) <= np.finfo(dtype).max
+    finite = finite.all(axis=1)
+    bad = np.flatnonzero(~finite)
+    n_bad = int(bad.size)
+    first_bad = start + int(bad[0]) if n_bad else -1
+    if collective:
+        from .parallel.distributed import allgather_host
+
+        counts = allgather_host(np.asarray([n_bad, first_bad], np.int64))
+        n_bad = int(counts[:, 0].sum())
+        firsts = counts[:, 1][counts[:, 1] >= 0]
+        first_bad = int(firsts.min()) if firsts.size else -1
+    if n_bad:
+        raise InvalidInputError(
+            f"input contains {n_bad} non-finite event row(s) "
+            f"(first at global row {first_bad}); NaN/Inf events silently "
+            "poison every statistic the reference computes -- clean the "
+            "data or pass validate_input=False/--no-validate-input to "
+            "proceed anyway"
+        )

@@ -84,7 +84,8 @@ def test_cli_sweep_log(csv_file, tmp_path):
     rows = [json.loads(l) for l in log.read_text().splitlines()]
     assert [r["num_clusters"] for r in rows] == [4, 3, 2]
     assert all(r["em_iters"] == 2 and np.isfinite(r["loglik"])
-               and np.isfinite(r["rissanen"]) for r in rows)
+               and np.isfinite(r["score"])
+               and r["criterion"] == "rissanen" for r in rows)
     # unwritable path fails fast, before any fitting
     assert run_cli(["4", csv_file, str(tmp_path / "o2"), "2",
                     f"--sweep-log={tmp_path}/no/such/dir/s.jsonl"]) == 1

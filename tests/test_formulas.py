@@ -2,8 +2,11 @@
 
 import math
 
+import pytest
+
 from cuda_gmm_mpi_tpu.ops.formulas import (
-    convergence_epsilon, free_params_per_cluster, rissanen_score,
+    convergence_epsilon, free_params_per_cluster, model_score,
+    n_free_params, rissanen_score,
 )
 
 
@@ -21,3 +24,20 @@ def test_rissanen():
     ll, k, n, d = -1.23e5, 8, 10000, 16
     expected = -ll + 0.5 * (k * (1 + d + 0.5 * (d + 1) * d) - 1) * math.log(n * d)
     assert rissanen_score(ll, k, n, d) == expected
+
+
+def test_model_score_criteria():
+    ll, k, n, d = -1.23e5, 8, 10000, 16
+    assert model_score(ll, k, n, d) == rissanen_score(ll, k, n, d)
+    p = n_free_params(k, d)
+    assert model_score(ll, k, n, d, "bic") == -2 * ll + p * math.log(n)
+    assert model_score(ll, k, n, d, "aic") == -2 * ll + 2 * p
+    # family-aware counting
+    p_sph = n_free_params(k, d, covariance_type="spherical")
+    assert model_score(ll, k, n, d, "bic", "spherical") == (
+        -2 * ll + p_sph * math.log(n))
+    # rissanen keeps the reference's full count regardless of family
+    assert model_score(ll, k, n, d, "rissanen", "diag") == (
+        rissanen_score(ll, k, n, d))
+    with pytest.raises(ValueError, match="criterion"):
+        model_score(ll, k, n, d, "mdl2")

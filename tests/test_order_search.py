@@ -37,6 +37,49 @@ def test_search_down_to_one(rng):
     assert result.min_rissanen == min(rec[2] for rec in result.sweep_log)
 
 
+def test_criterion_bic_aic_selection(rng):
+    """--criterion=bic/aic: scores match the closed forms, the best one is
+    selected, and the fused sweep agrees with the host sweep."""
+    import math
+
+    from cuda_gmm_mpi_tpu.ops.formulas import model_score
+
+    data, _ = make_blobs(rng, n=900, d=2, k=3)
+    n = len(data)
+    for crit in ("bic", "aic"):
+        r = fit_gmm(data, 5, 0, config=fast_cfg(criterion=crit))
+        # every sweep row's score is the criterion's closed form
+        for k, ll, score, _, _ in r.sweep_log:
+            expect = model_score(ll, int(k), n, 2, crit)
+            assert math.isclose(score, expect, rel_tol=1e-12), (crit, k)
+        assert r.min_rissanen == min(rec[2] for rec in r.sweep_log)
+        # BIC/AIC still find the true K on separated blobs
+        assert r.ideal_num_clusters == 3
+        # fused whole-sweep-on-device path scores identically
+        rf = fit_gmm(data, 5, 0,
+                     config=fast_cfg(criterion=crit, fused_sweep=True))
+        assert rf.ideal_num_clusters == r.ideal_num_clusters
+        np.testing.assert_allclose(rf.min_rissanen, r.min_rissanen,
+                                   rtol=1e-12)
+
+
+def test_checkpoint_criterion_mismatch_starts_fresh(rng, tmp_path):
+    """A checkpoint saved under one criterion must not be resumed under
+    another (the scores live on different scales)."""
+    data, _ = make_blobs(rng, n=400, d=2, k=2)
+    ck = str(tmp_path / "ck")
+    fit_gmm(data, 4, 2, config=fast_cfg(checkpoint_dir=ck))
+    # same dir, different criterion: fresh sweep, result identical to a
+    # checkpoint-free bic fit
+    r_resumed = fit_gmm(data, 4, 2, config=fast_cfg(checkpoint_dir=ck,
+                                                    criterion="bic"))
+    r_clean = fit_gmm(data, 4, 2, config=fast_cfg(criterion="bic"))
+    assert r_resumed.ideal_num_clusters == r_clean.ideal_num_clusters
+    np.testing.assert_allclose(r_resumed.min_rissanen, r_clean.min_rissanen,
+                               rtol=1e-12)
+    assert len(r_resumed.sweep_log) == len(r_clean.sweep_log)
+
+
 def test_memberships_shape_and_normalization(rng):
     data, _ = make_blobs(rng, n=500, d=3, k=3)
     cfg = fast_cfg()
