@@ -141,6 +141,10 @@ def build_parser() -> argparse.ArgumentParser:
                    "loglik, score, criterion, em_iters, seconds) as JSON "
                    "lines (rank 0; machine-readable sibling of the -v "
                    "per-K prints)")
+    t.add_argument("--init-from", default=None, metavar="MODEL.summary",
+                   help="warm-start: initial means from a saved .summary "
+                   "model (its K must equal num_clusters); covariances/"
+                   "weights restart from the reference seed recipe")
     t.add_argument("--predict-from", default=None, metavar="MODEL.summary",
                    help="skip fitting: load a saved .summary model (this "
                    "framework's or the reference's own output) and write "
@@ -229,10 +233,11 @@ def main(argv=None) -> int:
         if distributed_flags:
             print("--predict-from is a single-process mode", file=sys.stderr)
             return 1
-        if args.sweep_log:
-            # No sweep happens in this mode; rejecting beats leaving an
-            # empty log that downstream tooling would misread.
-            print("--sweep-log has no effect with --predict-from",
+        if args.sweep_log or args.init_from:
+            # No sweep and no fitting happen in this mode; rejecting beats
+            # silently ignoring flags the user believes took effect.
+            flag = "--sweep-log" if args.sweep_log else "--init-from"
+            print(f"{flag} has no effect with --predict-from",
                   file=sys.stderr)
             return 1
         return _predict_main(args, config)
@@ -314,11 +319,40 @@ def main(argv=None) -> int:
 
     from .utils.profiling import trace
 
+    init_means = None
+    if args.init_from:
+        # Multi-host safe like the --sweep-log probe: every rank loads and
+        # validates, then all ranks agree on one proceed/abort decision (a
+        # lone rank bailing here would strand the others in fit_gmm's first
+        # collective).
+        from .io.readers import read_summary
+
+        ok = True
+        try:
+            init_means = read_summary(args.init_from)["means"]
+        except (OSError, ValueError) as e:
+            print(f"Cannot load --init-from={args.init_from!r}: {e}",
+                  file=sys.stderr)
+            ok = False
+        if ok and init_means.shape != (args.num_clusters, n_dims):
+            print(f"--init-from model is {init_means.shape[0]} clusters x "
+                  f"{init_means.shape[1]} dims but this fit needs "
+                  f"({args.num_clusters}, {n_dims}).", file=sys.stderr)
+            ok = False
+        if nproc > 1:
+            import numpy as _np
+
+            from .parallel.distributed import allgather_host
+
+            ok = bool(allgather_host(_np.asarray([ok])).all())
+        if not ok:
+            return 1
+
     with trace(args.trace_dir):
         try:
             result = fit_gmm(
                 fit_input, args.num_clusters, args.target_num_clusters,
-                config=config,
+                config=config, init_means=init_means,
             )
         except InvalidInputError as e:
             # Data-content errors (non-finite rows from the input validator)

@@ -215,29 +215,28 @@ class GaussianMixture:
         """Mean per-event log-likelihood."""
         return float(np.mean(self.score_samples(X)))
 
-    def _n_free_params(self) -> float:
-        """Free parameters actually estimated by the fitted model (diagonal
-        covariances count D, spherical 1, tied one shared D(D+1)/2; the
-        weight simplex removes 1)."""
-        from .ops.formulas import n_free_params
+    def _criterion_on(self, X: np.ndarray, criterion: str) -> float:
+        from .ops.formulas import model_score
 
-        return n_free_params(self.n_components_,
-                             self._fitted.num_dimensions,
-                             covariance_type=self.config.covariance_type)
+        n = np.asarray(X).shape[0]
+        ll = float(np.sum(self.score_samples(X)))
+        return float(model_score(
+            ll, self.n_components_, n, self._fitted.num_dimensions,
+            criterion=criterion,
+            covariance_type=self.config.covariance_type,
+        ))
 
     def bic(self, X: np.ndarray) -> float:
         """Bayesian information criterion on X (lower is better) -- the
         scikit-learn-familiar sibling of the Rissanen/MDL score the order
         search minimizes (they differ only in the reference's N*D vs N
-        sample-count convention)."""
-        n = np.asarray(X).shape[0]
-        ll = float(np.sum(self.score_samples(X)))
-        return -2.0 * ll + self._n_free_params() * float(np.log(n))
+        sample-count convention). Delegates to ops.formulas.model_score so
+        the formula lives once."""
+        return self._criterion_on(X, "bic")
 
     def aic(self, X: np.ndarray) -> float:
         """Akaike information criterion on X (lower is better)."""
-        ll = float(np.sum(self.score_samples(X)))
-        return -2.0 * ll + 2.0 * self._n_free_params()
+        return self._criterion_on(X, "aic")
 
     def sample(self, n_samples: int, seed: Optional[int] = None) -> np.ndarray:
         """Draw events from the fitted mixture (generation -- absent from the

@@ -95,6 +95,29 @@ def test_cli_sweep_log(csv_file, tmp_path):
                     f"--sweep-log={log}"]) == 1
 
 
+def test_cli_init_from(csv_file, tmp_path):
+    """--init-from warm-starts fitting from a saved model's means."""
+    out = str(tmp_path / "m")
+    assert run_cli(["3", csv_file, out, "3", "--min-iters=40",
+                    "--max-iters=40", "--chunk-size=256"]) == 0
+    out2 = str(tmp_path / "m2")
+    assert run_cli(["3", csv_file, out2, "3", "--min-iters=4",
+                    "--max-iters=4", "--chunk-size=256",
+                    f"--init-from={out}.summary"]) == 0
+    # warm-started from a converged optimum: means stay put
+    def means(p):
+        return np.array([[float(v) for v in l.split()[1:]]
+                         for l in open(p) if l.startswith("Means:")])
+    np.testing.assert_allclose(np.sort(means(out2 + ".summary"), 0),
+                               np.sort(means(out + ".summary"), 0),
+                               atol=0.05)
+    # K mismatch is a clear error
+    assert run_cli(["5", csv_file, str(tmp_path / "m3"), "5",
+                    f"--init-from={out}.summary"]) == 1
+    assert run_cli(["3", csv_file, str(tmp_path / "m4"), "3",
+                    f"--init-from={tmp_path}/nope.summary"]) == 1
+
+
 def test_cli_predict_from(csv_file, tmp_path):
     """Inference-only mode: .results under a saved model reproduce the fit
     run's memberships; error paths for bad model / dim mismatch."""
