@@ -1,0 +1,56 @@
+"""Worker for the 2-process collective-abort test (input validation).
+
+Rank 1's slice of the dataset contains a NaN row; rank 0's is clean. The
+validator must bring BOTH ranks to the same InvalidInputError (via the
+allgather_host agreement) instead of rank 1 aborting alone and rank 0
+hanging in the moments collective.
+
+Usage: python multihost_validate_worker.py <process_id> <num_processes> <port>
+Prints one line: ABORTED pid=<i> nbad=<count from the message>
+"""
+
+import re
+import sys
+
+
+def main() -> int:
+    pid, nproc, port = int(sys.argv[1]), int(sys.argv[2]), sys.argv[3]
+
+    import jax
+
+    jax.config.update("jax_platforms", "cpu")
+    jax.config.update("jax_num_cpu_devices", 2)
+    jax.config.update("jax_enable_x64", True)
+
+    from cuda_gmm_mpi_tpu.parallel import distributed
+
+    distributed.initialize(
+        coordinator_address=f"127.0.0.1:{port}",
+        num_processes=nproc,
+        process_id=pid,
+    )
+
+    import numpy as np
+
+    from cuda_gmm_mpi_tpu.config import GMMConfig
+    from cuda_gmm_mpi_tpu.models.order_search import fit_gmm
+    from cuda_gmm_mpi_tpu.validation import InvalidInputError
+
+    n, d = 256, 3
+    rng = np.random.default_rng(7)
+    data = rng.normal(size=(n, d)).astype(np.float64)
+    data[200, 1] = np.nan  # row 200 lands in the SECOND host's slice
+
+    cfg = GMMConfig(min_iters=2, max_iters=2, chunk_size=32, dtype="float64")
+    try:
+        fit_gmm(data, 2, 2, config=cfg)
+    except InvalidInputError as e:
+        m = re.search(r"contains (\d+) non-finite", str(e))
+        print(f"ABORTED pid={pid} nbad={m.group(1)}", flush=True)
+        return 0
+    print(f"NO-ERROR pid={pid}", flush=True)
+    return 1
+
+
+if __name__ == "__main__":
+    sys.exit(main())

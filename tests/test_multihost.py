@@ -86,6 +86,37 @@ def test_host_chunk_bounds_equal_counts():
 
 
 @pytest.mark.slow
+def test_two_process_collective_input_abort():
+    """A NaN row in ONE rank's slice aborts BOTH ranks cleanly (the
+    validity allgather), rather than stranding the clean rank in the
+    moments collective until timeout."""
+    import subprocess
+
+    from .conftest import worker_env
+
+    worker = os.path.join(os.path.dirname(__file__),
+                          "multihost_validate_worker.py")
+    port = _free_port()
+    env = worker_env()
+    procs = [
+        subprocess.Popen(
+            [sys.executable, worker, str(i), "2", str(port)],
+            stdout=subprocess.PIPE, stderr=subprocess.PIPE, env=env,
+            text=True,
+        )
+        for i in range(2)
+    ]
+    for i, p in enumerate(procs):
+        try:
+            out, err = p.communicate(timeout=180)
+        finally:
+            if p.poll() is None:
+                p.kill()
+        assert p.returncode == 0, f"rank {i}:\n{out}\n{err[-2000:]}"
+        # both ranks report the GLOBAL bad count (1), including the clean one
+        assert f"ABORTED pid={i} nbad=1" in out, (i, out, err[-1000:])
+
+
 def test_two_process_distributed_em_matches_single():
     outs = _run_workers(2)
     for rc, out, err in outs:
