@@ -282,13 +282,7 @@ def main(argv=None) -> int:
                 print(f"Cannot write --sweep-log={args.sweep_log!r}: {e}",
                       file=sys.stderr)
                 ok = False
-        if nproc > 1:
-            import numpy as _np
-
-            from .parallel.distributed import allgather_host
-
-            ok = bool(allgather_host(_np.asarray([ok])).all())
-        if not ok:
+        if not _all_ranks_ok(ok, nproc):
             return 1
 
     t_io0 = time.perf_counter()
@@ -339,13 +333,7 @@ def main(argv=None) -> int:
                   f"{init_means.shape[1]} dims but this fit needs "
                   f"({args.num_clusters}, {n_dims}).", file=sys.stderr)
             ok = False
-        if nproc > 1:
-            import numpy as _np
-
-            from .parallel.distributed import allgather_host
-
-            ok = bool(allgather_host(_np.asarray([ok])).all())
-        if not ok:
+        if not _all_ranks_ok(ok, nproc):
             return 1
 
     with trace(args.trace_dir):
@@ -478,6 +466,17 @@ def _predict_main(args, config) -> int:
     if config.profile:
         print(f"Inference time: {(time.perf_counter() - t0) * 1e3:.3f} (ms)")
     return 0
+
+
+def _all_ranks_ok(ok: bool, nproc: int) -> bool:
+    """Collectively agree a proceed/abort decision (see allgather_host)."""
+    if nproc <= 1:
+        return ok
+    import numpy as np
+
+    from .parallel.distributed import allgather_host
+
+    return bool(allgather_host(np.asarray([ok])).all())
 
 
 def _read_events_or_none(reader, path):
