@@ -35,15 +35,43 @@ from ..utils.profiling import PhaseTimer
 from .gmm import GMMModel, chunk_events
 
 
-# Orbax's standard handler holds arrays/numbers only, so the selection
-# criterion rides checkpoints as an int code.
+# Orbax's standard handler holds arrays/numbers only, so config identity
+# rides checkpoints as int codes. A checkpoint is only resumable under the
+# semantics it was written with: criterion scores live on per-criterion
+# scales, and a state evolved under one covariance family must not continue
+# under another.
 _CRITERION_CODE = {"rissanen": 0, "bic": 1, "aic": 2}
 _CRITERION_NAME = {v: k for k, v in _CRITERION_CODE.items()}
+_COV_CODE = {"full": 0, "diag": 1, "spherical": 2, "tied": 3}
+_COV_NAME = {v: k for k, v in _COV_CODE.items()}
 
 
 def _restored_criterion(restored) -> str:
     return _CRITERION_NAME.get(int(restored.get("criterion_code", 0)),
                                "rissanen")
+
+
+def _restored_cov(restored, default: str) -> str:
+    # Checkpoints predating the covariance_type field carry the writing
+    # run's family implicitly; assume the resuming config's (the old
+    # behavior) rather than rejecting every legacy checkpoint.
+    if "cov_code" not in restored:
+        return default
+    return _COV_NAME.get(int(restored["cov_code"]), default)
+
+
+def _resume_mismatch(restored, config, log) -> bool:
+    """True (and warns) when a checkpoint's semantics differ from this run's."""
+    crit = _restored_criterion(restored)
+    cov = _restored_cov(restored, config.covariance_type)
+    if crit == config.criterion and cov == config.covariance_type:
+        return False
+    if log:
+        log.warning(
+            "checkpoint was written under criterion=%r covariance_type=%r "
+            "but this run uses %r/%r; starting fresh",
+            crit, cov, config.criterion, config.covariance_type)
+    return True
 
 
 @contextlib.contextmanager
@@ -266,15 +294,7 @@ def fit_gmm(
             log.warning("found a fused-sweep checkpoint; the host-driven "
                         "sweep cannot resume it -- starting fresh")
             restored = None
-        if (restored is not None
-                and _restored_criterion(restored) != config.criterion):
-            # Scores saved under a different criterion live on a different
-            # scale; comparing them against fresh ones would pick a wrong
-            # best model silently.
-            log.warning(
-                "checkpoint was written under criterion=%r but this run "
-                "uses %r; starting fresh",
-                _restored_criterion(restored), config.criterion)
+        if restored is not None and _resume_mismatch(restored, config, log):
             restored = None
         if restored is not None and int(restored["num_clusters"]) == num_clusters:
             state = restored["state"]
@@ -375,6 +395,7 @@ def fit_gmm(
                     "k": int(k),
                     "num_clusters": int(num_clusters),
                     "criterion_code": _CRITERION_CODE[config.criterion],
+                    "cov_code": _COV_CODE[config.covariance_type],
                     "sweep_log": np.asarray(sweep_log, np.float64),
                 })
         step += 1
@@ -606,13 +627,7 @@ def _run_fused_sweep(fused, config, state, chunks, wts, epsilon,
     resume = None
     if ckpt is not None:
         restored = ckpt.restore()
-        if (restored is not None
-                and _restored_criterion(restored) != config.criterion):
-            if log:
-                log.warning(
-                    "checkpoint was written under criterion=%r but this run "
-                    "uses %r; starting fresh",
-                    _restored_criterion(restored), config.criterion)
+        if restored is not None and _resume_mismatch(restored, config, log):
             restored = None
         if (restored is not None
                 and int(restored.get("num_clusters", -1)) == num_clusters):
@@ -655,6 +670,7 @@ def _run_fused_sweep(fused, config, state, chunks, wts, epsilon,
                 "fused_log": np.asarray(payload["log"]),
                 "num_clusters": int(num_clusters),
                 "criterion_code": _CRITERION_CODE[config.criterion],
+                "cov_code": _COV_CODE[config.covariance_type],
             })
 
         model._emit_target = emit

@@ -80,6 +80,22 @@ def test_checkpoint_criterion_mismatch_starts_fresh(rng, tmp_path):
     assert len(r_resumed.sweep_log) == len(r_clean.sweep_log)
 
 
+def test_checkpoint_covariance_mismatch_starts_fresh(rng, tmp_path):
+    """Same guard for the covariance family: a tied run must not continue a
+    full-covariance run's checkpoint."""
+    data, _ = make_blobs(rng, n=400, d=2, k=2)
+    ck = str(tmp_path / "ck")
+    fit_gmm(data, 4, 2, config=fast_cfg(checkpoint_dir=ck))
+    r_resumed = fit_gmm(data, 4, 2, config=fast_cfg(
+        checkpoint_dir=ck, covariance_type="tied"))
+    r_clean = fit_gmm(data, 4, 2, config=fast_cfg(covariance_type="tied"))
+    np.testing.assert_allclose(r_resumed.min_rissanen, r_clean.min_rissanen,
+                               rtol=1e-12)
+    np.testing.assert_allclose(r_resumed.covariances, r_clean.covariances,
+                               rtol=1e-10)
+    assert len(r_resumed.sweep_log) == len(r_clean.sweep_log)
+
+
 def test_memberships_shape_and_normalization(rng):
     data, _ = make_blobs(rng, n=500, d=3, k=3)
     cfg = fast_cfg()
