@@ -164,6 +164,21 @@ def test_should_use_pallas_gating():
     GMMConfig(use_pallas="always", matmul_precision="high")
 
 
+def test_use_pallas_always_interprets_on_cpu(rng):
+    """use_pallas='always' on a non-TPU backend auto-selects interpret mode
+    (make_stats_fn), so the kernel path is drivable end-to-end everywhere."""
+    from cuda_gmm_mpi_tpu.models.order_search import fit_gmm
+
+    data, _ = make_blobs(rng, n=256, d=3, k=2, dtype=np.float32)
+    kw = dict(min_iters=3, max_iters=3, chunk_size=64)
+    r_kernel = fit_gmm(data, 2, 2, GMMConfig(use_pallas="always", **kw))
+    r_xla = fit_gmm(data, 2, 2, GMMConfig(use_pallas="never", **kw))
+    np.testing.assert_allclose(r_kernel.final_loglik, r_xla.final_loglik,
+                               rtol=1e-4)
+    np.testing.assert_allclose(np.sort(r_kernel.means, 0),
+                               np.sort(r_xla.means, 0), rtol=1e-3, atol=1e-3)
+
+
 def test_fused_stats_manual_bf16_3x_matches_xla_high(rng):
     """Kernel precision='high' (manual split dots) ~= XLA Precision.HIGH.
 
