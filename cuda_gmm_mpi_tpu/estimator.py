@@ -84,6 +84,39 @@ class GaussianMixture:
         """Fit and return the hard cluster assignment of X (sklearn surface)."""
         return self.fit(X).predict(X)
 
+    # -- sklearn interop (clone(), pipelines, grid search) ---------------
+
+    def get_params(self, deep: bool = True) -> dict:
+        return {
+            "n_components": self.n_components,
+            "target_components": self.target_components,
+            "config": self.config,
+            "means_init": self.means_init,
+        }
+
+    def set_params(self, **params) -> "GaussianMixture":
+        import dataclasses
+
+        known = ("n_components", "target_components", "config", "means_init")
+        config_updates = {}
+        for k, v in params.items():
+            if k in known:
+                setattr(self, k, v)
+            elif hasattr(self.config, k):
+                config_updates[k] = v  # config fields addressable directly
+            else:
+                raise ValueError(f"unknown parameter {k!r}")
+        if config_updates:
+            if ("covariance_type" in config_updates
+                    and "diag_only" not in config_updates):
+                # diag_only and covariance_type are one coupled setting
+                # (GMMConfig.__post_init__); an explicit covariance_type
+                # must win over the carried-over diag_only flag, which
+                # would otherwise silently snap 'full' back to 'diag'.
+                config_updates["diag_only"] = False
+            self.config = dataclasses.replace(self.config, **config_updates)
+        return self
+
     @classmethod
     def from_summary(cls, path: str, config: Optional[GMMConfig] = None,
                      **config_overrides) -> "GaussianMixture":

@@ -66,6 +66,28 @@ def test_from_summary_roundtrip(fitted, tmp_path):
                                gm.predict_proba(data), atol=5e-3)
 
 
+def test_sklearn_params_interop(fitted):
+    gm, data, _ = fitted
+    p = gm.get_params()
+    clone = GaussianMixture(**p)
+    assert clone.n_components == gm.n_components
+    assert clone.config == gm.config
+    clone.set_params(n_components=4, min_iters=2, max_iters=2)
+    assert clone.n_components == 4
+    assert clone.config.min_iters == 2
+    with pytest.raises(ValueError, match="unknown parameter"):
+        clone.set_params(bogus=1)
+    # the coupled diag_only flag must not snap an explicit covariance_type
+    # update back to the old family
+    gd = GaussianMixture(3, covariance_type="diag")
+    gd.set_params(covariance_type="full")
+    assert gd.config.covariance_type == "full"
+    assert gd.config.diag_only is False
+    gd.set_params(covariance_type="spherical")
+    assert gd.config.covariance_type == "spherical"
+    assert gd.config.diag_only is True
+
+
 def test_means_init(rng):
     """User-supplied starting means (sklearn means_init): seeded exactly
     (modulo centering) and dominant over the seeding policy."""
