@@ -76,3 +76,6 @@ def test_em_trajectory_matches_sklearn(rng, cov_type):
     # per-event evidence agrees too (score_samples is sklearn-compatible)
     np.testing.assert_allclose(gm.score_samples(data),
                                sk.score_samples(data), rtol=1e-7, atol=1e-8)
+    # information criteria: same family-aware free-parameter counts
+    np.testing.assert_allclose(gm.bic(data), sk.bic(data), rtol=1e-9)
+    np.testing.assert_allclose(gm.aic(data), sk.aic(data), rtol=1e-9)
