@@ -65,8 +65,9 @@ def resolve_iters(config: GMMConfig, min_iters: Optional[int],
 def chunk_events(
     data: np.ndarray, chunk_size: int, num_shards: int = 1,
     num_chunks: Optional[int] = None,
+    sample_weight: Optional[np.ndarray] = None,
 ) -> Tuple[np.ndarray, np.ndarray]:
-    """Pad and reshape events to [num_chunks, chunk_size, D] plus a 0/1 mask.
+    """Pad and reshape events to [num_chunks, chunk_size, D] plus a weight row.
 
     The reference splits events into 16-aligned ranges per thread block
     (gaussian_kernel.cu:367-381) and pushes the remainder onto the last block;
@@ -77,8 +78,18 @@ def chunk_events(
     uses it so every host produces the same-shaped chunk array regardless of
     how the event remainder fell across hosts
     (``parallel.distributed.host_chunk_bounds``).
+
+    ``sample_weight`` ([n] nonnegative) replaces the 0/1 validity mask with
+    per-event weights (padding rows stay 0). The fused E+M pass multiplies
+    responsibilities and log-evidence by this row, which makes every
+    sufficient statistic exactly weighted -- an integer weight w is
+    identical to replicating the event w times.
     """
     n, d = data.shape
+    if sample_weight is not None and np.asarray(sample_weight).shape != (n,):
+        raise ValueError(
+            f"sample_weight must be [{n}], got "
+            f"{np.asarray(sample_weight).shape}")
     if num_chunks is not None:
         total = num_chunks * chunk_size
         if total < n:
@@ -95,7 +106,7 @@ def chunk_events(
     padded = np.zeros((total, d), dtype=data.dtype)
     padded[:n] = data
     wts = np.zeros((total,), dtype=data.dtype)
-    wts[:n] = 1.0
+    wts[:n] = 1.0 if sample_weight is None else sample_weight
     num_chunks = total // chunk_size
     return padded.reshape(num_chunks, chunk_size, d), wts.reshape(num_chunks, chunk_size)
 

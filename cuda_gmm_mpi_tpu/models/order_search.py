@@ -169,6 +169,7 @@ def fit_gmm(
     model: Optional[GMMModel] = None,
     verbose: Optional[bool] = None,
     init_means: Optional[np.ndarray] = None,
+    sample_weight: Optional[np.ndarray] = None,
 ) -> GMMResult:
     """Full GMM fit with model-order search -- the library entry point.
 
@@ -178,7 +179,17 @@ def fit_gmm(
     gaussian.cu:177-181). ``init_means`` ([K, D], original coordinates)
     overrides the seeding policy with user-supplied starting means
     (sklearn's means_init); with ``n_init > 1`` it seeds init 0 and the
-    kmeans++ restarts still run.
+    kmeans++ restarts still run. ``sample_weight`` ([N] nonnegative) weights
+    every sufficient statistic per event. Weights are event MULTIPLICITIES,
+    not probabilities: integer weights reproduce replicated rows exactly
+    except for the avgvar diagonal loading (seeded from the UNWEIGHTED data
+    variance, which physical replication shifts; set a huge
+    ``covariance_dynamic_range`` for exact parity), and the absolute
+    empty-cluster thresholds (Nk > 0.5 etc.) operate on weighted counts --
+    normalized weights summing to ~1 would make every cluster look empty,
+    so a total weight below ``num_clusters`` is rejected. In-memory data
+    only; seeding and the epsilon/criterion event counts stay unweighted.
+    (Upgrade beyond both the reference and sklearn.)
     """
     if not (1 <= num_clusters <= config.max_clusters):
         raise ValueError(
@@ -196,13 +207,23 @@ def fit_gmm(
         # JAX_PLATFORMS already. Must run before ANY device discovery --
         # including _fit_with_restarts' model/mesh construction.
         jax.config.update("jax_platforms", config.device)
+    if config.dtype == "float64" and not jax.config.jax_enable_x64:
+        # Refuse rather than silently truncating to float32 -- and rather
+        # than flipping the PROCESS-GLOBAL x64 flag here, which would make
+        # later float32 fits (and the host application's own JAX code)
+        # call-order dependent. The CLI sets the flag at process entry.
+        raise ValueError(
+            "dtype='float64' needs jax_enable_x64; set "
+            "jax.config.update('jax_enable_x64', True) at startup (the CLI "
+            "does this for --dtype=float64)")
     if config.debug_nans:
         jax.config.update("jax_debug_nans", True)
 
     if config.n_init > 1:
         return _fit_with_restarts(data, num_clusters, target_num_clusters,
                                   config, model, verbose,
-                                  init_means=init_means)
+                                  init_means=init_means,
+                                  sample_weight=sample_weight)
 
     log = get_logger(config)
     timer = PhaseTimer() if config.profile else None
@@ -221,7 +242,8 @@ def fit_gmm(
 
     (state, chunks, wts, chunks_np, wts_np, n_events, n_dims, shift,
      host_range) = _prepare_fit(data, num_clusters, config, model, phase, log,
-                                init_means=init_means)
+                                init_means=init_means,
+                                sample_weight=sample_weight)
     epsilon = convergence_epsilon(n_events, n_dims, config.epsilon_scale)
     if verbose:
         print(f"epsilon = {epsilon}")  # gaussian.cu:462
@@ -450,7 +472,7 @@ def _host_state(state, model):
 
 
 def _prepare_fit(data, num_clusters, config, model, phase, log,
-                 init_means=None):
+                 init_means=None, sample_weight=None):
     """Load, center, seed, chunk, and place the data -- one path for all
     four cases (ndarray or FileSource input x single- or multi-process run).
 
@@ -483,6 +505,11 @@ def _prepare_fit(data, num_clusters, config, model, phase, log,
             "hosts' devices); pass mesh_shape or let fit_gmm default it"
         )
 
+    if sample_weight is not None and source is not None:
+        raise ValueError(
+            "sample_weight requires in-memory event data (FileSource/"
+            "streamed inputs carry no weight column)")
+
     with phase("cpu"):
         if source is not None:
             n_events, n_dims = source.shape
@@ -496,6 +523,29 @@ def _prepare_fit(data, num_clusters, config, model, phase, log,
         local = (source.read_range(start, stop) if source is not None
                  else data[start:stop])
         local = np.ascontiguousarray(local)
+        local_weight = None
+        if sample_weight is not None:
+            sample_weight = np.asarray(sample_weight, np.float64)
+            if sample_weight.shape != (n_events,):
+                raise ValueError(
+                    f"sample_weight must be [{n_events}], got "
+                    f"{sample_weight.shape}")
+            if not np.isfinite(sample_weight).all() or (sample_weight < 0).any():
+                raise InvalidInputError(
+                    "sample_weight must be finite and nonnegative")
+            total_w = float(sample_weight.sum())
+            if total_w < num_clusters:
+                # Weights are event multiplicities; the absolute Nk
+                # thresholds (> 0.5 / >= 1, reference semantics) would
+                # classify every cluster as empty and return a silently
+                # degenerate model. (Every rank sees the full weight array,
+                # so this decision is identical without a collective.)
+                raise InvalidInputError(
+                    f"sample_weight sums to {total_w:.4g} < num_clusters="
+                    f"{num_clusters}: weights are event multiplicities, not "
+                    "probabilities -- scale them up (e.g. multiply "
+                    "normalized weights by the event count)")
+            local_weight = sample_weight[start:stop]
     # Before ANY arithmetic touches the data (the moments would just launder
     # NaNs into the shift): reject rows non-finite now or after the cast to
     # the compute dtype.
@@ -543,7 +593,9 @@ def _prepare_fit(data, num_clusters, config, model, phase, log,
             dtype=dtype,
         )
         chunks_np, wts_np = chunk_events(
-            local, config.chunk_size, num_chunks=num_chunks
+            local, config.chunk_size, num_chunks=num_chunks,
+            sample_weight=(None if local_weight is None
+                           else local_weight.astype(local.dtype)),
         )
 
     with phase("memcpy"):
@@ -558,7 +610,7 @@ def _prepare_fit(data, num_clusters, config, model, phase, log,
 
 
 def _fit_with_restarts(data, num_clusters, target_num_clusters, config,
-                       model, verbose, init_means=None):
+                       model, verbose, init_means=None, sample_weight=None):
     """n_init independent fits, keep the best Rissanen (capability upgrade;
     the reference's single deterministic init showed local-optima misses).
 
@@ -590,7 +642,8 @@ def _fit_with_restarts(data, num_clusters, target_num_clusters, config,
         )
         r = fit_gmm(data, num_clusters, target_num_clusters, config=sub,
                     model=model, verbose=verbose,
-                    init_means=(init_means if i == 0 else None))
+                    init_means=(init_means if i == 0 else None),
+                    sample_weight=sample_weight)
         if verbose:
             print(f"init {i}: {config.criterion}={r.min_rissanen:.6e} "
                   f"K={r.ideal_num_clusters}")

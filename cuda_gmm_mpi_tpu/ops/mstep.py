@@ -88,9 +88,12 @@ def chunk_stats(
 ) -> SuffStats:
     """Fused E+M statistics for one chunk of events.
 
-    ``wts`` is a [B] 0/1 validity mask for padded events (the TPU-native
-    replacement for the reference's 16-aligned block splits,
-    gaussian_kernel.cu:367-381: we pad to a static chunk grid and mask instead).
+    ``wts`` is a [B] row of nonnegative per-event weights: 1/0 when it is
+    the padding validity mask (the TPU-native replacement for the
+    reference's 16-aligned block splits, gaussian_kernel.cu:367-381: we pad
+    to a static chunk grid and mask instead), or arbitrary multiplicities
+    under ``sample_weight`` -- every statistic (loglik, Nk, M1, M2) scales
+    per event, so it is NOT a binary mask contract.
     """
     B, D = x.shape
     K = state.means.shape[0]

@@ -416,7 +416,8 @@ def _prep_inputs(state, data_chunks, wts_chunks, block_b, diag_only):
     else:
         wt = wts_chunks.reshape(n, 1).astype(jnp.float32)
 
-    # Pad events to a whole number of tiles (masked out via wt).
+    # Pad events to a whole number of tiles (weight 0 via wt; wt rows carry
+    # arbitrary nonnegative per-event weights, not just the 0/1 mask).
     pad = (-n) % block_b
     if pad:
         x = jnp.concatenate([x, jnp.zeros((pad, d), x.dtype)])

@@ -1,0 +1,91 @@
+"""Weighted events: integer sample_weight == replicated rows, exactly.
+
+The fused E+M pass multiplies responsibilities and log-evidence by the
+per-event weight row, so every sufficient statistic (loglik, Nk, M1, M2) of
+a weight-w event equals w copies of it -- the whole EM trajectory must
+match a fit on the physically replicated dataset (same init pinned via
+init_means so seeding differences can't leak in).
+"""
+
+import numpy as np
+import pytest
+
+from cuda_gmm_mpi_tpu import GaussianMixture, GMMConfig
+from cuda_gmm_mpi_tpu.models.order_search import fit_gmm
+from cuda_gmm_mpi_tpu.validation import InvalidInputError
+
+from .conftest import make_blobs
+
+
+@pytest.mark.parametrize("cov_type", ["full", "diag"])
+def test_integer_weights_equal_replication(rng, cov_type):
+    k, d, n = 3, 3, 500
+    centers = rng.normal(scale=8.0, size=(k, d))
+    data = (centers[rng.integers(0, k, n)]
+            + rng.normal(size=(n, d))).astype(np.float64)
+    w = rng.integers(0, 4, size=n).astype(np.float64)
+    replicated = np.repeat(data, w.astype(int), axis=0)
+
+    kw = dict(min_iters=6, max_iters=6, chunk_size=128, dtype="float64",
+              covariance_type=cov_type, center_data=False,
+              covariance_dynamic_range=1e30)  # avgvar ~ 0: it is seeded
+    # from the UNWEIGHTED data variance, which replication shifts -- not
+    # part of the weighting semantics under test
+    gw = GaussianMixture(k, target_components=k, means_init=centers,
+                         **kw).fit(data, sample_weight=w)
+    gr = GaussianMixture(k, target_components=k, means_init=centers,
+                         **kw).fit(replicated)
+
+    np.testing.assert_allclose(gw.weights_, gr.weights_, rtol=1e-10)
+    np.testing.assert_allclose(gw.means_, gr.means_, rtol=1e-9, atol=1e-10)
+    np.testing.assert_allclose(gw.covariances_, gr.covariances_,
+                               rtol=1e-8, atol=1e-10)
+
+
+def test_weighted_loglik_matches_replication(rng):
+    data, _ = make_blobs(rng, n=300, d=2, k=2, dtype=np.float64)
+    w = rng.integers(1, 3, size=len(data)).astype(np.float64)
+    cfg = dict(min_iters=3, max_iters=3, chunk_size=64, dtype="float64",
+               center_data=False, covariance_dynamic_range=1e30)
+    centers = data[:2]
+    rw = fit_gmm(data, 2, 2, GMMConfig(**cfg), init_means=centers,
+                 sample_weight=w)
+    rr = fit_gmm(np.repeat(data, w.astype(int), axis=0), 2, 2,
+                 GMMConfig(**cfg), init_means=centers)
+    np.testing.assert_allclose(rw.final_loglik, rr.final_loglik, rtol=1e-10)
+
+
+def test_sample_weight_validation(rng):
+    data, _ = make_blobs(rng, n=100, d=2, k=2, dtype=np.float64)
+    cfg = GMMConfig(min_iters=1, max_iters=1, chunk_size=64, dtype="float64")
+    with pytest.raises(ValueError, match="sample_weight must be"):
+        fit_gmm(data, 2, 2, cfg, sample_weight=np.ones(7))
+    with pytest.raises(InvalidInputError, match="nonnegative"):
+        fit_gmm(data, 2, 2, cfg,
+                sample_weight=np.full(len(data), -1.0))
+    bad = np.ones(len(data))
+    bad[3] = np.nan
+    with pytest.raises(InvalidInputError, match="finite"):
+        fit_gmm(data, 2, 2, cfg, sample_weight=bad)
+    # normalized-probability weights (sum ~ 1) would make every cluster
+    # look empty under the absolute Nk thresholds: rejected with guidance
+    with pytest.raises(InvalidInputError, match="multiplicities"):
+        fit_gmm(data, 2, 2, cfg,
+                sample_weight=np.full(len(data), 1.0 / len(data)))
+
+
+def test_fractional_weights_scale_statistics(rng):
+    """Non-integer weights: halving every weight must leave the MLE fixed
+    point unchanged (weights enter every statistic homogeneously; only pi's
+    normalizer and the loglik scale)."""
+    data, _ = make_blobs(rng, n=400, d=2, k=2, dtype=np.float64)
+    centers = data[:2]
+    kw = dict(min_iters=5, max_iters=5, chunk_size=128, dtype="float64",
+              center_data=False, covariance_dynamic_range=1e30)
+    g1 = GaussianMixture(2, target_components=2, means_init=centers,
+                         **kw).fit(data, sample_weight=np.ones(len(data)))
+    gh = GaussianMixture(2, target_components=2, means_init=centers,
+                         **kw).fit(data,
+                                   sample_weight=np.full(len(data), 0.5))
+    np.testing.assert_allclose(gh.means_, g1.means_, rtol=1e-9)
+    np.testing.assert_allclose(gh.weights_, g1.weights_, rtol=1e-9)
