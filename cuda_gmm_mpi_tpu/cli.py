@@ -233,13 +233,22 @@ def main(argv=None) -> int:
         if distributed_flags:
             print("--predict-from is a single-process mode", file=sys.stderr)
             return 1
-        if args.sweep_log or args.init_from:
-            # No sweep and no fitting happen in this mode; rejecting beats
-            # silently ignoring flags the user believes took effect.
-            flag = "--sweep-log" if args.sweep_log else "--init-from"
-            print(f"{flag} has no effect with --predict-from",
-                  file=sys.stderr)
-            return 1
+        # No sweep and no fitting happen in this mode; rejecting beats
+        # silently ignoring flags the user believes took effect.
+        fit_only = [
+            ("--sweep-log", args.sweep_log),
+            ("--init-from", args.init_from),
+            ("--checkpoint-dir", args.checkpoint_dir),
+            ("--fused-sweep", args.fused_sweep),
+            ("--n-init", args.n_init != 1),
+            ("--mesh", args.mesh),
+            ("--seed-method", args.seed_method != "even"),
+        ]
+        for flag, present in fit_only:
+            if present:
+                print(f"{flag} has no effect with --predict-from",
+                      file=sys.stderr)
+                return 1
         return _predict_main(args, config)
     if not (1 <= args.num_clusters <= config.max_clusters):
         print("Invalid number of starting clusters\n", file=sys.stderr)  # :1122
@@ -275,9 +284,14 @@ def main(argv=None) -> int:
         # first collective.
         ok = True
         if pid == 0:
+            existed = os.path.exists(args.sweep_log)
             try:
                 with open(args.sweep_log, "a"):
                     pass
+                if not existed:
+                    # The probe only checks writability; don't leave a
+                    # zero-byte artifact if the run aborts before fitting.
+                    os.remove(args.sweep_log)
             except OSError as e:
                 print(f"Cannot write --sweep-log={args.sweep_log!r}: {e}",
                       file=sys.stderr)

@@ -111,13 +111,21 @@ class GaussianMixture:
             else:
                 raise ValueError(f"unknown parameter {k!r}")
         if config_updates:
+            # diag_only and covariance_type are one coupled setting
+            # (GMMConfig.__post_init__): whichever one the user set
+            # explicitly must win over the carried-over value of the other,
+            # which would otherwise silently snap the update back.
             if ("covariance_type" in config_updates
                     and "diag_only" not in config_updates):
-                # diag_only and covariance_type are one coupled setting
-                # (GMMConfig.__post_init__); an explicit covariance_type
-                # must win over the carried-over diag_only flag, which
-                # would otherwise silently snap 'full' back to 'diag'.
                 config_updates["diag_only"] = False
+            elif ("diag_only" in config_updates
+                    and "covariance_type" not in config_updates):
+                cur = self.config.covariance_type
+                if config_updates["diag_only"] and cur in ("full", "tied"):
+                    config_updates["covariance_type"] = "diag"
+                elif not config_updates["diag_only"] and cur in (
+                        "diag", "spherical"):
+                    config_updates["covariance_type"] = "full"
             self.config = dataclasses.replace(self.config, **config_updates)
         return self
 
@@ -136,9 +144,28 @@ class GaussianMixture:
         from .ops.constants import compute_constants
         from .state import GMMState
 
+        import jax
+
         m = read_summary(path)
         k, d = m["means"].shape
         gm = cls(k, target_components=k, config=config, **config_overrides)
+        if (gm.config.dtype == "float64"
+                and not jax.config.jax_enable_x64):
+            # Same guard as the fit path: refuse silent float32 truncation.
+            raise ValueError(
+                "dtype='float64' needs jax_enable_x64; set "
+                "jax.config.update('jax_enable_x64', True) at startup")
+        if gm.config.diag_only:
+            offdiag = m["R"] - np.stack([np.diag(np.diag(r))
+                                         for r in m["R"]])
+            if np.abs(offdiag).max() > 0:
+                # Silently dropping off-diagonal covariance terms would
+                # compute every posterior under the wrong densities.
+                raise ValueError(
+                    f"{path!r} holds full covariances (nonzero "
+                    "off-diagonals) but the config requests "
+                    f"covariance_type={gm.config.covariance_type!r}; load "
+                    "it without --diag-only/diag config")
         dtype = jnp.float64 if gm.config.dtype == "float64" else jnp.float32
         eye = jnp.broadcast_to(jnp.eye(d, dtype=dtype), (k, d, d))
         state = GMMState(

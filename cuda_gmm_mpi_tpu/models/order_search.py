@@ -64,6 +64,11 @@ def _resume_mismatch(restored, config, log) -> bool:
     """True (and warns) when a checkpoint's semantics differ from this run's."""
     crit = _restored_criterion(restored)
     cov = _restored_cov(restored, config.covariance_type)
+    if ("cov_code" not in restored
+            and config.covariance_type in ("spherical", "tied")):
+        # Legacy checkpoints predate these families entirely, so the
+        # benefit-of-the-doubt default cannot apply to them.
+        cov = "pre-covariance_type (full or diag)"
     if crit == config.criterion and cov == config.covariance_type:
         return False
     if log:

@@ -93,6 +93,15 @@ def test_cli_sweep_log(csv_file, tmp_path):
     assert run_cli(["4", csv_file, str(tmp_path / "o2"),
                     f"--predict-from={tmp_path}/o.summary",
                     f"--sweep-log={log}"]) == 1
+    for extra in ("--n-init=3", "--fused-sweep", "--checkpoint-dir=ck"):
+        assert run_cli(["4", csv_file, str(tmp_path / "o2"),
+                        f"--predict-from={tmp_path}/o.summary", extra]) == 1
+    # a failed pre-fit abort must not leave a zero-byte sweep-log artifact
+    s2 = tmp_path / "s2.jsonl"
+    assert run_cli(["4", csv_file, str(tmp_path / "o3"), "2",
+                    f"--sweep-log={s2}",
+                    f"--init-from={tmp_path}/nope.summary"]) == 1
+    assert not s2.exists()
 
 
 def test_cli_init_from(csv_file, tmp_path):

@@ -86,6 +86,24 @@ def test_sklearn_params_interop(fitted):
     gd.set_params(covariance_type="spherical")
     assert gd.config.covariance_type == "spherical"
     assert gd.config.diag_only is True
+    # the symmetric direction: an explicit diag_only update wins too
+    gd.set_params(diag_only=False)
+    assert gd.config.covariance_type == "full"
+    assert gd.config.diag_only is False
+    gd.set_params(diag_only=True)
+    assert gd.config.covariance_type == "diag"
+
+
+def test_from_summary_diag_config_rejects_full_model(fitted, tmp_path):
+    """Loading a full-covariance model under a diag config must error, not
+    silently drop the off-diagonal terms."""
+    from cuda_gmm_mpi_tpu.io.writers import write_summary
+
+    gm, data, _ = fitted
+    path = str(tmp_path / "full.summary")
+    write_summary(path, gm.result_)
+    with pytest.raises(ValueError, match="off-diagonals"):
+        GaussianMixture.from_summary(path, diag_only=True)
 
 
 def test_means_init(rng):
