@@ -74,6 +74,20 @@ def test_sample_weight_validation(rng):
                 sample_weight=np.full(len(data), 1.0 / len(data)))
 
 
+def test_weighted_fused_sweep_matches_host(rng):
+    """sample_weight rides the same wts arrays into the fused on-device
+    sweep; trajectories match the host-driven sweep exactly."""
+    data, _ = make_blobs(rng, n=400, d=2, k=3, dtype=np.float64)
+    w = rng.integers(1, 3, size=len(data)).astype(np.float64)
+    kw = dict(min_iters=3, max_iters=3, chunk_size=128, dtype="float64")
+    rh = fit_gmm(data, 5, 2, GMMConfig(**kw), sample_weight=w)
+    rf = fit_gmm(data, 5, 2, GMMConfig(fused_sweep=True, **kw),
+                 sample_weight=w)
+    assert rf.ideal_num_clusters == rh.ideal_num_clusters
+    np.testing.assert_allclose(rf.final_loglik, rh.final_loglik, rtol=1e-12)
+    np.testing.assert_allclose(rf.means, rh.means, rtol=1e-10)
+
+
 def test_fractional_weights_scale_statistics(rng):
     """Non-integer weights: halving every weight must leave the MLE fixed
     point unchanged (weights enter every statistic homogeneously; only pi's
