@@ -1,9 +1,12 @@
 """cuda_gmm_mpi_tpu: a TPU-native GMM-EM clustering framework.
 
 A from-scratch JAX/XLA/Pallas re-design with the full capabilities of the
-CUDA/MPI/OpenMP reference (Corv/CUDA-GMM-MPI): full- and diagonal-covariance
-GMM fitting by EM over large event x dimension matrices, and a Rissanen/MDL
-model-order search merging clusters from a starting K down to a target K.
+CUDA/MPI/OpenMP reference (Corv/CUDA-GMM-MPI): GMM fitting by EM over large
+event x dimension matrices (four covariance families: full, diagonal,
+spherical, tied) and a model-order search merging clusters from a starting
+K down to a target K under a selectable criterion (Rissanen/MDL, BIC, AIC),
+with weighted events, warm starts, model-file round-trips, and
+single-device through multi-host sharded execution.
 
 See SURVEY.md at the repo root for the structural analysis of the reference and
 the file:line provenance cited throughout this package.
@@ -14,12 +17,13 @@ from .estimator import GaussianMixture
 from .models import (GMMModel, GMMResult, compute_memberships, fit_gmm,
                      iter_memberships)
 from .state import GMMState, compact, zeros_state
+from .validation import InvalidInputError
 
 __version__ = "0.1.0"
 
 __all__ = [
     "DEFAULT_CONFIG", "GMMConfig", "GaussianMixture",
     "GMMModel", "GMMResult", "compute_memberships", "fit_gmm", "iter_memberships",
-    "GMMState", "compact", "zeros_state",
+    "GMMState", "compact", "zeros_state", "InvalidInputError",
     "__version__",
 ]
