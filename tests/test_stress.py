@@ -94,6 +94,29 @@ def test_single_cluster_k1():
 
 
 @pytest.mark.slow
+def test_beyond_reference_envelope():
+    """K and D past the reference's compile-time caps (MAX_CLUSTERS=512,
+    NUM_DIMENSIONS=32, gaussian.h:10,16 -- its shared-memory sizing makes
+    both HARD limits): runtime config here, so K=600 and D=64 just work."""
+    rng = np.random.default_rng(4)
+    # K > 512 (needs --max-clusters raised, like the reference would need a
+    # recompile -- but no kernel limits behind it here)
+    data = rng.normal(size=(1500, 4)).astype(np.float32)
+    r = fit_gmm(data, 600, 599,
+                config=cfg(min_iters=1, max_iters=1, chunk_size=512,
+                           dtype="float32", max_clusters=600))
+    assert_finite_result(r)
+    assert r.ideal_num_clusters == 599
+    # D > 32 (the reference's estep shared-memory staging caps D at 32)
+    data = rng.normal(size=(1024, 64)).astype(np.float32)
+    r = fit_gmm(data, 8, 8,
+                config=cfg(min_iters=2, max_iters=2, chunk_size=256,
+                           dtype="float32"))
+    assert_finite_result(r)
+    assert r.state.means.shape[1] == 64
+
+
+@pytest.mark.slow
 def test_reference_envelope_k512_d32():
     """The reference's first-class supported envelope -- MAX_CLUSTERS=512,
     NUM_DIMENSIONS=32 (gaussian.h:10,16) -- exercised end to end at small N
