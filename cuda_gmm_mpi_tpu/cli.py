@@ -50,9 +50,9 @@ def build_parser() -> argparse.ArgumentParser:
                    "spherical (sigma^2 I per cluster) and tied (one shared "
                    "covariance) as capability upgrades")
     g.add_argument("--criterion", default="rissanen",
-                   choices=["rissanen", "bic", "aic"],
+                   choices=["rissanen", "bic", "aic", "aicc"],
                    help="model-order selection score: the reference's "
-                   "Rissanen/MDL (gaussian.cu:826), or BIC/AIC with "
+                   "Rissanen/MDL (gaussian.cu:826), or BIC/AIC/AICc with "
                    "family-correct parameter counts")
     g.add_argument("--min-iters", type=int, default=100,
                    help="MIN_ITERS (gaussian.h:27)")

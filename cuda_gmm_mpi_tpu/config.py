@@ -135,7 +135,7 @@ class GMMConfig:
         if self.covariance_type not in ("full", "diag", "spherical", "tied"):
             raise ValueError(
                 f"unknown covariance_type: {self.covariance_type!r}")
-        if self.criterion not in ("rissanen", "bic", "aic"):
+        if self.criterion not in ("rissanen", "bic", "aic", "aicc"):
             raise ValueError(f"unknown criterion: {self.criterion!r}")
         # diag_only (the reference's DIAG_ONLY flag) and covariance_type are
         # one setting: keep them coherent whichever way the user spells it.

@@ -40,7 +40,7 @@ from .gmm import GMMModel, chunk_events
 # semantics it was written with: criterion scores live on per-criterion
 # scales, and a state evolved under one covariance family must not continue
 # under another.
-_CRITERION_CODE = {"rissanen": 0, "bic": 1, "aic": 2}
+_CRITERION_CODE = {"rissanen": 0, "bic": 1, "aic": 2, "aicc": 3}
 _CRITERION_NAME = {v: k for k, v in _CRITERION_CODE.items()}
 _COV_CODE = {"full": 0, "diag": 1, "spherical": 2, "tied": 3}
 _COV_NAME = {v: k for k, v in _COV_CODE.items()}

@@ -93,4 +93,14 @@ def model_score(
         return -2.0 * loglik + p * math.log(float(num_events))
     if criterion == "aic":
         return -2.0 * loglik + 2.0 * p
+    if criterion == "aicc":
+        # Small-sample correction (Hurvich & Tsai); diverges as p -> n-1,
+        # which is the correct behavior (such models are unsupportable).
+        # max(d0, 0)+eps spelled branch-free via abs() so the fused sweep
+        # can trace this with K dynamic (Python max / np.maximum both
+        # choke on tracers).
+        n = float(num_events)
+        d0 = n - p - 1.0
+        denom = 0.5 * (d0 + abs(d0)) + 1e-12
+        return -2.0 * loglik + 2.0 * p + 2.0 * p * (p + 1.0) / denom
     raise ValueError(f"unknown criterion: {criterion!r}")

@@ -39,5 +39,8 @@ def test_model_score_criteria():
     # rissanen keeps the reference's full count regardless of family
     assert model_score(ll, k, n, d, "rissanen", "diag") == (
         rissanen_score(ll, k, n, d))
+    aicc = model_score(ll, k, n, d, "aicc")
+    assert aicc == -2 * ll + 2 * p + 2 * p * (p + 1) / (n - p - 1)
+    assert aicc > model_score(ll, k, n, d, "aic")  # correction is positive
     with pytest.raises(ValueError, match="criterion"):
         model_score(ll, k, n, d, "mdl2")
