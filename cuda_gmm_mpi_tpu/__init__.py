@@ -4,7 +4,8 @@ A from-scratch JAX/XLA/Pallas re-design with the full capabilities of the
 CUDA/MPI/OpenMP reference (Corv/CUDA-GMM-MPI): GMM fitting by EM over large
 event x dimension matrices (four covariance families: full, diagonal,
 spherical, tied) and a model-order search merging clusters from a starting
-K down to a target K under a selectable criterion (Rissanen/MDL, BIC, AIC),
+K down to a target K under a selectable criterion (Rissanen/MDL, BIC, AIC,
+AICc),
 with weighted events, warm starts, model-file round-trips, and
 single-device through multi-host sharded execution.
 

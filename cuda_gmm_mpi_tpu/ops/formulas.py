@@ -77,10 +77,11 @@ def model_score(
 
     'rissanen' is the reference's MDL formula exactly (gaussian.cu:826,
     full-covariance parameter count even under DIAG_ONLY). 'bic'
-    (-2 loglik + p ln N) and 'aic' (-2 loglik + 2p) are upgrades that count
-    the parameters the model actually estimates (family-aware via
+    (-2 loglik + p ln N), 'aic' (-2 loglik + 2p), and 'aicc' (AIC with the
+    Hurvich-Tsai small-sample correction) are upgrades that count the
+    parameters the model actually estimates (family-aware via
     ``covariance_type``) and use the conventional sample count N rather
-    than the reference's N*D. All three are plain arithmetic in
+    than the reference's N*D. All four are plain arithmetic in
     ``num_clusters`` plus a static log, so the fused on-device sweep can
     trace them with K dynamic.
     """

@@ -40,7 +40,9 @@ def test_model_score_criteria():
     assert model_score(ll, k, n, d, "rissanen", "diag") == (
         rissanen_score(ll, k, n, d))
     aicc = model_score(ll, k, n, d, "aicc")
-    assert aicc == -2 * ll + 2 * p + 2 * p * (p + 1) / (n - p - 1)
+    # the implementation's denominator carries a +1e-12 guard: approx, not ==
+    assert aicc == pytest.approx(
+        -2 * ll + 2 * p + 2 * p * (p + 1) / (n - p - 1), rel=1e-12)
     assert aicc > model_score(ll, k, n, d, "aic")  # correction is positive
     with pytest.raises(ValueError, match="criterion"):
         model_score(ll, k, n, d, "mdl2")
