@@ -175,6 +175,22 @@ def test_fused_sweep_matches_host_sweep(rng, ct):
                                rtol=1e-10, atol=1e-12)
 
 
+def test_tied_fused_sweep_on_mesh_matches_plain(rng):
+    """The deepest composition: tied's cross-cluster psum inside the fused
+    whole-sweep-on-device program under a (2, 2) shard_map mesh."""
+    data, _ = make_blobs(rng, n=640, d=3, k=4, dtype=np.float64)
+    kw = dict(covariance_type="tied", min_iters=3, max_iters=3,
+              chunk_size=64, dtype="float64")
+    r_plain = fit_gmm(data, 4, 2, GMMConfig(**kw))
+    r_mesh = fit_gmm(data, 4, 2, GMMConfig(mesh_shape=(2, 2),
+                                           fused_sweep=True, **kw))
+    assert r_mesh.ideal_num_clusters == r_plain.ideal_num_clusters
+    np.testing.assert_allclose(r_mesh.final_loglik, r_plain.final_loglik,
+                               rtol=1e-9)
+    np.testing.assert_allclose(r_mesh.covariances, r_plain.covariances,
+                               rtol=1e-8, atol=1e-10)
+
+
 def test_n_free_params_by_family():
     k, d = 5, 4
     full = k * (1 + d + d * (d + 1) / 2) - 1
