@@ -133,6 +133,11 @@ def build_parser() -> argparse.ArgumentParser:
                    help="trap NaN/Inf at the producing op (sanitizer mode)")
     t.add_argument("--no-validate-input", action="store_true",
                    help="skip the NaN/Inf input-row check at load")
+    t.add_argument("--stream-events", action="store_true",
+                   help="out-of-core mode: event chunks stay in host RAM "
+                   "and stream through the device per E+M pass (N bounded "
+                   "by host memory, not HBM; slower -- use only when the "
+                   "data exceeds device memory)")
     t.add_argument("--checkpoint-dir", default=None,
                    help="orbax checkpoint directory for the K-sweep (resume "
                    "with the same path)")
@@ -219,6 +224,7 @@ def main(argv=None) -> int:
             checkpoint_dir=args.checkpoint_dir,
             debug_nans=args.debug_nans,
             validate_input=not args.no_validate_input,
+            stream_events=args.stream_events,
         )
     except ValueError as e:
         print(str(e), file=sys.stderr)
@@ -243,6 +249,7 @@ def main(argv=None) -> int:
             ("--n-init", args.n_init != 1),
             ("--mesh", args.mesh),
             ("--seed-method", args.seed_method != "even"),
+            ("--stream-events", args.stream_events),
         ]
         for flag, present in fit_only:
             if present:
@@ -257,6 +264,13 @@ def main(argv=None) -> int:
         print("target_num_clusters must be less than equal to num_clusters\n",
               file=sys.stderr)  # :1150
         return 4
+
+    if args.stream_events and distributed_flags:
+        # Detectable from the args alone: fail before bringing up the
+        # multi-controller runtime (whose other ranks would then hang).
+        print("--stream-events is single-process; multi-host runs already "
+              "stream per-host slices via the range readers", file=sys.stderr)
+        return 1
 
     # MPI_Init equivalent (gaussian.cu:130-140): any distributed flag brings
     # up the multi-controller runtime; --num-processes=0 initializes from the

@@ -86,6 +86,13 @@ class GMMConfig:
     # host-driven sweep with a warning.
     fused_sweep: bool = False
 
+    # Out-of-core mode: event chunks stay in HOST memory and stream through
+    # the device one chunk per E+M pass, so N is bounded by host RAM rather
+    # than HBM. Trades the single-jit EM loop for per-chunk dispatches --
+    # only worth it when the data genuinely exceeds device memory
+    # (models/streaming.py). Single-process, single-device.
+    stream_events: bool = False
+
     # --- platform / parallelism ---
     device: Optional[str] = None  # None = JAX default platform
     # Mesh shape over (event axis, cluster axis). None = all local devices on the
@@ -149,6 +156,14 @@ class GMMConfig:
                 "it cannot combine with diag_only=True")
         if self.use_pallas not in ("auto", "always", "never"):
             raise ValueError(f"unknown use_pallas: {self.use_pallas!r}")
+        if self.stream_events and self.mesh_shape is not None:
+            raise ValueError(
+                "stream_events is single-device; use multi-host sharding "
+                "(each host streams its slice) instead of a mesh")
+        if self.stream_events and self.use_pallas == "always":
+            raise ValueError(
+                "stream_events streams per-chunk through the jnp path; "
+                "use_pallas='always' cannot be honored -- drop one flag")
         if self.seed_method not in ("even", "kmeans++"):
             raise ValueError(f"unknown seed_method: {self.seed_method!r}")
         if self.chunk_size < 1:

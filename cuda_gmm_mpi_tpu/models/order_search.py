@@ -235,8 +235,16 @@ def fit_gmm(
     phase = timer.phase if timer else _null_phase
 
     nproc = jax.process_count()
+    if config.stream_events and nproc > 1:
+        raise ValueError(
+            "stream_events is single-process; multi-host runs already "
+            "stream per-host slices via the range readers")
     if model is None:
-        if config.mesh_shape is not None or nproc > 1:
+        if config.stream_events:
+            from .streaming import StreamingGMMModel
+
+            model = StreamingGMMModel(config)
+        elif config.mesh_shape is not None or nproc > 1:
             # Multi-controller runs always need the sharded model (the mesh
             # spans all hosts' devices; default = every device on 'data').
             from ..parallel import ShardedGMMModel
@@ -630,7 +638,11 @@ def _fit_with_restarts(data, num_clusters, target_num_clusters, config,
         log.info("n_init=%d: init 0 uses seed_method=%r, restarts use "
                  "'kmeans++'", config.n_init, config.seed_method)
     if model is None:  # one model => executables shared across restarts
-        if config.mesh_shape is not None or jax.process_count() > 1:
+        if config.stream_events:
+            from .streaming import StreamingGMMModel
+
+            model = StreamingGMMModel(config)
+        elif config.mesh_shape is not None or jax.process_count() > 1:
             from ..parallel import ShardedGMMModel
 
             model = ShardedGMMModel(config)

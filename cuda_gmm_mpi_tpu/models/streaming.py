@@ -1,0 +1,113 @@
+"""Out-of-core EM: events stream host->device per chunk, per iteration.
+
+Scale upgrade past both the reference and the in-memory path: the reference
+holds every GPU's event shard resident in device memory for the whole run
+(``gaussian.cu:347-377``), and ``GMMModel`` likewise uploads all chunks to
+HBM once. Here the chunk array STAYS IN HOST MEMORY; each EM iteration
+streams chunks through a jitted fused E+M pass and accumulates sufficient
+statistics on device -- the device working set is one chunk plus the
+[K, D, D]-sized statistics, so N is bounded by host RAM, not HBM (e.g.
+400M x 24 float32 events = 38 GB host is fine on a 16 GB chip).
+
+The price is the single-jit EM loop: iteration control returns to the host
+(num_chunks dispatches per iteration instead of zero). Use it only when the
+data genuinely exceeds device memory; the in-memory model is strictly faster
+otherwise. Loop semantics (estep0; while cond: mstep; estep) and all guards
+are shared with ``em_while_loop`` via the same ops and the same
+chunk-sequential accumulation order, so trajectories match the in-memory
+path to summation-order noise (the CLI outputs are byte-identical).
+
+Single-process, single-device by design: multi-host runs already shard the
+data N-ways (per-host slices), which is the first remedy for N too big for
+one chip. A ``GMMModel`` subclass, so ``fit_gmm``, the model-order search,
+and the whole inference/output surface drive it unchanged; the fused
+whole-sweep path is disabled (it needs device-resident data) and falls back
+to the host-driven sweep.
+"""
+
+from __future__ import annotations
+
+from typing import Optional
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from ..config import GMMConfig
+from ..ops.mstep import apply_mstep, chunk_stats
+from .gmm import GMMModel, resolve_iters
+
+
+class StreamingGMMModel(GMMModel):
+    """GMMModel with host-resident chunks and a host-driven EM loop."""
+
+    supports_fused_emit = False
+    make_fused_sweep = None  # no fused sweep: data is not on device
+
+    def __init__(self, config: GMMConfig = GMMConfig()):
+        if config.mesh_shape is not None:
+            raise ValueError(
+                "stream_events is single-device; for data too large for one "
+                "chip ALSO consider multi-host sharding (each host streams "
+                "its slice)")
+        if config.use_pallas == "always":
+            raise ValueError(
+                "stream_events streams per-chunk through the jnp path; "
+                "use_pallas='always' (a hard kernel override) cannot be "
+                "honored -- drop one of the two flags")
+        super().__init__(config)  # inference surface + _posteriors
+
+        kw = dict(self._kw)
+
+        @jax.jit
+        def _stats(state, x, wts):
+            return chunk_stats(state, x, wts, **kw)
+
+        @jax.jit
+        def _add(a, b):
+            return a + b  # SuffStats.__add__
+
+        @jax.jit
+        def _mstep(state, stats):
+            return apply_mstep(state, stats, diag_only=config.diag_only,
+                               covariance_type=config.covariance_type)
+
+        self._chunk_stats_jit = _stats
+        self._add = _add
+        self._mstep = _mstep
+
+    def prepare(self, state, chunks_np, wts_np, host_local: bool = False):
+        """Keep the chunk arrays HOST-side; only the state goes on device."""
+        del host_local  # single-process
+        return (jax.tree_util.tree_map(jnp.asarray, state),
+                np.asarray(chunks_np), np.asarray(wts_np))
+
+    def prepare_state(self, state):
+        return jax.tree_util.tree_map(jnp.asarray, state)
+
+    def _estep_all(self, state, chunks, wts):
+        """One full-data fused E+M pass, streaming chunk by chunk."""
+        acc = None
+        for i in range(chunks.shape[0]):
+            s = self._chunk_stats_jit(state, jnp.asarray(chunks[i]),
+                                      jnp.asarray(wts[i]))
+            acc = s if acc is None else self._add(acc, s)
+        return acc
+
+    def run_em(self, state, chunks, wts, epsilon,
+               min_iters: Optional[int] = None,
+               max_iters: Optional[int] = None):
+        """Reference loop semantics (gaussian.cu:525-755), host-driven."""
+        lo, hi = resolve_iters(self.config, min_iters, max_iters)
+        lo, hi = int(lo), int(hi)
+        stats = self._estep_all(state, chunks, wts)
+        ll_old = float(stats.loglik)
+        change = abs(2.0 * float(epsilon)) + 1.0  # gaussian.cu:525
+        iters = 0
+        while iters < lo or (abs(change) > epsilon and iters < hi):
+            state = self._mstep(state, stats)
+            stats = self._estep_all(state, chunks, wts)
+            ll = float(stats.loglik)
+            change, ll_old = ll - ll_old, ll
+            iters += 1
+        return state, jnp.asarray(ll_old, chunks.dtype), jnp.asarray(iters)
