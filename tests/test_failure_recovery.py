@@ -22,7 +22,8 @@ import json, sys
 import jax
 jax.config.update("jax_platforms", "cpu")
 mesh = sys.argv[2] if len(sys.argv) > 2 else ""
-fused = len(sys.argv) > 3 and sys.argv[3] == "fused"
+mode = sys.argv[3] if len(sys.argv) > 3 else ""
+fused = mode == "fused"
 if mesh:
     jax.config.update("jax_num_cpu_devices", 8)
 jax.config.update("jax_enable_x64", True)
@@ -38,6 +39,7 @@ data = (centers[rng.integers(0, 4, 4000)]
 cfg = GMMConfig(min_iters=6, max_iters=6, chunk_size=512, dtype="float64",
                 checkpoint_dir=ckdir, enable_print=True,
                 fused_sweep=fused,
+                stream_events=(mode == "stream"),
                 mesh_shape=(tuple(int(x) for x in mesh.split(","))
                             if mesh else None))
 r = fit_gmm(data, 12, 2, config=cfg)
@@ -51,10 +53,13 @@ print(json.dumps({
 """
 
 
-def _spawn(ckdir: str, mesh: str = "", fused: bool = False):
+def _spawn(ckdir: str, mesh: str = "", fused: bool = False,
+           mode: str = ""):
     from .conftest import worker_env
 
-    extra = [mesh, "fused"] if fused else ([mesh] if mesh else [])
+    if fused:
+        mode = "fused"
+    extra = [mesh, mode] if mode else ([mesh] if mesh else [])
     return subprocess.Popen(
         [sys.executable, "-c", WORKER, ckdir, *extra],
         stdout=subprocess.PIPE, stderr=subprocess.PIPE, env=worker_env(),
@@ -128,6 +133,58 @@ def test_sigkill_mid_sweep_then_resume(tmp_path, mesh):
         np.asarray(resumed["means"]), np.asarray(ref["means"]),
         rtol=1e-7, atol=1e-9,
     )
+
+
+@pytest.mark.slow
+def test_sigkill_streaming_sweep_then_resume(tmp_path):
+    """Kill/resume for the out-of-core streaming path: the host-driven loop
+    checkpoints identically, and a killed streaming sweep resumes to the
+    uninterrupted answer."""
+    ck = str(tmp_path / "ck")
+    sweep_dir = os.path.join(ck, "sweep")
+    p = _spawn(ck, mode="stream")
+    deadline = time.time() + 300
+    try:
+        while time.time() < deadline:
+            steps = (
+                [d for d in os.listdir(sweep_dir) if d.isdigit()]
+                if os.path.isdir(sweep_dir) else []
+            )
+            if len(steps) >= 2:
+                break
+            if p.poll() is not None:
+                out, err = p.communicate()
+                raise AssertionError(
+                    f"worker exited before kill (rc={p.returncode}):\n"
+                    f"{out}\n{err[-3000:]}"
+                )
+            time.sleep(0.05)
+        else:
+            raise AssertionError("no checkpoint appeared within timeout")
+        os.kill(p.pid, signal.SIGKILL)
+    finally:
+        if p.poll() is None:
+            p.kill()
+        p.wait(timeout=60)
+    assert p.returncode != 0
+
+    from .conftest import communicate_or_kill
+
+    p2 = _spawn(ck, mode="stream")
+    out, err = communicate_or_kill(p2, timeout=600)
+    assert p2.returncode == 0, f"resume failed:\n{out}\n{err[-3000:]}"
+    resumed = json.loads(out.splitlines()[-1])
+    assert len(resumed["sweep_ks"]) == 11
+    ran_here = [l for l in out.splitlines() if l.startswith("K=")]
+    assert 0 < len(ran_here) < 11, out
+
+    p3 = _spawn(str(tmp_path / "ck_ref"), mode="stream")
+    out3, err3 = communicate_or_kill(p3, timeout=600)
+    assert p3.returncode == 0, f"reference run failed:\n{out3}\n{err3[-3000:]}"
+    ref = json.loads(out3.splitlines()[-1])
+    assert resumed["ideal_k"] == ref["ideal_k"]
+    np.testing.assert_allclose(resumed["min_rissanen"], ref["min_rissanen"],
+                               rtol=1e-9)
 
 
 @pytest.mark.slow
