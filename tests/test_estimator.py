@@ -129,6 +129,26 @@ def test_means_init(rng):
                 init_means=centers[:2])
 
 
+def test_read_summary_fuzz_no_crash(tmp_path, rng):
+    """Hostile/garbage .summary inputs raise ValueError (or parse), never
+    crash with an unrelated exception or hang."""
+    from cuda_gmm_mpi_tpu.io.readers import read_summary
+
+    p = tmp_path / "fuzz.summary"
+    fragments = ["Cluster #0\n", "Probability: 0.5\n", "N: nope\n",
+                 "Means: 1.0 2.0 \n", "R Matrix:\n", "1.0 0.0 \n",
+                 "\n", "::::\n", "Probability: \n", "Means:\n",
+                 "R Matrix:\nx y\n"]
+    for trial in range(30):
+        n = rng.integers(1, 8)
+        p.write_text("".join(
+            fragments[i] for i in rng.integers(0, len(fragments), n)))
+        try:
+            read_summary(str(p))
+        except ValueError:
+            pass  # the documented failure mode
+
+
 def test_from_summary_malformed(tmp_path):
     from cuda_gmm_mpi_tpu.io.readers import read_summary
 
