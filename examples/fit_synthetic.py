@@ -2,7 +2,8 @@
 
 Generates a well-separated mixture, fits from K=12 down with the Rissanen
 search, and prints the recovered structure. Runs on whatever platform JAX
-picks (CPU works; on TPU the Pallas fused kernel engages automatically).
+picks (CPU works; on TPU the XLA fused path is the measured default --
+`use_pallas='always'` selects the hand-written kernel, docs/PERF.md).
 
   PYTHONPATH=. python examples/fit_synthetic.py [--device=cpu]
 """
