@@ -26,8 +26,8 @@ import time
 import numpy as np
 
 
-def probe_default_platform(timeout_s: float = 150.0, attempts: int = 3,
-                           retry_wait_s: float = 45.0) -> bool:
+def probe_default_platform(timeout_s: float = 180.0, attempts: int = 5,
+                           retry_wait_s: float = 90.0) -> bool:
     """True if the default JAX platform initializes in a fresh subprocess.
 
     Device init happens in-process and cannot be interrupted once started
@@ -35,8 +35,15 @@ def probe_default_platform(timeout_s: float = 150.0, attempts: int = 3,
     disposable child first. Tunnel wedges (a killed client can hold the
     single-admission axon endpoint for a while) sometimes clear within
     minutes, so a failed probe is retried before giving up on the
-    accelerator.
+    accelerator. Defaults give the tunnel ~20 minutes to come back
+    (5 x 180s probes + 4 x 90s waits); override via
+    GMM_BENCH_PROBE_ATTEMPTS / GMM_BENCH_PROBE_TIMEOUT_S /
+    GMM_BENCH_PROBE_WAIT_S when a harness needs a tighter or looser
+    deadline.
     """
+    timeout_s = float(os.environ.get("GMM_BENCH_PROBE_TIMEOUT_S", timeout_s))
+    attempts = int(os.environ.get("GMM_BENCH_PROBE_ATTEMPTS", attempts))
+    retry_wait_s = float(os.environ.get("GMM_BENCH_PROBE_WAIT_S", retry_wait_s))
     for i in range(attempts):
         try:
             r = subprocess.run(
@@ -289,7 +296,11 @@ def main() -> int:
             ll = float(ll_dev)
             times.append(time.perf_counter() - t0)
         dt = min(times)
-        return int(iters), dt, ll, s, {}
+        # Report the rep spread alongside the min: remote-tunnel sessions
+        # vary by up to ~25% (docs/PERF.md), so a single best number
+        # without its range over-claims.
+        extra = {"rep_wall_s": [round(t, 4) for t in times]}
+        return int(iters), dt, ll, s, extra
 
     # 'auto' is the XLA path everywhere since the round-3 precision study
     # (docs/PERF.md); no Pallas fallback needed.
@@ -351,6 +362,11 @@ def main() -> int:
         "value": round(iters_per_sec, 3),
         "unit": "iters/sec",
         "vs_baseline": round(vs_baseline, 2),
+        # Top-level, machine-readable: True means the accelerator tunnel was
+        # down and this run is a CPU fallback -- a harness must never mistake
+        # it for an accelerator perf number (round-3's BENCH artifact did
+        # exactly that; see VERDICT.md r3 weak-#3).
+        "accelerator_unavailable": accel_unavailable,
         "loglik": float(ll),
         "wall_s_per_iter": round(dt / iters, 4),
         "cpu_baseline_iters_per_sec": round(cpu_iters_per_sec, 4),
@@ -358,7 +374,11 @@ def main() -> int:
         **note,
     }
     print(json.dumps(result))
-    return 0
+    # Distinguishable failure: rc 3 marks "no accelerator" (the JSON line is
+    # still printed so the artifact explains itself). rc 0 = real measurement
+    # on the intended platform. GMM_BENCH_CPU=1 deliberately benches CPU, so
+    # it stays rc 0.
+    return 3 if accel_unavailable else 0
 
 
 if __name__ == "__main__":

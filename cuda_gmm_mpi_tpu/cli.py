@@ -298,14 +298,25 @@ def main(argv=None) -> int:
         # first collective.
         ok = True
         if pid == 0:
-            existed = os.path.exists(args.sweep_log)
             try:
-                with open(args.sweep_log, "a"):
-                    pass
-                if not existed:
-                    # The probe only checks writability; don't leave a
-                    # zero-byte artifact if the run aborts before fitting.
-                    os.remove(args.sweep_log)
+                if os.path.exists(args.sweep_log):
+                    # Existing target: append is non-destructive, so probe
+                    # it directly (also rejects directories / read-only
+                    # files), and never remove it.
+                    with open(args.sweep_log, "a"):
+                        pass
+                else:
+                    # Absent target: probe with a unique sibling temp file
+                    # so the check never creates-then-removes the target
+                    # path itself (removing it could race a concurrent
+                    # process that just created a file under the same name).
+                    import tempfile
+
+                    fd, probe = tempfile.mkstemp(
+                        dir=os.path.dirname(args.sweep_log) or ".",
+                        prefix=os.path.basename(args.sweep_log) + ".probe.")
+                    os.close(fd)
+                    os.remove(probe)
             except OSError as e:
                 print(f"Cannot write --sweep-log={args.sweep_log!r}: {e}",
                       file=sys.stderr)

@@ -65,11 +65,15 @@ class GaussianMixture:
 
     # -- fitting ----------------------------------------------------------
 
-    def fit(self, X: np.ndarray,
+    def fit(self, X: np.ndarray, y=None, *,
             sample_weight: Optional[np.ndarray] = None) -> "GaussianMixture":
         """Fit; ``sample_weight`` ([N] nonnegative) weights every sufficient
         statistic per event (integer weights == replicated rows) -- an
-        upgrade over sklearn's GaussianMixture, whose fit() takes none."""
+        upgrade over sklearn's GaussianMixture, whose fit() takes none.
+
+        ``y`` is ignored (sklearn estimator convention: pipelines call
+        fit(X, y) positionally, so ``sample_weight`` is keyword-only to keep
+        labels from ever landing in the weight slot)."""
         X = np.asarray(X)
         if X.ndim != 2:
             raise ValueError(f"X must be [n_events, n_dims], got {X.shape}")
@@ -84,9 +88,12 @@ class GaussianMixture:
         self._model = self.result_.model or GMMModel(self.config)
         return self
 
-    def fit_predict(self, X: np.ndarray) -> np.ndarray:
-        """Fit and return the hard cluster assignment of X (sklearn surface)."""
-        return self.fit(X).predict(X)
+    def fit_predict(self, X: np.ndarray, y=None, *,
+                    sample_weight: np.ndarray | None = None) -> np.ndarray:
+        """Fit and return the hard cluster assignment of X (sklearn surface).
+
+        ``y`` is ignored; ``sample_weight`` is keyword-only (see fit())."""
+        return self.fit(X, sample_weight=sample_weight).predict(X)
 
     # -- sklearn interop (clone(), pipelines, grid search) ---------------
 
@@ -166,6 +173,22 @@ class GaussianMixture:
                     "off-diagonals) but the config requests "
                     f"covariance_type={gm.config.covariance_type!r}; load "
                     "it without --diag-only/diag config")
+        if gm.config.covariance_type == "spherical":
+            diags = np.stack([np.diag(r) for r in m["R"]])
+            if np.abs(diags - diags[:, :1]).max() > 0:
+                # Same contract as the diag guard above: scoring a
+                # non-spherical model under a spherical config would
+                # silently use the wrong densities.
+                raise ValueError(
+                    f"{path!r} holds non-spherical covariances (unequal "
+                    "variances within a cluster) but the config requests "
+                    "covariance_type='spherical'")
+        if gm.config.covariance_type == "tied" and k > 1:
+            if np.abs(m["R"] - m["R"][:1]).max() > 0:
+                raise ValueError(
+                    f"{path!r} holds per-cluster covariances (clusters "
+                    "differ) but the config requests "
+                    "covariance_type='tied'")
         dtype = jnp.float64 if gm.config.dtype == "float64" else jnp.float32
         eye = jnp.broadcast_to(jnp.eye(d, dtype=dtype), (k, d, d))
         state = GMMState(

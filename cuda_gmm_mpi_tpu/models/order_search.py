@@ -70,6 +70,16 @@ def _resume_mismatch(restored, config, log) -> bool:
         # benefit-of-the-doubt default cannot apply to them.
         cov = "pre-covariance_type (full or diag)"
     if crit == config.criterion and cov == config.covariance_type:
+        if "cov_code" not in restored and log:
+            # The family match above is an assumption, not a verification:
+            # legacy checkpoints don't record theirs. Resume proceeds (old
+            # behavior) but says so, so a diag checkpoint silently resumed
+            # under full (or vice versa) is at least diagnosable.
+            log.warning(
+                "checkpoint predates the covariance_type field; assuming it "
+                "was written under this run's family (%r) -- verify the "
+                "original run's config if results look wrong",
+                config.covariance_type)
         return False
     if log:
         log.warning(

@@ -106,6 +106,49 @@ def test_from_summary_diag_config_rejects_full_model(fitted, tmp_path):
         GaussianMixture.from_summary(path, diag_only=True)
 
 
+def test_from_summary_family_guards(fitted, tmp_path):
+    """spherical/tied configs get the same structural cross-check as diag:
+    a model whose covariances don't satisfy the requested family must be
+    rejected, not silently rescored under the wrong densities."""
+    from cuda_gmm_mpi_tpu.io.writers import write_summary
+
+    gm, data, _ = fitted
+    path = str(tmp_path / "full.summary")
+    write_summary(path, gm.result_)
+    with pytest.raises(ValueError, match="spherical"):
+        GaussianMixture.from_summary(path, covariance_type="spherical")
+    with pytest.raises(ValueError, match="tied"):
+        GaussianMixture.from_summary(path, covariance_type="tied")
+    # A genuinely spherical/tied model loads under its own family.
+    for family in ("spherical", "tied"):
+        own = GaussianMixture(2, target_components=2, covariance_type=family,
+                              min_iters=5, max_iters=5, chunk_size=128)
+        own.fit(data)
+        fpath = str(tmp_path / f"{family}.summary")
+        write_summary(fpath, own.result_)
+        back = GaussianMixture.from_summary(fpath, covariance_type=family)
+        assert back.n_components_ == own.n_components_
+
+
+def test_fit_predict_forwards_sample_weight(rng):
+    """fit_predict(X, sample_weight=...) must reach fit(): the fitted model
+    matches an explicit fit(X, sample_weight=...) exactly, and differs from
+    the unweighted fit."""
+    centers = np.array([[-8.0, -8.0], [8.0, 8.0]])
+    labels = rng.integers(0, 2, 400)
+    X = (centers[labels] + rng.normal(size=(400, 2))).astype(np.float32)
+    w = rng.uniform(0.1, 4.0, size=400).astype(np.float32)
+    kw = dict(target_components=2, min_iters=8, max_iters=8, chunk_size=128)
+    ref = GaussianMixture(2, **kw).fit(X, sample_weight=w)
+    gm = GaussianMixture(2, **kw)
+    pred = gm.fit_predict(X, sample_weight=w)
+    assert pred.shape == (400,)
+    np.testing.assert_array_equal(np.asarray(gm.means_),
+                                  np.asarray(ref.means_))
+    unw = GaussianMixture(2, **kw).fit(X)
+    assert np.abs(np.asarray(unw.means_) - np.asarray(gm.means_)).max() > 0
+
+
 def test_means_init(rng):
     """User-supplied starting means (sklearn means_init): seeded exactly
     (modulo centering) and dominant over the seeding policy."""
