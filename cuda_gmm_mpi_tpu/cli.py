@@ -305,6 +305,14 @@ def main(argv=None) -> int:
                     # files), and never remove it.
                     with open(args.sweep_log, "a"):
                         pass
+                elif os.path.lexists(args.sweep_log):
+                    # Dangling symlink: the eventual write follows the link,
+                    # so the probe must too (a sibling probe would test the
+                    # wrong directory). The append creates the resolved
+                    # target, which did not exist, so removing it is safe.
+                    with open(args.sweep_log, "a"):
+                        pass
+                    os.remove(os.path.realpath(args.sweep_log))
                 else:
                     # Absent target: probe with a unique sibling temp file
                     # so the check never creates-then-removes the target
