@@ -156,10 +156,11 @@ class GMMConfig:
                 "it cannot combine with diag_only=True")
         if self.use_pallas not in ("auto", "always", "never"):
             raise ValueError(f"unknown use_pallas: {self.use_pallas!r}")
-        if self.stream_events and self.mesh_shape is not None:
+        if (self.stream_events and self.mesh_shape is not None
+                and self.mesh_shape[1] != 1):
             raise ValueError(
-                "stream_events is single-device; use multi-host sharding "
-                "(each host streams its slice) instead of a mesh")
+                "stream_events shards events over local devices; the "
+                "cluster mesh axis must be 1 (use mesh_shape=(S, 1))")
         if self.stream_events and self.use_pallas == "always":
             raise ValueError(
                 "stream_events streams per-chunk through the jnp path; "

@@ -74,18 +74,19 @@ class GaussianMixture:
         ``y`` is ignored (sklearn estimator convention: pipelines call
         fit(X, y) positionally, so ``sample_weight`` is keyword-only to keep
         labels from ever landing in the weight slot)."""
-        if y is not None and np.asarray(y).dtype.kind == "f":
+        if y is not None:
             # Loud break for pre-y-parameter callers: fit(X, w) used to bind
-            # w to sample_weight positionally; a float array in the (ignored)
-            # label slot is almost certainly weights, and dropping it
-            # silently would change results without any signal. Integer /
-            # string y (pipeline labels) stays silent by design.
+            # w to sample_weight positionally (float OR integer multiplicity
+            # weights); dropping it silently would change results without
+            # any signal. Pipelines legitimately passing labels see the same
+            # warning once -- this estimator is unsupervised, so any y is
+            # ignored and saying so beats guessing dtypes.
             import warnings
 
             warnings.warn(
-                "fit() received a float array for y, which is ignored; "
-                "pass weights as fit(X, sample_weight=...)", UserWarning,
-                stacklevel=2)
+                "fit() ignores y (unsupervised estimator); if you meant "
+                "per-event weights, pass fit(X, sample_weight=...)",
+                UserWarning, stacklevel=2)
         X = np.asarray(X)
         if X.ndim != 2:
             raise ValueError(f"X must be [n_events, n_dims], got {X.shape}")

@@ -59,6 +59,7 @@ def fused_sweep(
     reduce_order_fn: Optional[Callable] = None,
     emit_cb: Optional[Callable] = None,
     emit_light: bool = False,
+    emit_gather_fn: Optional[Callable] = None,
 ):
     """Run the whole K-sweep on device.
 
@@ -79,6 +80,11 @@ def fused_sweep(
     ``best_state`` (pytree like ``state``), ``k``, ``step``, ``best_ll``,
     ``best_riss``, ``log`` -- all dynamic values, so resuming reuses the
     compiled executable.
+
+    ``emit_gather_fn(state_pytree)`` maps each emitted state to its FULL
+    (unsharded) form before the callback -- the hook through which the
+    cluster-sharded model all-gathers its K-shards so every host's
+    checkpoint payload is the complete model (parallel/sharded_em.py).
     """
     if reduce_order_fn is None:
         reduce_order_fn = lambda s: eliminate_and_reduce(s, diag_only=diag_only)
@@ -174,25 +180,37 @@ def fused_sweep(
             done=~cont,
         )
         if emit_cb is not None:
-            # Per-K host emission (checkpoint payload + log row): ordered so
-            # a checkpoint for step s is durable before step s+1's runs.
+            # Per-K host emission (checkpoint payload + log row).
             # ``emit_light`` ships only the scalars (profiling wants just
             # the arrival timestamp -- no per-K state transfer).
             if emit_light:
                 payload = dict(step=c["step"], done=new_carry["done"])
             else:
+                gather = emit_gather_fn or (lambda t: t)
                 payload = dict(
                     step=c["step"], k=k, ll=ll, riss=riss, iters=iters,
-                    state=new_carry["state"],
-                    best_state=best_state,
+                    state=gather(new_carry["state"]),
+                    best_state=gather(best_state),
                     best_ll=new_carry["best_ll"],
                     best_riss=new_carry["best_riss"],
                     log=log,
                     next_k=new_carry["k"],
                     done=new_carry["done"],
                 )
-            jax.experimental.io_callback(emit_cb, None, payload,
-                                         ordered=True)
+            # ``ordered=True`` sequences callbacks but does NOT make the
+            # device wait for them -- an enqueued-only emission could drain
+            # entirely after the program ends, so a crash would lose every
+            # "checkpoint" ever emitted. Returning a token and threading it
+            # into the carry (behind an optimization_barrier, or XLA folds
+            # the x*0-like dependence away) forces step s's emission to
+            # COMPLETE -- checkpoint durable on disk -- before step s+1
+            # computes. Costs one host round trip per K, only when emission
+            # is enabled; the emission-free path stays zero-roundtrip.
+            token = jax.experimental.io_callback(
+                emit_cb, jax.ShapeDtypeStruct((), jnp.int32), payload,
+                ordered=True)
+            new_carry["step"] = lax.optimization_barrier(
+                (new_carry["step"], token))[0]
         return new_carry
 
     out = lax.while_loop(cond, body, carry0)

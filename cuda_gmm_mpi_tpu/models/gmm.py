@@ -207,6 +207,9 @@ class GMMModel:
                 target = self._emit_target
                 if target is not None:
                     target(payload)
+                # Completion token: fused_sweep threads it into the carry so
+                # the device waits for the emission (checkpoint durability).
+                return np.int32(0)
 
         return cached_fused_sweep(
             self, dict(static, with_emit=with_emit, emit_light=emit_light),

@@ -289,8 +289,6 @@ def fit_gmm(
         # fall back to the host-driven sweep.
         want_emit = ckpt is not None or timer is not None
         blockers = []
-        if ckpt is not None and nproc > 1:
-            blockers.append("checkpointing on a multi-controller run")
         maker = getattr(model, "make_fused_sweep", None)
         if maker is None:
             blockers.append("model without fused-sweep support")
@@ -717,8 +715,17 @@ def _run_fused_sweep(fused, config, state, chunks, wts, epsilon,
                                 "sweep cannot resume it -- starting fresh")
             else:
                 state = restored["state"]
+                best_state_r = restored["best_state"]
+                if hasattr(model, "prepare_state"):
+                    # Sharded model: pad K to the cluster axis and place the
+                    # restored (host-local, replicated-on-every-rank) states
+                    # on the mesh; the data chunks were prepared already.
+                    state = model.prepare_state(
+                        jax.tree_util.tree_map(jnp.asarray, state))
+                    best_state_r = model.prepare_state(
+                        jax.tree_util.tree_map(jnp.asarray, best_state_r))
                 resume = dict(
-                    best_state=restored["best_state"],
+                    best_state=best_state_r,
                     k=int(restored["k"]),
                     step=int(restored["step"]) + 1,
                     best_ll=float(restored["best_ll"]),
@@ -738,10 +745,24 @@ def _run_fused_sweep(fused, config, state, chunks, wts, epsilon,
             # Arrival time of each per-K emission: real per-K wall seconds
             # for the sweep log / profile (the emission-free fused path can
             # only amortize; individual K timings don't exist off-device).
-            emit_times[int(payload["step"])] = time.perf_counter()
+            # First arrival per step wins: on a sharded model the callback
+            # fires once per LOCAL device shard with identical payloads
+            # (cluster shards pre-gathered), and since each device's stream
+            # is ordered, first arrivals are monotonic in step -- so this
+            # dedupe also keeps checkpoint saves in step order and saves
+            # exactly once per step per process (orbax coordinates the
+            # per-process saves on multi-controller runs).
+            step = int(payload["step"])
+            if step in emit_times:
+                return
+            emit_times[step] = time.perf_counter()
             if ckpt is None or bool(payload["done"]):
                 return  # a finished run returns its result right after
-            ckpt.save(int(payload["step"]), {
+            # save_local, NOT save: this runs inside the ordered io_callback
+            # while the device program is blocked on its completion -- the
+            # collective orbax save would deadlock the job (checkpoint.py
+            # module docstring).
+            ckpt.save_local(step, {
                 "state": payload["state"],
                 "best_state": payload["best_state"],
                 "k": int(payload["next_k"]),

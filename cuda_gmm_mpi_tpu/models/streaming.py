@@ -9,20 +9,37 @@ statistics on device -- the device working set is one chunk plus the
 [K, D, D]-sized statistics, so N is bounded by host RAM, not HBM (e.g.
 400M x 24 float32 events = 38 GB host is fine on a 16 GB chip).
 
+Two engineering properties matter at that scale:
+
+- **All local devices stay busy** (``mesh_shape=(S, 1)``): each streamed
+  block is S chunks placed sharded over the ``data`` mesh axis, every
+  device computes its chunk's statistics in parallel, and one psum merges
+  them at the end of the pass -- the reference's analog kept every GPU fed
+  from host-staged shards (``gaussian.cu:347-377``); a single-device stream
+  on an 8-chip host would idle 7/8 of the machine.
+- **Transfer/compute overlap**: the NEXT block's host->device copy is
+  enqueued before this block's compute is dispatched (double-buffering), so
+  the PCIe/ICI copy of block j+1 rides under the device compute of block j
+  instead of serializing with it.
+
 The price is the single-jit EM loop: iteration control returns to the host
 (num_chunks dispatches per iteration instead of zero). Use it only when the
 data genuinely exceeds device memory; the in-memory model is strictly faster
 otherwise. Loop semantics (estep0; while cond: mstep; estep) and all guards
 are shared with ``em_while_loop`` via the same ops and the same
 chunk-sequential accumulation order, so trajectories match the in-memory
-path to summation-order noise (the CLI outputs are byte-identical).
+path to summation-order noise (the CLI outputs are byte-identical). On a
+mesh, chunk j of shard d is the in-memory sharded model's chunk ``d*Cl + j``
+and the final cross-shard merge is the same psum collective, so the sharded
+trajectories line up the same way.
 
-Single-process, single-device by design: multi-host runs already shard the
-data N-ways (per-host slices), which is the first remedy for N too big for
-one chip. A ``GMMModel`` subclass, so ``fit_gmm``, the model-order search,
-and the whole inference/output surface drive it unchanged; the fused
-whole-sweep path is disabled (it needs device-resident data) and falls back
-to the host-driven sweep.
+Single-process by design: multi-host runs already shard the data N-ways
+(per-host slices), which is the first remedy for N too big for one chip.
+The cluster mesh axis must be 1 (events are what overflow memory, not K).
+A ``GMMModel`` subclass, so ``fit_gmm``, the model-order search, and the
+whole inference/output surface drive it unchanged; the fused whole-sweep
+path is disabled (it needs device-resident data) and falls back to the
+host-driven sweep.
 """
 
 from __future__ import annotations
@@ -32,6 +49,8 @@ from typing import Optional
 import jax
 import jax.numpy as jnp
 import numpy as np
+from jax import lax
+from jax.sharding import NamedSharding, PartitionSpec as P
 
 from ..config import GMMConfig
 from ..ops.mstep import apply_mstep, chunk_stats
@@ -43,13 +62,22 @@ class StreamingGMMModel(GMMModel):
 
     supports_fused_emit = False
     make_fused_sweep = None  # no fused sweep: data is not on device
+    data_size = 1  # overridden per-instance when a mesh is configured
 
     def __init__(self, config: GMMConfig = GMMConfig()):
+        self.mesh = None
         if config.mesh_shape is not None:
-            raise ValueError(
-                "stream_events is single-device; for data too large for one "
-                "chip ALSO consider multi-host sharding (each host streams "
-                "its slice)")
+            from ..parallel.mesh import CLUSTER_AXIS, DATA_AXIS, make_mesh
+
+            mesh = make_mesh(config.mesh_shape)
+            if mesh.shape[CLUSTER_AXIS] != 1:
+                # Config.__post_init__ enforces this too; keep the direct
+                # construction path honest.
+                raise ValueError(
+                    "stream_events shards events only; the cluster mesh "
+                    "axis must be 1")
+            self.mesh = mesh
+            self.data_size = mesh.shape[DATA_AXIS]
         if config.use_pallas == "always":
             raise ValueError(
                 "stream_events streams per-chunk through the jnp path; "
@@ -76,22 +104,126 @@ class StreamingGMMModel(GMMModel):
         self._add = _add
         self._mstep = _mstep
 
+        if self.mesh is not None:
+            from ..parallel.mesh import DATA_AXIS
+
+            self._data_axis = DATA_AXIS
+            self._x_sharding_stream = NamedSharding(
+                self.mesh, P(DATA_AXIS, None, None))
+            self._w_sharding_stream = NamedSharding(
+                self.mesh, P(DATA_AXIS, None))
+
+            @jax.jit
+            def _stats_block(state, xb, wb):
+                # [S, B, D] block sharded on the leading (shard) axis; the
+                # vmap keeps every shard's statistics independent, so XLA
+                # partitions this with zero communication.
+                return jax.vmap(
+                    lambda x, w: chunk_stats(state, x, w, **kw))(xb, wb)
+
+            self._stats_block = _stats_block
+            self._reduce_fn = None  # built lazily (leaf ranks known then)
+        self._block_major = False  # set by prepare()'s mesh layout pass
+
     def prepare(self, state, chunks_np, wts_np, host_local: bool = False):
-        """Keep the chunk arrays HOST-side; only the state goes on device."""
+        """Keep the chunk arrays HOST-side; only the state goes on device.
+
+        On a mesh this also (a) pads the chunk count to a multiple of the
+        data axis with zero-weight chunks (zero weight = zero contribution
+        to every statistic, the same contract chunk padding already uses),
+        and (b) reorders chunks block-major -- block j holding shard d's
+        chunk ``d*blocks + j`` contiguously -- so the per-pass strided
+        gather in ``_put_block`` becomes a free contiguous view instead of
+        a full extra host copy of the dataset every EM iteration."""
         del host_local  # single-process
+        chunks_np, wts_np = np.asarray(chunks_np), np.asarray(wts_np)
+        S = self.data_size
+        if S > 1:
+            n = chunks_np.shape[0]
+            pad = (-n) % S
+            if pad:
+                chunks_np = np.concatenate(
+                    [chunks_np, np.zeros((pad,) + chunks_np.shape[1:],
+                                         chunks_np.dtype)])
+                wts_np = np.concatenate(
+                    [wts_np, np.zeros((pad,) + wts_np.shape[1:],
+                                      wts_np.dtype)])
+                n += pad
+            blocks = n // S
+            order = (np.arange(n).reshape(S, blocks).T).ravel()
+            chunks_np = np.ascontiguousarray(chunks_np[order])
+            wts_np = np.ascontiguousarray(wts_np[order])
+            self._block_major = True
         return (jax.tree_util.tree_map(jnp.asarray, state),
-                np.asarray(chunks_np), np.asarray(wts_np))
+                chunks_np, wts_np)
 
     def prepare_state(self, state):
         return jax.tree_util.tree_map(jnp.asarray, state)
 
+    def _make_reduce(self, acc):
+        """psum the per-shard statistics over the data axis -- the SAME
+        collective the in-memory sharded model ends its pass with, so the
+        merged values match it bitwise, not just to reduction-order noise."""
+        from ..parallel.sharded_em import shard_map  # version-guarded import
+
+        axis = self._data_axis
+        in_specs = (jax.tree_util.tree_map(
+            lambda a: P(axis, *([None] * (a.ndim - 1))), acc),)
+        out_specs = jax.tree_util.tree_map(lambda a: P(), acc)
+
+        def body(t):
+            return jax.tree_util.tree_map(
+                lambda a: lax.psum(a[0], axis), t)
+
+        return jax.jit(shard_map(body, mesh=self.mesh, in_specs=in_specs,
+                                 out_specs=out_specs, check_vma=False))
+
+    def _put_block(self, chunks, wts, j: int, blocks: int):
+        """Enqueue block j's host->device copy (async; double-buffered by
+        the caller). On a mesh the block is S chunks -- shard d gets chunk
+        ``d*blocks + j`` of the original grid, the exact chunk the
+        in-memory sharded model assigns it -- placed sharded over the data
+        axis. ``prepare`` lays the chunks out block-major, so the block is
+        a contiguous zero-copy view; un-prepared arrays fall back to the
+        strided gather."""
+        if self.mesh is None:
+            return (jnp.asarray(chunks[j]), jnp.asarray(wts[j]))
+        if self._block_major:
+            S = self.data_size
+            sel_c, sel_w = chunks[j * S:(j + 1) * S], wts[j * S:(j + 1) * S]
+        else:
+            sel_c = np.ascontiguousarray(chunks[j::blocks])
+            sel_w = np.ascontiguousarray(wts[j::blocks])
+        return (jax.device_put(sel_c, self._x_sharding_stream),
+                jax.device_put(sel_w, self._w_sharding_stream))
+
     def _estep_all(self, state, chunks, wts):
-        """One full-data fused E+M pass, streaming chunk by chunk."""
+        """One full-data fused E+M pass, streaming block by block."""
+        n = chunks.shape[0]
+        if self.mesh is None:
+            blocks, stats_fn = n, self._chunk_stats_jit
+        else:
+            if n % self.data_size:
+                raise ValueError(
+                    f"chunk count {n} is not a multiple of the data mesh "
+                    f"axis {self.data_size}; pass the chunk arrays through "
+                    "prepare() (it pads with zero-weight chunks)")
+            blocks, stats_fn = n // self.data_size, self._stats_block
         acc = None
-        for i in range(chunks.shape[0]):
-            s = self._chunk_stats_jit(state, jnp.asarray(chunks[i]),
-                                      jnp.asarray(wts[i]))
+        nxt = self._put_block(chunks, wts, 0, blocks)
+        for j in range(blocks):
+            cur = nxt
+            if j + 1 < blocks:
+                # Double-buffer: enqueue block j+1's copy BEFORE dispatching
+                # block j's compute, so the transfer overlaps the compute
+                # instead of serializing behind it.
+                nxt = self._put_block(chunks, wts, j + 1, blocks)
+            s = stats_fn(state, *cur)
             acc = s if acc is None else self._add(acc, s)
+        if self.mesh is not None:
+            if self._reduce_fn is None:
+                self._reduce_fn = self._make_reduce(acc)
+            acc = self._reduce_fn(acc)
         return acc
 
     def run_em(self, state, chunks, wts, epsilon,

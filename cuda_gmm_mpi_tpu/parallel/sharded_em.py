@@ -94,9 +94,15 @@ class ShardedGMMModel:
     bespoke MPI/OpenMP plumbing through every step of main()).
     """
 
+    # Per-K fused-sweep emission is supported: the io_callback fires once
+    # per local device shard (cluster shards all-gathered to full state
+    # first); the host sink dedupes by step. See make_fused_sweep.
+    supports_fused_emit = True
+
     def __init__(self, config: GMMConfig = GMMConfig(), mesh=None,
                  stats_fn=None):
         self.config = config
+        self._emit_target = None  # host sink for fused-sweep per-K emission
         self.mesh = mesh if mesh is not None else make_mesh(config.mesh_shape)
         self.data_size = self.mesh.shape[DATA_AXIS]
         self.cluster_size = self.mesh.shape[CLUSTER_AXIS]
@@ -243,7 +249,8 @@ class ShardedGMMModel:
             jnp.asarray(epsilon, data_chunks.dtype), lo, hi,
         )
 
-    def make_fused_sweep(self, **static):
+    def make_fused_sweep(self, with_emit: bool = False,
+                         emit_light: bool = False, **static):
         """Whole-sweep-on-device under shard_map, any mesh layout.
 
         On cluster-sharded meshes the order-reduction step all-gathers the
@@ -251,6 +258,14 @@ class ShardedGMMModel:
         elimination + pair scan + merge replicated, and re-slices each
         shard's rows -- the pair scan needs the full K-state, which each
         device otherwise only holds 1/cluster_size of.
+
+        ``with_emit=True`` compiles in the per-K ordered ``io_callback``
+        (checkpoint/profile hook, same contract as the plain model's): the
+        callback fires once per LOCAL device shard with the FULL state
+        (cluster shards all-gathered first), so every process -- including
+        each rank of a multi-controller run -- observes a complete
+        checkpoint payload per K and the host sink dedupes arrivals by
+        step (order_search._run_fused_sweep).
         """
         from ..models.fused_sweep import fused_sweep
         from ..models.gmm import cached_fused_sweep
@@ -258,6 +273,24 @@ class ShardedGMMModel:
 
         cluster_axis = CLUSTER_AXIS if self.cluster_size > 1 else None
         diag_only = self._kw["diag_only"]
+
+        emit_cb = emit_gather_fn = None
+        if with_emit:
+            def emit_cb(payload):
+                target = self._emit_target
+                if target is not None:
+                    target(payload)
+                # Completion token (see fused_sweep): the device waits for
+                # the emission, bounding crash loss to one step.
+                return np.int32(0)
+
+            if cluster_axis is not None and not emit_light:
+                def emit_gather_fn(state):
+                    return jax.tree_util.tree_map(
+                        lambda a: lax.all_gather(a, cluster_axis, axis=0,
+                                                 tiled=True),
+                        state,
+                    )
 
         reduce_order_fn = None
         if cluster_axis is not None:
@@ -287,22 +320,54 @@ class ShardedGMMModel:
                 cluster_axis=cluster_axis,
                 covariance_type=self.config.covariance_type,
                 criterion=self.config.criterion,
-                reduce_order_fn=reduce_order_fn, **self._kw, **static,
+                reduce_order_fn=reduce_order_fn, emit_cb=emit_cb,
+                emit_light=emit_light, emit_gather_fn=emit_gather_fn,
+                **self._kw, **static,
             )
             sspec = state_pspecs()
             scalar = P()
-            return jax.jit(
-                shard_map(
-                    sweep_fn,
-                    mesh=self.mesh,
-                    in_specs=(sspec, P(DATA_AXIS, None, None),
-                              P(DATA_AXIS, None), scalar, scalar, scalar),
-                    out_specs=(sspec, scalar, scalar, scalar, scalar),
-                    check_vma=False,
-                )
+            base_in = (sspec, P(DATA_AXIS, None, None),
+                       P(DATA_AXIS, None), scalar, scalar, scalar)
+            out_specs = (sspec, scalar, scalar, scalar, scalar)
+            # Resume changes the arg pytree (an extra sweep-position dict),
+            # so the two variants are separate shard_maps; both live behind
+            # one cached callable with the plain model's calling convention
+            # (positional optional resume).
+            resume_spec = dict(
+                best_state=sspec, best_ll=scalar, best_riss=scalar,
+                k=scalar, log=scalar, step=scalar,
             )
+            variants = {}
 
-        return cached_fused_sweep(self, static, build)
+            def get(with_resume: bool):
+                fn = variants.get(with_resume)
+                if fn is None:
+                    if with_resume:
+                        body = lambda s, c, w, e, lo, hi, r: sweep_fn(
+                            s, c, w, e, lo, hi, r)
+                        in_specs = base_in + (resume_spec,)
+                    else:
+                        body = lambda s, c, w, e, lo, hi: sweep_fn(
+                            s, c, w, e, lo, hi)
+                        in_specs = base_in
+                    fn = variants[with_resume] = jax.jit(
+                        shard_map(body, mesh=self.mesh, in_specs=in_specs,
+                                  out_specs=out_specs, check_vma=False)
+                    )
+                return fn
+
+            def run(state, chunks, wts, eps, lo, hi, resume=None):
+                if resume is None:
+                    return get(False)(state, chunks, wts, eps, lo, hi)
+                resume = {k: jax.tree_util.tree_map(jnp.asarray, v)
+                          for k, v in resume.items()}
+                return get(True)(state, chunks, wts, eps, lo, hi, resume)
+
+            return run
+
+        return cached_fused_sweep(
+            self, dict(static, with_emit=with_emit, emit_light=emit_light),
+            build)
 
     @property
     def inference_block(self) -> int:

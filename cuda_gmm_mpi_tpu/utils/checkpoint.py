@@ -10,6 +10,25 @@ resumes at the next K instead of restarting the whole search.
 Layout: ``<dir>/sweep/<step>/`` orbax PyTree checkpoints, where step counts
 completed EM runs. The stored tree carries the current (possibly merged)
 state, the best-so-far state, and the sweep scalars.
+
+Two write paths share that layout:
+
+- **Collective (orbax)** -- the host-driven sweep: every rank calls
+  ``save``, orbax coordinates (primary host writes), with a cross-process
+  barrier. Safe only from the MAIN thread: the barrier executes a device
+  collective.
+- **Callback-safe (``<step>.npz``)** -- the fused sweep: ``save`` is
+  invoked from inside an ordered ``io_callback`` while the device is
+  mid-program and BLOCKED on the callback's completion, so it must never
+  dispatch device work (an orbax barrier here deadlocks the whole job:
+  the barrier's collective waits for the sweep, the sweep waits for the
+  callback, the callback waits for the barrier). Process 0 alone writes a
+  flat ``np.savez`` atomically (tmp + ``os.replace``); no barrier is
+  needed because the emitted payload is identical on every rank
+  (replicated state, cluster shards pre-gathered).
+
+``restore`` reads either format; mixing them in one directory resolves to
+the newest step.
 """
 
 from __future__ import annotations
@@ -55,17 +74,70 @@ class SweepCheckpointer:
         self._ckpt.save(path, tree, force=True)
         self._ckpt.wait_until_finished()
 
+    def save_local(self, step: int, payload: Dict[str, Any]) -> None:
+        """Callback-safe save: no device work, no cross-process barrier.
+
+        Process 0 writes ``<step>.npz`` atomically; other ranks return
+        immediately (every rank holds the identical replicated payload, so
+        one durable copy on the shared checkpoint FS is the whole story).
+        Safe to call from inside an ordered ``io_callback`` -- the ONLY
+        save path that is (see module docstring for the deadlock).
+        """
+        import jax
+
+        if jax.process_index() != 0:
+            return
+        tree = dict(payload)
+        tree["state"] = _to_tree(payload["state"])
+        tree["best_state"] = _to_tree(payload["best_state"])
+        flat = {}
+        for key, val in tree.items():
+            if isinstance(val, dict):
+                for leaf, arr in val.items():
+                    flat[f"{key}.{leaf}"] = np.asarray(arr)
+            else:
+                flat[key] = np.asarray(val)
+        tmp = os.path.join(self._dir, f".tmp.{step}.npz")
+        with open(tmp, "wb") as f:
+            np.savez(f, **flat)
+            # The durability contract ("checkpoint s on disk before step
+            # s+1 computes", fused_sweep.py) must survive a HOST crash, not
+            # just a process kill: flush+fsync the data before the atomic
+            # rename, then fsync the directory so the rename itself is
+            # durable.
+            f.flush()
+            os.fsync(f.fileno())
+        os.replace(tmp, os.path.join(self._dir, f"{step}.npz"))
+        dir_fd = os.open(self._dir, os.O_RDONLY)
+        try:
+            os.fsync(dir_fd)
+        finally:
+            os.close(dir_fd)
+
     def latest_step(self) -> Optional[int]:
         if not os.path.isdir(self._dir):
             return None
         steps = [int(d) for d in os.listdir(self._dir) if d.isdigit()]
+        steps += [int(f[:-4]) for f in os.listdir(self._dir)
+                  if f.endswith(".npz") and f[:-4].isdigit()]
         return max(steps) if steps else None
 
     def restore(self, step: Optional[int] = None) -> Optional[Dict[str, Any]]:
         step = self.latest_step() if step is None else step
         if step is None:
             return None
-        tree = self._ckpt.restore(os.path.join(self._dir, str(step)))
+        npz = os.path.join(self._dir, f"{step}.npz")
+        if os.path.exists(npz):
+            with np.load(npz) as z:
+                tree: Dict[str, Any] = {}
+                for key in z.files:
+                    if "." in key:
+                        group, leaf = key.split(".", 1)
+                        tree.setdefault(group, {})[leaf] = z[key]
+                    else:
+                        tree[key] = z[key]
+        else:
+            tree = self._ckpt.restore(os.path.join(self._dir, str(step)))
         tree["state"] = _from_tree(tree["state"])
         tree["best_state"] = _from_tree(tree["best_state"])
         tree["step"] = step

@@ -1,0 +1,42 @@
+#!/bin/bash
+# Round-4 TPU measurement session (run when the axon tunnel is ALIVE).
+#
+# One-shot, resumable: each step logs to $LOGDIR/<step>.log and is skipped
+# on re-run if that log ends with DONE -- the round-3 lesson (a 7h tunnel
+# outage killed the measurement story) is to capture everything the moment
+# the tunnel is up, most-important first, with per-step durability.
+#
+# Protocol notes (.claude/skills/verify/SKILL.md): generous budgets, no
+# tight `timeout` wrappers (a killed mid-execution client wedges the
+# single-admission tunnel), amortized timing inside each script.
+set -u
+cd "$(dirname "$0")/.."
+LOGDIR=${LOGDIR:-hw_r04_logs}
+mkdir -p "$LOGDIR"
+
+step() {
+  local name=$1; shift
+  local log="$LOGDIR/$name.log"
+  if [ -f "$log" ] && grep -q "^DONE$" "$log"; then
+    echo "== $name: already done, skipping"
+    return 0
+  fi
+  echo "== $name: $*"
+  { "$@" && echo DONE; } 2>&1 | tee "$log"
+}
+
+# 1. The official bench (BENCH_r04 rehearsal): north-star on TPU.
+step bench_north python bench.py
+# 2. Kernel-vs-XLA decision data (the ~5.6 ms/iter xouter HBM win).
+step kernel_north python examples/bench_kernel_precision.py north --blocks=256,512,1024
+step kernel_envelope_diag python examples/bench_kernel_precision.py envelope diag --blocks=256,512
+# 3. Config matrix incl. 5 (fresh same-session CPU denominator rides in
+#    bench.py's in-process baseline) and the reference envelope 6.
+step bench_5 python bench.py --config=5
+step bench_6 python bench.py --config=6
+step bench_3_diag python bench.py --config=3
+# 4. Streaming overlap: double-buffered out-of-core vs in-memory (item 6).
+step stream_overlap python examples/bench_streaming.py --n=4000000 --iters=10
+# (MFU profiling, item 3, is interactive: jax.profiler traces at the
+# north-star shape once the kernel decision from step 2 is in.)
+echo "session complete; logs in $LOGDIR/"

@@ -54,9 +54,98 @@ def test_streaming_estimator_and_weights(rng):
     assert pred.shape == (600,)
 
 
+def test_streaming_mesh_matches_sharded_in_memory(rng):
+    """Streaming over a local data mesh keeps every device busy AND
+    reproduces the in-memory sharded model's trajectory: per-shard chunk
+    assignment and the final psum are identical, so the fits agree to
+    float64 noise (VERDICT r3 item 5)."""
+    data, _ = make_blobs(rng, n=2100, d=3, k=3, dtype=np.float64)
+    kw = dict(min_iters=5, max_iters=5, chunk_size=64, dtype="float64",
+              mesh_shape=(8, 1))
+    r_mem = fit_gmm(data, 5, 2, GMMConfig(**kw))
+    r_str = fit_gmm(data, 5, 2, GMMConfig(stream_events=True, **kw))
+    assert r_str.ideal_num_clusters == r_mem.ideal_num_clusters
+    np.testing.assert_allclose(r_str.final_loglik, r_mem.final_loglik,
+                               rtol=1e-12)
+    np.testing.assert_allclose(r_str.means, r_mem.means, rtol=1e-10)
+    np.testing.assert_allclose(r_str.covariances, r_mem.covariances,
+                               rtol=1e-9, atol=1e-12)
+    for (k1, ll1, *_), (k2, ll2, *_) in zip(r_str.sweep_log, r_mem.sweep_log):
+        assert k1 == k2
+        np.testing.assert_allclose(ll1, ll2, rtol=1e-12)
+
+
+def test_streaming_mesh_cli_byte_identical(tmp_path):
+    """--stream-events --mesh=8 produces byte-identical .summary/.results
+    to the in-memory --mesh=8 run (the CLI-level contract of item 5)."""
+    from cuda_gmm_mpi_tpu.cli import main
+
+    rng = np.random.default_rng(3)
+    centers = rng.normal(scale=10.0, size=(4, 4))
+    x = (centers[rng.integers(0, 4, 3000)]
+         + rng.normal(size=(3000, 4))).astype(np.float32)
+    csv = tmp_path / "ev.csv"
+    csv.write_text("a,b,c,d\n" + "\n".join(
+        ",".join(f"{v:.6f}" for v in r) for r in x))
+
+    def run(tag, extra):
+        out = tmp_path / tag
+        rc = main(["6", str(csv), str(out), "4", "--mesh=8",
+                   "--min-iters=6", "--max-iters=6", *extra])
+        assert rc == 0
+        return (out.with_suffix(".summary").read_bytes(),
+                out.with_suffix(".results").read_bytes())
+
+    s_mem, m_mem = run("mem", [])
+    s_str, m_str = run("str", ["--stream-events"])
+    assert s_str == s_mem
+    assert m_str == m_mem
+
+
+@pytest.mark.slow
+def test_streaming_mesh_host_bounded_rss(tmp_path):
+    """The mesh-streaming path must not materialize the device-resident
+    dataset: fitting with a data array much larger than the per-block
+    working set keeps the process RSS growth far below a full-device
+    upload's footprint (O(blocks) transfers, O(1) residency)."""
+    import subprocess
+    import sys
+
+    from .conftest import worker_env
+
+    code = r"""
+import jax
+jax.config.update("jax_platforms", "cpu")
+jax.config.update("jax_num_cpu_devices", 8)
+import numpy as np, resource
+from cuda_gmm_mpi_tpu.config import GMMConfig
+from cuda_gmm_mpi_tpu.models import fit_gmm
+
+rng = np.random.default_rng(0)
+n, d = 2_000_000, 8
+data = rng.normal(size=(n, d)).astype(np.float32)  # 64 MB host-side
+base = resource.getrusage(resource.RUSAGE_SELF).ru_maxrss
+cfg = GMMConfig(min_iters=2, max_iters=2, chunk_size=4096,
+                stream_events=True, mesh_shape=(8, 1))
+r = fit_gmm(data, 2, 2, config=cfg)
+peak = resource.getrusage(resource.RUSAGE_SELF).ru_maxrss
+# ru_maxrss is KB on linux. Allow generous jit/runtime overhead but stay
+# far under a second full copy of the dataset on device (64 MB) --
+# streaming holds ~8 chunks x 4096 x 8 x 4B = 1 MB of blocks at a time.
+growth_mb = (peak - base) / 1024.0
+print("GROWTH_MB", growth_mb, "LL", float(r.final_loglik))
+assert growth_mb < 45.0, growth_mb
+"""
+    r = subprocess.run([sys.executable, "-c", code], capture_output=True,
+                       text=True, env=worker_env(), timeout=600)
+    assert r.returncode == 0, f"{r.stdout}\n{r.stderr[-3000:]}"
+    assert "GROWTH_MB" in r.stdout
+
+
 def test_streaming_guards(rng):
-    with pytest.raises(ValueError, match="single-device"):
+    with pytest.raises(ValueError, match="cluster mesh axis"):
         GMMConfig(stream_events=True, mesh_shape=(4, 2))
+    GMMConfig(stream_events=True, mesh_shape=(8, 1))  # data-only mesh: OK
     with pytest.raises(ValueError, match="use_pallas"):
         GMMConfig(stream_events=True, use_pallas="always")
     # fused sweep falls back to the host-driven sweep (no device-resident
