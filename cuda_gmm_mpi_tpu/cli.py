@@ -137,7 +137,8 @@ def build_parser() -> argparse.ArgumentParser:
                    help="out-of-core mode: event chunks stay in host RAM "
                    "and stream through the device per E+M pass (N bounded "
                    "by host memory, not HBM; slower -- use only when the "
-                   "data exceeds device memory)")
+                   "data exceeds device memory). Composes with --mesh=S to "
+                   "stream blocks sharded over S local devices")
     t.add_argument("--checkpoint-dir", default=None,
                    help="orbax checkpoint directory for the K-sweep (resume "
                    "with the same path)")
@@ -306,13 +307,20 @@ def main(argv=None) -> int:
                     with open(args.sweep_log, "a"):
                         pass
                 elif os.path.lexists(args.sweep_log):
-                    # Dangling symlink: the eventual write follows the link,
-                    # so the probe must too (a sibling probe would test the
-                    # wrong directory). The append creates the resolved
-                    # target, which did not exist, so removing it is safe.
-                    with open(args.sweep_log, "a"):
-                        pass
-                    os.remove(os.path.realpath(args.sweep_log))
+                    # Dangling symlink: the eventual write follows the
+                    # link, so probe the RESOLVED parent directory (a
+                    # sibling probe next to the symlink would test the
+                    # wrong filesystem) -- with a unique temp file, never
+                    # by creating/removing the real target, which could
+                    # delete a concurrent process's freshly written log.
+                    import tempfile
+
+                    target = os.path.realpath(args.sweep_log)
+                    fd, probe = tempfile.mkstemp(
+                        dir=os.path.dirname(target) or ".",
+                        prefix=os.path.basename(target) + ".probe.")
+                    os.close(fd)
+                    os.remove(probe)
                 else:
                     # Absent target: probe with a unique sibling temp file
                     # so the check never creates-then-removes the target

@@ -741,6 +741,10 @@ def _run_fused_sweep(fused, config, state, chunks, wts, epsilon,
     with_emit = ckpt is not None or timer is not None
     emit_times = {}
     if with_emit:
+        import threading
+
+        emit_lock = threading.Lock()
+
         def emit(payload):
             # Arrival time of each per-K emission: real per-K wall seconds
             # for the sweep log / profile (the emission-free fused path can
@@ -753,9 +757,13 @@ def _run_fused_sweep(fused, config, state, chunks, wts, epsilon,
             # exactly once per step per process (orbax coordinates the
             # per-process saves on multi-controller runs).
             step = int(payload["step"])
-            if step in emit_times:
-                return
-            emit_times[step] = time.perf_counter()
+            with emit_lock:
+                # Atomic test-and-set: arrivals from different local
+                # devices run on separate callback threads, and two of
+                # them racing past an unlocked check would both save.
+                if step in emit_times:
+                    return
+                emit_times[step] = time.perf_counter()
             if ckpt is None or bool(payload["done"]):
                 return  # a finished run returns its result right after
             # save_local, NOT save: this runs inside the ordered io_callback

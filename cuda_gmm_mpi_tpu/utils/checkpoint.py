@@ -97,14 +97,18 @@ class SweepCheckpointer:
                     flat[f"{key}.{leaf}"] = np.asarray(arr)
             else:
                 flat[key] = np.asarray(val)
-        tmp = os.path.join(self._dir, f".tmp.{step}.npz")
-        with open(tmp, "wb") as f:
+        import tempfile
+
+        fd, tmp = tempfile.mkstemp(dir=self._dir, suffix=".tmp.npz")
+        with os.fdopen(fd, "wb") as f:
             np.savez(f, **flat)
             # The durability contract ("checkpoint s on disk before step
             # s+1 computes", fused_sweep.py) must survive a HOST crash, not
             # just a process kill: flush+fsync the data before the atomic
             # rename, then fsync the directory so the rename itself is
-            # durable.
+            # durable. The tmp name is mkstemp-unique so concurrent savers
+            # (racing callback threads) can never interleave writes into
+            # one file.
             f.flush()
             os.fsync(f.fileno())
         os.replace(tmp, os.path.join(self._dir, f"{step}.npz"))
@@ -114,18 +118,45 @@ class SweepCheckpointer:
         finally:
             os.close(dir_fd)
 
-    def latest_step(self) -> Optional[int]:
+    def _all_steps(self) -> list:
         if not os.path.isdir(self._dir):
-            return None
+            return []
         steps = [int(d) for d in os.listdir(self._dir) if d.isdigit()]
         steps += [int(f[:-4]) for f in os.listdir(self._dir)
                   if f.endswith(".npz") and f[:-4].isdigit()]
+        return steps
+
+    def latest_step(self) -> Optional[int]:
+        steps = self._all_steps()
         return max(steps) if steps else None
 
     def restore(self, step: Optional[int] = None) -> Optional[Dict[str, Any]]:
-        step = self.latest_step() if step is None else step
-        if step is None:
-            return None
+        """Load the requested (default: newest) step. With no explicit
+        ``step``, an unreadable newest checkpoint (e.g. torn by a crash on
+        a filesystem without rename atomicity) falls back to the next
+        older one instead of wedging resume -- losing one step beats
+        losing the run."""
+        if step is not None:
+            return self._restore_step(step)
+        steps = self._all_steps()
+        for s in sorted(steps, reverse=True):
+            try:
+                return self._restore_step(s)
+            except Exception as e:
+                # Loud fallback: a systematic failure (permissions, numpy
+                # version skew) would otherwise masquerade as a clean
+                # resume from a much older step.
+                import warnings
+
+                warnings.warn(
+                    f"checkpoint step {s} unreadable "
+                    f"({type(e).__name__}: {e}); falling back to the "
+                    "previous step", RuntimeWarning)
+                if s == min(steps):
+                    raise
+        return None
+
+    def _restore_step(self, step: int) -> Dict[str, Any]:
         npz = os.path.join(self._dir, f"{step}.npz")
         if os.path.exists(npz):
             with np.load(npz) as z:

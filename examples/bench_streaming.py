@@ -37,6 +37,12 @@ def main() -> int:
             mesh = int(val)
 
     import jax
+
+    for a in sys.argv[1:]:
+        if a.startswith("--device="):
+            # Env JAX_PLATFORMS is not authoritative on this image
+            # (sitecustomize re-pins it); config.update is.
+            jax.config.update("jax_platforms", a.split("=", 1)[1])
     import jax.numpy as jnp
 
     from cuda_gmm_mpi_tpu.config import GMMConfig

@@ -56,6 +56,26 @@ def test_checkpoint_resume(rng, tmp_path):
     assert len(r2.sweep_log) <= len(r1.sweep_log)
 
 
+def test_checkpoint_restore_skips_torn_newest(rng, tmp_path):
+    """A corrupt newest npz checkpoint (torn write on a crash) must not
+    wedge resume: restore falls back to the next older step."""
+    from cuda_gmm_mpi_tpu.utils.checkpoint import SweepCheckpointer
+
+    data, _ = make_blobs(rng, n=400, d=2, k=3)
+    cfg = fast_cfg(checkpoint_dir=str(tmp_path / "ck"), fused_sweep=True)
+    fit_gmm(data, 6, 2, config=cfg)  # writes <step>.npz per completed K
+    ck = SweepCheckpointer(str(tmp_path / "ck"))
+    newest = ck.latest_step()
+    assert newest is not None and newest >= 1
+    path = tmp_path / "ck" / "sweep" / f"{newest}.npz"
+    good = ck.restore(newest - 1)
+    path.write_bytes(path.read_bytes()[: max(8, path.stat().st_size // 3)])
+    restored = ck.restore()
+    assert restored is not None and restored["step"] == newest - 1
+    np.testing.assert_array_equal(np.asarray(restored["state"].means),
+                                  np.asarray(good["state"].means))
+
+
 def test_checkpoint_ignored_for_different_k(rng, tmp_path):
     data, _ = make_blobs(rng, n=300, d=2, k=2)
     cfg = fast_cfg(checkpoint_dir=str(tmp_path / "ck2"))
