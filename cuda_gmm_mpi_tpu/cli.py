@@ -266,13 +266,6 @@ def main(argv=None) -> int:
               file=sys.stderr)  # :1150
         return 4
 
-    if args.stream_events and distributed_flags:
-        # Detectable from the args alone: fail before bringing up the
-        # multi-controller runtime (whose other ranks would then hang).
-        print("--stream-events is single-process; multi-host runs already "
-              "stream per-host slices via the range readers", file=sys.stderr)
-        return 1
-
     # MPI_Init equivalent (gaussian.cu:130-140): any distributed flag brings
     # up the multi-controller runtime; --num-processes=0 initializes from the
     # environment (TPU pod launchers).

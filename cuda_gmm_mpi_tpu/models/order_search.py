@@ -245,10 +245,6 @@ def fit_gmm(
     phase = timer.phase if timer else _null_phase
 
     nproc = jax.process_count()
-    if config.stream_events and nproc > 1:
-        raise ValueError(
-            "stream_events is single-process; multi-host runs already "
-            "stream per-host slices via the range readers")
     if model is None:
         if config.stream_events:
             from .streaming import StreamingGMMModel

@@ -33,9 +33,12 @@ mesh, chunk j of shard d is the in-memory sharded model's chunk ``d*Cl + j``
 and the final cross-shard merge is the same psum collective, so the sharded
 trajectories line up the same way.
 
-Single-process by design: multi-host runs already shard the data N-ways
-(per-host slices), which is the first remedy for N too big for one chip.
-The cluster mesh axis must be 1 (events are what overflow memory, not K).
+Multi-host composes too (round 4): each rank streams ITS host slice (the
+range readers already bound per-host host RAM) block-by-block over its
+local data shards, and the end-of-pass psum spans the global mesh -- the
+same collective the in-memory multi-controller path uses. So N is bounded
+by the CLUSTER's host RAM, with every chip of every host busy. The cluster
+mesh axis must be 1 (events are what overflow memory, not K).
 A ``GMMModel`` subclass, so ``fit_gmm``, the model-order search, and the
 whole inference/output surface drive it unchanged; the fused whole-sweep
 path is disabled (it needs device-resident data) and falls back to the
@@ -66,9 +69,12 @@ class StreamingGMMModel(GMMModel):
 
     def __init__(self, config: GMMConfig = GMMConfig()):
         self.mesh = None
-        if config.mesh_shape is not None:
+        if config.mesh_shape is not None or jax.process_count() > 1:
             from ..parallel.mesh import CLUSTER_AXIS, DATA_AXIS, make_mesh
 
+            # Multi-controller defaults to every device of every host on
+            # the data axis (the ShardedGMMModel default): the psum must
+            # span the whole job.
             mesh = make_mesh(config.mesh_shape)
             if mesh.shape[CLUSTER_AXIS] != 1:
                 # Config.__post_init__ enforces this too; keep the direct
@@ -78,6 +84,7 @@ class StreamingGMMModel(GMMModel):
                     "axis must be 1")
             self.mesh = mesh
             self.data_size = mesh.shape[DATA_AXIS]
+            self._local_data_size = mesh.local_mesh.shape[DATA_AXIS]
         if config.use_pallas == "always":
             raise ValueError(
                 "stream_events streams per-chunk through the jnp path; "
@@ -124,21 +131,34 @@ class StreamingGMMModel(GMMModel):
             self._stats_block = _stats_block
             self._reduce_fn = None  # built lazily (leaf ranks known then)
         self._block_major = False  # set by prepare()'s mesh layout pass
+        self._local_state_cache = None  # multi-host inference localization
+        self._counts_checked = None  # one-slot cross-host count check cache
 
     def prepare(self, state, chunks_np, wts_np, host_local: bool = False):
         """Keep the chunk arrays HOST-side; only the state goes on device.
 
         On a mesh this also (a) pads the chunk count to a multiple of the
-        data axis with zero-weight chunks (zero weight = zero contribution
-        to every statistic, the same contract chunk padding already uses),
-        and (b) reorders chunks block-major -- block j holding shard d's
-        chunk ``d*blocks + j`` contiguously -- so the per-pass strided
-        gather in ``_put_block`` becomes a free contiguous view instead of
-        a full extra host copy of the dataset every EM iteration."""
-        del host_local  # single-process
+        LOCAL data-axis extent with zero-weight chunks (zero weight = zero
+        contribution to every statistic, the same contract chunk padding
+        already uses), and (b) reorders chunks block-major -- block j
+        holding local shard d's chunk ``d*blocks + j`` contiguously -- so
+        the per-pass strided gather in ``_put_block`` becomes a free
+        contiguous view instead of a full extra host copy of the dataset
+        every EM iteration.
+
+        Multi-controller: ``chunks_np`` must be THIS host's slice
+        (``host_local=True``, same contract as ShardedGMMModel.prepare);
+        each host streams its slice over its local shards and the
+        end-of-pass psum spans the global mesh."""
+        if jax.process_count() > 1:
+            from ..parallel.distributed import require_host_local_chunks
+
+            require_host_local_chunks(
+                host_local, np.asarray(chunks_np).shape,
+                "stream every event process_count times")
         chunks_np, wts_np = np.asarray(chunks_np), np.asarray(wts_np)
-        S = self.data_size
-        if S > 1:
+        if self.mesh is not None:
+            S = self._local_data_size
             n = chunks_np.shape[0]
             pad = (-n) % S
             if pad:
@@ -154,11 +174,21 @@ class StreamingGMMModel(GMMModel):
             chunks_np = np.ascontiguousarray(chunks_np[order])
             wts_np = np.ascontiguousarray(wts_np[order])
             self._block_major = True
-        return (jax.tree_util.tree_map(jnp.asarray, state),
-                chunks_np, wts_np)
+        return self.prepare_state(state), chunks_np, wts_np
 
     def prepare_state(self, state):
-        return jax.tree_util.tree_map(jnp.asarray, state)
+        state = jax.tree_util.tree_map(jnp.asarray, state)
+        if self.mesh is not None and jax.process_count() > 1:
+            # Multi-controller: the state must be a GLOBAL (replicated)
+            # array so the SPMD stats/mstep jits accept it alongside the
+            # globally sharded blocks (every rank holds the identical
+            # replicated value; same contract as ShardedGMMModel).
+            from jax.experimental import multihost_utils
+
+            return multihost_utils.host_local_array_to_global_array(
+                state, self.mesh,
+                jax.tree_util.tree_map(lambda _: P(), state))
+        return state
 
     def _make_reduce(self, acc):
         """psum the per-shard statistics over the data axis -- the SAME
@@ -188,12 +218,25 @@ class StreamingGMMModel(GMMModel):
         strided gather."""
         if self.mesh is None:
             return (jnp.asarray(chunks[j]), jnp.asarray(wts[j]))
+        S = self._local_data_size
         if self._block_major:
-            S = self.data_size
             sel_c, sel_w = chunks[j * S:(j + 1) * S], wts[j * S:(j + 1) * S]
         else:
             sel_c = np.ascontiguousarray(chunks[j::blocks])
             sel_w = np.ascontiguousarray(wts[j::blocks])
+        if jax.process_count() > 1:
+            # Each host contributes its local S chunks; the assembled
+            # global block is [S_global, B, D] sharded over the data axis.
+            from jax.experimental import multihost_utils
+
+            return (
+                multihost_utils.host_local_array_to_global_array(
+                    np.ascontiguousarray(sel_c), self.mesh,
+                    P(self._data_axis, None, None)),
+                multihost_utils.host_local_array_to_global_array(
+                    np.ascontiguousarray(sel_w), self.mesh,
+                    P(self._data_axis, None)),
+            )
         return (jax.device_put(sel_c, self._x_sharding_stream),
                 jax.device_put(sel_w, self._w_sharding_stream))
 
@@ -203,12 +246,29 @@ class StreamingGMMModel(GMMModel):
         if self.mesh is None:
             blocks, stats_fn = n, self._chunk_stats_jit
         else:
-            if n % self.data_size:
+            if jax.process_count() > 1 and self._counts_checked != id(chunks):
+                # Direct run_em callers may bypass prepare(): verify the
+                # cross-host chunk counts COLLECTIVELY before anything can
+                # raise locally, so a mismatch fails identically on every
+                # rank instead of one rank erroring while the others hang
+                # in the psum. One allgather per chunk array, not per pass.
+                from jax.experimental import multihost_utils
+
+                multihost_utils.assert_equal(
+                    np.asarray(chunks.shape),
+                    "per-host chunk array shapes differ across hosts; "
+                    "derive slices with "
+                    "parallel.distributed.host_chunk_bounds")
+                self._counts_checked = id(chunks)
+            if n % self._local_data_size:
+                # After the collective check the counts are equal
+                # everywhere, so this raises on every rank or none.
                 raise ValueError(
-                    f"chunk count {n} is not a multiple of the data mesh "
-                    f"axis {self.data_size}; pass the chunk arrays through "
-                    "prepare() (it pads with zero-weight chunks)")
-            blocks, stats_fn = n // self.data_size, self._stats_block
+                    f"local chunk count {n} is not a multiple of the local "
+                    f"data mesh extent {self._local_data_size}; pass the "
+                    "chunk arrays through prepare() (it pads with "
+                    "zero-weight chunks)")
+            blocks, stats_fn = n // self._local_data_size, self._stats_block
         acc = None
         nxt = self._put_block(chunks, wts, 0, blocks)
         for j in range(blocks):
@@ -225,6 +285,21 @@ class StreamingGMMModel(GMMModel):
                 self._reduce_fn = self._make_reduce(acc)
             acc = self._reduce_fn(acc)
         return acc
+
+    def infer_posteriors(self, state, xb):
+        """Single-device posterior pass (inherited), with one twist: on a
+        multi-controller run the fitted state is a GLOBAL replicated array,
+        which a single-device jit cannot take -- localize it (host copy of
+        the replicated value) once per state and reuse."""
+        if self.mesh is not None and jax.process_count() > 1:
+            cached = self._local_state_cache
+            if cached is None or cached[0] is not state:
+                local = jax.tree_util.tree_map(
+                    lambda a: jnp.asarray(np.asarray(jax.device_get(a))),
+                    state)
+                self._local_state_cache = (state, local)
+            state = self._local_state_cache[1]
+        return super().infer_posteriors(state, xb)
 
     def run_em(self, state, chunks, wts, epsilon,
                min_iters: Optional[int] = None,

@@ -137,6 +137,32 @@ def host_slice(num_events: int, process_id: int, process_count: int):
     return start, stop
 
 
+def require_host_local_chunks(host_local: bool, chunks_shape,
+                              consequence: str) -> None:
+    """The shared multi-controller ``prepare()`` contract (ShardedGMMModel
+    and StreamingGMMModel): the caller must pass THIS host's chunk slice
+    (``host_local=True``), and every host's chunk array must be identically
+    shaped -- collectively verified so an inconsistent chunking fails with
+    a clear error on every rank instead of a shape-mismatch deadlock in the
+    first collective. ``consequence`` finishes the sentence "passing
+    full-dataset chunks here would ..." for the model's failure mode."""
+    if not host_local:
+        raise ValueError(
+            "multi-controller run: prepare() must receive this host's "
+            "LOCAL chunk slice (derive it with "
+            "parallel.distributed.host_chunk_bounds) and host_local=True. "
+            f"Passing full-dataset chunks here would {consequence}. "
+            "fit_gmm/GaussianMixture handle this automatically; only "
+            "direct model drivers need host_chunk_bounds "
+            "(docs/DISTRIBUTED.md).")
+    from jax.experimental import multihost_utils
+
+    multihost_utils.assert_equal(
+        np.asarray(chunks_shape),
+        "per-host chunk array shapes differ across hosts; derive slices "
+        "with parallel.distributed.host_chunk_bounds")
+
+
 def host_chunk_bounds(
     num_events: int,
     chunk_size: int,

@@ -178,33 +178,17 @@ class ShardedGMMModel:
         assembled with zero cross-host traffic.
         """
         if jax.process_count() > 1:
-            if not host_local:
-                raise ValueError(
-                    "multi-controller run: prepare() must receive this "
-                    "host's LOCAL chunk slice (derive it with "
-                    "parallel.distributed.host_chunk_bounds) and "
-                    "host_local=True. Passing full-dataset chunks here "
-                    "would silently duplicate every event process_count "
-                    "times. fit_gmm/GaussianMixture are single-controller "
-                    "APIs; drive ShardedGMMModel directly on multi-host "
-                    "(docs/DISTRIBUTED.md)."
-                )
-            # Multi-controller: the chunk arrays passed in are HOST-LOCAL
-            # (this host's equal-shaped slice from host_chunk_bounds);
-            # assemble the global sharded arrays with zero cross-host
-            # traffic.
-            from jax.experimental import multihost_utils
-
-            from .distributed import sharded_chunks_from_host_data
-
-            # Fail fast (with a clear error, not a shape-mismatch deadlock)
-            # if hosts chunked their slices inconsistently -- use
-            # distributed.host_chunk_bounds to guarantee equal counts.
-            multihost_utils.assert_equal(
-                np.asarray(data_chunks.shape),
-                "per-host chunk array shapes differ across hosts; derive "
-                "slices with parallel.distributed.host_chunk_bounds",
+            from .distributed import (
+                require_host_local_chunks, sharded_chunks_from_host_data,
             )
+
+            # Shared multi-controller contract (clear error instead of a
+            # shape-mismatch deadlock); then assemble the global sharded
+            # arrays from the equal-shaped host-local slices with zero
+            # cross-host traffic.
+            require_host_local_chunks(
+                host_local, np.asarray(data_chunks).shape,
+                "silently duplicate every event process_count times")
             chunks, wts = sharded_chunks_from_host_data(
                 self.mesh, np.asarray(data_chunks), np.asarray(wts_chunks)
             )

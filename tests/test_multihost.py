@@ -249,45 +249,64 @@ def test_results_gather_without_shared_fs(tmp_path, nproc):
     assert "GATHER_OK" in outs[0][1]
 
 
+CLI_N_EVENTS = 600
+CLI_COMMON = [
+    "6", None, None, "2", "--device=cpu", "--dtype=float64",
+    "--mesh=4", "--chunk-size=64", "--min-iters=5", "--max-iters=5",
+]
+
+
+def _spawn_cli(infile, outbase, extra, ndev):
+    from .conftest import worker_env
+
+    argv = list(CLI_COMMON)
+    argv[1], argv[2] = str(infile), str(outbase)
+    cmd = [sys.executable, "-m", "cuda_gmm_mpi_tpu.cli",
+           *argv, f"--cpu-devices={ndev}", *extra]
+    return subprocess.Popen(cmd, stdout=subprocess.PIPE,
+                            stderr=subprocess.PIPE,
+                            env=worker_env(), text=True)
+
+
+@pytest.fixture(scope="module")
+def cli_single_reference(tmp_path_factory):
+    """(infile, single.summary bytes, single.results bytes): the
+    single-process 4-device reference fit, run once and shared by both
+    parametrizations of the byte-identity test."""
+    root = tmp_path_factory.mktemp("cli_ref")
+    rng = np.random.default_rng(99)
+    k, d = 3, 4
+    centers = rng.normal(scale=10.0, size=(k, d))
+    data = (centers[rng.integers(0, k, CLI_N_EVENTS)]
+            + rng.normal(size=(CLI_N_EVENTS, d))).astype(np.float32)
+    infile = root / "events.csv"
+    with open(infile, "w") as f:
+        f.write(",".join(f"c{j}" for j in range(d)) + "\n")
+        for row in data:
+            f.write(",".join(f"{v:.6f}" for v in row) + "\n")
+    p = _spawn_cli(infile, root / "single", [], 4)
+    out, err = p.communicate(timeout=300)
+    assert p.returncode == 0, f"single-proc CLI failed:\n{out}\n{err[-3000:]}"
+    return (infile, (root / "single.summary").read_bytes(),
+            (root / "single.results").read_bytes())
+
+
 @pytest.mark.slow
-def test_two_process_cli_byte_identical(tmp_path):
+@pytest.mark.parametrize("stream", [False, True], ids=["mem", "stream"])
+def test_two_process_cli_byte_identical(tmp_path, stream,
+                                        cli_single_reference):
     """The reference's end-to-end story -- ``mpirun -np 2 gaussianMPI K in
     out`` producing .summary/.results -- run through THIS CLI: the same
     command on 2 processes (2 CPU devices each, per-host sharded file
     loading, cross-process collectives, rank-0 output assembly) must produce
     byte-identical outputs to a single-process run on the same 4-device
-    mesh. Matches gaussian.cu:128-207, 998-1061."""
-    from .conftest import worker_env
+    mesh. Matches gaussian.cu:128-207, 998-1061.
 
-    rng = np.random.default_rng(99)
-    k, d, n = 3, 4, 600
-    centers = rng.normal(scale=10.0, size=(k, d))
-    data = (centers[rng.integers(0, k, n)]
-            + rng.normal(size=(n, d))).astype(np.float32)
-    infile = str(tmp_path / "events.csv")
-    with open(infile, "w") as f:
-        f.write(",".join(f"c{j}" for j in range(d)) + "\n")
-        for row in data:
-            f.write(",".join(f"{v:.6f}" for v in row) + "\n")
-
-    common = [
-        "6", infile, None, "2", "--device=cpu", "--dtype=float64",
-        "--mesh=4", "--chunk-size=64", "--min-iters=5", "--max-iters=5",
-    ]
-    env = worker_env()
-
-    def run_cli(outbase, extra, ndev):
-        argv = list(common)
-        argv[2] = outbase
-        cmd = [sys.executable, "-m", "cuda_gmm_mpi_tpu.cli",
-               *argv, f"--cpu-devices={ndev}", *extra]
-        return subprocess.Popen(cmd, stdout=subprocess.PIPE,
-                                stderr=subprocess.PIPE, env=env, text=True)
-
-    # Single-process reference run: all 4 devices local.
-    p = run_cli(str(tmp_path / "single"), [], 4)
-    out, err = p.communicate(timeout=300)
-    assert p.returncode == 0, f"single-proc CLI failed:\n{out}\n{err[-3000:]}"
+    ``stream`` additionally runs the multi-process side out-of-core
+    (--stream-events, round 4): each rank streams its host slice block-wise
+    over its local shards with the end-of-pass psum spanning the global
+    mesh -- and must still match the in-memory single-process bytes."""
+    infile, single_summary, single_results = cli_single_reference
 
     # Two processes x 2 devices over a localhost coordination service.
     # Each rank spools its .results part in a PRIVATE --part-dir, so the
@@ -295,11 +314,13 @@ def test_two_process_cli_byte_identical(tmp_path):
     port = _free_port()
     for i in range(2):
         (tmp_path / f"scratch{i}").mkdir(exist_ok=True)
+    stream_flags = ["--stream-events"] if stream else []
     procs = [
-        run_cli(str(tmp_path / "multi"),
-                [f"--coordinator=127.0.0.1:{port}", "--num-processes=2",
-                 f"--process-id={i}",
-                 f"--part-dir={tmp_path / ('scratch%d' % i)}"], 2)
+        _spawn_cli(infile, tmp_path / "multi",
+                   [f"--coordinator=127.0.0.1:{port}", "--num-processes=2",
+                    f"--process-id={i}",
+                    f"--part-dir={tmp_path / ('scratch%d' % i)}",
+                    *stream_flags], 2)
         for i in range(2)
     ]
     for i, p in enumerate(procs):
@@ -307,14 +328,12 @@ def test_two_process_cli_byte_identical(tmp_path):
         assert p.returncode == 0, \
             f"rank {i} CLI failed:\n{out}\n{err[-3000:]}"
 
-    single_summary = (tmp_path / "single.summary").read_bytes()
     multi_summary = (tmp_path / "multi.summary").read_bytes()
     assert len(single_summary) > 100
     assert multi_summary == single_summary
 
-    single_results = (tmp_path / "single.results").read_bytes()
     multi_results = (tmp_path / "multi.results").read_bytes()
-    assert single_results.count(b"\n") == n
+    assert single_results.count(b"\n") == CLI_N_EVENTS
     assert multi_results == single_results
     # parts were cleaned up after assembly
     assert not list(tmp_path.glob("multi.results.part*"))
