@@ -7,12 +7,14 @@ was a full restart (SURVEY.md SS5.3). Checkpoints are written through the
 multi-process orbax path (every rank calls save, primary writes) and a
 restarted pair of workers must resume mid-sweep.
 
-Usage: python multihost_ckpt_worker.py <pid> <nproc> <port> <ckdir> [fused]
+Usage: python multihost_ckpt_worker.py <pid> <nproc> <port> <ckdir> [mode]
 Prints one line: RESULT {json}
 
-With the optional ``fused`` argument the sweep runs as ONE device program
-per rank (--fused-sweep) and checkpoints ride the per-K ordered io_callback
-emission -- the multi-controller composition VERDICT r3 item 4 requires.
+``mode``: ``fused`` runs the sweep as ONE device program per rank
+(--fused-sweep) with checkpoints riding the per-K ordered io_callback
+emission -- the multi-controller composition VERDICT r3 item 4 requires;
+``stream`` runs the sweep out-of-core (--stream-events) with each rank
+streaming its host slice over its local shards (round 4).
 """
 
 import json
@@ -23,7 +25,8 @@ def main() -> int:
     pid, nproc, port, ckdir = (
         int(sys.argv[1]), int(sys.argv[2]), sys.argv[3], sys.argv[4],
     )
-    fused = len(sys.argv) > 5 and sys.argv[5] == "fused"
+    mode = sys.argv[5] if len(sys.argv) > 5 else ""
+    fused = mode == "fused"
 
     import jax
 
@@ -58,7 +61,8 @@ def main() -> int:
     cfg = GMMConfig(min_iters=iters, max_iters=iters, chunk_size=64,
                     dtype="float64",
                     checkpoint_dir=ckdir, enable_print=True,
-                    fused_sweep=fused)
+                    fused_sweep=fused,
+                    stream_events=(mode == "stream"))
     r = fit_gmm(data, 10, 2, config=cfg)
     print("RESULT " + json.dumps({
         "pid": pid,

@@ -142,6 +142,23 @@ assert growth_mb < 45.0, growth_mb
     assert "GROWTH_MB" in r.stdout
 
 
+def test_streaming_mesh_sample_weight_matches_in_memory(rng):
+    """sample_weight rides the host chunks through the block-major mesh
+    layout: weighted mesh-streaming matches weighted mesh in-memory."""
+    centers = rng.normal(scale=8.0, size=(2, 3))
+    data = (centers[rng.integers(0, 2, 900)]
+            + rng.normal(size=(900, 3))).astype(np.float64)
+    w = rng.uniform(0.5, 3.0, size=900).astype(np.float64)
+    kw = dict(min_iters=4, max_iters=4, chunk_size=64, dtype="float64",
+              mesh_shape=(8, 1))
+    r_mem = fit_gmm(data, 3, 2, GMMConfig(**kw), sample_weight=w)
+    r_str = fit_gmm(data, 3, 2, GMMConfig(stream_events=True, **kw),
+                    sample_weight=w)
+    np.testing.assert_allclose(r_str.final_loglik, r_mem.final_loglik,
+                               rtol=1e-12)
+    np.testing.assert_allclose(r_str.means, r_mem.means, rtol=1e-10)
+
+
 def test_streaming_guards(rng):
     with pytest.raises(ValueError, match="cluster mesh axis"):
         GMMConfig(stream_events=True, mesh_shape=(4, 2))
