@@ -66,6 +66,7 @@ class StreamingGMMModel(GMMModel):
     supports_fused_emit = False
     make_fused_sweep = None  # no fused sweep: data is not on device
     data_size = 1  # overridden per-instance when a mesh is configured
+    cluster_size = 1  # events-only sharding (prepare_inference contract)
 
     def __init__(self, config: GMMConfig = GMMConfig()):
         self.mesh = None
@@ -112,7 +113,13 @@ class StreamingGMMModel(GMMModel):
         self._mstep = _mstep
 
         if self.mesh is not None:
-            from ..parallel.mesh import DATA_AXIS
+            import functools
+
+            from ..ops.estep import posteriors
+            from ..parallel.mesh import (
+                CLUSTER_AXIS, DATA_AXIS, state_pspecs,
+            )
+            from ..parallel.sharded_em import shard_map
 
             self._data_axis = DATA_AXIS
             self._x_sharding_stream = NamedSharding(
@@ -130,8 +137,34 @@ class StreamingGMMModel(GMMModel):
 
             self._stats_block = _stats_block
             self._reduce_fn = None  # built lazily (leaf ranks known then)
+
+            # Output/inference pass over ALL local devices (mirrors
+            # ShardedGMMModel: the reference computed final memberships on
+            # every GPU, gaussian.cu:768-823) -- streaming's whole point is
+            # huge N, which makes a single-device output pass the next
+            # bottleneck. Multi-host uses the host-local submesh so each
+            # host's output pass is collective-free.
+            self._inference_mesh = (
+                self.mesh if jax.process_count() == 1
+                else self.mesh.local_mesh
+            )
+            self._inference_data_size = (
+                self._inference_mesh.shape[DATA_AXIS])
+            post_fn = functools.partial(posteriors, cluster_axis=None, **kw)
+            sspec = state_pspecs()
+            self._post_sharded = jax.jit(
+                shard_map(
+                    lambda s, x: post_fn(s, x),
+                    mesh=self._inference_mesh,
+                    in_specs=(sspec, P(DATA_AXIS, None)),
+                    out_specs=(P(DATA_AXIS, CLUSTER_AXIS), P(DATA_AXIS)),
+                    check_vma=False,
+                )
+            )
+            self._x_sharding = NamedSharding(
+                self._inference_mesh, P(DATA_AXIS, None))
+            self._inference_cache = None  # one-slot (state -> placed)
         self._block_major = False  # set by prepare()'s mesh layout pass
-        self._local_state_cache = None  # multi-host inference localization
         self._counts_checked = None  # one-slot cross-host count check cache
 
     def prepare(self, state, chunks_np, wts_np, host_local: bool = False):
@@ -286,20 +319,34 @@ class StreamingGMMModel(GMMModel):
             acc = self._reduce_fn(acc)
         return acc
 
+    @property
+    def inference_block(self) -> int:
+        """Events per output-path block: one chunk per local data shard on
+        a mesh, one chunk otherwise (the inherited single-device pass)."""
+        if self.mesh is None:
+            return self.config.chunk_size
+        return self.config.chunk_size * self._inference_data_size
+
     def infer_posteriors(self, state, xb):
-        """Single-device posterior pass (inherited), with one twist: on a
-        multi-controller run the fitted state is a GLOBAL replicated array,
-        which a single-device jit cannot take -- localize it (host copy of
-        the replicated value) once per state and reuse."""
-        if self.mesh is not None and jax.process_count() > 1:
-            cached = self._local_state_cache
-            if cached is None or cached[0] is not state:
-                local = jax.tree_util.tree_map(
-                    lambda a: jnp.asarray(np.asarray(jax.device_get(a))),
-                    state)
-                self._local_state_cache = (state, local)
-            state = self._local_state_cache[1]
-        return super().infer_posteriors(state, xb)
+        """(w [B, K], logZ [B]) for one [inference_block, D] event block --
+        on a mesh, computed on all local devices in parallel (the shared
+        ShardedGMMModel machinery, incl. localization of multi-controller
+        global states)."""
+        if self.mesh is None:
+            return super().infer_posteriors(state, xb)
+        from ..parallel.sharded_em import infer_posteriors_sharded
+
+        return infer_posteriors_sharded(self, state, xb)
+
+    def memberships(self, state, data_chunks, return_logz: bool = False):
+        """Output pass over all local devices on a mesh (single-device
+        inherited otherwise) -- streaming exists for huge N, where a
+        one-device output pass would idle the rest of the host."""
+        if self.mesh is None:
+            return super().memberships(state, data_chunks, return_logz)
+        from ..parallel.sharded_em import memberships_sharded
+
+        return memberships_sharded(self, state, data_chunks, return_logz)
 
     def run_em(self, state, chunks, wts, epsilon,
                min_iters: Optional[int] = None,

@@ -71,6 +71,80 @@ def pad_state_clusters(state, cluster_size: int):
     )
 
 
+def prepare_inference_state(model, state):
+    """(placed_state, K_columns): the shared all-local-devices inference
+    preparation for ShardedGMMModel and the mesh StreamingGMMModel.
+
+    Localizes a multi-controller global state (non-fully-addressable
+    leaves) to host numpy first, pads K to the cluster axis when the model
+    shards clusters, and places the result on ``model._inference_mesh`` in
+    ONE host->device transfer. One-slot cache keyed on the state's
+    identity so a streamed output pass prepares once; the strong reference
+    (not ``id()``) pins the state so a recycled address can never serve a
+    stale prepared state.
+    """
+    cached = model._inference_cache
+    if cached is not None and cached[0] is state:
+        return cached[1], cached[2]
+    local = state
+    if any(isinstance(a, jax.Array) and not a.is_fully_addressable
+           for a in jax.tree_util.tree_leaves(state)):
+        local = jax.tree_util.tree_map(
+            lambda a: np.asarray(jax.device_get(a)), state)
+    k_cols = int(np.asarray(jax.device_get(local.N)).shape[0])
+    if model.cluster_size > 1:
+        local = pad_state_clusters(
+            jax.tree_util.tree_map(jnp.asarray, local), model.cluster_size)
+    prepared = jax.device_put(
+        local,
+        jax.tree_util.tree_map(
+            lambda s: NamedSharding(model._inference_mesh, s),
+            state_pspecs()),
+    )
+    model._inference_cache = (state, prepared, k_cols)
+    return prepared, k_cols
+
+
+def infer_posteriors_sharded(model, state, xb):
+    """(w [B, K], logZ [B]) for one [inference_block, D] event block,
+    computed on all of the model's local devices in parallel."""
+    prepared, k_cols = prepare_inference_state(model, state)
+    # device_put straight from the host buffer: one per-shard placement,
+    # no intermediate default-device commit.
+    xb = jax.device_put(xb, model._x_sharding)
+    w, logz = model._post_sharded(prepared, xb)
+    return w[:, :k_cols], logz
+
+
+def memberships_sharded(model, state, data_chunks,
+                        return_logz: bool = False):
+    """Materialized posteriors [N_padded, K] -- output path only.
+
+    Same contract as GMMModel.memberships, but each block of
+    ``_inference_data_size`` chunks is evaluated in ONE sharded dispatch
+    across the host's local devices (the within-host half of the
+    reference's all-GPU membership recompute, gaussian.cu:768-823).
+    """
+    chunks = np.asarray(data_chunks)
+    C, B, D = chunks.shape
+    S = model._inference_data_size
+    w_out, z_out = [], []
+    for i in range(0, C, S):
+        blk = chunks[i:i + S]
+        nvalid = blk.shape[0]
+        if nvalid < S:  # pad the tail to a whole sharded block
+            blk = np.concatenate(
+                [blk, np.zeros((S - nvalid, B, D), blk.dtype)])
+        w, logz = model.infer_posteriors(state, blk.reshape(S * B, D))
+        w_out.append(np.asarray(jax.device_get(w))[:nvalid * B])
+        if return_logz:
+            z_out.append(np.asarray(jax.device_get(logz))[:nvalid * B])
+    w = np.concatenate(w_out, axis=0)
+    if return_logz:
+        return w, np.concatenate(z_out, axis=0)
+    return w
+
+
 def make_psum_reduce(data_axis: str = DATA_AXIS):
     """Stats reduction hook: one psum of the whole SuffStats pytree.
 
@@ -358,63 +432,12 @@ class ShardedGMMModel:
         """Events per output-path block: one chunk per local data shard."""
         return self.config.chunk_size * self._inference_data_size
 
-    def _prepare_inference(self, state):
-        """(placed_state, K_columns): pad K to the cluster axis and place on
-        the inference mesh. One-slot cache keyed on the state's identity so a
-        streamed output pass prepares once."""
-        cached = self._inference_cache
-        if cached is not None and cached[0] is state:
-            return cached[1], cached[2]
-        k_cols = int(np.asarray(state.N).shape[0])
-        prepared = pad_state_clusters(
-            jax.tree_util.tree_map(jnp.asarray, state), self.cluster_size
-        )
-        sspec = state_pspecs()
-        prepared = jax.device_put(
-            prepared,
-            jax.tree_util.tree_map(
-                lambda s: NamedSharding(self._inference_mesh, s), sspec
-            ),
-        )
-        # Hold the state object itself (not id()): the strong reference pins
-        # it, so a recycled address can never serve a stale prepared state.
-        self._inference_cache = (state, prepared, k_cols)
-        return prepared, k_cols
-
     def infer_posteriors(self, state, xb):
         """(w [B, K], logZ [B]) for one [inference_block, D] event block,
         computed on all local devices in parallel. ``state`` is the plain
         (compacted, unpadded) fit result state."""
-        prepared, k_cols = self._prepare_inference(state)
-        # device_put straight from the host buffer: one per-shard placement,
-        # no intermediate default-device commit.
-        xb = jax.device_put(xb, self._x_sharding)
-        w, logz = self._post_sharded(prepared, xb)
-        return w[:, :k_cols], logz
+        return infer_posteriors_sharded(self, state, xb)
 
     def memberships(self, state, data_chunks, return_logz: bool = False):
-        """Materialized posteriors [N_padded, K] -- output path only.
-
-        Same contract as GMMModel.memberships, but each block of
-        ``_inference_data_size`` chunks is evaluated in ONE sharded dispatch
-        across the host's local devices (the within-host half of the
-        reference's all-GPU membership recompute, gaussian.cu:768-823).
-        """
-        chunks = np.asarray(data_chunks)
-        C, B, D = chunks.shape
-        S = self._inference_data_size
-        w_out, z_out = [], []
-        for i in range(0, C, S):
-            blk = chunks[i:i + S]
-            nvalid = blk.shape[0]
-            if nvalid < S:  # pad the tail to a whole sharded block
-                blk = np.concatenate(
-                    [blk, np.zeros((S - nvalid, B, D), blk.dtype)])
-            w, logz = self.infer_posteriors(state, blk.reshape(S * B, D))
-            w_out.append(np.asarray(jax.device_get(w))[:nvalid * B])
-            if return_logz:
-                z_out.append(np.asarray(jax.device_get(logz))[:nvalid * B])
-        w = np.concatenate(w_out, axis=0)
-        if return_logz:
-            return w, np.concatenate(z_out, axis=0)
-        return w
+        """All-local-devices output pass (memberships_sharded)."""
+        return memberships_sharded(self, state, data_chunks, return_logz)
