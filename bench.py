@@ -139,13 +139,17 @@ def numpy_em_iteration_diag(x, x2, params):
 CONFIGS = {
     # BASELINE.md benchmark config matrix (1-5); "north" = the north-star;
     # 6 = the reference's first-class envelope (MAX_CLUSTERS=512,
-    # NUM_DIMENSIONS=32 -- gaussian.h:10,16).
+    # NUM_DIMENSIONS=32 -- gaussian.h:10,16); "5stream" = config 5 run
+    # out-of-core (--stream-events: chunks stay in host RAM, the scale
+    # path for N past HBM -- its vs_baseline shows what streaming costs
+    # against the same CPU denominator).
     "north": dict(n=1_000_000, d=24, k=100, diag=False),
     "1": dict(n=10_000, d=4, k=8, diag=False),
     "2": dict(n=100_000, d=21, k=64, diag=False),
     "3": dict(n=1_000_000, d=24, k=256, diag=True),
     "4": dict(n=500_000, d=16, k=100, diag=False, target_k=10),
     "5": dict(n=10_000_000, d=24, k=128, diag=False),
+    "5stream": dict(n=10_000_000, d=24, k=128, diag=False, stream=True),
     "6": dict(n=1_000_000, d=32, k=512, diag=False),
 }
 
@@ -267,10 +271,17 @@ def main() -> int:
         cfg = GMMConfig(min_iters=bench_iters, max_iters=bench_iters,
                         chunk_size=chunk, diag_only=diag,
                         matmul_precision=precision,
-                        use_pallas=use_pallas)
-        model = GMMModel(cfg)
+                        use_pallas=use_pallas,
+                        stream_events=bool(spec.get("stream", False)))
         chunks, wts = chunk_events(data, cfg.chunk_size)
-        chunks, wts = jnp.asarray(chunks), jnp.asarray(wts)
+        if cfg.stream_events:
+            from cuda_gmm_mpi_tpu.models.streaming import StreamingGMMModel
+
+            model = StreamingGMMModel(cfg)
+            _, chunks, wts = model.prepare(state, chunks, wts)
+        else:
+            model = GMMModel(cfg)
+            chunks, wts = jnp.asarray(chunks), jnp.asarray(wts)
         eps = convergence_epsilon(n_events, n_dims)
 
         # Warmup/compile on the SAME jit instance that gets timed (a separate
@@ -348,6 +359,8 @@ def main() -> int:
 
     cov = "diagonal" if diag else "full"
     note = dict(sweep_extra)
+    if spec.get("stream"):
+        note["streamed"] = True
     if diag:
         note["baseline_note"] = "CPU baseline runs the diagonal iteration"
     if accel_unavailable:
@@ -356,9 +369,10 @@ def main() -> int:
             "this is a CPU-fallback measurement, not an accelerator result"
         )
     kdesc = f"K={k}->{target_k}" if target_k else f"K={k}"
+    streamed = ", streamed" if spec.get("stream") else ""
     result = {
         "metric": f"EM iters/sec ({n_events}x{n_dims}, {kdesc}, "
-                  f"{cov} covariance, {platform})",
+                  f"{cov} covariance{streamed}, {platform})",
         "value": round(iters_per_sec, 3),
         "unit": "iters/sec",
         "vs_baseline": round(vs_baseline, 2),

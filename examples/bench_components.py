@@ -1,0 +1,158 @@
+"""Decompose one fused E+M iteration into its component costs on TPU.
+
+The MFU-push tool (VERDICT r4 item 3): after the kernel-vs-XLA decision,
+this attributes the north-star iteration's wall time to its pieces so the
+next bottleneck is measured, not guessed:
+
+  full     -- the complete fused chunk_stats pass (what bench.py times)
+  quad     -- xouter features + the (B,F)@(F,K) + (B,D)@(D,K) logp matmuls
+  estep    -- the full posteriors() pass (quad + masked LSE + softmax);
+              estep - quad ~ the VPU-bound LSE/softmax cost
+  moments  -- the (K,B)@(B,D) M1 and (K,B)@(B,F) M2 accumulations
+  xouter   -- materializing the [B,F] outer-product features alone
+              (optimization_barrier forces the materialization XLA would
+              otherwise fuse away)
+
+Components overlap (quad+lse+moments ~ full, minus fusion wins), so read
+the deltas, not the absolute sum. Timing protocol per the verify-skill
+runbook: every component is a lax.scan over the chunk grid inside ONE jit
+(amortizes the tunnel's per-dispatch latency), min-of-3 perturbed reps,
+readback inside the timed region.
+
+Usage: python examples/bench_components.py [north|envelope] [--iters=20]
+           [--precision=high] [--device=cpu]
+"""
+
+from __future__ import annotations
+
+import sys
+import time
+
+import numpy as np
+
+SHAPES = {
+    "north": dict(n=1_000_000, d=24, k=100),
+    "envelope": dict(n=1_000_000, d=32, k=512),
+}
+
+
+def main() -> int:
+    names = [a for a in sys.argv[1:] if not a.startswith("--")] or ["north"]
+    iters, precision = 20, "high"
+    for a in sys.argv[1:]:
+        if a.startswith("--iters="):
+            iters = int(a.split("=", 1)[1])
+        if a.startswith("--precision="):
+            precision = a.split("=", 1)[1]
+
+    import jax
+
+    for a in sys.argv[1:]:
+        if a.startswith("--device="):
+            jax.config.update("jax_platforms", a.split("=", 1)[1])
+
+    import jax.numpy as jnp
+    from jax import lax
+
+    from cuda_gmm_mpi_tpu.config import GMMConfig
+    from cuda_gmm_mpi_tpu.models.gmm import chunk_events
+    from cuda_gmm_mpi_tpu.ops.estep import posteriors
+    from cuda_gmm_mpi_tpu.ops.mstep import chunk_stats
+    from cuda_gmm_mpi_tpu.ops.seeding import seed_clusters_host
+
+    print(f"platform: {jax.devices()[0].platform}  precision={precision} "
+          f"iters={iters}", flush=True)
+    prec = {"default": lax.Precision.DEFAULT, "high": lax.Precision.HIGH,
+            "highest": lax.Precision.HIGHEST}[precision]
+
+    for name in names:
+        spec = SHAPES[name]
+        n, d, k = spec["n"], spec["d"], spec["k"]
+        rng = np.random.default_rng(42)
+        centers = rng.normal(scale=8.0, size=(k, d))
+        data = (centers[rng.integers(0, k, n)]
+                + rng.normal(size=(n, d))).astype(np.float32)
+        state = seed_clusters_host(data, k)
+        chunks_np, wts_np = chunk_events(data, 131072)
+        chunks, wts = jnp.asarray(chunks_np), jnp.asarray(wts_np)
+        kw = dict(diag_only=False, quad_mode="expanded",
+                  matmul_precision=precision)
+
+        def scan_over_chunks(per_chunk):
+            """ONE jit covering all ``iters`` repetitions: an outer scan
+            whose carry perturbs the state per repetition (sequential
+            dependence -- no layer can CSE or parallelize the reps) around
+            an inner scan over the chunk grid. Amortizes the remote
+            tunnel's per-dispatch latency per the verify-skill runbook."""
+            def f(st, ch, wt):
+                def iter_body(c, _):
+                    st2 = st.replace(means=st.means * (1.0 + c * 1e-12))
+
+                    def body(cc, xw):
+                        x, w_row = xw
+                        return cc + per_chunk(st2, x, w_row), None
+
+                    out, _ = lax.scan(body, c * 1e-30, (ch, wt))
+                    return out, None
+                tot, _ = lax.scan(iter_body, jnp.float32(0.0), None,
+                                  length=iters)
+                return tot
+            return jax.jit(f)
+
+        def full_chunk(st, x, w_row):
+            s = chunk_stats(st, x, w_row, **kw)
+            return s.loglik.astype(jnp.float32) + jnp.sum(s.M2) * 0
+
+        def quad_chunk(st, x, w_row):
+            B, D = x.shape
+            xo = (x[:, :, None] * x[:, None, :]).reshape(B, D * D)
+            A = st.Rinv.reshape(k, D * D)
+            b = jnp.einsum("kde,ke->kd", st.Rinv, st.means, precision=prec)
+            q = (jnp.matmul(xo, A.T, precision=prec)
+                 - 2.0 * jnp.matmul(x, b.T, precision=prec))
+            return jnp.sum(q * 1e-9) + jnp.sum(w_row) * 0
+
+        def estep_chunk(st, x, w_row):
+            # the whole E-side: logp matmuls -> LSE -> softmax (no moments)
+            w, logZ = posteriors(st, x, **kw)
+            return jnp.sum(logZ) + jnp.sum(w[:, :1]) * 0
+
+        def moments_chunk(st, x, w_row):
+            B, D = x.shape
+            xo = (x[:, :, None] * x[:, None, :]).reshape(B, D * D)
+            w = jnp.broadcast_to(w_row[:, None], (B, k)) * 1e-6
+            M1 = jnp.einsum("nk,nd->kd", w, x, precision=prec)
+            M2 = jnp.einsum("nk,nf->kf", w, xo, precision=prec)
+            return jnp.sum(M1) + jnp.sum(M2) * 1e-9
+
+        def xouter_chunk(st, x, w_row):
+            B, D = x.shape
+            xo = (x[:, :, None] * x[:, None, :]).reshape(B, D * D)
+            # Barrier: without it XLA fuses the strided sum into the
+            # producer and never materializes the [B, F] tensor -- the
+            # exact cost this component exists to measure.
+            xo = lax.optimization_barrier(xo)
+            return jnp.sum(xo[:, ::7]) * 1e-9 + jnp.sum(w_row) * 0
+
+        comps = [("full", full_chunk), ("quad", quad_chunk),
+                 ("estep", estep_chunk), ("moments", moments_chunk),
+                 ("xouter", xouter_chunk)]
+        for tag, per_chunk in comps:
+            fn = scan_over_chunks(per_chunk)
+            # warm/compile
+            float(fn(state, chunks, wts))
+            times = []
+            for r in range(3):
+                sr = state.replace(
+                    means=state.means * (1.0 + 1e-6 * (r + 1)))
+                t0 = time.perf_counter()
+                v = float(fn(sr, chunks, wts))
+                times.append((time.perf_counter() - t0) / iters)
+            assert np.isfinite(v)
+            print(f"{name:9s} {tag:8s} {min(times)*1e3:8.2f} ms/pass",
+                  flush=True)
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -33,10 +33,13 @@ step kernel_envelope_diag python examples/bench_kernel_precision.py envelope dia
 # 3. Config matrix incl. 5 (fresh same-session CPU denominator rides in
 #    bench.py's in-process baseline) and the reference envelope 6.
 step bench_5 python bench.py --config=5
+step bench_5stream python bench.py --config=5stream
 step bench_6 python bench.py --config=6
 step bench_3_diag python bench.py --config=3
 # 4. Streaming overlap: double-buffered out-of-core vs in-memory (item 6).
 step stream_overlap python examples/bench_streaming.py --n=4000000 --iters=10
-# (MFU profiling, item 3, is interactive: jax.profiler traces at the
-# north-star shape once the kernel decision from step 2 is in.)
+# 5. MFU decomposition (item 3): attribute the north-star iteration's
+#    wall time to quad/lse/moments/xouter components.
+step components_north python examples/bench_components.py north
+step components_envelope python examples/bench_components.py envelope --iters=10
 echo "session complete; logs in $LOGDIR/"
