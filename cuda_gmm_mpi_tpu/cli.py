@@ -116,6 +116,10 @@ def build_parser() -> argparse.ArgumentParser:
                    "best Rissanen kept (1 = reference single-init)")
     t.add_argument("--pallas", default="auto", choices=["auto", "always", "never"],
                    help="use the Pallas fused kernel")
+    t.add_argument("--precompute-features", action="store_true",
+                   help="hoist the [N, F] outer-product features out of the "
+                   "EM loop (built once, held in HBM: N*F*4 bytes); "
+                   "full-covariance in-memory runs only")
     t.add_argument("--fused-sweep", action="store_true",
                    help="run the whole model-order sweep as one device "
                    "program (fastest; composes with --checkpoint-dir and "
@@ -226,6 +230,7 @@ def main(argv=None) -> int:
             debug_nans=args.debug_nans,
             validate_input=not args.no_validate_input,
             stream_events=args.stream_events,
+            precompute_features=args.precompute_features,
         )
     except ValueError as e:
         print(str(e), file=sys.stderr)

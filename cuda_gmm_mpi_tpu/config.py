@@ -74,6 +74,13 @@ class GMMConfig:
     # supported in-kernel ('high' is a manual 3-dot bf16_3x decomposition,
     # since Mosaic rejects native Precision.HIGH).
     use_pallas: str = "auto"  # 'auto' | 'always' | 'never'
+    # Hoist the [N, F] outer-product features out of the EM loop: built
+    # once per run and held in HBM (N*F*4 bytes -- 2.3 GB at 1M x 24),
+    # replacing every iteration's feature rebuild+write with a read. The
+    # XLA-path candidate for the measured xouter-traffic bottleneck
+    # (docs/PERF.md); bit-identical results. Full-covariance 'expanded'
+    # in-memory paths only.
+    precompute_features: bool = False
     # Events per Pallas grid tile (the kernel's VMEM working set is
     # ~ block_b * D^2 floats for the outer products).
     pallas_block_b: int = 512  # best measured tile on v5e (docs/PERF.md)
@@ -165,6 +172,24 @@ class GMMConfig:
             raise ValueError(
                 "stream_events streams per-chunk through the jnp path; "
                 "use_pallas='always' cannot be honored -- drop one flag")
+        if self.precompute_features:
+            if self.diag_only:
+                raise ValueError(
+                    "precompute_features is a full-covariance optimization "
+                    "(diag builds no [N, F] features)")
+            if self.quad_mode != "expanded":
+                raise ValueError(
+                    "precompute_features requires quad_mode='expanded'")
+            if self.use_pallas == "always":
+                raise ValueError(
+                    "precompute_features is the XLA-path feature hoist; "
+                    "the Pallas kernel builds features in VMEM -- drop one "
+                    "flag")
+            if self.stream_events:
+                raise ValueError(
+                    "precompute_features holds all features in device "
+                    "memory; stream_events exists because the data does "
+                    "not fit there -- drop one flag")
         if self.seed_method not in ("even", "kmeans++"):
             raise ValueError(f"unknown seed_method: {self.seed_method!r}")
         if self.chunk_size < 1:

@@ -60,6 +60,7 @@ def fused_sweep(
     emit_cb: Optional[Callable] = None,
     emit_light: bool = False,
     emit_gather_fn: Optional[Callable] = None,
+    precompute_features: bool = False,
 ):
     """Run the whole K-sweep on device.
 
@@ -109,6 +110,7 @@ def fused_sweep(
             quad_mode=quad_mode, matmul_precision=matmul_precision,
             cluster_axis=cluster_axis, stats_fn=stats_fn,
             covariance_type=covariance_type,
+            precompute_features=precompute_features,
         )
 
     zero = jnp.zeros((), dtype)

@@ -148,7 +148,9 @@ class GMMModel:
         self._em_run = jax.jit(
             functools.partial(em_while_loop, reduce_stats=reduce_stats,
                               stats_fn=stats_fn,
-                              covariance_type=config.covariance_type, **kw)
+                              covariance_type=config.covariance_type,
+                              precompute_features=config.precompute_features,
+                              **kw)
         )
         self._estep_stats = jax.jit(
             functools.partial(self._estep_stats_impl, reduce_stats=reduce_stats,
@@ -220,6 +222,7 @@ class GMMModel:
                     emit_light=emit_light,
                     covariance_type=self.config.covariance_type,
                     criterion=self.config.criterion,
+                    precompute_features=self.config.precompute_features,
                     **self._kw, **static,
                 )
             ))
@@ -273,6 +276,7 @@ def em_while_loop(
     cluster_axis: str | None = None,
     stats_fn: Optional[Callable] = None,
     covariance_type: str | None = None,
+    precompute_features: bool = False,
 ):
     """The whole per-K EM algorithm as one traced program.
 
@@ -281,15 +285,33 @@ def em_while_loop(
     (ops/pallas/fused_stats.py) replaces XLA-generated code on the hot path.
     ``covariance_type`` selects the M-step covariance constraint
     (ops/mstep.py apply_mstep); the E-step/statistics path is shared.
+
+    ``precompute_features`` hoists the [C, B, F] outer-product features out
+    of the EM loop: they depend only on the data, so building them once and
+    holding them in HBM replaces every iteration's rebuild (a write of
+    N x F per iteration) with a read -- the XLA-path candidate for the
+    measured xouter-traffic bottleneck (docs/PERF.md). Costs N*F*4 bytes of
+    HBM residency (2.3 GB at the north-star); full-covariance 'expanded'
+    only, and a no-op under a custom stats_fn (the kernel builds features
+    in VMEM). Results are bit-identical either way (same values through
+    the same matmuls).
     """
     kw = dict(diag_only=diag_only, quad_mode=quad_mode,
               matmul_precision=matmul_precision, cluster_axis=cluster_axis)
+
+    feats = None
+    if (precompute_features and stats_fn is None and not diag_only
+            and quad_mode == "expanded"):
+        from ..ops.estep import expand_features
+
+        feats = jax.vmap(expand_features)(data_chunks)
 
     def estep(s) -> SuffStats:
         if stats_fn is not None:
             stats = stats_fn(s, data_chunks, wts_chunks)
         else:
-            stats = accumulate_stats(s, data_chunks, wts_chunks, **kw)
+            stats = accumulate_stats(s, data_chunks, wts_chunks,
+                                     feats_chunks=feats, **kw)
         return reduce_stats(stats) if reduce_stats else stats
 
     stats0 = estep(state)  # initial E-step (gaussian.cu:487-516)

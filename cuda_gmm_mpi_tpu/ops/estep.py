@@ -56,6 +56,18 @@ def _tri(D: int):
     return iu0, iu1, fullmap
 
 
+def expand_features(x: jax.Array) -> jax.Array:
+    """[B, D] events -> [B, D*D] flattened outer products x x^T.
+
+    THE single definition of the expanded feature layout: the E-step quad
+    matmul, the M2 accumulation, and em_while_loop's precompute_features
+    hoist all consume exactly this expression, and the hoist's bit-identity
+    guarantee depends on every site computing it identically.
+    """
+    B, D = x.shape
+    return (x[:, :, None] * x[:, None, :]).reshape(B, D * D)
+
+
 def pack_features(x: jax.Array) -> jax.Array:
     """[B, D] events -> [B, D(D+1)/2] upper-triangle products x_i * x_j (i<=j).
 
@@ -134,10 +146,8 @@ def log_densities(
         # full flattened xx^T (expanded) or its upper triangle (packed; the
         # symmetric-half saving on the dominant contraction).
         if xouter is None:
-            xouter = (
-                pack_features(x) if quad_mode == "packed"
-                else (x[:, :, None] * x[:, None, :]).reshape(B, D * D)
-            )
+            xouter = (pack_features(x) if quad_mode == "packed"
+                      else expand_features(x))
         A = (
             pack_sym_weighted(Rinv) if quad_mode == "packed"
             else Rinv.reshape(K, D * D)

@@ -35,7 +35,9 @@ import jax
 import jax.numpy as jnp
 from jax import lax
 
-from .estep import posteriors, _precision, pack_features, unpack_sym
+from .estep import (
+    posteriors, _precision, expand_features, pack_features, unpack_sym,
+)
 from .constants import compute_constants
 
 
@@ -85,6 +87,7 @@ def chunk_stats(
     quad_mode: str = "expanded",
     matmul_precision: str = "highest",
     cluster_axis: str | None = None,
+    xouter: Optional[jax.Array] = None,
 ) -> SuffStats:
     """Fused E+M statistics for one chunk of events.
 
@@ -99,11 +102,15 @@ def chunk_stats(
     K = state.means.shape[0]
     prec = _precision(matmul_precision)
 
-    xouter = None
-    if not diag_only and quad_mode == "packed":
-        xouter = pack_features(x)
-    elif not diag_only and quad_mode == "expanded":
-        xouter = (x[:, :, None] * x[:, None, :]).reshape(B, D * D)
+    # ``xouter`` may arrive precomputed (em_while_loop's
+    # precompute_features: the [B, F] features are data-only, so hoisting
+    # them out of the EM loop trades HBM residency for the per-iteration
+    # rebuild); it is built here otherwise.
+    if xouter is None:
+        if not diag_only and quad_mode == "packed":
+            xouter = pack_features(x)
+        elif not diag_only and quad_mode == "expanded":
+            xouter = expand_features(x)
 
     w, logZ = posteriors(
         state, x, diag_only=diag_only, quad_mode=quad_mode,
@@ -126,7 +133,7 @@ def chunk_stats(
         M2 = unpack_sym(jnp.einsum("nk,nt->kt", w, xouter, precision=prec), D)
     else:
         if xouter is None:
-            xouter = (x[:, :, None] * x[:, None, :]).reshape(B, D * D)
+            xouter = expand_features(x)
         M2 = jnp.einsum("nk,nf->kf", w, xouter, precision=prec).reshape(K, D, D)
     return SuffStats(loglik=loglik, Nk=Nk, M1=M1, M2=M2)
 
@@ -140,28 +147,34 @@ def accumulate_stats(
     quad_mode: str = "expanded",
     matmul_precision: str = "highest",
     cluster_axis: str | None = None,
+    feats_chunks: Optional[jax.Array] = None,
 ) -> SuffStats:
     """Scan the fused E+M pass over [num_chunks, B, D] event chunks.
 
     The scan keeps the working set to one chunk's intermediates -- the
     TPU-native analog of the reference streaming events through a fixed grid of
     thread blocks -- and means the N x K posterior matrix never exists in HBM.
+
+    ``feats_chunks`` optionally carries precomputed [num_chunks, B, F]
+    outer-product features (loop-invariant across EM iterations; see
+    em_while_loop's precompute_features).
     """
     num_chunks, B, D = data_chunks.shape
     K = state.means.shape[0]
 
     def body(acc, inp):
-        x, wts = inp
+        x, wts, feats = inp
         s = chunk_stats(
             state, x, wts, diag_only=diag_only, quad_mode=quad_mode,
             matmul_precision=matmul_precision, cluster_axis=cluster_axis,
+            xouter=feats,
         )
         return acc + s, None
 
     if wts_chunks is None:
         wts_chunks = jnp.ones(data_chunks.shape[:2], data_chunks.dtype)
     init = zeros_stats(K, D, data_chunks.dtype, diag_only=diag_only)
-    acc, _ = lax.scan(body, init, (data_chunks, wts_chunks))
+    acc, _ = lax.scan(body, init, (data_chunks, wts_chunks, feats_chunks))
     return acc
 
 

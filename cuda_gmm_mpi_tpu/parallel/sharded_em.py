@@ -203,6 +203,7 @@ class ShardedGMMModel:
             cluster_axis=cluster_axis,
             stats_fn=stats_fn,
             covariance_type=config.covariance_type,
+            precompute_features=config.precompute_features,
             **kw,
         )
         sspec = state_pspecs()
@@ -380,6 +381,7 @@ class ShardedGMMModel:
                 criterion=self.config.criterion,
                 reduce_order_fn=reduce_order_fn, emit_cb=emit_cb,
                 emit_light=emit_light, emit_gather_fn=emit_gather_fn,
+                precompute_features=self.config.precompute_features,
                 **self._kw, **static,
             )
             sspec = state_pspecs()

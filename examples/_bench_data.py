@@ -1,0 +1,19 @@
+"""Shared synthetic dataset for the benchmark example scripts.
+
+One definition so bench_kernel_precision / bench_components /
+bench_streaming rows measured at the same (n, d, k) are measured on the
+SAME bytes -- cross-script comparisons depend on it.
+"""
+
+from __future__ import annotations
+
+import numpy as np
+
+
+def make_bench_data(n: int, d: int, k: int, seed: int = 42):
+    """(data [n, d] float32, centers [k, d]): k scale-8 blobs, unit noise."""
+    rng = np.random.default_rng(seed)
+    centers = rng.normal(scale=8.0, size=(k, d))
+    data = (centers[rng.integers(0, k, n)]
+            + rng.normal(size=(n, d))).astype(np.float32)
+    return data, centers

@@ -30,6 +30,8 @@ import time
 
 import numpy as np
 
+from _bench_data import make_bench_data
+
 SHAPES = {
     "north": dict(n=1_000_000, d=24, k=100),
     "envelope": dict(n=1_000_000, d=32, k=512),
@@ -68,10 +70,7 @@ def main() -> int:
     for name in names:
         spec = SHAPES[name]
         n, d, k = spec["n"], spec["d"], spec["k"]
-        rng = np.random.default_rng(42)
-        centers = rng.normal(scale=8.0, size=(k, d))
-        data = (centers[rng.integers(0, k, n)]
-                + rng.normal(size=(n, d))).astype(np.float32)
+        data, _ = make_bench_data(n, d, k)
         state = seed_clusters_host(data, k)
         chunks_np, wts_np = chunk_events(data, 131072)
         chunks, wts = jnp.asarray(chunks_np), jnp.asarray(wts_np)

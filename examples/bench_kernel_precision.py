@@ -17,6 +17,8 @@ import time
 
 import numpy as np
 
+from _bench_data import make_bench_data
+
 
 SHAPES = {
     "north": dict(n=1_000_000, d=24, k=100, diag=False),
@@ -50,10 +52,7 @@ def main() -> int:
     for name in names:
         spec = SHAPES[name]
         n, d, k, diag = spec["n"], spec["d"], spec["k"], spec["diag"]
-        rng = np.random.default_rng(42)
-        centers = rng.normal(scale=8.0, size=(k, d))
-        data = (centers[rng.integers(0, k, n)]
-                + rng.normal(size=(n, d))).astype(np.float32)
+        data, _ = make_bench_data(n, d, k)
         state = seed_clusters_host(data, k)
         eps = convergence_epsilon(n, d)
 
@@ -80,6 +79,17 @@ def main() -> int:
                             chunk_size=131072, diag_only=diag,
                             matmul_precision=prec)
             run(f"xla {prec}", cfg)
+            if not diag:
+                # The round-4 XLA-path candidate: features hoisted out of
+                # the EM loop (precompute_features) -- kills the
+                # per-iteration xouter rebuild/write at the cost of N*F*4
+                # bytes HBM residency. Compare directly against the kernel
+                # rows below.
+                run(f"xla+feats {prec}",
+                    GMMConfig(min_iters=iters, max_iters=iters,
+                              chunk_size=131072, diag_only=diag,
+                              matmul_precision=prec,
+                              precompute_features=True))
             for bb in blocks:
                 kcfg = GMMConfig(min_iters=iters, max_iters=iters,
                                  chunk_size=131072, diag_only=diag,

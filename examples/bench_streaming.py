@@ -18,6 +18,8 @@ import time
 
 import numpy as np
 
+from _bench_data import make_bench_data
+
 
 def main() -> int:
     n, d, k, iters, chunk, mesh = 4_000_000, 24, 64, 10, 131072, 0
@@ -54,10 +56,7 @@ def main() -> int:
     print(f"platform: {jax.devices()[0].platform}  n={n} d={d} k={k} "
           f"iters={iters} chunk={chunk} mesh={mesh or 'off'}", flush=True)
 
-    rng = np.random.default_rng(42)
-    centers = rng.normal(scale=8.0, size=(k, d))
-    data = (centers[rng.integers(0, k, n)]
-            + rng.normal(size=(n, d))).astype(np.float32)
+    data, _ = make_bench_data(n, d, k)
     state = seed_clusters_host(data, k)
     eps = convergence_epsilon(n, d)
     mesh_shape = (mesh, 1) if mesh else None

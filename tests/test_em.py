@@ -87,3 +87,37 @@ def test_em_float32_close_to_oracle(blobs):
     np.testing.assert_allclose(float(ll), lls[-1], rtol=2e-5)
     np.testing.assert_allclose(np.asarray(state.means), params["means"],
                                rtol=2e-3, atol=2e-3)
+
+
+def test_precompute_features_bitwise_identical(blobs):
+    """precompute_features hoists the [C, B, F] features out of the EM loop
+    but feeds the SAME values through the SAME matmuls: the whole fit --
+    plain model, sharded model, and the fused sweep -- must be bit-identical
+    with the flag on."""
+    import pytest
+
+    from cuda_gmm_mpi_tpu.models.order_search import fit_gmm
+
+    data, _ = blobs
+    kw = dict(min_iters=5, max_iters=5, chunk_size=256, dtype="float64")
+
+    for extra in (dict(), dict(mesh_shape=(4, 2)), dict(fused_sweep=True)):
+        r0 = fit_gmm(data, 5, 2, GMMConfig(**kw, **extra))
+        r1 = fit_gmm(data, 5, 2,
+                     GMMConfig(precompute_features=True, **kw, **extra))
+        assert r1.ideal_num_clusters == r0.ideal_num_clusters, extra
+        np.testing.assert_array_equal(np.asarray(r1.means),
+                                      np.asarray(r0.means), err_msg=str(extra))
+        np.testing.assert_array_equal(r1.final_loglik, r0.final_loglik,
+                                      err_msg=str(extra))
+
+    # Guards: the flag is meaningless off the expanded full-covariance
+    # in-memory path and must say so.
+    with pytest.raises(ValueError, match="full-covariance"):
+        GMMConfig(precompute_features=True, diag_only=True)
+    with pytest.raises(ValueError, match="expanded"):
+        GMMConfig(precompute_features=True, quad_mode="packed")
+    with pytest.raises(ValueError, match="Pallas"):
+        GMMConfig(precompute_features=True, use_pallas="always")
+    with pytest.raises(ValueError, match="stream"):
+        GMMConfig(precompute_features=True, stream_events=True)
