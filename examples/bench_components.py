@@ -41,11 +41,16 @@ SHAPES = {
 def main() -> int:
     names = [a for a in sys.argv[1:] if not a.startswith("--")] or ["north"]
     iters, precision = 20, "high"
+    n_override, chunk = None, 131072
     for a in sys.argv[1:]:
         if a.startswith("--iters="):
             iters = int(a.split("=", 1)[1])
         if a.startswith("--precision="):
             precision = a.split("=", 1)[1]
+        if a.startswith("--n="):  # smoke-testing the runbook off-TPU
+            n_override = int(a.split("=", 1)[1])
+        if a.startswith("--chunk="):
+            chunk = int(a.split("=", 1)[1])
 
     import jax
 
@@ -70,9 +75,11 @@ def main() -> int:
     for name in names:
         spec = SHAPES[name]
         n, d, k = spec["n"], spec["d"], spec["k"]
+        if n_override:
+            n = n_override
         data, _ = make_bench_data(n, d, k)
         state = seed_clusters_host(data, k)
-        chunks_np, wts_np = chunk_events(data, 131072)
+        chunks_np, wts_np = chunk_events(data, chunk)
         chunks, wts = jnp.asarray(chunks_np), jnp.asarray(wts_np)
         kw = dict(diag_only=False, quad_mode="expanded",
                   matmul_precision=precision)

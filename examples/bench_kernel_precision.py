@@ -31,27 +31,39 @@ def main() -> int:
     names = [a for a in sys.argv[1:] if not a.startswith("--")] or ["north"]
     blocks = [512]
     iters = 20
+    n_override = chunk = None
     for a in sys.argv[1:]:
         if a.startswith("--blocks="):
             blocks = [int(v) for v in a.split("=", 1)[1].split(",")]
         if a.startswith("--iters="):
             iters = int(a.split("=", 1)[1])
+        if a.startswith("--n="):
+            # Shrink the event count (smoke-testing the runbook off-TPU;
+            # decision runs use the real shapes).
+            n_override = int(a.split("=", 1)[1])
+        if a.startswith("--chunk="):
+            chunk = int(a.split("=", 1)[1])
 
     import jax
+
+    for a in sys.argv[1:]:
+        if a.startswith("--device="):
+            jax.config.update("jax_platforms", a.split("=", 1)[1])
     import jax.numpy as jnp
 
     from cuda_gmm_mpi_tpu.config import GMMConfig
     from cuda_gmm_mpi_tpu.models.gmm import GMMModel, chunk_events
     from cuda_gmm_mpi_tpu.ops.formulas import convergence_epsilon
-    from cuda_gmm_mpi_tpu.ops.pallas.fused_stats import fused_stats_pallas
     from cuda_gmm_mpi_tpu.ops.seeding import seed_clusters_host
-    import functools
 
     print(f"platform: {jax.devices()[0].platform}", flush=True)
 
     for name in names:
         spec = SHAPES[name]
         n, d, k, diag = spec["n"], spec["d"], spec["k"], spec["diag"]
+        if n_override:
+            n = n_override
+        chunk_size = chunk or 131072
         data, _ = make_bench_data(n, d, k)
         state = seed_clusters_host(data, k)
         eps = convergence_epsilon(n, d)
@@ -76,7 +88,7 @@ def main() -> int:
 
         for prec in ("high", "highest", "default"):
             cfg = GMMConfig(min_iters=iters, max_iters=iters,
-                            chunk_size=131072, diag_only=diag,
+                            chunk_size=chunk_size, diag_only=diag,
                             matmul_precision=prec)
             run(f"xla {prec}", cfg)
             if not diag:
@@ -87,18 +99,19 @@ def main() -> int:
                 # rows below.
                 run(f"xla+feats {prec}",
                     GMMConfig(min_iters=iters, max_iters=iters,
-                              chunk_size=131072, diag_only=diag,
+                              chunk_size=chunk_size, diag_only=diag,
                               matmul_precision=prec,
                               precompute_features=True))
             for bb in blocks:
+                # use_pallas='always' routes GMMModel through make_stats_fn,
+                # which builds the kernel partial (incl. the off-TPU
+                # interpret fallback) -- one policy, no duplicate here.
                 kcfg = GMMConfig(min_iters=iters, max_iters=iters,
-                                 chunk_size=131072, diag_only=diag,
+                                 chunk_size=chunk_size, diag_only=diag,
                                  matmul_precision=prec, use_pallas="always",
                                  pallas_block_b=bb)
-                sf = functools.partial(fused_stats_pallas, diag_only=diag,
-                                       block_b=bb, precision=prec)
                 try:
-                    run(f"kernel {prec} b={bb}", kcfg, stats_fn=sf)
+                    run(f"kernel {prec} b={bb}", kcfg)
                 except Exception as e:  # Mosaic compile failures are data too
                     print(f"{name:9s} kernel {prec} b={bb}: FAILED "
                           f"{type(e).__name__}: {str(e)[:120]}", flush=True)

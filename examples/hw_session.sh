@@ -9,9 +9,21 @@
 # Protocol notes (.claude/skills/verify/SKILL.md): generous budgets, no
 # tight `timeout` wrappers (a killed mid-execution client wedges the
 # single-admission tunnel), amortized timing inside each script.
+# HW_SMOKE=1 shrinks every step to toy shapes on CPU so the whole runbook
+# can be validated end-to-end without the tunnel (a broken step discovered
+# DURING the real session wastes the tunnel window).
 set -u
 cd "$(dirname "$0")/.."
-LOGDIR=${LOGDIR:-hw_r04_logs}
+# The package is not pip-installed; examples/* import it from the repo root.
+export PYTHONPATH="$PWD${PYTHONPATH:+:$PYTHONPATH}"
+SMOKE=()
+default_logdir=hw_r04_logs
+if [ "${HW_SMOKE:-}" = "1" ]; then
+  default_logdir=/tmp/hw_smoke_logs
+  export GMM_BENCH_CPU=1
+  SMOKE=(--n=20000 --chunk=4096 --iters=2 --device=cpu)
+fi
+LOGDIR=${LOGDIR:-$default_logdir}
 mkdir -p "$LOGDIR"
 
 step() {
@@ -27,9 +39,10 @@ step() {
 
 # 1. The official bench (BENCH_r04 rehearsal): north-star on TPU.
 step bench_north python bench.py
-# 2. Kernel-vs-XLA decision data (the ~5.6 ms/iter xouter HBM win).
-step kernel_north python examples/bench_kernel_precision.py north --blocks=256,512,1024
-step kernel_envelope_diag python examples/bench_kernel_precision.py envelope diag --blocks=256,512
+# 2. Kernel-vs-XLA(-vs-feature-hoist) decision data (the ~5.6 ms/iter
+#    xouter HBM win).
+step kernel_north python examples/bench_kernel_precision.py north --blocks=256,512,1024 "${SMOKE[@]}"
+step kernel_envelope_diag python examples/bench_kernel_precision.py envelope diag --blocks=256,512 "${SMOKE[@]}"
 # 3. Config matrix incl. 5 (fresh same-session CPU denominator rides in
 #    bench.py's in-process baseline) and the reference envelope 6.
 step bench_5 python bench.py --config=5
@@ -37,9 +50,10 @@ step bench_5stream python bench.py --config=5stream
 step bench_6 python bench.py --config=6
 step bench_3_diag python bench.py --config=3
 # 4. Streaming overlap: double-buffered out-of-core vs in-memory (item 6).
-step stream_overlap python examples/bench_streaming.py --n=4000000 --iters=10
+#    (SMOKE's flags come last, so they win over the full-shape defaults.)
+step stream_overlap python examples/bench_streaming.py --n=4000000 --iters=10 "${SMOKE[@]}"
 # 5. MFU decomposition (item 3): attribute the north-star iteration's
 #    wall time to quad/lse/moments/xouter components.
-step components_north python examples/bench_components.py north
-step components_envelope python examples/bench_components.py envelope --iters=10
+step components_north python examples/bench_components.py north "${SMOKE[@]}"
+step components_envelope python examples/bench_components.py envelope --iters=10 "${SMOKE[@]}"
 echo "session complete; logs in $LOGDIR/"
