@@ -146,6 +146,9 @@ def build_parser() -> argparse.ArgumentParser:
     t.add_argument("--checkpoint-dir", default=None,
                    help="orbax checkpoint directory for the K-sweep (resume "
                    "with the same path)")
+    t.add_argument("--checkpoint-keep", type=int, default=2,
+                   help="retained checkpoint steps (newest + fallbacks); "
+                   "older steps are pruned after each durable save")
     t.add_argument("--sweep-log", default=None, metavar="FILE.jsonl",
                    help="write the per-K sweep trajectory (num_clusters, "
                    "loglik, score, criterion, em_iters, seconds) as JSON "
@@ -227,6 +230,7 @@ def main(argv=None) -> int:
             enable_output=not args.no_output,
             profile=args.profile,
             checkpoint_dir=args.checkpoint_dir,
+            checkpoint_keep=args.checkpoint_keep,
             debug_nans=args.debug_nans,
             validate_input=not args.no_validate_input,
             stream_events=args.stream_events,

@@ -113,6 +113,10 @@ class GMMConfig:
     enable_print: bool = False
     enable_output: bool = True
 
+    # Retained sweep-checkpoint steps (newest + fallbacks; utils/checkpoint
+    # prunes older ones after each durable save). >= 1.
+    checkpoint_keep: int = 2
+
     # --- aux subsystems ---
     profile: bool = False
     checkpoint_dir: Optional[str] = None
@@ -192,6 +196,8 @@ class GMMConfig:
                     "not fit there -- drop one flag")
         if self.seed_method not in ("even", "kmeans++"):
             raise ValueError(f"unknown seed_method: {self.seed_method!r}")
+        if self.checkpoint_keep < 1:
+            raise ValueError("checkpoint_keep must be >= 1")
         if self.chunk_size < 1:
             raise ValueError("chunk_size must be >= 1")
         if self.pallas_block_b < 1:

@@ -277,7 +277,8 @@ def fit_gmm(
         # multi-process saves (primary host writes). Multi-host runs require
         # checkpoint_dir on a filesystem every rank can read (on TPU pods
         # that is GCS/NFS by construction; docs/DISTRIBUTED.md).
-        ckpt = SweepCheckpointer(config.checkpoint_dir)
+        ckpt = SweepCheckpointer(config.checkpoint_dir,
+                                 keep=config.checkpoint_keep)
 
     if config.fused_sweep:
         # Checkpointing AND profiling both ride the per-K io_callback

@@ -56,14 +56,58 @@ def _from_tree(t: Dict[str, Any]) -> GMMState:
 
 
 class SweepCheckpointer:
-    """Orbax-backed persistence of the order-search sweep."""
+    """Orbax-backed persistence of the order-search sweep.
 
-    def __init__(self, directory: str):
+    ``keep`` bounds retained steps (default 2: the newest for resume plus
+    one fallback in case the newest is torn -- restore() walks back). A
+    K=512 sweep would otherwise leave ~500 dead steps (~17 MB each at the
+    reference envelope) on the checkpoint filesystem.
+    """
+
+    def __init__(self, directory: str, keep: int = 2):
         import orbax.checkpoint as ocp
 
         self._dir = os.path.abspath(os.path.join(directory, "sweep"))
         os.makedirs(self._dir, exist_ok=True)
         self._ckpt = ocp.StandardCheckpointer()
+        self._keep = max(1, keep)
+
+    def _prune(self, newest_step: int) -> None:
+        """Drop steps older than the retention window. Called by the save
+        paths AFTER step ``newest_step`` is durable; only process 0 removes
+        (other ranks never write). Best-effort: a prune failure must never
+        break the run that just checkpointed successfully."""
+        import shutil
+
+        cutoff = newest_step - self._keep + 1
+        try:
+            for s in self._all_steps():
+                if s >= cutoff:
+                    continue
+                try:
+                    npz = os.path.join(self._dir, f"{s}.npz")
+                    if os.path.exists(npz):
+                        os.remove(npz)
+                    d = os.path.join(self._dir, str(s))
+                    if os.path.isdir(d):
+                        shutil.rmtree(d)
+                except OSError:
+                    pass
+            # Orphaned tmp files from crashed save_local calls (killed
+            # between mkstemp and replace) match neither pattern above;
+            # they are dead the moment this process is saving again (one
+            # writer, serialized saves), so sweep them too.
+            for f in os.listdir(self._dir):
+                if f.endswith(".tmp.npz"):
+                    try:
+                        os.remove(os.path.join(self._dir, f))
+                    except OSError:
+                        pass
+        except OSError:
+            # Best-effort end to end: a transient listdir failure (ESTALE/
+            # EIO on network filesystems) must never kill the run that
+            # just checkpointed successfully.
+            pass
 
     def save(self, step: int, payload: Dict[str, Any]) -> None:
         """payload: state, best_state (GMMState), plus plain scalars."""
@@ -73,6 +117,10 @@ class SweepCheckpointer:
         path = os.path.join(self._dir, str(step))
         self._ckpt.save(path, tree, force=True)
         self._ckpt.wait_until_finished()
+        import jax
+
+        if jax.process_index() == 0:
+            self._prune(step)
 
     def save_local(self, step: int, payload: Dict[str, Any]) -> None:
         """Callback-safe save: no device work, no cross-process barrier.
@@ -117,6 +165,7 @@ class SweepCheckpointer:
             os.fsync(dir_fd)
         finally:
             os.close(dir_fd)
+        self._prune(step)  # already process-0-only here
 
     def _all_steps(self) -> list:
         if not os.path.isdir(self._dir):

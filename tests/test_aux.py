@@ -76,6 +76,25 @@ def test_checkpoint_restore_skips_torn_newest(rng, tmp_path):
                                   np.asarray(good["state"].means))
 
 
+def test_checkpoint_retention_bounds_disk(rng, tmp_path):
+    """Only the retention window (default 2 steps) survives a sweep: a
+    K=512 run must not leave ~500 dead checkpoints on the (possibly GCS)
+    checkpoint filesystem. Applies to both write paths."""
+    import os
+
+    data, _ = make_blobs(rng, n=400, d=2, k=3)
+    for sub, extra in (("host", {}), ("fused", dict(fused_sweep=True))):
+        ck = tmp_path / sub
+        fit_gmm(data, 8, 2, config=fast_cfg(checkpoint_dir=str(ck), **extra))
+        steps = [f for f in os.listdir(ck / "sweep")
+                 if f.isdigit() or (f.endswith(".npz") and f[:-4].isdigit())]
+        assert len(steps) <= 2, (sub, steps)
+        # ...and the survivors still resume to the same answer
+        r = fit_gmm(data, 8, 2,
+                    config=fast_cfg(checkpoint_dir=str(ck), **extra))
+        assert r.ideal_num_clusters >= 2
+
+
 def test_checkpoint_ignored_for_different_k(rng, tmp_path):
     data, _ = make_blobs(rng, n=300, d=2, k=2)
     cfg = fast_cfg(checkpoint_dir=str(tmp_path / "ck2"))
