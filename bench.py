@@ -182,6 +182,38 @@ def main() -> int:
         print("bench.py: accelerator probe failed; using CPU", file=sys.stderr)
         want_cpu = accel_unavailable = True
 
+    # Watchdog: the probe only proves the accelerator was alive at start;
+    # a tunnel that dies MID-RUN would hang the measurement forever and
+    # leave the harness with no artifact at all. After the deadline, emit
+    # an explicit unavailable-JSON and exit 3 (same contract as the probe
+    # fallback, but distinguishable via "watchdog": true).
+    import threading
+
+    watchdog_s = float(os.environ.get("GMM_BENCH_WATCHDOG_S", 1800))
+
+    def _watchdog_fire():
+        print(json.dumps({
+            "metric": f"EM iters/sec (config={cfg_name})",
+            "value": 0.0,
+            "unit": "iters/sec",
+            "vs_baseline": 0.0,
+            "accelerator_unavailable": True,
+            "watchdog": True,
+            "platform_note": (
+                f"benchmark exceeded {watchdog_s:.0f}s after a successful "
+                "accelerator probe -- the device likely died mid-run; no "
+                "measurement was completed"),
+        }), flush=True)
+        os._exit(3)
+
+    # Accelerator runs only: CPU runs (deliberate or probe-fallback) have
+    # no tunnel to die mid-run, and the rc-0 CPU contract must hold even
+    # on a slow host.
+    watchdog = threading.Timer(watchdog_s, _watchdog_fire)
+    watchdog.daemon = True
+    if not want_cpu:
+        watchdog.start()
+
     import jax
 
     if want_cpu:
@@ -387,6 +419,7 @@ def main() -> int:
         "precision": precision,
         **note,
     }
+    watchdog.cancel()
     print(json.dumps(result))
     # Distinguishable failure: rc 3 marks "no accelerator" (the JSON line is
     # still printed so the artifact explains itself). rc 0 = real measurement
