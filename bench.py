@@ -226,7 +226,16 @@ def main() -> int:
     n_events, n_dims, k = spec["n"], spec["d"], spec["k"]
     target_k = int(spec.get("target_k", 0))
     if on_accel:
-        bench_iters, chunk = 20, 131072
+        # GMM_BENCH_CHUNK tunes the accelerator chunk size (hardware
+        # sessions probe 131072 vs larger tiles). Empty-string-safe like
+        # GMM_BENCH_PRECISION; nonpositive values fail loudly here rather
+        # than degenerating inside chunk_events.
+        bench_iters = 20
+        chunk = int(os.environ.get("GMM_BENCH_CHUNK") or 131072)
+        if chunk < 1:
+            print(f"bench.py: GMM_BENCH_CHUNK={chunk} must be >= 1",
+                  file=sys.stderr)
+            return 2
     else:
         # Scaled down on CPU so the harness stays fast.
         n_events = min(n_events, 100_000)
