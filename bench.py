@@ -272,6 +272,11 @@ def main() -> int:
     precision = os.environ.get("GMM_BENCH_PRECISION") or (
         "highest" if diag else "high"
     )
+    # GMM_BENCH_PRECOMPUTE=1 A/Bs the feature hoist on the official bench
+    # artifact (full-covariance in-memory configs only -- the flag's own
+    # domain; see GMMConfig.precompute_features).
+    precompute = (os.environ.get("GMM_BENCH_PRECOMPUTE") == "1"
+                  and not diag and not spec.get("stream"))
 
     def measure(use_pallas: str):
         """(iters, dt, ll, final_state, sweep_extra) for one measured run."""
@@ -285,7 +290,8 @@ def main() -> int:
             fit_cfg = GMMConfig(min_iters=bench_iters, max_iters=bench_iters,
                                 chunk_size=chunk, diag_only=diag,
                                 matmul_precision=precision,
-                                use_pallas=use_pallas, fused_sweep=True)
+                                use_pallas=use_pallas, fused_sweep=True,
+                                precompute_features=precompute)
             fit_model = GMMModel(fit_cfg)
             fit_gmm(data, k, target_k, fit_cfg, model=fit_model)  # warm
             t0 = time.perf_counter()
@@ -313,7 +319,8 @@ def main() -> int:
                         chunk_size=chunk, diag_only=diag,
                         matmul_precision=precision,
                         use_pallas=use_pallas,
-                        stream_events=bool(spec.get("stream", False)))
+                        stream_events=bool(spec.get("stream", False)),
+                        precompute_features=precompute)
         chunks, wts = chunk_events(data, cfg.chunk_size)
         if cfg.stream_events:
             from cuda_gmm_mpi_tpu.models.streaming import StreamingGMMModel
@@ -402,6 +409,8 @@ def main() -> int:
     note = dict(sweep_extra)
     if spec.get("stream"):
         note["streamed"] = True
+    if precompute:
+        note["precompute_features"] = True
     if diag:
         note["baseline_note"] = "CPU baseline runs the diagonal iteration"
     if accel_unavailable:
