@@ -37,8 +37,11 @@ step() {
   { "$@" && echo DONE; } 2>&1 | tee "$log"
 }
 
-# 1. The official bench (BENCH_r04 rehearsal): north-star on TPU.
+# 1. The official bench (BENCH_r04 rehearsal): north-star on TPU; plus the
+#    two one-env A/Bs (feature hoist; double-size chunk tile).
 step bench_north python bench.py
+step bench_north_feats env GMM_BENCH_PRECOMPUTE=1 python bench.py
+step bench_north_chunk262k env GMM_BENCH_CHUNK=262144 python bench.py
 # 2. Kernel-vs-XLA(-vs-feature-hoist) decision data (the ~5.6 ms/iter
 #    xouter HBM win).
 step kernel_north python examples/bench_kernel_precision.py north --blocks=256,512,1024 "${SMOKE[@]}"
