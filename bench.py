@@ -13,6 +13,15 @@ vs_baseline  = speedup over an optimized vectorized CPU (NumPy/BLAS)
 Smaller shapes are used automatically on CPU-only hosts so the bench stays
 fast; the reported metric is always normalized to iterations/sec at the
 measured shape, with the shape recorded in the JSON.
+
+Env knobs: GMM_BENCH_CPU=1 (deliberate CPU run, rc 0); GMM_BENCH_PRECISION
+(matmul precision override); GMM_BENCH_PRECOMPUTE=1 (feature-hoist A/B,
+full-covariance in-memory configs); GMM_BENCH_CHUNK (accelerator chunk
+size); GMM_BENCH_WATCHDOG_S (mid-run dead-device deadline, default 1800);
+GMM_BENCH_PROBE_{ATTEMPTS,TIMEOUT_S,WAIT_S} (accelerator probe budget).
+Exit codes: 0 = measured on the intended platform; 2 = bad usage; 3 = no
+accelerator (probe fallback or watchdog; JSON carries
+accelerator_unavailable=true).
 """
 
 from __future__ import annotations
