@@ -20,7 +20,6 @@ import numpy as np
 from .config import GMMConfig
 from .models.gmm import GMMModel, chunk_events
 from .models.order_search import GMMResult, fit_gmm
-from .ops.estep import posteriors
 
 
 class GaussianMixture:
