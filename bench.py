@@ -18,7 +18,12 @@ Env knobs: GMM_BENCH_CPU=1 (deliberate CPU run, rc 0); GMM_BENCH_PRECISION
 (matmul precision override); GMM_BENCH_PRECOMPUTE=1 (feature-hoist A/B,
 full-covariance in-memory configs); GMM_BENCH_CHUNK (accelerator chunk
 size); GMM_BENCH_WATCHDOG_S (mid-run dead-device deadline, default 1800);
-GMM_BENCH_PROBE_{ATTEMPTS,TIMEOUT_S,WAIT_S} (accelerator probe budget).
+GMM_BENCH_PROBE_{ATTEMPTS,TIMEOUT_S,WAIT_S} (accelerator probe budget);
+GMM_BENCH_SETTLE_S (pause between the probe client's disconnect and this
+process's device init, default 10); GMM_BENCH_REQUIRE_ACCEL=1 (on probe
+failure, emit the unavailable artifact and exit 3 immediately instead of
+measuring the CPU fallback -- for unattended accelerator sessions where a
+multi-hour CPU run of a 10M-event config would be pure waste).
 Exit codes: 0 = measured on the intended platform; 2 = bad usage; 3 = no
 accelerator (probe fallback or watchdog; JSON carries
 accelerator_unavailable=true).
@@ -188,8 +193,29 @@ def main() -> int:
         # hanging the harness; the platform is recorded in the metric AND in
         # an explicit note so a CPU-fallback number is never mistaken for an
         # accelerator regression.
+        if os.environ.get("GMM_BENCH_REQUIRE_ACCEL") == "1":
+            print(json.dumps({
+                "metric": f"EM iters/sec (config={cfg_name})",
+                "value": 0.0,
+                "unit": "iters/sec",
+                "vs_baseline": 0.0,
+                "accelerator_unavailable": True,
+                "platform_note": (
+                    "accelerator probe failed and GMM_BENCH_REQUIRE_ACCEL=1 "
+                    "-- skipping the CPU fallback measurement"),
+            }), flush=True)
+            return 3
         print("bench.py: accelerator probe failed; using CPU", file=sys.stderr)
         want_cpu = accel_unavailable = True
+    elif not want_cpu:
+        # The probe subprocess was itself a tunnel client that just
+        # disconnected; give the single-admission relay a moment to release
+        # it before this process's own (uninterruptible) device init
+        # connects. Back-to-back admission is a suspected wedge trigger
+        # (2026-07-31 session: one client hung in init ~6s after the
+        # previous client exited). Empty-string-safe like GMM_BENCH_CHUNK;
+        # negative values clamp to 0.
+        time.sleep(max(0.0, float(os.environ.get("GMM_BENCH_SETTLE_S") or 10)))
 
     # Watchdog: the probe only proves the accelerator was alive at start;
     # a tunnel that dies MID-RUN would hang the measurement forever and

@@ -22,19 +22,95 @@ if [ "${HW_SMOKE:-}" = "1" ]; then
   default_logdir=/tmp/hw_smoke_logs
   export GMM_BENCH_CPU=1
   SMOKE=(--n=20000 --chunk=4096 --iters=2 --device=cpu)
+else
+  # This session exists to measure the accelerator; if the tunnel is gone,
+  # a bench step must exit 3 immediately (step() then aborts the session)
+  # rather than burn hours measuring a 10M-event config on CPU. One probe
+  # attempt only: retry-on-wedge is the OUTER loop's job
+  # (hw_wait_and_run.sh), and bench.py's default 5-attempt ladder would
+  # fire the timeout-killed-client pile-up astep() exists to avoid.
+  export GMM_BENCH_REQUIRE_ACCEL=1
+  export GMM_BENCH_PROBE_ATTEMPTS=${GMM_BENCH_PROBE_ATTEMPTS:-1}
 fi
 LOGDIR=${LOGDIR:-$default_logdir}
 mkdir -p "$LOGDIR"
 
+abort_wedged() {
+  # Continuing past a dead tunnel would make every remaining step fire its
+  # own ladder of timeout-killed probe clients against it -- the exact
+  # pile-up SKILL.md warns extends the wedge. Stop; resume later (rc 3 is
+  # also hw_wait_and_run.sh's signal to go back to waiting).
+  echo "== $1: accelerator unavailable -- aborting session;"
+  echo "   re-run examples/hw_session.sh when the tunnel returns"
+  exit 3
+}
+
+finish_step() {  # finish_step <name> <log> <rc>
+  if [ "$3" -eq 0 ]; then
+    echo DONE | tee -a "$2"
+  elif [ "$3" -eq 3 ]; then
+    abort_wedged "$1"   # bench.py contract: probe fallback or watchdog
+  else
+    echo "== $1: failed (rc=$3); no DONE written, will re-run on resume"
+  fi
+}
+
+skip_done() {  # true (and prints) if this step's log already ends in DONE
+  [ -f "$1" ] && grep -q "^DONE$" "$1"
+}
+
+settle() {
+  # Let the single-admission relay release the previous client before the
+  # next one connects. Observed 2026-07-31: a step that connected ~6s
+  # after the prior client exited hung forever in device init (in-process
+  # init has no retry) and its watchdog-kill then wedged the tunnel for
+  # the rest of the window; the same relay had just served back-to-back
+  # clients spaced ~25s apart without trouble. Applies before the FIRST
+  # step too: the documented entry path probes the tunnel immediately
+  # before launching this script, and that probe was itself a client.
+  [ ${#SMOKE[@]} -eq 0 ] && sleep "${HW_STEP_SETTLE_S:-45}"
+  return 0
+}
+
+# For bench.py, which carries its own accelerator probe, CPU-fallback
+# refusal (GMM_BENCH_REQUIRE_ACCEL) and mid-run watchdog.
 step() {
   local name=$1; shift
   local log="$LOGDIR/$name.log"
-  if [ -f "$log" ] && grep -q "^DONE$" "$log"; then
-    echo "== $name: already done, skipping"
-    return 0
-  fi
+  if skip_done "$log"; then echo "== $name: already done, skipping"; return 0; fi
+  settle
   echo "== $name: $*"
-  { "$@" && echo DONE; } 2>&1 | tee "$log"
+  "$@" 2>&1 | tee "$log"
+  finish_step "$name" "$log" "${PIPESTATUS[0]}"
+}
+
+# For the example scripts, which have NO probe/watchdog of their own: a
+# wedged tunnel would hang their in-process device init forever. Guard
+# with a single preflight probe client (no retry ladder) and an outer
+# wall-clock bound; either failing aborts the session. The outer timeout
+# is the lesser evil explicitly: yes, a timeout-killed client can extend
+# the wedge (SKILL.md), but we abort right after, so nothing piles up --
+# whereas an unbounded hang silently eats the whole unattended window.
+astep() {
+  local name=$1; shift
+  local log="$LOGDIR/$name.log"
+  if skip_done "$log"; then echo "== $name: already done, skipping"; return 0; fi
+  settle
+  if [ ${#SMOKE[@]} -eq 0 ]; then
+    if ! timeout 180 python -c "import jax; jax.devices()" >/dev/null 2>&1; then
+      abort_wedged "$name (preflight probe)"
+    fi
+    sleep "${GMM_BENCH_SETTLE_S:-10}"   # probe client was just admitted
+    echo "== $name: $*"
+    timeout "${HW_STEP_TIMEOUT_S:-3600}" "$@" 2>&1 | tee "$log"
+    local rc=${PIPESTATUS[0]}
+    [ "$rc" -eq 124 ] && abort_wedged "$name (exceeded ${HW_STEP_TIMEOUT_S:-3600}s)"
+    finish_step "$name" "$log" "$rc"
+  else
+    echo "== $name: $*"
+    "$@" 2>&1 | tee "$log"
+    finish_step "$name" "$log" "${PIPESTATUS[0]}"
+  fi
 }
 
 # 1. The official bench (BENCH_r04 rehearsal): north-star on TPU; plus the
@@ -44,8 +120,8 @@ step bench_north_feats env GMM_BENCH_PRECOMPUTE=1 python bench.py
 step bench_north_chunk262k env GMM_BENCH_CHUNK=262144 python bench.py
 # 2. Kernel-vs-XLA(-vs-feature-hoist) decision data (the ~5.6 ms/iter
 #    xouter HBM win).
-step kernel_north python examples/bench_kernel_precision.py north --blocks=256,512,1024 "${SMOKE[@]}"
-step kernel_envelope_diag python examples/bench_kernel_precision.py envelope diag --blocks=256,512 "${SMOKE[@]}"
+astep kernel_north python examples/bench_kernel_precision.py north --blocks=256,512,1024 "${SMOKE[@]}"
+astep kernel_envelope_diag python examples/bench_kernel_precision.py envelope diag --blocks=256,512 "${SMOKE[@]}"
 # 3. Config matrix incl. 5 (fresh same-session CPU denominator rides in
 #    bench.py's in-process baseline) and the reference envelope 6.
 step bench_5 python bench.py --config=5
@@ -54,9 +130,9 @@ step bench_6 python bench.py --config=6
 step bench_3_diag python bench.py --config=3
 # 4. Streaming overlap: double-buffered out-of-core vs in-memory (item 6).
 #    (SMOKE's flags come last, so they win over the full-shape defaults.)
-step stream_overlap python examples/bench_streaming.py --n=4000000 --iters=10 "${SMOKE[@]}"
+astep stream_overlap python examples/bench_streaming.py --n=4000000 --iters=10 "${SMOKE[@]}"
 # 5. MFU decomposition (item 3): attribute the north-star iteration's
 #    wall time to quad/lse/moments/xouter components.
-step components_north python examples/bench_components.py north "${SMOKE[@]}"
-step components_envelope python examples/bench_components.py envelope --iters=10 "${SMOKE[@]}"
+astep components_north python examples/bench_components.py north "${SMOKE[@]}"
+astep components_envelope python examples/bench_components.py envelope --iters=10 "${SMOKE[@]}"
 echo "session complete; logs in $LOGDIR/"

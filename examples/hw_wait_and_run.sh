@@ -1,0 +1,87 @@
+#!/bin/bash
+# Wait for the axon TPU tunnel to come back, then run the measurement
+# session (examples/hw_session.sh, resumable). Designed to run unattended
+# in the background for hours.
+#
+# Probe discipline (.claude/skills/verify/SKILL.md): the relay is a LOCAL
+# listener, so `ss -tln` is a FREE check (no tunnel client is created) —
+# poll that often. A real `jax.devices()` probe creates a client, and a
+# timeout-killed client can EXTEND a wedge — so only probe when the
+# listener looks alive, at most once per GMM_HW_PROBE_EVERY_S (default
+# 20 min), and give each probe a generous 300s.
+#
+# The machine must also be QUIET before the session starts: bench.py
+# measures an in-process CPU baseline, and a concurrent test-suite run
+# contaminated round-3's config-5 denominator. We refuse to launch while
+# pytest (or another bench) is running.
+set -u
+cd "$(dirname "$0")/.."
+PROBE_EVERY_S=${GMM_HW_PROBE_EVERY_S:-1200}
+POLL_S=${GMM_HW_POLL_S:-120}
+DEADLINE_S=${GMM_HW_DEADLINE_S:-36000}
+start=$(date +%s)
+last_probe=0
+
+relay_alive() {
+  # Baseline listeners on this image are 48271 (relay control) and 2024;
+  # the tunnel's data ports show up beyond those when the relay is up.
+  ss -tln 2>/dev/null | awk '{print $4}' | grep -oE '[0-9]+$' \
+    | grep -vE '^(48271|2024)$' | grep -q .
+}
+
+machine_quiet() {
+  # NOT pgrep -f: the build-driver's own command line quotes these very
+  # words (its system prompt mentions pytest/bench.py), so match against
+  # ps args with the driver's wrapper processes filtered out first.
+  ! ps -eo args | grep -vE 'claude|grep' \
+    | grep -qE 'pytest|bench\.py|bench_kernel_precision|bench_streaming|bench_components'
+}
+
+while :; do
+  now=$(date +%s)
+  if [ $((now - start)) -gt "$DEADLINE_S" ]; then
+    echo "hw_wait: deadline reached without a live tunnel; giving up"
+    exit 1
+  fi
+  if relay_alive && [ $((now - last_probe)) -ge "$PROBE_EVERY_S" ]; then
+    if ! machine_quiet; then
+      echo "hw_wait: relay up but machine busy (pytest/bench running); waiting"
+      sleep "$POLL_S"
+      continue
+    fi
+    echo "hw_wait: relay listener up; probing device ($(date -u +%H:%M:%S))"
+    last_probe=$now
+    if timeout 300 python -c "import jax; jax.devices()" >/dev/null 2>&1; then
+      echo "hw_wait: tunnel ALIVE; settling, then running hw_session.sh"
+      sleep "${HW_STEP_SETTLE_S:-45}"
+      # The probe + settle took minutes; a pytest/bench run may have
+      # started meanwhile. Launching anyway would contaminate bench.py's
+      # in-process CPU baselines (the round-3 config-5 lesson), so
+      # re-check and hold until the machine is quiet again.
+      until machine_quiet; do
+        if [ $(( $(date +%s) - start )) -gt "$DEADLINE_S" ]; then
+          echo "hw_wait: deadline reached while holding for a quiet machine"
+          exit 1
+        fi
+        echo "hw_wait: tunnel alive but machine became busy; holding"
+        sleep "$POLL_S"
+      done
+      # Child, not exec: if the tunnel wedges mid-session the session
+      # aborts with rc 3 (its anti-pile-up contract) and THIS loop must
+      # survive to resume it when the tunnel comes back. rc 0 = every
+      # step DONE; anything else is left for the next attempt too.
+      bash examples/hw_session.sh
+      rc=$?
+      if [ "$rc" -eq 0 ]; then
+        echo "hw_wait: session complete"
+        exit 0
+      fi
+      echo "hw_wait: session aborted (rc=$rc); back to waiting"
+      last_probe=$(date +%s)   # the session just proved the tunnel is sick
+      sleep "$POLL_S"
+      continue
+    fi
+    echo "hw_wait: probe hung/failed; backing off ${PROBE_EVERY_S}s"
+  fi
+  sleep "$POLL_S"
+done
