@@ -1,0 +1,80 @@
+"""Lock bench.py's driver contract.
+
+The build driver's only interface to this repo's performance story is
+``python bench.py``: ONE JSON line on stdout (metric/value/unit/
+vs_baseline, BENCH_r{N}.json is recorded verbatim from it) plus an exit
+code — 0 measured on the intended platform, 2 bad usage, 3 no
+accelerator (``accelerator_unavailable`` set so a dead tunnel can never
+masquerade as a perf regression, the round-3 lesson where a CPU fallback
+was recorded as 0.9x baseline). These tests pin that contract from the
+outside, as a subprocess, exactly the way the driver calls it.
+
+No test here touches the TPU tunnel: the fast-fail test kills the probe
+subprocess in ~10 ms (before the child can even start importing jax),
+and the measured runs force GMM_BENCH_CPU=1.
+"""
+
+import json
+import os
+import subprocess
+import sys
+
+import pytest
+
+from .conftest import worker_env
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+BENCH = os.path.join(REPO, "bench.py")
+
+
+def _run(env_extra, args=(), timeout=600):
+    # worker_env scrubs the harness's 8-device forcing and pins CPU for
+    # subprocesses; bench.py owns its platform selection beyond that.
+    env = worker_env()
+    env.update(env_extra)
+    return subprocess.run(
+        [sys.executable, BENCH, *args],
+        capture_output=True, text=True, env=env, timeout=timeout,
+    )
+
+
+def _json_line(stdout):
+    lines = [ln for ln in stdout.strip().splitlines() if ln.startswith("{")]
+    assert len(lines) == 1, f"expected exactly one JSON line, got {stdout!r}"
+    return json.loads(lines[0])
+
+
+def test_require_accel_fast_fails_with_unavailable_artifact():
+    """GMM_BENCH_REQUIRE_ACCEL=1 + failed probe => immediate rc 3 and an
+    artifact that cannot be mistaken for a measurement (no CPU fallback
+    measurement is run — for unattended accelerator sessions)."""
+    r = _run({
+        "GMM_BENCH_REQUIRE_ACCEL": "1",
+        "GMM_BENCH_PROBE_ATTEMPTS": "1",
+        "GMM_BENCH_PROBE_TIMEOUT_S": "0.01",  # killed before jax imports
+    }, timeout=120)
+    assert r.returncode == 3, r.stderr
+    j = _json_line(r.stdout)
+    assert j["accelerator_unavailable"] is True
+    assert j["value"] == 0.0 and j["vs_baseline"] == 0.0
+    for key in ("metric", "value", "unit", "vs_baseline"):
+        assert key in j
+
+
+def test_unknown_config_is_usage_error():
+    r = _run({"GMM_BENCH_CPU": "1"}, ["--config=nope"], timeout=120)
+    assert r.returncode == 2
+    assert "unknown --config" in r.stderr
+
+
+@pytest.mark.slow
+def test_deliberate_cpu_run_measures_with_rc0():
+    """GMM_BENCH_CPU=1 is the deliberate-CPU contract: rc 0, a real
+    measurement, and accelerator_unavailable explicitly false."""
+    r = _run({"GMM_BENCH_CPU": "1"}, ["--config=1"])
+    assert r.returncode == 0, r.stderr
+    j = _json_line(r.stdout)
+    assert j["unit"] == "iters/sec"
+    assert j["value"] > 0 and j["vs_baseline"] > 0
+    assert j["accelerator_unavailable"] is False
+    assert "cpu" in j["metric"]
