@@ -1,0 +1,181 @@
+"""Turn a captured hw_session.sh log directory into the routing decision.
+
+The TPU tunnel's windows are short and unpredictable (round-3: one 7h
+outage; round-4: one bench captured before a wedge), so the measurement
+session only CAPTURES data; the analysis — which backend should
+`use_pallas='auto'` route per shape, whether `precompute_features` should
+default on, what the chunk-tile A/B said — happens offline from the logs,
+whenever. This script is that analysis.
+
+Usage: python examples/analyze_hw_session.py [logdir]   (default hw_r04_logs)
+
+Reads:
+  kernel_*.log        -- bench_kernel_precision.py rows:
+                         "<shape> <tag> <ms> ms/iter loglik=<ll>"
+  bench_*.log         -- bench.py JSON lines (north + A/Bs + config matrix)
+Prints a markdown decision table (paste into docs/PERF.md) plus the
+per-shape winner and the code changes it implies. Purely textual: no jax,
+no devices, safe to run anywhere.
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import re
+import sys
+
+ROW = re.compile(
+    r"^(?P<shape>\w+)\s+(?P<tag>(?:xla\+feats|xla|kernel)\b.*?)\s+"
+    r"(?P<ms>[0-9.]+)\s+ms/iter\s+loglik=(?P<ll>-?[0-9.]+)")
+FAIL = re.compile(r"^(?P<shape>\w+)\s+(?P<tag>kernel [^:]+): FAILED (?P<err>.*)")
+
+
+def parse_kernel_logs(logdir):
+    rows, fails = [], []
+    for fn in sorted(os.listdir(logdir)):
+        if not (fn.startswith("kernel") and fn.endswith(".log")):
+            continue
+        for line in open(os.path.join(logdir, fn)):
+            m = ROW.match(line.strip())
+            if m:
+                rows.append(dict(shape=m["shape"], tag=m["tag"].strip(),
+                                 ms=float(m["ms"]), loglik=float(m["ll"])))
+                continue
+            f = FAIL.match(line.strip())
+            if f:
+                fails.append(dict(shape=f["shape"], tag=f["tag"],
+                                  err=f["err"].strip()))
+    return rows, fails
+
+
+def parse_bench_logs(logdir):
+    out = {}
+    for fn in sorted(os.listdir(logdir)):
+        if not (fn.startswith("bench") and fn.endswith(".log")):
+            continue
+        for line in open(os.path.join(logdir, fn)):
+            line = line.strip()
+            if line.startswith("{"):
+                try:
+                    out[fn[:-4]] = json.loads(line)
+                except ValueError:
+                    pass
+    return out
+
+
+def precision_of(tag):
+    for p in ("highest", "high", "default"):
+        if f" {p}" in " " + tag.replace("b=", "").replace("+feats", ""):
+            return p
+    return "?"
+
+
+def backend_of(tag):
+    if tag.startswith("xla+feats"):
+        return "xla+feats"
+    if tag.startswith("kernel"):
+        return "kernel"
+    return "xla"
+
+
+def main() -> int:
+    logdir = sys.argv[1] if len(sys.argv) > 1 else "hw_r04_logs"
+    if not os.path.isdir(logdir):
+        print(f"analyze_hw_session: no such logdir {logdir!r}", file=sys.stderr)
+        return 2
+    rows, fails = parse_kernel_logs(logdir)
+    bench = parse_bench_logs(logdir)
+
+    if rows:
+        # Decision table: per (shape, precision), every measured backend,
+        # winner marked. loglik column guards against a "win" that computed
+        # a different answer (all backends run the same EM; logliks must
+        # agree to ~1e-4 relative).
+        print("## Kernel-vs-XLA decision table\n")
+        print("| shape | precision | backend | ms/iter | vs best | loglik |")
+        print("|---|---|---|---|---|---|")
+        decisions = {}
+        shapes = sorted({r["shape"] for r in rows})
+        for shape in shapes:
+            for prec in ("high", "highest", "default"):
+                grp = [r for r in rows
+                       if r["shape"] == shape and precision_of(r["tag"]) == prec]
+                if not grp:
+                    continue
+                # Answer-correctness reference: the plain XLA row (the path
+                # the whole test suite oracles against sklearn), falling
+                # back to the group median. NOT the speed winner's own
+                # loglik -- a fastest-but-wrong backend must lose, not
+                # become the yardstick everyone else "drifts" from.
+                xla = [r for r in grp if backend_of(r["tag"]) == "xla"]
+                if xla:
+                    ll0 = xla[0]["loglik"]
+                else:
+                    lls = sorted(r["loglik"] for r in grp)
+                    ll0 = lls[len(lls) // 2]
+
+                def drifted(r):
+                    return abs(r["loglik"] - ll0) / max(1.0, abs(ll0)) > 1e-4
+
+                sound = [r for r in grp if not drifted(r)]
+                best = min(sound or grp, key=lambda r: r["ms"])
+                for r in sorted(grp, key=lambda r: r["ms"]):
+                    mark = " **<- winner**" if r is best else ""
+                    warn = " (ANSWER DRIFT, excluded)" if drifted(r) else ""
+                    print(f"| {shape} | {prec} | {r['tag']}{mark} | "
+                          f"{r['ms']:.2f} | {r['ms']/best['ms']:.2f}x | "
+                          f"{r['loglik']:.0f}{warn} |")
+                decisions[(shape, prec)] = best
+        print()
+        print("### Routing implied (for ops/pallas should_use_pallas + "
+              "GMMConfig.precompute_features defaults)\n")
+        for (shape, prec), best in sorted(decisions.items()):
+            b = backend_of(best["tag"])
+            extra = ""
+            if b == "kernel":
+                bb = re.search(r"b=(\d+)", best["tag"])
+                extra = f" (pallas_block_b={bb.group(1)})" if bb else ""
+            if b == "xla+feats":
+                extra = " (precompute_features=True)"
+            print(f"- {shape} @ {prec}: route to **{b}**{extra}")
+        print()
+    if fails:
+        print("### Kernel compile failures (decision data too)\n")
+        for f in fails:
+            print(f"- {f['shape']} {f['tag']}: {f['err']}")
+        print()
+
+    if bench:
+        print("## bench.py captures\n")
+        print("| run | iters/sec | ms/iter | vs CPU | note |")
+        print("|---|---|---|---|---|")
+        for name, j in sorted(bench.items()):
+            if j.get("accelerator_unavailable"):
+                note = "NO MEASUREMENT (tunnel down)"
+                print(f"| {name} | - | - | - | {note} |")
+                continue
+            ms = j.get("wall_s_per_iter", 0) * 1e3
+            print(f"| {name} | {j['value']:.1f} | {ms:.1f} | "
+                  f"{j['vs_baseline']:.0f}x | {j.get('precision', '')} |")
+        print()
+        # The two one-env A/Bs ride the same config as bench_north; call
+        # the deltas out explicitly when all sides exist and measured.
+        base = bench.get("bench_north")
+        ok = lambda j: j and not j.get("accelerator_unavailable")
+        if ok(base):
+            for ab, label in (("bench_north_feats", "feature hoist"),
+                              ("bench_north_chunk262k", "262k chunk tile")):
+                j = bench.get(ab)
+                if ok(j):
+                    d = (j["value"] / base["value"] - 1.0) * 100
+                    print(f"- {label}: {d:+.1f}% vs bench_north "
+                          f"(same session)")
+    if not rows and not fails and not bench:
+        print(f"analyze_hw_session: nothing parseable in {logdir}/")
+        return 1
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())
