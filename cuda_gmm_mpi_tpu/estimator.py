@@ -337,10 +337,14 @@ class GaussianMixture:
         """Akaike information criterion on X (lower is better)."""
         return self._criterion_on(X, "aic")
 
-    def sample(self, n_samples: int, seed: Optional[int] = None) -> np.ndarray:
+    def sample(self, n_samples: int, seed: Optional[int] = None
+               ) -> tuple[np.ndarray, np.ndarray]:
         """Draw events from the fitted mixture (generation -- absent from the
-        reference, natural for a library estimator)."""
-        res = self._fitted
+        reference, natural for a library estimator).
+
+        Returns ``(X, y)`` -- samples and their component labels -- matching
+        sklearn's ``GaussianMixture.sample`` contract exactly, so code
+        written against sklearn keeps working unchanged."""
         rng = np.random.default_rng(self.config.seed if seed is None else seed)
         pi = np.asarray(self.weights_, np.float64)
         pi = pi / pi.sum()
@@ -352,4 +356,4 @@ class GaussianMixture:
             m = comps == c
             if m.any():
                 out[m] = rng.multivariate_normal(mu[c], cov[c], size=int(m.sum()))
-        return out.astype(np.dtype(self.config.dtype))
+        return out.astype(np.dtype(self.config.dtype)), comps

@@ -234,12 +234,20 @@ def test_score_samples_matches_loglik(fitted):
 
 
 def test_sample_statistics(fitted):
-    """Samples from the fitted mixture have ~the mixture's global mean."""
+    """Samples from the fitted mixture have ~the mixture's global mean, and
+    sample() returns (X, y) exactly like sklearn's GaussianMixture."""
     gm, data, _ = fitted
-    xs = gm.sample(20000, seed=0)
+    xs, ys = gm.sample(20000, seed=0)
     assert xs.shape == (20000, 3)
+    assert ys.shape == (20000,) and ys.min() >= 0
+    assert ys.max() < gm.n_components_
     global_mean = (gm.weights_[:, None] * gm.means_).sum(axis=0)
     np.testing.assert_allclose(xs.mean(axis=0), global_mean, atol=0.2)
+    # Per-component: events labeled c were drawn from component c.
+    for c in range(gm.n_components_):
+        if (ys == c).sum() > 1000:
+            np.testing.assert_allclose(xs[ys == c].mean(axis=0),
+                                       gm.means_[c], atol=0.3)
 
 
 def test_order_search_selects_k():
