@@ -41,7 +41,8 @@ import numpy as np
 
 
 def probe_default_platform(timeout_s: float = 180.0, attempts: int = 5,
-                           retry_wait_s: float = 90.0) -> bool:
+                           retry_wait_s: float = 90.0, *,
+                           honor_env: bool = True) -> bool:
     """True if the default JAX platform initializes in a fresh subprocess.
 
     Device init happens in-process and cannot be interrupted once started
@@ -53,11 +54,16 @@ def probe_default_platform(timeout_s: float = 180.0, attempts: int = 5,
     (5 x 180s probes + 4 x 90s waits); override via
     GMM_BENCH_PROBE_ATTEMPTS / GMM_BENCH_PROBE_TIMEOUT_S /
     GMM_BENCH_PROBE_WAIT_S when a harness needs a tighter or looser
-    deadline.
+    deadline. ``honor_env=False`` makes the explicit arguments binding
+    (callers like __graft_entry__.entry() that deliberately want one quick
+    attempt, regardless of a bench-oriented environment).
     """
-    timeout_s = float(os.environ.get("GMM_BENCH_PROBE_TIMEOUT_S", timeout_s))
-    attempts = int(os.environ.get("GMM_BENCH_PROBE_ATTEMPTS", attempts))
-    retry_wait_s = float(os.environ.get("GMM_BENCH_PROBE_WAIT_S", retry_wait_s))
+    if honor_env:
+        timeout_s = float(
+            os.environ.get("GMM_BENCH_PROBE_TIMEOUT_S", timeout_s))
+        attempts = int(os.environ.get("GMM_BENCH_PROBE_ATTEMPTS", attempts))
+        retry_wait_s = float(
+            os.environ.get("GMM_BENCH_PROBE_WAIT_S", retry_wait_s))
     for i in range(attempts):
         try:
             r = subprocess.run(
@@ -76,6 +82,19 @@ def probe_default_platform(timeout_s: float = 180.0, attempts: int = 5,
                   f"retrying in {retry_wait_s:.0f}s", file=sys.stderr)
             time.sleep(retry_wait_s)
     return False
+
+
+def settle_after_probe() -> None:
+    """Pause between a probe client's disconnect and in-process device init.
+
+    The probe subprocess was itself a tunnel client; give the
+    single-admission relay a moment to release it before the caller's own
+    (uninterruptible) device init connects. Back-to-back admission is a
+    suspected wedge trigger (2026-07-31 session: one client hung in init
+    ~6s after the previous client exited). GMM_BENCH_SETTLE_S overrides
+    the default 10s; empty-string-safe, negative values clamp to 0.
+    """
+    time.sleep(max(0.0, float(os.environ.get("GMM_BENCH_SETTLE_S") or 10)))
 
 
 def numpy_em_iteration(x, x2, params):
@@ -208,14 +227,7 @@ def main() -> int:
         print("bench.py: accelerator probe failed; using CPU", file=sys.stderr)
         want_cpu = accel_unavailable = True
     elif not want_cpu:
-        # The probe subprocess was itself a tunnel client that just
-        # disconnected; give the single-admission relay a moment to release
-        # it before this process's own (uninterruptible) device init
-        # connects. Back-to-back admission is a suspected wedge trigger
-        # (2026-07-31 session: one client hung in init ~6s after the
-        # previous client exited). Empty-string-safe like GMM_BENCH_CHUNK;
-        # negative values clamp to 0.
-        time.sleep(max(0.0, float(os.environ.get("GMM_BENCH_SETTLE_S") or 10)))
+        settle_after_probe()
 
     # Watchdog: the probe only proves the accelerator was alive at start;
     # a tunnel that dies MID-RUN would hang the measurement forever and
