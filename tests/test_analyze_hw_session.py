@@ -1,0 +1,90 @@
+"""Lock examples/analyze_hw_session.py to its producers' formats.
+
+The analyzer parses two formats written by other files -- the
+"<shape> <tag> <ms> ms/iter loglik=<ll>" rows of
+examples/bench_kernel_precision.py and bench.py's JSON lines as captured
+by examples/hw_session.sh -- so a format change in either producer must
+fail a test, not silently produce an empty decision table in the one
+short tunnel window where the real logs get made.
+"""
+
+import importlib.util
+import json
+import os
+import subprocess
+import sys
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+SCRIPT = os.path.join(REPO, "examples", "analyze_hw_session.py")
+
+
+def _load():
+    spec = importlib.util.spec_from_file_location("analyze_hw_session", SCRIPT)
+    mod = importlib.util.module_from_spec(spec)
+    spec.loader.exec_module(mod)
+    return mod
+
+
+def _write_logs(d):
+    (d / "kernel_north.log").write_text(
+        "platform: tpu\n"
+        "north     xla high                       8.60 ms/iter  loglik=-39473088\n"
+        "north     xla+feats high                 6.10 ms/iter  loglik=-39473090\n"
+        "north     kernel high b=512              5.20 ms/iter  loglik=-99999999\n"
+        "north     kernel high b=1024: FAILED MosaicError: VMEM overflow\n"
+        "DONE\n")
+    (d / "bench_north.log").write_text(
+        json.dumps({"metric": "EM iters/sec (1000000x24, K=100, full "
+                              "covariance, tpu)",
+                    "value": 78.2, "unit": "iters/sec", "vs_baseline": 432.8,
+                    "accelerator_unavailable": False, "precision": "high",
+                    "wall_s_per_iter": 0.0128}) + "\nDONE\n")
+    (d / "bench_north_feats.log").write_text(
+        json.dumps({"metric": "EM iters/sec (config=north)", "value": 0.0,
+                    "unit": "iters/sec", "vs_baseline": 0.0,
+                    "accelerator_unavailable": True, "watchdog": True}) + "\n")
+
+
+def test_parses_producer_formats_and_guards_wrong_answers(tmp_path):
+    _write_logs(tmp_path)
+    mod = _load()
+    rows, fails = mod.parse_kernel_logs(str(tmp_path))
+    assert {r["tag"] for r in rows} == {"xla high", "xla+feats high",
+                                        "kernel high b=512"}
+    assert fails and "MosaicError" in fails[0]["err"]
+    bench = mod.parse_bench_logs(str(tmp_path))
+    assert bench["bench_north"]["value"] == 78.2
+    assert bench["bench_north_feats"]["accelerator_unavailable"] is True
+
+
+def test_cli_decision_excludes_drifted_winner(tmp_path):
+    _write_logs(tmp_path)
+    r = subprocess.run([sys.executable, SCRIPT, str(tmp_path)],
+                       capture_output=True, text=True, timeout=60)
+    assert r.returncode == 0, r.stderr
+    out = r.stdout
+    # kernel b=512 is fastest but computed a wrong answer (loglik far off
+    # the XLA oracle row): it must be excluded, xla+feats crowned.
+    assert "xla+feats high **<- winner**" in out
+    assert "kernel high b=512" in out and "ANSWER DRIFT" in out
+    assert "route to **xla+feats**" in out
+    # The A/B section must not fabricate a delta from the watchdog artifact.
+    assert "feature hoist" not in out
+    # The no-measurement artifact is labeled as such in the bench table.
+    assert "NO MEASUREMENT" in out
+
+
+def test_live_formats_still_match_producers():
+    """The row format the analyzer parses is the one the producer prints."""
+    import re
+
+    src = open(os.path.join(REPO, "examples",
+                            "bench_kernel_precision.py")).read()
+    # The producer's print template must still contain the ms/iter +
+    # loglik shape the ROW regex keys on.
+    assert "ms/iter" in src and "loglik=" in src
+    mod = _load()
+    line = "north     kernel highest b=256         507.25 ms/iter  loglik=-794809"
+    m = mod.ROW.match(line)
+    assert m and m["tag"].strip() == "kernel highest b=256"
+    assert float(m["ms"]) == 507.25
