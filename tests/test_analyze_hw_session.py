@@ -14,6 +14,10 @@ import os
 import subprocess
 import sys
 
+import pytest
+
+from .conftest import worker_env
+
 REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
 SCRIPT = os.path.join(REPO, "examples", "analyze_hw_session.py")
 
@@ -74,17 +78,24 @@ def test_cli_decision_excludes_drifted_winner(tmp_path):
     assert "NO MEASUREMENT" in out
 
 
-def test_live_formats_still_match_producers():
-    """The row format the analyzer parses is the one the producer prints."""
-    import re
-
-    src = open(os.path.join(REPO, "examples",
-                            "bench_kernel_precision.py")).read()
-    # The producer's print template must still contain the ms/iter +
-    # loglik shape the ROW regex keys on.
-    assert "ms/iter" in src and "loglik=" in src
+@pytest.mark.slow
+def test_live_producer_output_parses(tmp_path):
+    """Run the real producer on a toy shape and parse its actual output --
+    the binding check that the two files' formats cannot drift apart."""
+    r = subprocess.run(
+        [sys.executable,
+         os.path.join(REPO, "examples", "bench_kernel_precision.py"),
+         "north", "--blocks=256", "--n=2000", "--chunk=512", "--iters=1",
+         "--device=cpu"],
+        capture_output=True, text=True, env=worker_env(), timeout=600)
+    assert r.returncode == 0, r.stderr[-2000:]
+    (tmp_path / "kernel_live.log").write_text(r.stdout)
     mod = _load()
-    line = "north     kernel highest b=256         507.25 ms/iter  loglik=-794809"
-    m = mod.ROW.match(line)
-    assert m and m["tag"].strip() == "kernel highest b=256"
-    assert float(m["ms"]) == 507.25
+    rows, fails = mod.parse_kernel_logs(str(tmp_path))
+    # Every non-FAILED measurement line the producer printed must parse:
+    # 3 precisions x (xla, xla+feats, kernel b=256), minus any kernel rows
+    # that legitimately FAILED (surfaced in `fails`, still decision data).
+    assert len(rows) + len(fails) == 9, r.stdout
+    assert {mod.backend_of(r_["tag"]) for r_ in rows} >= {"xla", "xla+feats"}
+    for prec in ("high", "highest", "default"):
+        assert any(mod.precision_of(r_["tag"]) == prec for r_ in rows)
