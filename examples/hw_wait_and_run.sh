@@ -45,26 +45,39 @@ while :; do
   fi
   if relay_alive && [ $((now - last_probe)) -ge "$PROBE_EVERY_S" ]; then
     if ! machine_quiet; then
-      echo "hw_wait: relay up but machine busy (pytest/bench running); waiting"
-      sleep "$POLL_S"
-      continue
+      # Bounded hold only: a busy machine contaminates bench.py's
+      # in-process CPU baselines (secondary data), but tunnel windows are
+      # rare and short (2026-07-31: the relay flapped up for minutes
+      # during a 16-min pytest run and was gone again after) -- the TPU
+      # timings themselves are unaffected by host load, so after the
+      # grace period proceed anyway and let the vs_baseline denominators
+      # carry the risk.
+      busy_since=${busy_since:-$now}
+      if [ $((now - busy_since)) -lt "${GMM_HW_BUSY_GRACE_S:-600}" ]; then
+        echo "hw_wait: $(date -u +%H:%M:%S) relay up but machine busy; holding ($((now - busy_since))s)"
+        sleep 60
+        continue
+      fi
+      echo "hw_wait: $(date -u +%H:%M:%S) machine still busy after grace -- proceeding; CPU baselines in this session may be contaminated"
     fi
+    busy_since=""
     echo "hw_wait: relay listener up; probing device ($(date -u +%H:%M:%S))"
     last_probe=$now
     if timeout 300 python -c "import jax; jax.devices()" >/dev/null 2>&1; then
-      echo "hw_wait: tunnel ALIVE; settling, then running hw_session.sh"
+      echo "hw_wait: $(date -u +%H:%M:%S) tunnel ALIVE; settling, then running hw_session.sh"
       sleep "${HW_STEP_SETTLE_S:-45}"
-      # The probe + settle took minutes; a pytest/bench run may have
-      # started meanwhile. Launching anyway would contaminate bench.py's
-      # in-process CPU baselines (the round-3 config-5 lesson), so
-      # re-check and hold until the machine is quiet again.
+      # A pytest/bench run may have started during probe+settle; same
+      # bounded hold as above -- the live tunnel outranks clean CPU
+      # baselines after the grace period.
+      quiet_hold=0
       until machine_quiet; do
-        if [ $(( $(date +%s) - start )) -gt "$DEADLINE_S" ]; then
-          echo "hw_wait: deadline reached while holding for a quiet machine"
-          exit 1
+        if [ "$quiet_hold" -ge "${GMM_HW_BUSY_GRACE_S:-600}" ]; then
+          echo "hw_wait: $(date -u +%H:%M:%S) still busy after grace -- launching anyway (CPU baselines may be contaminated)"
+          break
         fi
-        echo "hw_wait: tunnel alive but machine became busy; holding"
-        sleep "$POLL_S"
+        echo "hw_wait: $(date -u +%H:%M:%S) tunnel alive but machine busy; holding (${quiet_hold}s)"
+        sleep 60
+        quiet_hold=$((quiet_hold + 60))
       done
       # Child, not exec: if the tunnel wedges mid-session the session
       # aborts with rc 3 (its anti-pile-up contract) and THIS loop must
