@@ -10,15 +10,19 @@
 # listener looks alive, at most once per GMM_HW_PROBE_EVERY_S (default
 # 20 min), and give each probe a generous 300s.
 #
-# The machine must also be QUIET before the session starts: bench.py
+# The machine should also be QUIET before the session starts: bench.py
 # measures an in-process CPU baseline, and a concurrent test-suite run
-# contaminated round-3's config-5 denominator. We refuse to launch while
-# pytest (or another bench) is running.
+# contaminated round-3's config-5 denominator. But tunnel windows are rare
+# and short, and host load does not affect the TPU timings themselves, so
+# a busy machine only HOLDS the launch for GMM_HW_BUSY_GRACE_S (default
+# 600s); after that the session launches anyway and the CPU-baseline
+# contamination risk is logged.
 set -u
 cd "$(dirname "$0")/.."
 PROBE_EVERY_S=${GMM_HW_PROBE_EVERY_S:-1200}
 POLL_S=${GMM_HW_POLL_S:-120}
 DEADLINE_S=${GMM_HW_DEADLINE_S:-36000}
+BUSY_GRACE_S=${GMM_HW_BUSY_GRACE_S:-600}
 start=$(date +%s)
 last_probe=0
 
@@ -53,9 +57,9 @@ while :; do
       # grace period proceed anyway and let the vs_baseline denominators
       # carry the risk.
       busy_since=${busy_since:-$now}
-      if [ $((now - busy_since)) -lt "${GMM_HW_BUSY_GRACE_S:-600}" ]; then
+      if [ $((now - busy_since)) -lt "$BUSY_GRACE_S" ]; then
         echo "hw_wait: $(date -u +%H:%M:%S) relay up but machine busy; holding ($((now - busy_since))s)"
-        sleep 60
+        sleep "$POLL_S"
         continue
       fi
       echo "hw_wait: $(date -u +%H:%M:%S) machine still busy after grace -- proceeding; CPU baselines in this session may be contaminated"
@@ -71,13 +75,13 @@ while :; do
       # baselines after the grace period.
       quiet_hold=0
       until machine_quiet; do
-        if [ "$quiet_hold" -ge "${GMM_HW_BUSY_GRACE_S:-600}" ]; then
+        if [ "$quiet_hold" -ge "$BUSY_GRACE_S" ]; then
           echo "hw_wait: $(date -u +%H:%M:%S) still busy after grace -- launching anyway (CPU baselines may be contaminated)"
           break
         fi
         echo "hw_wait: $(date -u +%H:%M:%S) tunnel alive but machine busy; holding (${quiet_hold}s)"
-        sleep 60
-        quiet_hold=$((quiet_hold + 60))
+        sleep "$POLL_S"
+        quiet_hold=$((quiet_hold + POLL_S))
       done
       # Child, not exec: if the tunnel wedges mid-session the session
       # aborts with rc 3 (its anti-pile-up contract) and THIS loop must
@@ -95,6 +99,11 @@ while :; do
       continue
     fi
     echo "hw_wait: probe hung/failed; backing off ${PROBE_EVERY_S}s"
+  else
+    # Not probing this tick (relay down or probe not due): any busy-hold
+    # accounting belongs to a dead relay window; reset it so the next
+    # window starts its grace period fresh.
+    busy_since=""
   fi
   sleep "$POLL_S"
 done
