@@ -61,6 +61,23 @@ def test_require_accel_fast_fails_with_unavailable_artifact():
         assert key in j
 
 
+def test_deliberate_cpu_wins_over_require_accel():
+    """GMM_BENCH_CPU=1 skips the probe entirely, so REQUIRE_ACCEL (meant
+    for unattended accelerator sessions, exported by hw_session.sh) must
+    not turn a deliberate CPU run into an rc-3 abort -- e.g. when a
+    harness inherits both knobs from a measurement session's environment."""
+    r = _run({
+        "GMM_BENCH_CPU": "1",
+        "GMM_BENCH_REQUIRE_ACCEL": "1",
+        "GMM_BENCH_PROBE_ATTEMPTS": "1",
+        "GMM_BENCH_PROBE_TIMEOUT_S": "0.01",
+    }, ["--config=1"], timeout=300)
+    assert r.returncode == 0, r.stderr
+    j = _json_line(r.stdout)
+    assert j["value"] > 0
+    assert j["accelerator_unavailable"] is False
+
+
 def test_unknown_config_is_usage_error():
     r = _run({"GMM_BENCH_CPU": "1"}, ["--config=nope"], timeout=120)
     assert r.returncode == 2
