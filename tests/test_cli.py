@@ -218,3 +218,23 @@ def test_cli_no_output(csv_file, tmp_path):
     # semantics, gaussian.cu:1015, 1042)
     assert (tmp_path / "noout.summary").read_text() == ""
     assert not (tmp_path / "noout.results").exists()
+
+
+def test_cli_profile_and_trace_dir(csv_file, tmp_path, capsys):
+    """--profile prints the 7-category report (gaussian.cu:967 analog) and
+    --trace-dir captures a jax.profiler trace (SURVEY SS5.1's TPU-native
+    tracing path), composed on one run."""
+    out = str(tmp_path / "out")
+    trace_dir = tmp_path / "traces"
+    rc = run_cli(["2", csv_file, out, "2", "--profile",
+                  f"--trace-dir={trace_dir}",
+                  "--min-iters=2", "--max-iters=2", "--chunk-size=256"])
+    assert rc == 0
+    rep = capsys.readouterr().out
+    assert "Phase profile" in rep
+    for cat in ("e_step", "m_step", "constants", "reduce", "memcpy",
+                "cpu", "mpi"):
+        assert cat in rep
+    # jax.profiler writes <dir>/plugins/profile/<ts>/*.xplane.pb
+    captures = list(trace_dir.rglob("*.xplane.pb"))
+    assert captures, f"no trace capture under {trace_dir}"
