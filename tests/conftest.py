@@ -6,9 +6,14 @@ Must run before any jax import, hence module-level env mutation in conftest.
 
 import os
 
-# NOTE: this image preloads jax via a sitecustomize hook, so JAX_PLATFORMS in
-# os.environ is read before conftest runs -- the config.update calls below are
-# what actually pins the test platform. The env mutations cover subprocesses.
+# NOTE: this image preloads jax via a sitecustomize hook that registers the
+# axon TPU plugin in EVERY python process; JAX_PLATFORMS in os.environ does
+# NOT pin the platform even when set before interpreter start (verified
+# 2026-07-31 -- a wedged tunnel hangs `env JAX_PLATFORMS=cpu python -c
+# "import jax; jax.devices()"` forever). The config.update calls below are
+# what actually pins this process; subprocess workers must each call
+# jax.config.update("jax_platforms", "cpu") themselves (they do -- see
+# multihost_worker.py and worker_env()'s docstring).
 os.environ["JAX_PLATFORMS"] = "cpu"
 _flags = os.environ.get("XLA_FLAGS", "")
 if "xla_force_host_platform_device_count" not in _flags:
@@ -32,8 +37,10 @@ def rng():
 
 
 def worker_env():
-    """Environment for subprocess test workers: CPU platform, fresh device
-    config (scrub this harness's 8-device forcing), repo on PYTHONPATH."""
+    """Environment for subprocess test workers: scrub the 8-device forcing,
+    repo on PYTHONPATH. JAX_PLATFORMS=cpu is advisory only on this image
+    (see the NOTE above) -- every worker script must still pin CPU itself
+    via jax.config.update("jax_platforms", "cpu") before touching devices."""
     env = dict(os.environ)
     env.pop("XLA_FLAGS", None)
     env["JAX_PLATFORMS"] = "cpu"
