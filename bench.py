@@ -15,9 +15,14 @@ fast; the reported metric is always normalized to iterations/sec at the
 measured shape, with the shape recorded in the JSON.
 
 Env knobs: GMM_BENCH_CPU=1 (deliberate CPU run, rc 0); GMM_BENCH_PRECISION
-(matmul precision override); GMM_BENCH_PRECOMPUTE=1 (feature-hoist A/B,
-full-covariance in-memory configs); GMM_BENCH_CHUNK (accelerator chunk
-size); GMM_BENCH_WATCHDOG_S (mid-run dead-device deadline, default 1800);
+(matmul precision override); GMM_BENCH_PRECOMPUTE=1/0 (feature-hoist A/B,
+full-covariance in-memory configs; defaults ON for CPU runs -- the NumPy
+baseline hoists its own features -- and OFF on the accelerator pending the
+hw-session routing decision); GMM_BENCH_CHUNK (chunk size on EITHER
+platform; accelerator default 131072, CPU default 4096 from the round-5
+cache sweep); GMM_BENCH_MAX_N (CPU-run event cap, default 100000 -- smoke
+runs shrink it); GMM_BENCH_WATCHDOG_S (mid-run dead-device deadline,
+default 1800);
 GMM_BENCH_PROBE_{ATTEMPTS,TIMEOUT_S,WAIT_S} (accelerator probe budget);
 GMM_BENCH_SETTLE_S (pause between the probe client's disconnect and this
 process's device init, default 10); GMM_BENCH_REQUIRE_ACCEL=1 (on probe
@@ -38,6 +43,13 @@ import sys
 import time
 
 import numpy as np
+
+# North-star cross-session tunnel band (ms/iter) from docs/PERF.md's
+# "session-variance band" — update BOTH together when a hardware session
+# widens it. Emitted in the north-star accelerator JSON so a driver
+# diffing BENCH_r{N} across rounds can tell tunnel weather from a code
+# regression.
+SESSION_BAND_MS_PER_ITER = [8.6, 12.8]
 
 
 def probe_default_platform(timeout_s: float = 180.0, attempts: int = 5,
@@ -84,7 +96,7 @@ def probe_default_platform(timeout_s: float = 180.0, attempts: int = 5,
     return False
 
 
-def settle_after_probe() -> None:
+def settle_after_probe(*, honor_env: bool = True) -> None:
     """Pause between a probe client's disconnect and in-process device init.
 
     The probe subprocess was itself a tunnel client; give the
@@ -93,8 +105,14 @@ def settle_after_probe() -> None:
     suspected wedge trigger (2026-07-31 session: one client hung in init
     ~6s after the previous client exited). GMM_BENCH_SETTLE_S overrides
     the default 10s; empty-string-safe, negative values clamp to 0.
+    ``honor_env=False`` keeps the default settle even when bench-oriented
+    env is set (mirrors probe_default_platform: __graft_entry__.entry()
+    must not lose its anti-wedge settle to a stray GMM_BENCH_SETTLE_S=0).
     """
-    time.sleep(max(0.0, float(os.environ.get("GMM_BENCH_SETTLE_S") or 10)))
+    settle_s = 10.0
+    if honor_env:
+        settle_s = float(os.environ.get("GMM_BENCH_SETTLE_S") or settle_s)
+    time.sleep(max(0.0, settle_s))
 
 
 def numpy_em_iteration(x, x2, params):
@@ -273,20 +291,32 @@ def main() -> int:
     n_events, n_dims, k = spec["n"], spec["d"], spec["k"]
     target_k = int(spec.get("target_k", 0))
     if on_accel:
-        # GMM_BENCH_CHUNK tunes the accelerator chunk size (hardware
-        # sessions probe 131072 vs larger tiles). Empty-string-safe like
-        # GMM_BENCH_PRECISION; nonpositive values fail loudly here rather
-        # than degenerating inside chunk_events.
         bench_iters = 20
-        chunk = int(os.environ.get("GMM_BENCH_CHUNK") or 131072)
-        if chunk < 1:
-            print(f"bench.py: GMM_BENCH_CHUNK={chunk} must be >= 1",
+    else:
+        # Scaled down on CPU so the harness stays fast. GMM_BENCH_MAX_N
+        # shrinks further for smoke runs (hw_session.sh's HW_SMOKE
+        # end-to-end rehearsal keeps the full producer->analyzer pipeline
+        # under test without 100k-event CPU configs).
+        max_n = int(os.environ.get("GMM_BENCH_MAX_N") or 100_000)
+        if max_n < 1:
+            print(f"bench.py: GMM_BENCH_MAX_N={max_n} must be >= 1",
                   file=sys.stderr)
             return 2
-    else:
-        # Scaled down on CPU so the harness stays fast.
-        n_events = min(n_events, 100_000)
-        bench_iters, chunk = 5, 16384
+        n_events = min(n_events, max_n)
+        bench_iters = 5
+    # GMM_BENCH_CHUNK tunes the chunk size (hardware sessions probe 131072
+    # vs larger tiles). The CPU default 4096 is the CPU-optimal tile from
+    # the round-5 sweep on this image's single-core host (1024..100000,
+    # precompute on: 4096 ~ 2.3-2.8 iters/s vs 1.8 at 16384 vs 1.9
+    # unchunked -- L2/L3 locality of the [chunk, D^2] feature block
+    # dominates). Empty-string-safe like GMM_BENCH_PRECISION; nonpositive
+    # values fail loudly here rather than degenerating inside chunk_events.
+    chunk = int(os.environ.get("GMM_BENCH_CHUNK")
+                or (131072 if on_accel else 4096))
+    if chunk < 1:
+        print(f"bench.py: GMM_BENCH_CHUNK={chunk} must be >= 1",
+              file=sys.stderr)
+        return 2
     if target_k:
         # Model-order-search configs sweep K..target_k full EM runs; fewer
         # iterations per K keeps the bench bounded.
@@ -319,11 +349,16 @@ def main() -> int:
     precision = os.environ.get("GMM_BENCH_PRECISION") or (
         "highest" if diag else "high"
     )
-    # GMM_BENCH_PRECOMPUTE=1 A/Bs the feature hoist on the official bench
+    # GMM_BENCH_PRECOMPUTE A/Bs the feature hoist on the official bench
     # artifact (full-covariance in-memory configs only -- the flag's own
-    # domain; see GMMConfig.precompute_features).
-    precompute = (os.environ.get("GMM_BENCH_PRECOMPUTE") == "1"
-                  and not diag and not spec.get("stream"))
+    # domain; see GMMConfig.precompute_features). Default: ON for CPU runs
+    # -- the NumPy baseline precomputes its own [N, D^2] features outside
+    # the timed region, so hoisting is the like-for-like comparison, and
+    # the round-5 CPU sweep measured it worth ~1.15-1.3x there; OFF on the
+    # accelerator until the hw-session A/B settles the routing decision.
+    env_pre = os.environ.get("GMM_BENCH_PRECOMPUTE")
+    want_pre = env_pre == "1" if env_pre not in (None, "") else not on_accel
+    precompute = want_pre and not diag and not spec.get("stream")
 
     def measure(use_pallas: str):
         """(iters, dt, ll, final_state, sweep_extra) for one measured run."""
@@ -465,6 +500,9 @@ def main() -> int:
             "accelerator tunnel unavailable (probe failed after retries); "
             "this is a CPU-fallback measurement, not an accelerator result"
         )
+    if on_accel and cfg_name == "north":
+        note["session_band_ms_per_iter"] = SESSION_BAND_MS_PER_ITER
+    note["measured_utc"] = time.strftime("%Y-%m-%dT%H:%M:%SZ", time.gmtime())
     kdesc = f"K={k}->{target_k}" if target_k else f"K={k}"
     streamed = ", streamed" if spec.get("stream") else ""
     result = {

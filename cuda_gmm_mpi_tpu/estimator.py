@@ -342,9 +342,12 @@ class GaussianMixture:
         """Draw events from the fitted mixture (generation -- absent from the
         reference, natural for a library estimator).
 
-        Returns ``(X, y)`` -- samples and their component labels -- matching
-        sklearn's ``GaussianMixture.sample`` contract exactly, so code
-        written against sklearn keeps working unchanged."""
+        Returns ``(X, y)`` -- samples and their component labels -- shaped
+        like sklearn's ``GaussianMixture.sample`` so code written against
+        sklearn keeps working. Deliberate differences: a ``seed`` kwarg
+        (sklearn reuses the estimator's ``random_state``), ``X`` cast to
+        ``config.dtype`` (sklearn returns float64), and per-component
+        counts drawn via ``rng.choice`` rather than one multinomial."""
         rng = np.random.default_rng(self.config.seed if seed is None else seed)
         pi = np.asarray(self.weights_, np.float64)
         pi = pi / pi.sum()

@@ -93,26 +93,6 @@ def chol_inverse_logdet(R: jax.Array, diag_only: bool = False):
     return Rinv, log_det, ok
 
 
-def chol_logdet(R: jax.Array, diag_only: bool = False):
-    """Batched log-determinant + PD check WITHOUT the inverse.
-
-    The merge pair scan (ops/merge.py::pairwise_merge_distances) evaluates
-    O(K^2) candidate covariances but consumes only each one's log|R| for the
-    merged constant -- computing the inverse there (two triangular solves +
-    a [D,D]x[D,D] product per candidate) was pure waste. Returns
-    ``(log_det [K], ok [K])``.
-    """
-    if diag_only:
-        d = jnp.diagonal(R, axis1=-2, axis2=-1)  # [K, D]
-        ok = jnp.all(d > 0, axis=-1)
-        return jnp.sum(jnp.log(jnp.where(d > 0, d, 1.0)), axis=-1), ok
-    L = jax.lax.linalg.cholesky(R)  # NaN rows where not PD
-    ok = jnp.all(jnp.isfinite(L.reshape(L.shape[0], -1)), axis=-1)
-    diag = jnp.abs(jnp.diagonal(L, axis1=-2, axis2=-1))
-    diag = jnp.where(ok[:, None], diag, 1.0)
-    return 2.0 * jnp.sum(jnp.log(diag), axis=-1), ok
-
-
 def compute_constants(state, diag_only: bool = False,
                       cluster_axis: str | None = None):
     """Recompute Rinv, constant, and pi from R and N.

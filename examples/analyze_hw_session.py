@@ -7,7 +7,7 @@ session only CAPTURES data; the analysis — which backend should
 default on, what the chunk-tile A/B said — happens offline from the logs,
 whenever. This script is that analysis.
 
-Usage: python examples/analyze_hw_session.py [logdir]   (default hw_r04_logs)
+Usage: python examples/analyze_hw_session.py [logdir]   (default hw_r05_logs)
 
 Reads:
   kernel_*.log        -- bench_kernel_precision.py rows:
@@ -80,7 +80,7 @@ def backend_of(tag):
 
 
 def main() -> int:
-    logdir = sys.argv[1] if len(sys.argv) > 1 else "hw_r04_logs"
+    logdir = sys.argv[1] if len(sys.argv) > 1 else "hw_r05_logs"
     if not os.path.isdir(logdir):
         print(f"analyze_hw_session: no such logdir {logdir!r}", file=sys.stderr)
         return 2

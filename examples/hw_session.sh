@@ -1,5 +1,5 @@
 #!/bin/bash
-# Round-4 TPU measurement session (run when the axon tunnel is ALIVE).
+# Round-5 TPU measurement session (run when the axon tunnel is ALIVE).
 #
 # One-shot, resumable: each step logs to $LOGDIR/<step>.log and is skipped
 # on re-run if that log ends with DONE -- the round-3 lesson (a 7h tunnel
@@ -17,10 +17,17 @@ cd "$(dirname "$0")/.."
 # The package is not pip-installed; examples/* import it from the repo root.
 export PYTHONPATH="$PWD${PYTHONPATH:+:$PYTHONPATH}"
 SMOKE=()
-default_logdir=hw_r04_logs
+default_logdir=hw_r05_logs
 if [ "${HW_SMOKE:-}" = "1" ]; then
   default_logdir=/tmp/hw_smoke_logs
   export GMM_BENCH_CPU=1
+  export GMM_BENCH_MAX_N=20000
+  # CPU bench runs default precompute ON (bench.py), which would make the
+  # smoke bench_north identical to the bench_north_feats A/B and leave the
+  # precompute-OFF path -- the one the real accelerator session runs --
+  # unrehearsed. Force it off; the feats step's `env GMM_BENCH_PRECOMPUTE=1`
+  # still wins for its own step, preserving the A/B shape.
+  export GMM_BENCH_PRECOMPUTE=0
   SMOKE=(--n=20000 --chunk=4096 --iters=2 --device=cpu)
 else
   # This session exists to measure the accelerator; if the tunnel is gone,
@@ -136,3 +143,13 @@ astep stream_overlap python examples/bench_streaming.py --n=4000000 --iters=10 "
 astep components_north python examples/bench_components.py north "${SMOKE[@]}"
 astep components_envelope python examples/bench_components.py envelope --iters=10 "${SMOKE[@]}"
 echo "session complete; logs in $LOGDIR/"
+# Leave the decision artifact next to the logs immediately: if the window
+# fired unattended, the routing analysis must not depend on someone
+# remembering to run the analyzer later. Analyzer failure must be loud --
+# an ANALYSIS.md that is just an error message defeats the point.
+if python examples/analyze_hw_session.py "$LOGDIR" > "$LOGDIR/ANALYSIS.md" 2>&1; then
+  echo "analysis written to $LOGDIR/ANALYSIS.md"
+else
+  echo "ERROR: analyze_hw_session.py failed (rc=$?); $LOGDIR/ANALYSIS.md holds its output"
+  exit 4   # distinct from 3 (wedge): data captured, analysis broken
+fi

@@ -29,8 +29,24 @@ last_probe=0
 relay_alive() {
   # Baseline listeners on this image are 48271 (relay control) and 2024;
   # the tunnel's data ports show up beyond those when the relay is up.
-  ss -tln 2>/dev/null | awk '{print $4}' | grep -oE '[0-9]+$' \
-    | grep -vE '^(48271|2024)$' | grep -q .
+  # Any OTHER local service (dev server, jupyter) would also match and
+  # make this loop spend a real probe client per PROBE_EVERY_S against a
+  # dead tunnel, so both sides are configurable: set GMM_HW_RELAY_PORTS
+  # to the relay's known data ports (e.g. '8471|8472') to match them
+  # explicitly, or extend GMM_HW_IGNORE_PORTS with the extra local
+  # listeners to ignore.
+  local ignore="48271|2024${GMM_HW_IGNORE_PORTS:+|$GMM_HW_IGNORE_PORTS}"
+  local ports
+  ports=$(ss -tln 2>/dev/null | awk '{print $4}' | grep -oE '[0-9]+$' \
+    | grep -vE "^(${ignore})$" | grep .)
+  if [ -n "${GMM_HW_RELAY_PORTS:-}" ]; then
+    # Accept comma or pipe separators; the `grep .` above dropped empty
+    # lines so a stray trailing separator cannot match an empty string and
+    # invert the check.
+    echo "$ports" | grep -qE "^(${GMM_HW_RELAY_PORTS//,/|})$"
+  else
+    [ -n "$ports" ]
+  fi
 }
 
 machine_quiet() {
@@ -90,8 +106,17 @@ while :; do
       bash examples/hw_session.sh
       rc=$?
       if [ "$rc" -eq 0 ]; then
+        # hw_session.sh wrote $LOGDIR/ANALYSIS.md itself (it owns LOGDIR).
         echo "hw_wait: session complete"
         exit 0
+      fi
+      if [ "$rc" -eq 4 ]; then
+        # Measurements all captured; only the offline analyzer broke.
+        # Retrying would re-fail deterministically and burn a probe client
+        # per attempt against the live tunnel -- stop loudly instead.
+        echo "hw_wait: session data captured but ANALYSIS FAILED (rc=4);"
+        echo "         fix examples/analyze_hw_session.py and re-run it by hand"
+        exit 4
       fi
       echo "hw_wait: session aborted (rc=$rc); back to waiting"
       last_probe=$(date +%s)   # the session just proved the tunnel is sick

@@ -79,6 +79,45 @@ def test_cli_decision_excludes_drifted_winner(tmp_path):
 
 
 @pytest.mark.slow
+def test_smoke_session_end_to_end(tmp_path):
+    """Run the REAL runbook (HW_SMOKE=1 hw_session.sh: every step, toy
+    shapes, CPU) into the REAL analyzer -- the binding rehearsal that the
+    round's hardware window cannot be lost to a step or format break the
+    per-producer pins didn't cover (VERDICT r4 item 6)."""
+    env = worker_env()
+    env["HW_SMOKE"] = "1"
+    env["LOGDIR"] = str(tmp_path)
+    r = subprocess.run(
+        ["bash", os.path.join(REPO, "examples", "hw_session.sh")],
+        capture_output=True, text=True, env=env, timeout=1500, cwd=REPO)
+    assert r.returncode == 0, (r.stdout[-3000:], r.stderr[-2000:])
+    assert "session complete" in r.stdout
+    # Every step must have durably completed (DONE sentinel = resumability).
+    # Exact set, not a count: a silently dropped/renamed step is precisely
+    # the break this rehearsal exists to catch before a live window.
+    logs = sorted(p.name for p in tmp_path.glob("*.log"))
+    assert logs == sorted([
+        "bench_north.log", "bench_north_feats.log",
+        "bench_north_chunk262k.log", "bench_5.log", "bench_5stream.log",
+        "bench_6.log", "bench_3_diag.log", "kernel_north.log",
+        "kernel_envelope_diag.log", "stream_overlap.log",
+        "components_north.log", "components_envelope.log",
+    ]), logs
+    for p in tmp_path.glob("*.log"):
+        assert "DONE" in p.read_text(), f"{p.name} did not finish"
+
+    # The session must have written the decision artifact itself (the
+    # unattended-window contract): a kernel-vs-XLA decision table with
+    # routing, the bench capture table, and the one-env A/Bs.
+    analysis = (tmp_path / "ANALYSIS.md").read_text()
+    assert "analysis written" in r.stdout
+    assert "Kernel-vs-XLA decision table" in analysis
+    assert "Routing implied" in analysis
+    assert "bench.py captures" in analysis
+    assert "feature hoist" in analysis and "chunk tile" in analysis
+
+
+@pytest.mark.slow
 def test_live_producer_output_parses(tmp_path):
     """Run the real producer on a toy shape and parse its actual output --
     the binding check that the two files' formats cannot drift apart."""
