@@ -20,7 +20,7 @@ from .models import (GMMModel, GMMResult, compute_memberships, fit_gmm,
 from .state import GMMState, compact, zeros_state
 from .validation import InvalidInputError
 
-__version__ = "0.4.0"
+__version__ = "0.5.0"
 
 __all__ = [
     "DEFAULT_CONFIG", "GMMConfig", "GaussianMixture",
