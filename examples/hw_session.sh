@@ -120,27 +120,33 @@ astep() {
   fi
 }
 
-# 1. The official bench (BENCH_r04 rehearsal): north-star on TPU; plus the
-#    two one-env A/Bs (feature hoist; double-size chunk tile).
+# Step order = VERDICT r4 priority, because observed windows die
+# mid-session (round 4: ONE step completed): the official BENCH artifact
+# first, then the two inputs to the routing decision (feature hoist,
+# kernel decision rows), then the MFU decomposition, then the config
+# matrix (incl. the clean config-5 same-session CPU denominator), then
+# the secondary A/Bs and streaming/envelope characterization.
+# 1. The official bench (BENCH_r05 rehearsal): north-star on TPU.
 step bench_north python bench.py
+# 2. Routing decision data: feature hoist A/B + kernel-vs-XLA rows (the
+#    ~5.6 ms/iter xouter HBM win).
 step bench_north_feats env GMM_BENCH_PRECOMPUTE=1 python bench.py
-step bench_north_chunk262k env GMM_BENCH_CHUNK=262144 python bench.py
-# 2. Kernel-vs-XLA(-vs-feature-hoist) decision data (the ~5.6 ms/iter
-#    xouter HBM win).
 astep kernel_north python examples/bench_kernel_precision.py north --blocks=256,512,1024 "${SMOKE[@]}"
-astep kernel_envelope_diag python examples/bench_kernel_precision.py envelope diag --blocks=256,512 "${SMOKE[@]}"
-# 3. Config matrix incl. 5 (fresh same-session CPU denominator rides in
+# 3. MFU decomposition: attribute the north-star iteration's wall time to
+#    quad/lse/moments/xouter components.
+astep components_north python examples/bench_components.py north "${SMOKE[@]}"
+# 4. Config matrix incl. 5 (fresh same-session CPU denominator rides in
 #    bench.py's in-process baseline) and the reference envelope 6.
 step bench_5 python bench.py --config=5
 step bench_5stream python bench.py --config=5stream
 step bench_6 python bench.py --config=6
 step bench_3_diag python bench.py --config=3
-# 4. Streaming overlap: double-buffered out-of-core vs in-memory (item 6).
+# 5. Secondary A/Bs and characterization.
+step bench_north_chunk262k env GMM_BENCH_CHUNK=262144 python bench.py
+astep kernel_envelope_diag python examples/bench_kernel_precision.py envelope diag --blocks=256,512 "${SMOKE[@]}"
+# 6. Streaming overlap: double-buffered out-of-core vs in-memory.
 #    (SMOKE's flags come last, so they win over the full-shape defaults.)
 astep stream_overlap python examples/bench_streaming.py --n=4000000 --iters=10 "${SMOKE[@]}"
-# 5. MFU decomposition (item 3): attribute the north-star iteration's
-#    wall time to quad/lse/moments/xouter components.
-astep components_north python examples/bench_components.py north "${SMOKE[@]}"
 astep components_envelope python examples/bench_components.py envelope --iters=10 "${SMOKE[@]}"
 echo "session complete; logs in $LOGDIR/"
 # Leave the decision artifact next to the logs immediately: if the window

@@ -84,6 +84,17 @@ def test_unknown_config_is_usage_error():
     assert "unknown --config" in r.stderr
 
 
+def test_bad_env_knobs_are_usage_errors():
+    """Nonpositive GMM_BENCH_MAX_N / GMM_BENCH_CHUNK must fail loudly with
+    rc 2 (not crash deep in setup with an opaque shape error)."""
+    r = _run({"GMM_BENCH_CPU": "1", "GMM_BENCH_MAX_N": "0"}, timeout=300)
+    assert r.returncode == 2
+    assert "GMM_BENCH_MAX_N" in r.stderr
+    r = _run({"GMM_BENCH_CPU": "1", "GMM_BENCH_CHUNK": "-3"}, timeout=300)
+    assert r.returncode == 2
+    assert "GMM_BENCH_CHUNK" in r.stderr
+
+
 @pytest.mark.slow
 def test_deliberate_cpu_run_measures_with_rc0():
     """GMM_BENCH_CPU=1 is the deliberate-CPU contract: rc 0, a real
