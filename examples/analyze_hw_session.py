@@ -13,6 +13,9 @@ Reads:
   kernel_*.log        -- bench_kernel_precision.py rows:
                          "<shape> <tag> <ms> ms/iter loglik=<ll>"
   bench_*.log         -- bench.py JSON lines (north + A/Bs + config matrix)
+  components_*.log    -- bench_components.py rows ("<shape> <comp> <ms>
+                         ms/pass"): the MFU decomposition
+  stream_overlap.log  -- bench_streaming.py ("streaming/in-memory ratio")
 Prints a markdown decision table (paste into docs/PERF.md) plus the
 per-shape winner and the code changes it implies. Purely textual: no jax,
 no devices, safe to run anywhere.
@@ -31,37 +34,87 @@ ROW = re.compile(
 FAIL = re.compile(r"^(?P<shape>\w+)\s+(?P<tag>kernel [^:]+): FAILED (?P<err>.*)")
 
 
+def _log_lines(logdir, prefix):
+    """(filename_stem, stripped_line) for every line of {prefix}*.log."""
+    for fn in sorted(os.listdir(logdir)):
+        if not (fn.startswith(prefix) and fn.endswith(".log")):
+            continue
+        with open(os.path.join(logdir, fn)) as fh:
+            for line in fh:
+                yield fn[:-4], line.strip()
+
+
 def parse_kernel_logs(logdir):
     rows, fails = [], []
-    for fn in sorted(os.listdir(logdir)):
-        if not (fn.startswith("kernel") and fn.endswith(".log")):
+    for _, line in _log_lines(logdir, "kernel"):
+        m = ROW.match(line)
+        if m:
+            rows.append(dict(shape=m["shape"], tag=m["tag"].strip(),
+                             ms=float(m["ms"]), loglik=float(m["ll"])))
             continue
-        for line in open(os.path.join(logdir, fn)):
-            m = ROW.match(line.strip())
-            if m:
-                rows.append(dict(shape=m["shape"], tag=m["tag"].strip(),
-                                 ms=float(m["ms"]), loglik=float(m["ll"])))
-                continue
-            f = FAIL.match(line.strip())
-            if f:
-                fails.append(dict(shape=f["shape"], tag=f["tag"],
-                                  err=f["err"].strip()))
+        f = FAIL.match(line)
+        if f:
+            fails.append(dict(shape=f["shape"], tag=f["tag"],
+                              err=f["err"].strip()))
     return rows, fails
 
 
 def parse_bench_logs(logdir):
     out = {}
-    for fn in sorted(os.listdir(logdir)):
-        if not (fn.startswith("bench") and fn.endswith(".log")):
-            continue
-        for line in open(os.path.join(logdir, fn)):
-            line = line.strip()
-            if line.startswith("{"):
-                try:
-                    out[fn[:-4]] = json.loads(line)
-                except ValueError:
-                    pass
+    for stem, line in _log_lines(logdir, "bench"):
+        if line.startswith("{"):
+            try:
+                out[stem] = json.loads(line)
+            except ValueError:
+                pass
     return out
+
+
+COMPONENT_ROW = re.compile(
+    r"^(?P<shape>\w+)\s+(?P<comp>\w+)\s+(?P<ms>[0-9.]+)\s+ms/pass")
+STREAM_ROW = re.compile(
+    r"^(?P<mode>in-memory|streaming)\s+(?P<ms>[0-9.]+)\s+ms/iter\s+"
+    r"loglik=(?P<ll>-?[0-9.]+)")
+STREAM_RATIO = re.compile(
+    r"^streaming/in-memory ratio:\s*(?P<ratio>[0-9.]+)x")
+
+
+def parse_component_logs(logdir):
+    """[(shape, component, ms)] from components_*.log (bench_components.py)."""
+    rows = []
+    for _, line in _log_lines(logdir, "components"):
+        m = COMPONENT_ROW.match(line)
+        if m:
+            rows.append((m["shape"], m["comp"], float(m["ms"])))
+    return rows
+
+
+def parse_stream_overlap(logdir):
+    """(wall ratio, loglik drift) from stream_overlap.log, or None.
+
+    Drift is |streaming - in-memory| / max(1, |in-memory|): a fast
+    streaming path that computed a DIFFERENT answer must be flagged, not
+    celebrated (same guard the kernel decision table applies)."""
+    ratio, lls = None, {}
+    for stem, line in _log_lines(logdir, "stream_overlap"):
+        if stem != "stream_overlap":
+            # Exactly one run's file: merging fields across e.g. a
+            # stream_overlap_mesh8.log variant would compute drift between
+            # two different runs.
+            continue
+        m = STREAM_RATIO.match(line)
+        if m:
+            ratio = float(m["ratio"])
+        m = STREAM_ROW.match(line)
+        if m:
+            lls[m["mode"]] = float(m["ll"])
+    if ratio is None:
+        return None
+    drift = None
+    if "in-memory" in lls and "streaming" in lls:
+        drift = (abs(lls["streaming"] - lls["in-memory"])
+                 / max(1.0, abs(lls["in-memory"])))
+    return ratio, drift
 
 
 def precision_of(tag):
@@ -171,7 +224,44 @@ def main() -> int:
                     d = (j["value"] / base["value"] - 1.0) * 100
                     print(f"- {label}: {d:+.1f}% vs bench_north "
                           f"(same session)")
-    if not rows and not fails and not bench:
+        print()
+    comps = parse_component_logs(logdir)
+    if comps:
+        # MFU attribution: each component pass is timed standalone, so the
+        # 'full' row is the yardstick and the parts may not sum to it
+        # (XLA fuses differently in the full program -- that residual IS
+        # decision data: a large one means the standalone timings
+        # misattribute and only a trace can split further).
+        print("## Component decomposition (ms/pass, standalone)\n")
+        print("| shape | component | ms/pass | share of full |")
+        print("|---|---|---|---|")
+        for shape in sorted({s for s, _, _ in comps}):
+            grp = [(c, ms) for s, c, ms in comps if s == shape]
+            full = dict(grp).get("full")
+            for c, ms in grp:
+                share = f"{ms / full:.0%}" if full else "-"
+                print(f"| {shape} | {c} | {ms:.2f} | {share} |")
+        print()
+    stream = parse_stream_overlap(logdir)
+    if stream is not None:
+        ratio, drift = stream
+        if drift is None:
+            # Ratio present but the per-mode loglik pair didn't parse: the
+            # answer agreement is UNVERIFIED, which must not read as a pass.
+            verdict_s = ("loglik pair unparsed -- answer agreement "
+                         "unverified, treat the ratio as provisional")
+        elif drift > 1e-4:
+            verdict_s = (f"ANSWER DRIFT (loglik rel. diff {drift:.1e}) -- "
+                         "the streaming path computed a different answer; "
+                         "the ratio is void until that is fixed")
+        elif ratio <= 1.3:
+            verdict_s = "overlap holds (within the ~1.3x in-memory budget)"
+        else:
+            verdict_s = ("overlap NOT holding -- double-buffering is not "
+                         "hiding host->device copies at this shape")
+        print(f"## Streaming overlap\n\n- out-of-core / in-memory wall "
+              f"ratio: **{ratio:.2f}x** -- {verdict_s}\n")
+    if not rows and not fails and not bench and not comps and stream is None:
         print(f"analyze_hw_session: nothing parseable in {logdir}/")
         return 1
     return 0

@@ -47,6 +47,17 @@ def _write_logs(d):
         json.dumps({"metric": "EM iters/sec (config=north)", "value": 0.0,
                     "unit": "iters/sec", "vs_baseline": 0.0,
                     "accelerator_unavailable": True, "watchdog": True}) + "\n")
+    (d / "components_north.log").write_text(
+        "platform: tpu  precision=high iters=20\n"
+        "north     full         8.60 ms/pass\n"
+        "north     xouter       5.60 ms/pass\n"
+        "DONE\n")
+    (d / "stream_overlap.log").write_text(
+        "platform: tpu  n=4000000 d=24 k=64 iters=10 chunk=131072 mesh=off\n"
+        "in-memory                 10.00 ms/iter  loglik=-1\n"
+        "streaming                 12.00 ms/iter  loglik=-1\n"
+        "streaming/in-memory ratio: 1.20x\n"
+        "DONE\n")
 
 
 def test_parses_producer_formats_and_guards_wrong_answers(tmp_path):
@@ -59,6 +70,10 @@ def test_parses_producer_formats_and_guards_wrong_answers(tmp_path):
     bench = mod.parse_bench_logs(str(tmp_path))
     assert bench["bench_north"]["value"] == 78.2
     assert bench["bench_north_feats"]["accelerator_unavailable"] is True
+    comps = mod.parse_component_logs(str(tmp_path))
+    assert ("north", "xouter", 5.6) in comps and ("north", "full", 8.6) in comps
+    ratio, drift = mod.parse_stream_overlap(str(tmp_path))
+    assert ratio == 1.2 and drift == 0.0
 
 
 def test_cli_decision_excludes_drifted_winner(tmp_path):
@@ -76,6 +91,33 @@ def test_cli_decision_excludes_drifted_winner(tmp_path):
     assert "feature hoist" not in out
     # The no-measurement artifact is labeled as such in the bench table.
     assert "NO MEASUREMENT" in out
+    # Component decomposition and streaming-overlap sections rendered.
+    assert "Component decomposition" in out and "| north | xouter | 5.60 |" in out
+    assert "Streaming overlap" in out and "1.20x" in out
+    assert "overlap holds" in out
+
+
+def test_stream_overlap_answer_drift_voids_ratio(tmp_path):
+    """A fast-but-wrong streaming run must be flagged, not celebrated."""
+    (tmp_path / "stream_overlap.log").write_text(
+        "platform: tpu  n=4000000 d=24 k=64 iters=10 chunk=131072 mesh=off\n"
+        "in-memory                 10.00 ms/iter  loglik=-1000000\n"
+        "streaming                  8.00 ms/iter  loglik=-990000\n"
+        "streaming/in-memory ratio: 0.80x\n"
+        "DONE\n")
+    r = subprocess.run([sys.executable, SCRIPT, str(tmp_path)],
+                       capture_output=True, text=True, timeout=60)
+    assert r.returncode == 0, r.stderr
+    assert "ANSWER DRIFT" in r.stdout
+    assert "overlap holds" not in r.stdout
+    # A ratio whose loglik pair didn't parse is unverified, not a pass.
+    (tmp_path / "stream_overlap.log").write_text(
+        "in-memory sharded          10.00 ms/iter  loglik=-1000000\n"
+        "streaming                   8.00 ms/iter  loglik=-1000000\n"
+        "streaming/in-memory ratio: 0.80x\nDONE\n")
+    r = subprocess.run([sys.executable, SCRIPT, str(tmp_path)],
+                       capture_output=True, text=True, timeout=60)
+    assert "unverified" in r.stdout and "overlap holds" not in r.stdout
 
 
 @pytest.mark.slow
@@ -115,6 +157,8 @@ def test_smoke_session_end_to_end(tmp_path):
     assert "Routing implied" in analysis
     assert "bench.py captures" in analysis
     assert "feature hoist" in analysis and "chunk tile" in analysis
+    assert "Component decomposition" in analysis
+    assert "Streaming overlap" in analysis
 
 
 @pytest.mark.slow
