@@ -115,7 +115,8 @@ def build_parser() -> argparse.ArgumentParser:
                    help="independent restarts with varied kmeans++ seeds; "
                    "best Rissanen kept (1 = reference single-init)")
     t.add_argument("--pallas", default="auto", choices=["auto", "always", "never"],
-                   help="use the Pallas fused kernel")
+                   help="use the experimental Pallas fused kernel ('auto' "
+                        "routes to the XLA path; see docs/PERF.md)")
     t.add_argument("--precompute-features", action="store_true",
                    help="hoist the [N, F] outer-product features out of the "
                    "EM loop (built once, held in HBM: N*F*4 bytes); "

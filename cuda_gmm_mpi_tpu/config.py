@@ -69,10 +69,12 @@ class GMMConfig:
     quad_mode: str = "expanded"
     # Center data at fit() time (shift-equivariant; outputs are shifted back).
     center_data: bool = True
-    # Pallas fused kernel for the E+M pass; 'always' forces it, 'auto'
-    # resolves per the measured matrix in docs/PERF.md. All precisions are
-    # supported in-kernel ('high' is a manual 3-dot bf16_3x decomposition,
-    # since Mosaic rejects native Precision.HIGH).
+    # Pallas fused kernel for the E+M pass (EXPERIMENTAL; docs/PERF.md
+    # round-5 routing decision): 'always' forces it, 'auto' resolves to
+    # the XLA path everywhere -- at matched precision XLA met or beat the
+    # kernel at every measured shape. All precisions are supported
+    # in-kernel ('high' is a manual 3-dot bf16_3x decomposition, since
+    # Mosaic rejects native Precision.HIGH).
     use_pallas: str = "auto"  # 'auto' | 'always' | 'never'
     # Hoist the [N, F] outer-product features out of the EM loop: built
     # once per run and held in HBM (N*F*4 bytes -- 2.3 GB at 1M x 24),

@@ -1,19 +1,28 @@
-"""Pallas/Mosaic TPU kernels -- the hand-written L2 device kernels.
+"""Pallas/Mosaic TPU kernels -- EXPERIMENTAL alternates to the XLA path.
 
-``should_use_pallas`` decides kernel-vs-jnp per config. Since the round-3
-matched-precision study (docs/PERF.md), 'auto' resolves to the jnp/XLA path
-everywhere -- the kernel's earlier measured wins were an artifact of Mosaic
-lowering precision-unannotated dots at DEFAULT (bf16); at honest precision
-XLA meets or beats the kernel at every measured shape. The kernels stay
-available under ``use_pallas='always'`` (fp32; all precisions -- 'high' is
-a manual 3-dot bf16_3x decomposition since Mosaic rejects native
-Precision.HIGH), correct and tested: the single-shard fused E+M kernel
-(full + diagonal covariance) and the two-pass cluster-sharded variant
-(per-shard LSE in-kernel, pmax/psum outside -- the cross-device
-generalization of estep1's per-cluster grid axis,
-``gaussian_kernel.cu:383``; diagonal covariance only).
-``make_stats_fn`` binds the config's covariance mode, tile size, precision,
-and mesh axis into the ``stats_fn`` hook consumed by ``em_while_loop``.
+STATUS (settled round 5, on round-3 hardware data -- see docs/PERF.md
+"routing decision"): the production path is jnp/XLA everywhere; these
+kernels are kept as measured-and-lost research artifacts plus the
+starting point for any future VMEM-resident-features attempt. The round-3
+matched-precision study showed the kernel's earlier wins were an artifact
+of Mosaic lowering precision-unannotated dots at DEFAULT (bf16); at
+honest precision XLA met or beat the kernel at every measured shape. The
+one untested hope -- that in-kernel feature materialization beats XLA's
+xouter HBM traffic at the north star -- is what the hardware session's
+``kernel_north`` step measures; a win there is the only thing that should
+flip ``should_use_pallas``.
+
+``should_use_pallas`` decides kernel-vs-jnp per config: 'auto' resolves
+to the jnp/XLA path everywhere. The kernels stay available under
+``use_pallas='always'`` (fp32; all precisions -- 'high' is a manual 3-dot
+bf16_3x decomposition since Mosaic rejects native Precision.HIGH),
+correct and parity-tested: the single-shard fused E+M kernel (full +
+diagonal covariance) and the two-pass cluster-sharded variant (per-shard
+LSE in-kernel, pmax/psum outside -- the cross-device generalization of
+estep1's per-cluster grid axis, ``gaussian_kernel.cu:383``; diagonal
+covariance only). ``make_stats_fn`` binds the config's covariance mode,
+tile size, precision, and mesh axis into the ``stats_fn`` hook consumed
+by ``em_while_loop``.
 """
 
 from __future__ import annotations
