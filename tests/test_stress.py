@@ -93,6 +93,23 @@ def test_single_cluster_k1():
     np.testing.assert_allclose(r.weights[0], 1.0, atol=1e-6)
 
 
+def test_univariate_d1():
+    """D=1 (univariate mixture): R is [K,1,1], the feature expansion is a
+    single column, the merge distance reduces to a scalar formula. The
+    reference never exercises this (NUM_DIMENSIONS is a compile-time 21+);
+    a runtime-D framework must not break on the smallest case. The sweep
+    must also recover the true K=2."""
+    rng = np.random.default_rng(5)
+    x = np.concatenate([rng.normal(-5, 1.0, 600),
+                        rng.normal(5, 0.5, 400)])[:, None]
+    r = fit_gmm(x, 4, 2, config=cfg(min_iters=5, max_iters=30))
+    assert_finite_result(r)
+    assert r.ideal_num_clusters == 2
+    np.testing.assert_allclose(np.sort(r.means.ravel()[:2]), [-5.0, 5.0],
+                               atol=0.2)
+    np.testing.assert_allclose(np.sort(r.weights[:2]), [0.4, 0.6], atol=0.05)
+
+
 @pytest.mark.slow
 def test_beyond_reference_envelope():
     """K and D past the reference's compile-time caps (MAX_CLUSTERS=512,
