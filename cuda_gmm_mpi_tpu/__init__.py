@@ -13,14 +13,13 @@ See SURVEY.md at the repo root for the structural analysis of the reference and
 the file:line provenance cited throughout this package.
 """
 
+from ._version import __version__
 from .config import DEFAULT_CONFIG, GMMConfig
 from .estimator import GaussianMixture
 from .models import (GMMModel, GMMResult, compute_memberships, fit_gmm,
                      iter_memberships)
 from .state import GMMState, compact, zeros_state
 from .validation import InvalidInputError
-
-__version__ = "0.5.0"
 
 __all__ = [
     "DEFAULT_CONFIG", "GMMConfig", "GaussianMixture",

@@ -25,6 +25,10 @@ def build_parser() -> argparse.ArgumentParser:
         description="TPU-native GMM-EM clustering with Rissanen model-order "
         "search (capabilities of CUDA-GMM-MPI's gaussianMPI).",
     )
+    from ._version import __version__
+
+    p.add_argument("--version", action="version",
+                   version=f"%(prog)s {__version__}")
     p.add_argument("num_clusters", type=int,
                    help="number of starting clusters")
     p.add_argument("infile", help="input data: CSV (first line = header) or "

@@ -26,6 +26,16 @@ def run_cli(args):
     return main(args)
 
 
+def test_version_flag():
+    from cuda_gmm_mpi_tpu import __version__
+
+    r = subprocess.run(
+        [sys.executable, "-m", "cuda_gmm_mpi_tpu.cli", "--version"],
+        capture_output=True, text=True, timeout=120)
+    assert r.returncode == 0
+    assert r.stdout.strip() == f"gmm {__version__}"
+
+
 def test_cli_end_to_end(csv_file, tmp_path):
     out = str(tmp_path / "out")
     rc = run_cli(["3", csv_file, out, "3",
