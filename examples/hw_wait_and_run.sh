@@ -40,10 +40,12 @@ relay_alive() {
   ports=$(ss -tln 2>/dev/null | awk '{print $4}' | grep -oE '[0-9]+$' \
     | grep -vE "^(${ignore})$" | grep .)
   if [ -n "${GMM_HW_RELAY_PORTS:-}" ]; then
-    # Accept comma or pipe separators; the `grep .` above dropped empty
-    # lines so a stray trailing separator cannot match an empty string and
-    # invert the check.
-    echo "$ports" | grep -qE "^(${GMM_HW_RELAY_PORTS//,/|})$"
+    # Accept comma or pipe separators. printf (not echo): with no ports
+    # left, echo would still emit one empty line, which a stray trailing
+    # separator in the pattern ('8471|' -> '^(8471|)$') matches -- a dead
+    # relay reported alive. printf '%s' of an empty string feeds grep
+    # nothing, so the check stays dead. Pinned by tests/test_hw_waiter.py.
+    printf '%s' "$ports" | grep -qE "^(${GMM_HW_RELAY_PORTS//,/|})$"
   else
     [ -n "$ports" ]
   fi
@@ -56,6 +58,14 @@ machine_quiet() {
   ! ps -eo args | grep -vE 'claude|grep' \
     | grep -qE 'pytest|bench\.py|bench_kernel_precision|bench_streaming|bench_components'
 }
+
+# Sourcing mode for tests: define the functions above, skip the wait loop
+# (tests/test_hw_waiter.py stubs `ss`/`ps` on PATH and probes
+# relay_alive/machine_quiet directly -- these heuristics have been
+# review-flagged repeatedly and must not regress silently). The exit
+# fallback keeps an EXECUTED script with the var leaked from a test env a
+# no-op too (top-level `return` errors when not sourced).
+[ "${GMM_HW_SOURCE_ONLY:-}" = "1" ] && { return 0 2>/dev/null || exit 0; }
 
 while :; do
   now=$(date +%s)

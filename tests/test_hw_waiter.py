@@ -1,0 +1,115 @@
+"""Unit tests for hw_wait_and_run.sh's detection predicates.
+
+The waiter is the round's unattended tunnel-catcher; its `relay_alive`
+port heuristic has been review-flagged twice (replace-vs-extend ignore
+semantics; empty-line match inversion on a trailing separator). These
+tests source the script with GMM_HW_SOURCE_ONLY=1 and drive the
+predicates against a stubbed `ss` on PATH, so the shell logic is pinned
+without any real sockets or a live relay.
+"""
+
+import os
+import subprocess
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+SCRIPT = os.path.join(REPO, "examples", "hw_wait_and_run.sh")
+
+
+def run_relay_alive(tmp_path, listen_ports, env_extra=None):
+    """rc of relay_alive() with `ss -tln` stubbed to the given ports."""
+    stub = tmp_path / "ss"
+    lines = ["State  Recv-Q Send-Q Local Address:Port Peer Address:Port"]
+    lines += [f"LISTEN 0      128    0.0.0.0:{p}      0.0.0.0:*"
+              for p in listen_ports]
+    stub.write_text("#!/bin/sh\n" + "\n".join(
+        f"echo '{ln}'" for ln in lines) + "\n")
+    stub.chmod(0o755)
+    env = dict(os.environ)
+    env.pop("GMM_HW_RELAY_PORTS", None)
+    env.pop("GMM_HW_IGNORE_PORTS", None)
+    env.update(env_extra or {})
+    env["GMM_HW_SOURCE_ONLY"] = "1"
+    env["PATH"] = f"{tmp_path}:{env['PATH']}"
+    r = subprocess.run(
+        ["bash", "-c", f". '{SCRIPT}'; relay_alive"],
+        capture_output=True, text=True, env=env, timeout=30)
+    return r.returncode
+
+
+def test_baseline_ports_are_not_a_relay(tmp_path):
+    assert run_relay_alive(tmp_path, [48271, 2024]) != 0
+
+
+def test_extra_port_means_alive(tmp_path):
+    assert run_relay_alive(tmp_path, [48271, 2024, 35975]) == 0
+
+
+def test_no_ports_at_all_is_dead(tmp_path):
+    assert run_relay_alive(tmp_path, []) != 0
+
+
+def test_ignore_ports_extend_not_replace(tmp_path):
+    """A user-supplied ignore list must EXTEND the baseline: with jupyter's
+    8888 ignored, the baseline listeners alone still must not read as a
+    live relay (the replace semantics bug would return alive here)."""
+    env = {"GMM_HW_IGNORE_PORTS": "8888"}
+    assert run_relay_alive(tmp_path, [48271, 2024, 8888], env) != 0
+    assert run_relay_alive(tmp_path, [48271, 2024, 8888, 35975], env) == 0
+
+
+def test_explicit_relay_ports_match_only_those(tmp_path):
+    env = {"GMM_HW_RELAY_PORTS": "8471,8472"}
+    # an unrelated extra listener is NOT the relay
+    assert run_relay_alive(tmp_path, [48271, 2024, 9999], env) != 0
+    # one of the named ports is
+    assert run_relay_alive(tmp_path, [48271, 2024, 8472], env) == 0
+
+
+def test_trailing_separator_cannot_invert_the_check(tmp_path):
+    """'8471|' (or ',') must not match the empty string and report a dead
+    relay as alive."""
+    for sep_val in ("8471|", "8471,"):
+        env = {"GMM_HW_RELAY_PORTS": sep_val}
+        assert run_relay_alive(tmp_path, [48271, 2024], env) != 0
+
+
+def run_machine_quiet(tmp_path, ps_lines):
+    """rc of machine_quiet() with `ps -eo args` stubbed."""
+    stub = tmp_path / "ps"
+    stub.write_text("#!/bin/sh\n" + "\n".join(
+        f"echo '{ln}'" for ln in (["ARGS"] + ps_lines)) + "\n")
+    stub.chmod(0o755)
+    env = dict(os.environ)
+    env["GMM_HW_SOURCE_ONLY"] = "1"
+    env["PATH"] = f"{tmp_path}:{env['PATH']}"
+    r = subprocess.run(
+        ["bash", "-c", f". '{SCRIPT}'; machine_quiet"],
+        capture_output=True, text=True, env=env, timeout=30)
+    return r.returncode
+
+
+def test_machine_quiet_detects_bench_and_pytest(tmp_path):
+    assert run_machine_quiet(tmp_path, ["/bin/bash", "python bench.py"]) != 0
+    assert run_machine_quiet(
+        tmp_path, ["python -m pytest tests/ -x -q"]) != 0
+    assert run_machine_quiet(tmp_path, ["/bin/bash", "vim notes.md"]) == 0
+
+
+def test_machine_quiet_ignores_the_driver_wrapper(tmp_path):
+    """The build driver's own command line QUOTES 'pytest'/'bench.py' (its
+    system prompt mentions them); lines containing 'claude' are filtered
+    before matching so the driver does not read as a busy machine."""
+    driver = "claude -p --append-system-prompt 'run pytest and bench.py'"
+    assert run_machine_quiet(tmp_path, [driver]) == 0
+    assert run_machine_quiet(tmp_path, [driver, "python bench.py"]) != 0
+
+
+def test_executed_with_source_only_is_a_noop(tmp_path):
+    """GMM_HW_SOURCE_ONLY leaked into an EXECUTED run must not fall through
+    into the hours-long wait loop."""
+    env = dict(os.environ)
+    env["GMM_HW_SOURCE_ONLY"] = "1"
+    r = subprocess.run(["bash", SCRIPT], capture_output=True, text=True,
+                       env=env, timeout=30)
+    assert r.returncode == 0
+    assert "hw_wait" not in r.stdout
