@@ -115,6 +115,23 @@ def settle_after_probe(*, honor_env: bool = True) -> None:
     time.sleep(max(0.0, settle_s))
 
 
+def baseline_params(state, k, dtype=np.float32):
+    """Extract the NumPy-baseline parameter dict from a GMMState.
+
+    Single source for what the CPU baseline iterates on (the parity test
+    tests/test_bench_contract.py certifies numpy_em_iteration* against the
+    framework through this same extraction, so the two cannot diverge
+    silently). The pi clamp mirrors the framework's 1e-10 floor.
+    """
+    return {
+        "means": np.asarray(state.means, dtype)[:k],
+        "Rinv": np.asarray(state.Rinv, dtype)[:k],
+        "constant": np.asarray(state.constant, dtype)[:k],
+        "pi": np.maximum(np.asarray(state.pi, dtype)[:k], 1e-10),
+        "avgvar": np.asarray(state.avgvar, dtype)[:k],
+    }
+
+
 def numpy_em_iteration(x, x2, params):
     """One fused EM iteration in NumPy (same matmul formulation, BLAS-backed)."""
     mu, Rinv, const, pi, avgvar = (
@@ -461,13 +478,7 @@ def main() -> int:
     else:
         x2s = (xs[:, :, None] * xs[:, None, :]).reshape(n_sub, -1)
         cpu_iteration = numpy_em_iteration
-    p0 = {
-        "means": np.asarray(s.means, np.float32)[:k],
-        "Rinv": np.asarray(s.Rinv, np.float32)[:k],
-        "constant": np.asarray(s.constant, np.float32)[:k],
-        "pi": np.maximum(np.asarray(s.pi, np.float32)[:k], 1e-10),
-        "avgvar": np.asarray(s.avgvar, np.float32)[:k],
-    }
+    p0 = baseline_params(s, k)
     cpu_iteration(xs, x2s, p0)  # warm caches
     # Direct configs: min-of-reps on BOTH sides (the accelerator loop above
     # also takes min), best-case vs best-case. Sweep (target_k) configs time

@@ -11,7 +11,10 @@ outside, as a subprocess, exactly the way the driver calls it.
 
 No test here touches the TPU tunnel: the fast-fail test kills the probe
 subprocess in ~10 ms (before the child can even start importing jax),
-and the measured runs force GMM_BENCH_CPU=1.
+and the measured runs force GMM_BENCH_CPU=1. One exception to the
+subprocess framing: the baseline-parity test loads bench.py in-process
+(importlib; no top-level side effects) to certify its NumPy iterations
+against the framework's under conftest's CPU/x64 setup.
 """
 
 import json
@@ -93,6 +96,68 @@ def test_bad_env_knobs_are_usage_errors():
     r = _run({"GMM_BENCH_CPU": "1", "GMM_BENCH_CHUNK": "-3"}, timeout=300)
     assert r.returncode == 2
     assert "GMM_BENCH_CHUNK" in r.stderr
+
+
+def _load_bench():
+    import importlib.util
+
+    spec = importlib.util.spec_from_file_location("bench_mod", BENCH)
+    mod = importlib.util.module_from_spec(spec)
+    spec.loader.exec_module(mod)
+    return mod
+
+
+@pytest.mark.parametrize("diag", [False, True])
+def test_numpy_baseline_matches_framework_iteration(diag):
+    """vs_baseline is only honest if bench.py's NumPy iteration computes
+    the SAME iteration the framework runs: one EM step from the same seed
+    state on the same data must produce the same loglik and parameters
+    (float64, well-populated clusters so no degeneracy guard fires)."""
+    import jax.numpy as jnp
+    import numpy as np
+
+    from cuda_gmm_mpi_tpu.config import GMMConfig
+    from cuda_gmm_mpi_tpu.models.gmm import GMMModel, chunk_events
+    from cuda_gmm_mpi_tpu.ops.formulas import convergence_epsilon
+    from cuda_gmm_mpi_tpu.ops.seeding import seed_clusters_host
+
+    bench = _load_bench()
+    rng = np.random.default_rng(11)
+    k, d, n = 5, 4, 4000
+    centers = rng.normal(scale=10.0, size=(k, d))
+    data = (centers[rng.integers(0, k, n)]
+            + rng.normal(size=(n, d))).astype(np.float64)
+
+    cfg = GMMConfig(min_iters=1, max_iters=1, chunk_size=1000,
+                    dtype="float64", diag_only=diag)
+    model = GMMModel(cfg)
+    state = seed_clusters_host(data, k, dtype=np.float64)
+    chunks, wts = chunk_events(data, cfg.chunk_size)
+    s1, ll1, iters = model.run_em(state, jnp.asarray(chunks),
+                                  jnp.asarray(wts),
+                                  convergence_epsilon(n, d))
+    assert int(iters) == 1
+
+    p0 = bench.baseline_params(state, k, dtype=np.float64)
+    if diag:
+        x2 = data * data
+        cpu_iteration = bench.numpy_em_iteration_diag
+    else:
+        x2 = (data[:, :, None] * data[:, None, :]).reshape(n, -1)
+        cpu_iteration = bench.numpy_em_iteration
+    # em_while_loop returns the loglik of the UPDATED params (its body is
+    # M-step then E-step), so parity needs two NumPy calls: the first
+    # yields the updated params p1, the second's loglik is evaluated at p1.
+    p1, _ = cpu_iteration(data, x2, p0)
+    _, ll_np = cpu_iteration(data, x2, p1)
+
+    np.testing.assert_allclose(float(ll1), ll_np, rtol=1e-10)
+    np.testing.assert_allclose(np.asarray(s1.means)[:k], p1["means"],
+                               rtol=1e-9, atol=1e-9)
+    np.testing.assert_allclose(np.asarray(s1.pi)[:k], p1["pi"],
+                               rtol=1e-9, atol=1e-12)
+    np.testing.assert_allclose(np.asarray(s1.constant)[:k], p1["constant"],
+                               rtol=1e-9)
 
 
 @pytest.mark.slow
