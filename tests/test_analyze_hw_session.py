@@ -21,6 +21,17 @@ from .conftest import worker_env
 REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
 SCRIPT = os.path.join(REPO, "examples", "analyze_hw_session.py")
 
+# The full step set of examples/hw_session.sh, shared by the end-to-end
+# smoke test (exact produced-log set) and the resume/failure-path test
+# (pre-marked DONE logs). One list: a step added/renamed in the session
+# script must be reflected here exactly once.
+SESSION_STEPS = [
+    "bench_north", "bench_north_feats", "bench_north_chunk262k",
+    "bench_5", "bench_5stream", "bench_6", "bench_3_diag",
+    "kernel_north", "kernel_envelope_diag", "stream_overlap",
+    "components_north", "components_envelope",
+]
+
 
 def _load():
     spec = importlib.util.spec_from_file_location("analyze_hw_session", SCRIPT)
@@ -138,13 +149,7 @@ def test_smoke_session_end_to_end(tmp_path):
     # Exact set, not a count: a silently dropped/renamed step is precisely
     # the break this rehearsal exists to catch before a live window.
     logs = sorted(p.name for p in tmp_path.glob("*.log"))
-    assert logs == sorted([
-        "bench_north.log", "bench_north_feats.log",
-        "bench_north_chunk262k.log", "bench_5.log", "bench_5stream.log",
-        "bench_6.log", "bench_3_diag.log", "kernel_north.log",
-        "kernel_envelope_diag.log", "stream_overlap.log",
-        "components_north.log", "components_envelope.log",
-    ]), logs
+    assert logs == sorted(f"{s}.log" for s in SESSION_STEPS), logs
     for p in tmp_path.glob("*.log"):
         assert "DONE" in p.read_text(), f"{p.name} did not finish"
 
@@ -159,6 +164,27 @@ def test_smoke_session_end_to_end(tmp_path):
     assert "feature hoist" in analysis and "chunk tile" in analysis
     assert "Component decomposition" in analysis
     assert "Streaming overlap" in analysis
+
+
+def test_session_resume_skips_done_and_fails_loud_on_broken_analysis(tmp_path):
+    """Two session contracts in one fast run (no bench executes): every
+    step whose log ends in DONE is skipped on resume, and an analyzer
+    failure exits 4 (hw_wait_and_run.sh stops loudly instead of burning
+    probe clients on deterministic re-failure)."""
+    for s in SESSION_STEPS:
+        # DONE so the step skips; content unparseable so the analyzer
+        # finds nothing and returns nonzero.
+        (tmp_path / f"{s}.log").write_text("gibberish\nDONE\n")
+    env = worker_env()
+    env["HW_SMOKE"] = "1"
+    env["LOGDIR"] = str(tmp_path)
+    r = subprocess.run(
+        ["bash", os.path.join(REPO, "examples", "hw_session.sh")],
+        capture_output=True, text=True, env=env, timeout=120, cwd=REPO)
+    assert r.returncode == 4, (r.returncode, r.stdout[-2000:])
+    assert r.stdout.count("already done, skipping") == len(SESSION_STEPS)
+    assert "analyze_hw_session.py failed" in r.stdout
+    assert "nothing parseable" in (tmp_path / "ANALYSIS.md").read_text()
 
 
 @pytest.mark.slow
