@@ -36,6 +36,20 @@ def test_version_flag():
     assert r.stdout.strip() == f"gmm {__version__}"
 
 
+def test_version_matches_pyproject():
+    """_version.py and pyproject.toml are bumped together (the version
+    deliberately lives in exactly these two places)."""
+    import os
+    import tomllib
+
+    from cuda_gmm_mpi_tpu import __version__
+
+    repo = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+    with open(os.path.join(repo, "pyproject.toml"), "rb") as fh:
+        meta = tomllib.load(fh)
+    assert meta["project"]["version"] == __version__
+
+
 def test_cli_end_to_end(csv_file, tmp_path):
     out = str(tmp_path / "out")
     rc = run_cli(["3", csv_file, out, "3",
