@@ -7,7 +7,7 @@ import numpy as np
 from cuda_gmm_mpi_tpu.config import GMMConfig
 from cuda_gmm_mpi_tpu.models.gmm import GMMModel, chunk_events
 from cuda_gmm_mpi_tpu.ops.formulas import convergence_epsilon
-from cuda_gmm_mpi_tpu.ops.seeding import seed_clusters
+from cuda_gmm_mpi_tpu.ops.seeding import seed_clusters, seed_clusters_host
 
 from .reference_impl import np_em
 
@@ -87,6 +87,27 @@ def test_em_float32_close_to_oracle(blobs):
     np.testing.assert_allclose(float(ll), lls[-1], rtol=2e-5)
     np.testing.assert_allclose(np.asarray(state.means), params["means"],
                                rtol=2e-3, atol=2e-3)
+
+
+def test_chunk_size_invariance(blobs):
+    """The chunk grid is an execution detail: the same fit across chunk
+    sizes -- including ragged tails that exercise the zero-weight padding
+    row -- must agree to float64 reduction-order tolerance."""
+    data, _ = blobs  # n=2000
+    results = []
+    for chunk in (64, 300, 2000):  # 300 leaves a padded ragged tail
+        cfg = GMMConfig(min_iters=5, max_iters=5, chunk_size=chunk,
+                        dtype="float64")
+        chunks, wts = chunk_events(data, cfg.chunk_size)
+        state = seed_clusters_host(data, 4)
+        s, ll, _ = GMMModel(cfg).run_em(state, jnp.asarray(chunks),
+                                        jnp.asarray(wts),
+                                        convergence_epsilon(*data.shape))
+        results.append((float(ll), np.asarray(s.means)[:4]))
+    ll0, m0 = results[0]
+    for ll, m in results[1:]:
+        np.testing.assert_allclose(ll, ll0, rtol=1e-11)
+        np.testing.assert_allclose(m, m0, rtol=1e-9, atol=1e-9)
 
 
 def test_precompute_features_bitwise_identical(blobs):
