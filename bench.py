@@ -23,6 +23,11 @@ platform; accelerator default 131072, CPU default 4096 from the round-5
 cache sweep); GMM_BENCH_MAX_N (CPU-run event cap, default 100000 -- smoke
 runs shrink it); GMM_BENCH_WATCHDOG_S (mid-run dead-device deadline,
 default 1800);
+GMM_BENCH_METRICS (opt-in: a JSONL path -- sweep configs run the timed fit
+with the telemetry recorder attached and the per-K iteration/seconds
+numbers are read back from the schema-versioned stream instead of the
+in-process sweep_log, exercising the same consumer path `gmm report`
+uses; the artifact notes telemetry_source=jsonl);
 GMM_BENCH_PROBE_{ATTEMPTS,TIMEOUT_S,WAIT_S} (accelerator probe budget);
 GMM_BENCH_SETTLE_S (pause between the probe client's disconnect and this
 process's device init, default 10); GMM_BENCH_REQUIRE_ACCEL=1 (on probe
@@ -377,6 +382,12 @@ def main() -> int:
     want_pre = env_pre == "1" if env_pre not in (None, "") else not on_accel
     precompute = want_pre and not diag and not spec.get("stream")
 
+    # Opt-in telemetry consumption: the timed sweep writes the JSONL
+    # event stream and the per-K numbers are read back from it (the same
+    # consumer contract `gmm report` uses) instead of the in-process
+    # sweep_log.
+    metrics_path = os.environ.get("GMM_BENCH_METRICS") or None
+
     def measure(use_pallas: str):
         """(iters, dt, ll, final_state, sweep_extra) for one measured run."""
         if target_k:
@@ -390,13 +401,26 @@ def main() -> int:
                                 chunk_size=chunk, diag_only=diag,
                                 matmul_precision=precision,
                                 use_pallas=use_pallas, fused_sweep=True,
-                                precompute_features=precompute)
+                                precompute_features=precompute,
+                                metrics_file=metrics_path)
             fit_model = GMMModel(fit_cfg)
             fit_gmm(data, k, target_k, fit_cfg, model=fit_model)  # warm
             t0 = time.perf_counter()
             res = fit_gmm(data, k, target_k, fit_cfg, model=fit_model)
             sweep_wall = time.perf_counter() - t0
-            timed = res.sweep_log
+            if metrics_path:
+                # The recorder truncates per run, so the file holds exactly
+                # the timed fit's stream.
+                from cuda_gmm_mpi_tpu.telemetry import read_stream
+
+                timed = [
+                    (r["k"], r["loglik"], r["score"], r["iters"],
+                     r["seconds"])
+                    for r in read_stream(metrics_path)
+                    if r.get("event") == "em_done"
+                ]
+            else:
+                timed = res.sweep_log
             iters = sum(int(r[3]) for r in timed)
             dt = sweep_wall
             # Event-cluster work units for the CPU comparison. Counts REAL
@@ -406,11 +430,13 @@ def main() -> int:
             # conservative).
             extra = {
                 "sweep_wall_s": round(sweep_wall, 3),
-                "sweep_ks": len(res.sweep_log),
+                "sweep_ks": len(timed),
                 "work_units": sum(
                     int(r[3]) * n_events * int(r[0]) for r in timed),
                 "ideal_k": res.ideal_num_clusters,
             }
+            if metrics_path:
+                extra["telemetry_source"] = "jsonl"
             # CPU baseline runs at the starting K's shapes
             return iters, dt, res.final_loglik, state, extra
 

@@ -24,6 +24,9 @@ def build_parser() -> argparse.ArgumentParser:
         prog="gmm",
         description="TPU-native GMM-EM clustering with Rissanen model-order "
         "search (capabilities of CUDA-GMM-MPI's gaussianMPI).",
+        epilog="Subcommand: `gmm report FILE.jsonl` renders a "
+        "--metrics-file telemetry stream (phase profile, loglik "
+        "trajectory, sweep summary) offline.",
     )
     from ._version import __version__
 
@@ -159,6 +162,13 @@ def build_parser() -> argparse.ArgumentParser:
                    "loglik, score, criterion, em_iters, seconds) as JSON "
                    "lines (rank 0; machine-readable sibling of the -v "
                    "per-K prints)")
+    t.add_argument("--metrics-file", default=None, metavar="FILE.jsonl",
+                   help="run-scoped telemetry stream: schema-versioned "
+                   "JSONL records (run_start, per-iteration em_iter, per-K "
+                   "em_done, merge, chunk_flush, heartbeat, run_summary "
+                   "with the 7-category phase profile and metrics "
+                   "registry) for every execution path; render it with "
+                   "`gmm report FILE.jsonl` (docs/OBSERVABILITY.md)")
     t.add_argument("--init-from", default=None, metavar="MODEL.summary",
                    help="warm-start: initial means from a saved .summary "
                    "model (its K must equal num_clusters); covariances/"
@@ -172,6 +182,14 @@ def build_parser() -> argparse.ArgumentParser:
 
 
 def main(argv=None) -> int:
+    argv = sys.argv[1:] if argv is None else list(argv)
+    if argv and argv[0] == "report":
+        # `gmm report <metrics.jsonl>`: offline rendering of a
+        # --metrics-file telemetry stream (phase profile, loglik
+        # trajectory, sweep summary) -- no devices, no state files.
+        from .telemetry import report_main
+
+        return report_main(argv[1:])
     args = build_parser().parse_args(argv)
 
     # Platform must be pinned before JAX initializes its backends. Set the env
@@ -184,9 +202,20 @@ def main(argv=None) -> int:
 
         jax.config.update("jax_platforms", args.device)
     if args.cpu_devices:
+        # Older JAX has no jax_num_cpu_devices config; fall back to the
+        # XLA_FLAGS device-count forcing (effective when jax has not been
+        # preloaded yet) rather than crashing the CLI.
+        flags = os.environ.get("XLA_FLAGS", "")
+        if "xla_force_host_platform_device_count" not in flags:
+            os.environ["XLA_FLAGS"] = (
+                flags + " --xla_force_host_platform_device_count="
+                f"{args.cpu_devices}").strip()
         import jax
 
-        jax.config.update("jax_num_cpu_devices", args.cpu_devices)
+        try:
+            jax.config.update("jax_num_cpu_devices", args.cpu_devices)
+        except AttributeError:
+            pass
     if args.dtype == "float64":
         import jax
 
@@ -234,6 +263,7 @@ def main(argv=None) -> int:
             enable_print=args.verbose or args.debug,
             enable_output=not args.no_output,
             profile=args.profile,
+            metrics_file=args.metrics_file,
             checkpoint_dir=args.checkpoint_dir,
             checkpoint_keep=args.checkpoint_keep,
             debug_nans=args.debug_nans,
@@ -258,6 +288,7 @@ def main(argv=None) -> int:
         # silently ignoring flags the user believes took effect.
         fit_only = [
             ("--sweep-log", args.sweep_log),
+            ("--metrics-file", args.metrics_file),
             ("--init-from", args.init_from),
             ("--checkpoint-dir", args.checkpoint_dir),
             ("--fused-sweep", args.fused_sweep),
@@ -298,50 +329,21 @@ def main(argv=None) -> int:
             return 1
     pid, nproc = jax.process_index(), jax.process_count()
 
-    if args.sweep_log:
+    for flag, target in (("--sweep-log", args.sweep_log),
+                         ("--metrics-file", args.metrics_file)):
         # Fail-fast (an unwritable log path must not surface as a crash
         # AFTER an hours-long fit), but only once the runtime is up: only
-        # rank 0 writes the log, and in multi-host runs every rank must
+        # rank 0 writes these files, and in multi-host runs every rank must
         # reach the same proceed/abort decision or the others hang in the
         # first collective.
+        if not target:
+            continue
         ok = True
         if pid == 0:
             try:
-                if os.path.exists(args.sweep_log):
-                    # Existing target: append is non-destructive, so probe
-                    # it directly (also rejects directories / read-only
-                    # files), and never remove it.
-                    with open(args.sweep_log, "a"):
-                        pass
-                elif os.path.lexists(args.sweep_log):
-                    # Dangling symlink: the eventual write follows the
-                    # link, so probe the RESOLVED parent directory (a
-                    # sibling probe next to the symlink would test the
-                    # wrong filesystem) -- with a unique temp file, never
-                    # by creating/removing the real target, which could
-                    # delete a concurrent process's freshly written log.
-                    import tempfile
-
-                    target = os.path.realpath(args.sweep_log)
-                    fd, probe = tempfile.mkstemp(
-                        dir=os.path.dirname(target) or ".",
-                        prefix=os.path.basename(target) + ".probe.")
-                    os.close(fd)
-                    os.remove(probe)
-                else:
-                    # Absent target: probe with a unique sibling temp file
-                    # so the check never creates-then-removes the target
-                    # path itself (removing it could race a concurrent
-                    # process that just created a file under the same name).
-                    import tempfile
-
-                    fd, probe = tempfile.mkstemp(
-                        dir=os.path.dirname(args.sweep_log) or ".",
-                        prefix=os.path.basename(args.sweep_log) + ".probe.")
-                    os.close(fd)
-                    os.remove(probe)
+                _probe_writable(target)
             except OSError as e:
-                print(f"Cannot write --sweep-log={args.sweep_log!r}: {e}",
+                print(f"Cannot write {flag}={target!r}: {e}",
                       file=sys.stderr)
                 ok = False
         if not _all_ranks_ok(ok, nproc):
@@ -528,6 +530,33 @@ def _predict_main(args, config) -> int:
     if config.profile:
         print(f"Inference time: {(time.perf_counter() - t0) * 1e3:.3f} (ms)")
     return 0
+
+
+def _probe_writable(path: str) -> None:
+    """Raise OSError unless ``path`` will accept a write (without ever
+    creating-then-removing the target itself -- that could race a
+    concurrent process's freshly written file)."""
+    if os.path.exists(path):
+        # Existing target: append is non-destructive, so probe it directly
+        # (also rejects directories / read-only files), and never remove it.
+        with open(path, "a"):
+            pass
+        return
+    import tempfile
+
+    if os.path.lexists(path):
+        # Dangling symlink: the eventual write follows the link, so probe
+        # the RESOLVED parent directory (a sibling probe next to the
+        # symlink would test the wrong filesystem).
+        target = os.path.realpath(path)
+    else:
+        # Absent target: probe with a unique sibling temp file.
+        target = path
+    fd, probe = tempfile.mkstemp(
+        dir=os.path.dirname(target) or ".",
+        prefix=os.path.basename(target) + ".probe.")
+    os.close(fd)
+    os.remove(probe)
 
 
 def _all_ranks_ok(ok: bool, nproc: int) -> bool:

@@ -121,6 +121,13 @@ class GMMConfig:
 
     # --- aux subsystems ---
     profile: bool = False
+    # Run-scoped telemetry sink: a JSONL path that receives the
+    # schema-versioned event stream (run_start / em_iter / em_done / merge /
+    # chunk_flush / heartbeat / run_summary -- docs/OBSERVABILITY.md) for
+    # every execution path. None (default) = off; the legacy stderr lines
+    # (metrics_line, --profile) are unaffected either way. Multi-host runs
+    # write one coherent stream from process 0 with rank-tagged records.
+    metrics_file: Optional[str] = None
     checkpoint_dir: Optional[str] = None
     seed: int = 0  # RNG seed for any randomized paths (reference is deterministic)
     # Initial means: 'even' = the reference's evenly-spaced event rows

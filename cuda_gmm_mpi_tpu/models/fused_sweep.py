@@ -13,8 +13,12 @@ remote-TPU link (or any high-latency dispatch path) this removes the last
 per-K latency. Per-K checkpointing and (coarse) profiling compose via the
 ordered ``io_callback`` emission hook (``emit_cb``/``resume``, round 3) --
 whole-K spans are attributed to e_step, since finer phase boundaries are
-not host-observable inside one device program. Opt-in fast path
-(``GMMConfig.fused_sweep``); the host loop remains the default.
+not host-observable inside one device program. The telemetry subsystem
+rides the same hook: an active RunRecorder turns emission on so the
+``em_done`` records carry REAL per-K seconds (emission arrival deltas);
+per-iteration ``em_iter`` records do not exist on this path by design --
+the EM iterations never touch the host (docs/OBSERVABILITY.md). Opt-in
+fast path (``GMMConfig.fused_sweep``); the host loop remains the default.
 
 Semantics match the host sweep exactly (same save rule gaussian.cu:839, same
 termination conditions); parity is asserted in tests/test_fused_sweep.py.

@@ -152,6 +152,7 @@ class GMMModel:
                               precompute_features=config.precompute_features,
                               **kw)
         )
+        self._em_run_traj = None  # built lazily on first trajectory request
         self._estep_stats = jax.jit(
             functools.partial(self._estep_stats_impl, reduce_stats=reduce_stats,
                               stats_fn=stats_fn, **kw)
@@ -175,15 +176,34 @@ class GMMModel:
         return reduce_stats(stats) if reduce_stats else stats
 
     def run_em(self, state, data_chunks, wts_chunks, epsilon: float,
-               min_iters: Optional[int] = None, max_iters: Optional[int] = None):
+               min_iters: Optional[int] = None, max_iters: Optional[int] = None,
+               *, trajectory: bool = False):
         """Full EM at the current active-K. Returns (state, loglik, iters).
 
         ``min_iters``/``max_iters`` override the config's values without
         recompiling (they are dynamic args of the jitted loop) -- e.g. a
         1-iteration warmup call on the same executable the real run uses.
+
+        ``trajectory=True`` (telemetry paths) uses a separately compiled
+        variant that also returns the device-captured per-iteration loglik
+        log (``em_while_loop`` ``trajectory_len`` contract, sized to the
+        config's ``max_iters``): return becomes (state, loglik, iters,
+        ll_log).
         """
         lo, hi = resolve_iters(self.config, min_iters, max_iters)
-        return self._em_run(
+        if trajectory:
+            if self._em_run_traj is None:
+                self._em_run_traj = jax.jit(functools.partial(
+                    em_while_loop, reduce_stats=self.reduce_stats,
+                    stats_fn=self.stats_fn,
+                    covariance_type=self.config.covariance_type,
+                    precompute_features=self.config.precompute_features,
+                    trajectory_len=int(self.config.max_iters),
+                    **self._kw))
+            run = self._em_run_traj
+        else:
+            run = self._em_run
+        return run(
             state, data_chunks, wts_chunks,
             jnp.asarray(epsilon, data_chunks.dtype), lo, hi,
         )
@@ -277,6 +297,7 @@ def em_while_loop(
     stats_fn: Optional[Callable] = None,
     covariance_type: str | None = None,
     precompute_features: bool = False,
+    trajectory_len: int = 0,
 ):
     """The whole per-K EM algorithm as one traced program.
 
@@ -295,6 +316,15 @@ def em_while_loop(
     only, and a no-op under a custom stats_fn (the kernel builds features
     in VMEM). Results are bit-identical either way (same values through
     the same matmuls).
+
+    ``trajectory_len > 0`` (static) additionally records the per-iteration
+    loglik trajectory on device -- the telemetry subsystem's ``em_iter``
+    source for paths whose EM loop is a single dispatch (per-iteration
+    logliks are otherwise not host-observable). The return gains a fourth
+    element ``ll_log`` of shape [trajectory_len + 1]: slot 0 is the initial
+    E-step's loglik, slot i+1 iteration i's; unwritten slots are NaN, and
+    iterations beyond the buffer are dropped (not an error), so a dynamic
+    ``max_iters`` above the static buffer stays safe.
     """
     kw = dict(diag_only=diag_only, quad_mode=quad_mode,
               matmul_precision=matmul_precision, cluster_axis=cluster_axis)
@@ -316,22 +346,34 @@ def em_while_loop(
 
     stats0 = estep(state)  # initial E-step (gaussian.cu:487-516)
     change0 = jnp.asarray(2.0, stats0.loglik.dtype) * epsilon + 1.0  # :525
-    carry0 = (state, stats0, stats0.loglik, change0, jnp.asarray(0, jnp.int32))
+    if trajectory_len:
+        ll_log0 = jnp.full((trajectory_len + 1,), jnp.nan,
+                           stats0.loglik.dtype)
+        ll_log0 = ll_log0.at[0].set(stats0.loglik)
+    else:
+        ll_log0 = jnp.zeros((0,), stats0.loglik.dtype)
+    carry0 = (state, stats0, stats0.loglik, change0,
+              jnp.asarray(0, jnp.int32), ll_log0)
 
     def cond(carry):
-        _, _, _, change, iters = carry
+        _, _, _, change, iters, _ = carry
         return (iters < min_iters) | (
             (jnp.abs(change) > epsilon) & (iters < max_iters)
         )  # gaussian.cu:532
 
     def body(carry):
-        s, stats, ll_old, _, iters = carry
+        s, stats, ll_old, _, iters, ll_log = carry
         s = apply_mstep(s, stats, diag_only=diag_only,
                         cluster_axis=cluster_axis,
                         covariance_type=covariance_type)  # :541-701
         stats_new = estep(s)  # :713-741
         ll = stats_new.loglik
-        return (s, stats_new, ll, ll - ll_old, iters + 1)  # :748-751
+        if trajectory_len:
+            # mode='drop': dynamic max_iters can exceed the static buffer.
+            ll_log = ll_log.at[iters + 1].set(ll, mode="drop")
+        return (s, stats_new, ll, ll - ll_old, iters + 1, ll_log)  # :748-751
 
-    s, _, ll, _, iters = lax.while_loop(cond, body, carry0)
+    s, _, ll, _, iters, ll_log = lax.while_loop(cond, body, carry0)
+    if trajectory_len:
+        return s, ll, iters, ll_log
     return s, ll, iters

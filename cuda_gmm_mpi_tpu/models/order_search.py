@@ -30,6 +30,8 @@ from ..ops.formulas import convergence_epsilon, model_score
 from ..validation import InvalidInputError, validate_finite
 from ..ops.merge import eliminate_and_reduce
 from ..state import GMMState, compact
+from .. import telemetry
+from ..telemetry import RunRecorder
 from ..utils.logging_ import get_logger, metrics_line
 from ..utils.profiling import PhaseTimer
 from .gmm import GMMModel, chunk_events
@@ -92,6 +94,74 @@ def _resume_mismatch(restored, config, log) -> bool:
 @contextlib.contextmanager
 def _null_phase(_name):
     yield
+
+
+def _emit_em_iters(rec, k, ll_log, iters, dt, epsilon, model):
+    """Per-iteration ``em_iter`` records from one K's EM run.
+
+    ``ll_log`` is the [max_iters + 1] loglik log (slot 0 = initial E-step;
+    NaN beyond the iteration count -- em_while_loop's trajectory contract).
+    Wall time per iteration is REAL for host-driven loops that expose
+    ``last_iter_seconds`` (streaming), amortized (whole-K wall / iters)
+    for single-dispatch EM loops, and says which in ``timing``.
+    """
+    if not rec.active or ll_log is None or iters <= 0:
+        return
+    lls = np.asarray(jax.device_get(ll_log), np.float64)
+    n = min(iters, lls.shape[0] - 1)
+    secs = getattr(model, "last_iter_seconds", None)
+    measured = isinstance(secs, list) and len(secs) == iters
+    for i in range(n):
+        wall = secs[i] if measured else dt / max(iters, 1)
+        rec.emit("em_iter", k=int(k), iter=i,
+                 loglik=float(lls[i + 1]),
+                 delta=float(lls[i + 1] - lls[i]),
+                 epsilon=float(epsilon),
+                 wall_s=round(float(wall), 6),
+                 timing="measured" if measured else "amortized")
+
+
+def _emit_run_summary(rec, config, timer, sweep_log, ideal_k, best_score,
+                      best_ll, em_walls):
+    """Final ``run_summary`` record: scores, 7-category phase profile,
+    compile/execute split, metrics-registry snapshot, and (multi-host)
+    every rank's snapshot gathered to the one stream process 0 writes.
+
+    The compile split is the first-vs-warm estimate: the first K's EM call
+    compiles the executable the later Ks reuse, so
+    ``first_call_s - min(warm calls)`` bounds the compile cost (single-K
+    runs carry nulls -- there is no warm call to difference against).
+    """
+    if not rec.active:
+        return
+    first = em_walls[0] if em_walls else None
+    warm = min(em_walls[1:]) if len(em_walls) > 1 else None
+    fields = dict(
+        ideal_k=int(ideal_k),
+        score=float(best_score),
+        criterion=config.criterion,
+        final_loglik=float(best_ll),
+        total_iters=int(sum(r[3] for r in sweep_log)),
+        wall_s=round(float(sum(r[4] for r in sweep_log)), 6),
+        phase_profile=(timer.snapshot() if timer is not None
+                       else {"seconds": {}, "counts": {}}),
+        compile={
+            "first_call_s": (round(first, 6) if first is not None else None),
+            "warm_call_s": (round(warm, 6) if warm is not None else None),
+            "est_compile_s": (round(max(first - warm, 0.0), 6)
+                              if first is not None and warm is not None
+                              else None),
+        },
+        metrics=rec.metrics.snapshot(),
+        memory_stats=telemetry.memory_stats(),
+    )
+    if jax.process_count() > 1:
+        # Collective: every rank contributes its snapshot (all ranks run
+        # this; only process 0 writes the assembled record).
+        from ..parallel.distributed import allgather_json
+
+        fields["per_process"] = allgather_json(rec.metrics.snapshot())
+    rec.emit("run_summary", **fields)
 
 
 def _rebuild_result(state: dict) -> "GMMResult":
@@ -205,7 +275,29 @@ def fit_gmm(
     so a total weight below ``num_clusters`` is rejected. In-memory data
     only; seeding and the epsilon/criterion event counts stay unweighted.
     (Upgrade beyond both the reference and sklearn.)
+
+    With ``config.metrics_file`` set, the whole fit runs under an active
+    :class:`~cuda_gmm_mpi_tpu.telemetry.RunRecorder`: every execution path
+    (in-memory, streaming, sharded, multi-controller, fused-sweep) emits
+    the schema-versioned JSONL event stream described in
+    docs/OBSERVABILITY.md. Already-active ambient recorders (library users
+    wrapping fits in ``telemetry.use``) are reused, not replaced.
     """
+    if config.metrics_file and not telemetry.current().active:
+        # One recorder spans the whole fit, restarts included: the
+        # recursive n_init sub-fits find the ambient recorder active and
+        # ride it instead of truncating the stream per init.
+        rec = RunRecorder(config.metrics_file)
+        with telemetry.use(rec), rec:
+            return _fit_gmm(data, num_clusters, target_num_clusters, config,
+                            model, verbose, init_means, sample_weight)
+    return _fit_gmm(data, num_clusters, target_num_clusters, config, model,
+                    verbose, init_means, sample_weight)
+
+
+def _fit_gmm(data, num_clusters, target_num_clusters, config, model,
+             verbose, init_means, sample_weight) -> GMMResult:
+    """fit_gmm's body, run under whatever ambient recorder is active."""
     if not (1 <= num_clusters <= config.max_clusters):
         raise ValueError(
             f"num_clusters must be in [1, {config.max_clusters}], got {num_clusters}"
@@ -241,7 +333,12 @@ def fit_gmm(
                                   sample_weight=sample_weight)
 
     log = get_logger(config)
-    timer = PhaseTimer() if config.profile else None
+    rec = telemetry.current()
+    # An active recorder needs the same per-K host syncs profiling needs
+    # (per-iteration walls, the 7-category profile in run_summary), so
+    # telemetry runs imply a PhaseTimer; the report still prints only
+    # under config.profile, keeping --profile's stderr contract unchanged.
+    timer = PhaseTimer() if (config.profile or rec.active) else None
     phase = timer.phase if timer else _null_phase
 
     nproc = jax.process_count()
@@ -268,6 +365,33 @@ def fit_gmm(
         print(f"epsilon = {epsilon}")  # gaussian.cu:462
     log.debug("epsilon=%s n=%d d=%d k=%d", epsilon, n_events, n_dims,
               num_clusters)
+
+    if rec.active:
+        # Static tags ride every subsequent record (sharded/multi-host
+        # streams stay self-describing: path + mesh + process).
+        mesh = getattr(model, "mesh", None)
+        rec.set_context(
+            path=("streaming" if config.stream_events
+                  else "sharded" if mesh is not None else "in-memory"),
+            mesh=(list(mesh.shape.values()) if mesh is not None else None),
+        )
+        rec.emit(
+            "run_start",
+            platform=jax.devices()[0].platform,
+            num_events=int(n_events), num_dimensions=int(n_dims),
+            start_k=int(num_clusters), target_k=int(target_num_clusters),
+            epsilon=float(epsilon),
+            process_count=int(nproc),
+            device_count=int(jax.device_count()),
+            local_device_count=int(jax.local_device_count()),
+            dtype=config.dtype, chunk_size=int(config.chunk_size),
+            covariance_type=config.covariance_type,
+            criterion=config.criterion,
+            fused_sweep=bool(config.fused_sweep),
+            stream_events=bool(config.stream_events),
+            n_init=int(config.n_init),
+            memory_stats=telemetry.memory_stats(),
+        )
 
     ckpt = None
     if config.checkpoint_dir:
@@ -356,12 +480,20 @@ def fit_gmm(
                 restored["sweep_log"]).tolist()] if len(
                     restored.get("sweep_log", [])) else []
             log.info("resumed sweep from checkpoint: next K=%d", k)
+            rec.metrics.count("resumes") if rec.active else None
 
+    want_traj = rec.active  # per-iteration loglik log rides the EM call
+    em_walls = []  # per-K EM wall seconds (first includes compile)
     while k >= stop_number:
         t0 = time.perf_counter()
         last_k = k <= stop_number
         with phase("e_step"):  # fused E+M loop (m_step/constants folded in)
-            state, ll, iters = model.run_em(state, chunks, wts, epsilon)
+            if want_traj:
+                state, ll, iters, ll_log = model.run_em(
+                    state, chunks, wts, epsilon, trajectory=True)
+            else:
+                ll_log = None
+                state, ll, iters = model.run_em(state, chunks, wts, epsilon)
             if timer or last_k:
                 # Block on EM here so the e_step phase (and sweep_log's
                 # seconds) measure EM alone. Profiling trades away the
@@ -393,6 +525,7 @@ def fit_gmm(
         if timer:
             timer.counts["e_step"] += int(iters_i) - 1  # per-iter averages
         sweep_log.append((k, ll_f, riss, int(iters_i), dt))
+        em_walls.append(dt)
         if verbose:
             print(f"K={k}: loglik={ll_f:.6e} {config.criterion}={riss:.6e} "
                   f"iters={int(iters_i)} ({dt:.2f}s)")
@@ -400,6 +533,15 @@ def fit_gmm(
                      criterion=config.criterion,
                      iters=int(iters_i), seconds=round(dt, 4)) if (
                          config.enable_debug) else None
+        if rec.active:
+            rec.metrics.count("em_iters", int(iters_i))
+            rec.metrics.gauge("active_k", int(k))
+            rec.metrics.series("active_k", int(k))
+            _emit_em_iters(rec, k, ll_log, int(iters_i), dt, epsilon, model)
+            rec.emit("em_done", k=int(k), loglik=ll_f, score=float(riss),
+                     criterion=config.criterion, iters=int(iters_i),
+                     seconds=round(dt, 6))
+            rec.heartbeat("sweep", k=int(k))
 
         if (
             k == num_clusters
@@ -421,10 +563,15 @@ def fit_gmm(
             # the sweep rather than corrupt the state.
             log.warning("no valid merge pair at K=%d; stopping sweep", k)
             break
+        if rec.active:
+            rec.emit("merge", k_active=int(k), next_k=int(k) - 1,
+                     min_distance=float(min_d_f))
+            rec.metrics.count("merges")
         state = next_state
         k -= 1
 
         if ckpt is not None:
+            rec.metrics.count("checkpoint_saves") if rec.active else None
             with phase("cpu"):
                 ckpt.save(step, {
                     "state": _host_state(state, model),
@@ -447,6 +594,8 @@ def fit_gmm(
         print(f"Final {config.criterion} score was: {min_rissanen}, "
               f"with {ideal_k} clusters.")
 
+    _emit_run_summary(rec, config, timer, sweep_log, n_active,
+                      float(min_rissanen), float(best_ll), em_walls)
     return GMMResult(
         state=compact_state,
         ideal_num_clusters=n_active,
@@ -623,6 +772,12 @@ def _prepare_fit(data, num_clusters, config, model, phase, log,
             )
         else:
             chunks, wts = jnp.asarray(chunks_np), jnp.asarray(wts_np)
+    rec = telemetry.current()
+    if rec.active and not config.stream_events:
+        # Streaming keeps the chunks host-side and accounts its transfers
+        # per flushed block instead (StreamingGMMModel._estep_all).
+        rec.metrics.count("h2d_bytes", int(np.asarray(chunks_np).nbytes)
+                          + int(np.asarray(wts_np).nbytes))
     return (state, chunks, wts, chunks_np, wts_np, n_events, n_dims,
             np.asarray(shift), (start, stop))
 
@@ -654,7 +809,13 @@ def _fit_with_restarts(data, num_clusters, target_num_clusters, config,
         else:
             model = GMMModel(config)
     best = None
+    rec = telemetry.current()
     for i in range(config.n_init):
+        if rec.active:
+            # The restart index tags every record of this init's sub-fit;
+            # all inits share one stream (and one run_id).
+            rec.set_context(init=i)
+            rec.metrics.count("restarts") if i else None
         sub = dataclasses.replace(
             config, n_init=1,
             seed_method=(config.seed_method if i == 0 else "kmeans++"),
@@ -674,6 +835,8 @@ def _fit_with_restarts(data, num_clusters, target_num_clusters, config,
         if (best is None or math.isnan(best.min_rissanen)
                 or r.min_rissanen < best.min_rissanen):
             best = r
+    if rec.active:
+        rec.set_context(init=None)  # clear the tag for any later records
     if verbose:
         print(f"best of {config.n_init} inits: "
               f"{config.criterion}={best.min_rissanen:.6e} "
@@ -843,6 +1006,22 @@ def _run_fused_sweep(fused, config, state, chunks, wts, epsilon,
             + "\n  (fused sweep: whole-K spans attributed to e_step)"
         )
 
+    rec = telemetry.current()
+    if rec.active:
+        # The fused device program exposes per-K granularity only (its EM
+        # iterations never touch the host), so the stream carries em_done
+        # records -- with REAL per-K seconds from the emission arrivals --
+        # but no em_iter rows; docs/OBSERVABILITY.md documents the gap.
+        for k_, ll_, riss_, it_, secs_ in sweep_log:
+            rec.metrics.count("em_iters", int(it_))
+            rec.metrics.series("active_k", int(k_))
+            rec.emit("em_done", k=int(k_), loglik=float(ll_),
+                     score=float(riss_), criterion=config.criterion,
+                     iters=int(it_), seconds=round(float(secs_), 6))
+        _emit_run_summary(rec, config, timer, sweep_log, n_active,
+                          float(best_riss), float(best_ll),
+                          [s for _, s in sorted(step_secs.items())])
+
     return GMMResult(
         state=compact_state,
         ideal_num_clusters=n_active,
@@ -913,7 +1092,11 @@ def iter_memberships(
         # an eager jnp.asarray here would commit to one device first and pay
         # a second device->device reshard).
         w, _ = model.infer_posteriors(state, xb)
-        yield block, np.asarray(jax.device_get(w))[:valid]
+        w_host = np.asarray(jax.device_get(w))[:valid]
+        rec = telemetry.current()
+        if rec.active:
+            rec.metrics.count("d2h_bytes", int(w_host.nbytes))
+        yield block, w_host
 
 
 def compute_memberships(

@@ -47,6 +47,7 @@ host-driven sweep.
 
 from __future__ import annotations
 
+import time
 from typing import Optional
 
 import jax
@@ -57,6 +58,7 @@ from jax.sharding import NamedSharding, PartitionSpec as P
 
 from ..config import GMMConfig
 from ..ops.mstep import apply_mstep, chunk_stats
+from ..telemetry import current as current_recorder
 from .gmm import GMMModel, resolve_iters
 
 
@@ -166,6 +168,11 @@ class StreamingGMMModel(GMMModel):
             self._inference_cache = None  # one-slot (state -> placed)
         self._block_major = False  # set by prepare()'s mesh layout pass
         self._counts_checked = None  # one-slot cross-host count check cache
+        self._pass_index = 0  # full-data E+M passes within the current run_em
+        # Real per-iteration wall seconds of the latest run_em (host-driven
+        # loop, so these are measured, not amortized); the telemetry layer
+        # reads them for the em_iter records.
+        self.last_iter_seconds: list = []
 
     def prepare(self, state, chunks_np, wts_np, host_local: bool = False):
         """Keep the chunk arrays HOST-side; only the state goes on device.
@@ -302,6 +309,10 @@ class StreamingGMMModel(GMMModel):
                     "chunk arrays through prepare() (it pads with "
                     "zero-weight chunks)")
             blocks, stats_fn = n // self._local_data_size, self._stats_block
+        rec = current_recorder()
+        emit = rec.active
+        pass_idx, self._pass_index = self._pass_index, self._pass_index + 1
+        chunks_per_block = 1 if self.mesh is None else self._local_data_size
         acc = None
         nxt = self._put_block(chunks, wts, 0, blocks)
         for j in range(blocks):
@@ -313,6 +324,14 @@ class StreamingGMMModel(GMMModel):
                 nxt = self._put_block(chunks, wts, j + 1, blocks)
             s = stats_fn(state, *cur)
             acc = s if acc is None else self._add(acc, s)
+            if emit:
+                # One record per streamed block flush ("iter" is the pass
+                # index: 0 = the initial E-step, i+1 = EM iteration i).
+                nbytes = int(cur[0].nbytes) + int(cur[1].nbytes)
+                rec.metrics.count("h2d_bytes", nbytes)
+                rec.emit("chunk_flush", iter=pass_idx, block=j,
+                         chunks=chunks_per_block, bytes=nbytes)
+                rec.heartbeat("stream")
         if self.mesh is not None:
             if self._reduce_fn is None:
                 self._reduce_fn = self._make_reduce(acc)
@@ -350,18 +369,33 @@ class StreamingGMMModel(GMMModel):
 
     def run_em(self, state, chunks, wts, epsilon,
                min_iters: Optional[int] = None,
-               max_iters: Optional[int] = None):
-        """Reference loop semantics (gaussian.cu:525-755), host-driven."""
+               max_iters: Optional[int] = None, *, trajectory: bool = False):
+        """Reference loop semantics (gaussian.cu:525-755), host-driven.
+
+        ``trajectory=True`` returns (state, loglik, iters, ll_log) like the
+        in-memory models' telemetry variant; being host-driven, the logliks
+        come for free and ``last_iter_seconds`` carries REAL per-iteration
+        wall times (the jitted paths can only amortize).
+        """
         lo, hi = resolve_iters(self.config, min_iters, max_iters)
         lo, hi = int(lo), int(hi)
+        self._pass_index = 0
+        self.last_iter_seconds = []
         stats = self._estep_all(state, chunks, wts)
         ll_old = float(stats.loglik)
+        lls = [ll_old]  # slot 0: initial E-step (em_while_loop's contract)
         change = abs(2.0 * float(epsilon)) + 1.0  # gaussian.cu:525
         iters = 0
         while iters < lo or (abs(change) > epsilon and iters < hi):
+            t0 = time.perf_counter()
             state = self._mstep(state, stats)
             stats = self._estep_all(state, chunks, wts)
             ll = float(stats.loglik)
+            self.last_iter_seconds.append(time.perf_counter() - t0)
+            lls.append(ll)
             change, ll_old = ll - ll_old, ll
             iters += 1
-        return state, jnp.asarray(ll_old, chunks.dtype), jnp.asarray(iters)
+        out = (state, jnp.asarray(ll_old, chunks.dtype), jnp.asarray(iters))
+        if trajectory:
+            return out + (np.asarray(lls, np.float64),)
+        return out

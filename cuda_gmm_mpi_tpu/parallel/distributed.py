@@ -114,6 +114,37 @@ def allgather_host(values: np.ndarray) -> np.ndarray:
     return np.asarray(multihost_utils.process_allgather(values))
 
 
+def allgather_json(obj) -> list:
+    """Gather one small JSON-serializable object from every process:
+    returns ``[rank0_obj, rank1_obj, ...]`` identically on all ranks.
+
+    The telemetry layer's host-0 aggregation primitive: each rank's
+    metrics-registry snapshot rides a padded uint8 buffer through
+    ``process_allgather`` (two collectives: max-length, then payload), so
+    the one stream process 0 writes can carry every rank's numbers
+    (``run_summary.per_process``). Single-process: ``[obj]``, no runtime
+    touched. Keep payloads small -- this is for summaries, not data.
+    """
+    import json
+
+    if jax.process_count() == 1:
+        return [obj]
+    from jax.experimental import multihost_utils
+
+    payload = json.dumps(obj).encode("utf-8")
+    sizes = np.asarray(multihost_utils.process_allgather(
+        np.asarray([len(payload)], np.int64))).reshape(-1)
+    cap = int(sizes.max())
+    buf = np.zeros((max(cap, 1),), np.uint8)
+    buf[:len(payload)] = np.frombuffer(payload, np.uint8)
+    rows = np.asarray(multihost_utils.process_allgather(buf)).reshape(
+        len(sizes), -1)
+    return [
+        json.loads(rows[i, :int(sizes[i])].tobytes().decode("utf-8"))
+        for i in range(len(sizes))
+    ]
+
+
 def barrier(name: str = "gmm_barrier") -> None:
     """Cross-host sync point (the MPI_Barrier analog -- needed only at host
     filesystem rendezvous like output assembly, never inside compute)."""

@@ -39,12 +39,41 @@ from .mesh import (
     state_pspecs,
 )
 
-try:  # jax>=0.4.35 exposes shard_map at top level; fall back to experimental
-    from jax import shard_map as _shard_map_mod  # type: ignore
-
-    shard_map = _shard_map_mod  # pragma: no cover
+try:  # newer jax exposes shard_map at top level; fall back to experimental
+    from jax import shard_map as _shard_map_impl  # type: ignore
 except ImportError:  # pragma: no cover
-    from jax.experimental.shard_map import shard_map
+    from jax.experimental.shard_map import shard_map as _shard_map_impl
+
+
+def _shard_map_params() -> frozenset:
+    import inspect
+
+    return frozenset(inspect.signature(_shard_map_impl).parameters)
+
+
+_SHARD_MAP_PARAMS = _shard_map_params()
+
+# check_rep-era (older) jax has a second relevant limitation: an ordered
+# io_callback inside a shard_map'd while_loop trips an XLA
+# sharding-propagation CHECK abort (process-killing, not catchable), so
+# per-K fused-sweep emission must be declared unsupported there -- fused
+# runs that want emission (checkpoint/profile/telemetry) then fall back to
+# the host-driven sweep with a warning instead of crashing.
+SHARD_MAP_FUSED_EMIT_OK = "check_vma" in _SHARD_MAP_PARAMS
+
+
+def shard_map(f, *, check_vma=None, **kwargs):
+    """Version-bridging shard_map: newer jax spells the replication-check
+    flag ``check_vma``, older releases ``check_rep`` (same semantics, and
+    this codebase always disables it -- the EM state specs are replicated
+    by construction). Translate to whatever the installed jax accepts so
+    every mesh path works across the supported version range."""
+    if check_vma is not None:
+        if "check_vma" in _SHARD_MAP_PARAMS:
+            kwargs["check_vma"] = check_vma
+        elif "check_rep" in _SHARD_MAP_PARAMS:
+            kwargs["check_rep"] = check_vma
+    return _shard_map_impl(f, **kwargs)
 
 
 def pad_state_clusters(state, cluster_size: int):
@@ -168,10 +197,13 @@ class ShardedGMMModel:
     bespoke MPI/OpenMP plumbing through every step of main()).
     """
 
-    # Per-K fused-sweep emission is supported: the io_callback fires once
-    # per local device shard (cluster shards all-gathered to full state
-    # first); the host sink dedupes by step. See make_fused_sweep.
-    supports_fused_emit = True
+    # Per-K fused-sweep emission: the io_callback fires once per local
+    # device shard (cluster shards all-gathered to full state first); the
+    # host sink dedupes by step. See make_fused_sweep. Version-gated:
+    # check_rep-era jax CHECK-aborts on io_callback under shard_map
+    # (SHARD_MAP_FUSED_EMIT_OK above), where emission-wanting runs fall
+    # back to the host-driven sweep.
+    supports_fused_emit = SHARD_MAP_FUSED_EMIT_OK
 
     def __init__(self, config: GMMConfig = GMMConfig(), mesh=None,
                  stats_fn=None):
@@ -197,6 +229,8 @@ class ShardedGMMModel:
                 cluster_axis=cluster_axis,
             )
         self._stats_fn = stats_fn
+        self._cluster_axis = cluster_axis
+        self._em_run_traj = None  # built lazily on first trajectory request
         em_fn = functools.partial(
             em_while_loop,
             reduce_stats=make_psum_reduce(DATA_AXIS),
@@ -301,9 +335,40 @@ class ShardedGMMModel:
         )
 
     def run_em(self, state, data_chunks, wts_chunks, epsilon: float,
-               min_iters: Optional[int] = None, max_iters: Optional[int] = None):
+               min_iters: Optional[int] = None, max_iters: Optional[int] = None,
+               *, trajectory: bool = False):
         lo, hi = resolve_iters(self.config, min_iters, max_iters)
-        return self._em_run(
+        run = self._em_run
+        if trajectory:
+            # Telemetry variant: same SPMD loop with the device-captured
+            # per-iteration loglik log (em_while_loop trajectory_len).
+            # After the psum the loglik is replicated on every shard, so
+            # the log's out-spec is fully replicated like the scalars.
+            if self._em_run_traj is None:
+                em_fn = functools.partial(
+                    em_while_loop,
+                    reduce_stats=make_psum_reduce(DATA_AXIS),
+                    cluster_axis=self._cluster_axis,
+                    stats_fn=self._stats_fn,
+                    covariance_type=self.config.covariance_type,
+                    precompute_features=self.config.precompute_features,
+                    trajectory_len=int(self.config.max_iters),
+                    **self._kw,
+                )
+                sspec = state_pspecs()
+                scalar = P()
+                self._em_run_traj = jax.jit(
+                    shard_map(
+                        em_fn,
+                        mesh=self.mesh,
+                        in_specs=(sspec, P(DATA_AXIS, None, None),
+                                  P(DATA_AXIS, None), scalar, scalar, scalar),
+                        out_specs=(sspec, scalar, scalar, scalar),
+                        check_vma=False,
+                    )
+                )
+            run = self._em_run_traj
+        return run(
             state, data_chunks, wts_chunks,
             jnp.asarray(epsilon, data_chunks.dtype), lo, hi,
         )

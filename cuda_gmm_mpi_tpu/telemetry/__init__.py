@@ -1,0 +1,27 @@
+"""Run-scoped observability: event stream, metrics registry, reporting.
+
+The structured replacement for the reference's printf telemetry + cudaEvent
+phase timers (SURVEY.md SS5.1/5.5): every execution path -- in-memory,
+streaming, sharded-mesh, multi-controller, fused-sweep -- emits the same
+schema-versioned JSONL record stream through one :class:`RunRecorder`,
+and ``gmm report`` / ``bench.py`` consume it instead of scraping stdout.
+
+Layering: ``schema`` is the wire contract, ``registry`` the numeric
+aggregates, ``recorder`` the event bus + ambient-activation plumbing,
+``report`` the offline renderer. ``utils.profiling.PhaseTimer`` and
+``utils.logging_.metrics_line`` are thin adapters over this package.
+"""
+
+from .recorder import (RunRecorder, current, memory_stats, read_stream, use,
+                       write_line)
+from .registry import MetricsRegistry
+from .report import render_phase_table, render_report, report_main
+from .schema import (EVENT_FIELDS, SCHEMA_VERSION, validate_record,
+                     validate_stream)
+
+__all__ = [
+    "RunRecorder", "MetricsRegistry", "current", "use", "write_line",
+    "read_stream", "memory_stats",
+    "render_phase_table", "render_report", "report_main",
+    "EVENT_FIELDS", "SCHEMA_VERSION", "validate_record", "validate_stream",
+]

@@ -1,0 +1,219 @@
+"""RunRecorder: the run-scoped event bus behind every execution path.
+
+The structured replacement for the reference's printf telemetry
+(``gaussian.cu`` status prints + the ``profile_t`` report at :967): one
+recorder spans one fit, stamps every record with the schema version, a run
+id, and this process's rank, and appends JSON lines to the configured sink
+(``GMMConfig.metrics_file`` / ``--metrics-file``; default off).
+
+Multi-controller semantics ("host-0 aggregation"): every rank runs the
+instrumentation -- its registry accumulates, and collective summary
+gathers execute everywhere -- but only process 0 writes the file, so a
+multi-host run yields ONE coherent stream whose records carry the rank
+tags of the data they aggregate (``run_summary.per_process``).
+
+Activation is run-scoped, not global: ``with use(recorder):`` makes it the
+ambient recorder that instrumented layers find via ``current()`` (models
+never thread a recorder argument through their signatures). The default
+ambient recorder is inert, so uninstrumented library use costs one
+attribute check per touchpoint.
+
+``write_line`` is the shared one-JSON-object-per-line formatter; the legacy
+``utils.logging_.metrics_line`` is a thin adapter over it (same stderr
+bytes as before this subsystem existed).
+"""
+
+from __future__ import annotations
+
+import contextlib
+import json
+import sys
+import threading
+import time
+import uuid
+from typing import Any, Dict, List, Optional
+
+from .registry import MetricsRegistry
+from .schema import SCHEMA_VERSION
+
+
+def _json_default(o):
+    """Coerce numpy scalars/arrays (the usual payload types) to JSON."""
+    item = getattr(o, "item", None)
+    if callable(item):
+        try:
+            return o.item()
+        except (TypeError, ValueError):
+            pass
+    tolist = getattr(o, "tolist", None)
+    if callable(tolist):
+        return o.tolist()
+    return str(o)
+
+
+def write_line(record: Dict[str, Any], stream=None) -> str:
+    """Write one record as a compact JSON line; returns the line."""
+    line = json.dumps(record, default=_json_default)
+    print(line, file=stream or sys.stderr)
+    return line
+
+
+class RunRecorder:
+    """Schema-versioned JSONL event bus for one run.
+
+    ``path``: JSONL sink file (truncated at first emit -- one run, one
+    stream; rank 0 only). ``stream``: an open text stream sink instead
+    (tests). ``stderr_passthrough``: additionally mirror every record to
+    stderr in the legacy ``metrics_line`` format. With neither path nor
+    stream the recorder is inert (``active`` False) and every method is a
+    cheap no-op.
+    """
+
+    def __init__(self, path: Optional[str] = None, stream=None,
+                 stderr_passthrough: bool = False,
+                 heartbeat_interval_s: float = 30.0,
+                 run_id: Optional[str] = None):
+        self._path = path
+        self._stream = stream
+        self._stderr = stderr_passthrough
+        self._fh = None
+        self._lock = threading.Lock()
+        self._context: Dict[str, Any] = {}
+        self._process: Optional[int] = None
+        self._writer: Optional[bool] = None
+        self._heartbeat_interval_s = heartbeat_interval_s
+        # 0.0 (not t0): the first heartbeat() call emits immediately --
+        # one early liveness mark per run -- then rate-limiting kicks in.
+        self._last_heartbeat = 0.0
+        self._t0 = time.perf_counter()
+        self.run_id = run_id or uuid.uuid4().hex[:12]
+        self.metrics = MetricsRegistry()
+
+    @property
+    def active(self) -> bool:
+        return self._path is not None or self._stream is not None
+
+    def set_context(self, **fields) -> None:
+        """Merge static fields into every subsequent record (None drops)."""
+        with self._lock:
+            for k, v in fields.items():
+                if v is None:
+                    self._context.pop(k, None)
+                else:
+                    self._context[k] = v
+
+    def _resolve_process(self) -> None:
+        # Deferred: constructing a recorder must not initialize a JAX
+        # backend (fit_gmm builds it BEFORE pinning the platform). First
+        # emit happens after device setup, where process_index is safe.
+        if self._process is not None:
+            return
+        try:
+            import jax
+
+            self._process = int(jax.process_index())
+        except Exception:
+            self._process = 0
+        self._writer = self._process == 0
+
+    def _sink(self):
+        if self._stream is not None:
+            return self._stream
+        if self._fh is None and self._path is not None:
+            # Truncate: one run, one stream. Rank 0 only (host-0
+            # aggregation); other ranks keep accumulating metrics.
+            self._fh = open(self._path, "w", encoding="utf-8")
+        return self._fh
+
+    def emit(self, event: str, **fields) -> Optional[dict]:
+        """Append one stamped record to the sink; returns the record."""
+        if not self.active:
+            return None
+        self._resolve_process()
+        rec: Dict[str, Any] = {
+            "event": event,
+            "schema": SCHEMA_VERSION,
+            "ts": round(time.time(), 6),
+            "run_id": self.run_id,
+            "process": self._process,
+        }
+        rec.update(self._context)
+        rec.update(fields)
+        with self._lock:
+            if self._writer:
+                sink = self._sink()
+                if sink is not None:
+                    sink.write(json.dumps(rec, default=_json_default) + "\n")
+                    sink.flush()  # crash-robust: every record is durable
+            if self._stderr:
+                write_line(rec)
+        return rec
+
+    def heartbeat(self, phase: str, **fields) -> None:
+        """Rate-limited liveness record (at most one per interval)."""
+        if not self.active:
+            return
+        now = time.perf_counter()
+        if now - self._last_heartbeat < self._heartbeat_interval_s:
+            return
+        self._last_heartbeat = now
+        self.emit("heartbeat", phase=phase,
+                  elapsed_s=round(now - self._t0, 3), **fields)
+
+    def close(self) -> None:
+        with self._lock:
+            if self._fh is not None:
+                self._fh.close()
+                self._fh = None
+
+    def __enter__(self):
+        return self
+
+    def __exit__(self, *exc):
+        self.close()
+
+
+_NULL = RunRecorder()  # inert ambient default
+_stack: List[RunRecorder] = []
+
+
+def current() -> RunRecorder:
+    """The ambient recorder (inert unless a run activated one)."""
+    return _stack[-1] if _stack else _NULL
+
+
+@contextlib.contextmanager
+def use(recorder: RunRecorder):
+    """Make ``recorder`` the ambient recorder for the enclosed run."""
+    _stack.append(recorder)
+    try:
+        yield recorder
+    finally:
+        _stack.pop()
+
+
+def read_stream(path: str) -> List[dict]:
+    """Decode a JSONL metrics file; raises OSError/ValueError on bad input."""
+    records = []
+    with open(path, "r", encoding="utf-8") as fh:
+        for i, line in enumerate(fh):
+            line = line.strip()
+            if not line:
+                continue
+            try:
+                records.append(json.loads(line))
+            except ValueError as e:
+                raise ValueError(f"{path}:{i + 1}: not JSON: {e}") from None
+    return records
+
+
+def memory_stats() -> Optional[dict]:
+    """First local device's memory_stats(), or None where unsupported
+    (CPU backends and some plugins return None or raise)."""
+    try:
+        import jax
+
+        stats = jax.local_devices()[0].memory_stats()
+        return dict(stats) if stats else None
+    except Exception:
+        return None

@@ -1,0 +1,78 @@
+"""Run-scoped metrics registry: counters, gauges, histograms, series.
+
+The numeric complement of the event stream (recorder.py): events answer
+"what happened when", the registry answers "how much in total". One
+instance lives on each :class:`RunRecorder`; its ``snapshot()`` is folded
+into the ``run_summary`` record. Thread-safe -- fused-sweep emissions and
+streaming flushes arrive from io_callback / transfer threads.
+
+Instrument kinds:
+  counter    monotonically accumulating totals (em_iters, h2d_bytes, ...)
+  gauge      last-written value (active_k, first EM call seconds, ...)
+  histogram  count/sum/min/max aggregate of observed values (phase spans)
+  series     bounded append-only trajectory (active-K across the sweep)
+"""
+
+from __future__ import annotations
+
+import threading
+from typing import Dict, List
+
+_SERIES_CAP = 4096  # bound memory for arbitrarily long sweeps
+
+
+class MetricsRegistry:
+    """Counters/gauges/histograms/series keyed by flat string names."""
+
+    def __init__(self):
+        self._lock = threading.Lock()
+        self._counters: Dict[str, float] = {}
+        self._gauges: Dict[str, float] = {}
+        self._hists: Dict[str, Dict[str, float]] = {}
+        self._series: Dict[str, List[float]] = {}
+
+    def count(self, name: str, value: float = 1) -> None:
+        """Add ``value`` to the counter ``name`` (created at 0)."""
+        with self._lock:
+            self._counters[name] = self._counters.get(name, 0) + value
+
+    def gauge(self, name: str, value: float) -> None:
+        """Set gauge ``name`` to ``value`` (last write wins)."""
+        with self._lock:
+            self._gauges[name] = value
+
+    def observe(self, name: str, value: float) -> None:
+        """Fold ``value`` into histogram ``name`` (count/sum/min/max)."""
+        with self._lock:
+            h = self._hists.get(name)
+            if h is None:
+                self._hists[name] = {"count": 1, "sum": value,
+                                     "min": value, "max": value}
+            else:
+                h["count"] += 1
+                h["sum"] += value
+                h["min"] = min(h["min"], value)
+                h["max"] = max(h["max"], value)
+
+    def series(self, name: str, value: float) -> None:
+        """Append ``value`` to the bounded trajectory ``name``."""
+        with self._lock:
+            s = self._series.setdefault(name, [])
+            if len(s) < _SERIES_CAP:
+                s.append(value)
+
+    def snapshot(self) -> dict:
+        """JSON-ready copy of every instrument (empty kinds omitted)."""
+        with self._lock:
+            out = {}
+            if self._counters:
+                out["counters"] = dict(self._counters)
+            if self._gauges:
+                out["gauges"] = dict(self._gauges)
+            if self._hists:
+                out["histograms"] = {k: dict(v)
+                                     for k, v in self._hists.items()}
+            if self._series:
+                out["series"] = {k: list(v)
+                                 for k, v in self._series.items()}
+            return out

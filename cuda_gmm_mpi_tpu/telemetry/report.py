@@ -1,0 +1,164 @@
+"""Offline rendering of a telemetry stream: the ``gmm report`` backend.
+
+Turns a ``--metrics-file`` JSONL stream back into the reference's
+human-readable surfaces -- the 7-category phase-profile table
+(``gaussian.cu:967``'s layout, shared with ``PhaseTimer.report`` so the
+live ``--profile`` print and the offline report are byte-compatible), the
+per-K selection sweep summary, and the per-iteration loglik trajectory --
+from the stream alone: no pickle, no state files, no devices.
+"""
+
+from __future__ import annotations
+
+import sys
+from typing import Dict, List, Optional
+
+from .schema import validate_stream
+
+
+def render_phase_table(seconds: Dict[str, float],
+                       counts: Optional[Dict[str, int]] = None) -> str:
+    """Total + per-call average per category (gaussian.cu:967's layout).
+
+    The single formatter behind both the live ``PhaseTimer.report`` and
+    the offline ``gmm report`` phase table.
+    """
+    counts = counts or {}
+    lines = ["Phase profile (seconds total / calls / avg):"]
+    for name, total in seconds.items():
+        n = max(counts.get(name, 0), 1)
+        lines.append(f"  {name:<10s}\t{total:9.4f}\t{counts.get(name, 0):6d}"
+                     f"\t{total / n:9.6f}")
+    return "\n".join(lines)
+
+
+def _fmt_run_start(rec: dict) -> str:
+    bits = [f"run {rec.get('run_id', '?')}",
+            f"platform={rec.get('platform', '?')}",
+            f"N={rec.get('num_events', '?')}",
+            f"D={rec.get('num_dimensions', '?')}",
+            f"start_k={rec.get('start_k', '?')}"]
+    if rec.get("target_k"):
+        bits.append(f"target_k={rec['target_k']}")
+    if rec.get("path"):
+        bits.append(f"path={rec['path']}")
+    if rec.get("mesh"):
+        bits.append(f"mesh={rec['mesh']}")
+    if rec.get("process_count", 1) and rec.get("process_count", 1) > 1:
+        bits.append(f"processes={rec['process_count']}")
+    return "  ".join(str(b) for b in bits)
+
+
+def render_report(records: List[dict], max_trajectory_rows: int = 400) -> str:
+    """The full ``gmm report`` text for one decoded stream."""
+    out: List[str] = []
+    starts = [r for r in records if r.get("event") == "run_start"]
+    iters = [r for r in records if r.get("event") == "em_iter"]
+    dones = [r for r in records if r.get("event") == "em_done"]
+    merges = [r for r in records if r.get("event") == "merge"]
+    chunks = [r for r in records if r.get("event") == "chunk_flush"]
+    summaries = [r for r in records if r.get("event") == "run_summary"]
+
+    for s in starts:
+        out.append(_fmt_run_start(s))
+    if starts:
+        out.append("")
+
+    if dones:
+        out.append("Model-order sweep (em_done):")
+        out.append(f"  {'K':>5s}  {'loglik':>15s}  {'score':>15s}"
+                   f"  {'iters':>6s}  {'seconds':>9s}")
+        for r in dones:
+            out.append(f"  {r['k']:>5d}  {r['loglik']:>15.6e}"
+                       f"  {r['score']:>15.6e}  {r['iters']:>6d}"
+                       f"  {r['seconds']:>9.3f}")
+        if merges:
+            out.append(f"  ({len(merges)} closest-pair merges)")
+        out.append("")
+
+    if iters:
+        out.append("Loglik trajectory (em_iter):")
+        out.append(f"  {'K':>5s} {'iter':>5s}  {'loglik':>15s}"
+                   f"  {'delta':>12s}  {'wall_s':>9s}")
+        shown = iters[:max_trajectory_rows]
+        for r in shown:
+            delta = r.get("delta")
+            dstr = f"{delta:>12.4e}" if delta is not None else f"{'-':>12s}"
+            out.append(f"  {r['k']:>5d} {r['iter']:>5d}"
+                       f"  {r['loglik']:>15.6e}  {dstr}"
+                       f"  {r['wall_s']:>9.4f}")
+        if len(iters) > len(shown):
+            out.append(f"  ... {len(iters) - len(shown)} more rows elided")
+        out.append("")
+
+    if chunks:
+        total_bytes = sum(int(r.get("bytes", 0)) for r in chunks)
+        out.append(f"Streaming: {len(chunks)} block flushes, "
+                   f"{total_bytes / 1e6:.1f} MB host->device")
+        out.append("")
+
+    for s in summaries:
+        prof = s.get("phase_profile") or {}
+        if prof.get("seconds"):
+            out.append(render_phase_table(prof["seconds"],
+                                          prof.get("counts")))
+        comp = s.get("compile") or {}
+        if comp:
+            first = comp.get("first_call_s")
+            warm = comp.get("warm_call_s")
+            est = comp.get("est_compile_s")
+            out.append(
+                "Compile/execute split: first call "
+                + (f"{first:.3f}s" if first is not None else "-")
+                + ", warm call "
+                + (f"{warm:.3f}s" if warm is not None else "-")
+                + ", est. compile "
+                + (f"{est:.3f}s" if est is not None else "-"))
+        out.append(
+            f"Best model: K={s.get('ideal_k')} "
+            f"{s.get('criterion', 'score')}={s.get('score'):.6e} "
+            f"loglik={s.get('final_loglik'):.6e} "
+            f"({s.get('total_iters')} EM iterations, "
+            f"{s.get('wall_s'):.2f}s)")
+        metrics = s.get("metrics") or {}
+        counters = metrics.get("counters")
+        if counters:
+            out.append("Counters: " + "  ".join(
+                f"{k}={v:g}" for k, v in sorted(counters.items())))
+        out.append("")
+
+    if not out:
+        return "(no telemetry records)"
+    return "\n".join(out).rstrip() + "\n"
+
+
+def report_main(argv=None) -> int:
+    """``gmm report <metrics.jsonl>``: render a stream on stdout."""
+    import argparse
+
+    from .recorder import read_stream
+
+    p = argparse.ArgumentParser(
+        prog="gmm report",
+        description="Render a --metrics-file JSONL telemetry stream: phase "
+        "profile, loglik trajectory, and model-order sweep summary.")
+    p.add_argument("metrics_file", help="JSONL stream from --metrics-file")
+    p.add_argument("--validate", action="store_true",
+                   help="exit nonzero if any record fails schema validation")
+    args = p.parse_args(argv)
+    try:
+        records = read_stream(args.metrics_file)
+    except OSError as e:
+        print(f"Cannot read {args.metrics_file!r}: {e}", file=sys.stderr)
+        return 2
+    except ValueError as e:
+        print(str(e), file=sys.stderr)
+        return 1
+    if not records:
+        print(f"{args.metrics_file}: empty stream", file=sys.stderr)
+        return 1
+    errors = validate_stream(records)
+    for e in errors:
+        print(f"schema: {e}", file=sys.stderr)
+    print(render_report(records), end="")
+    return 1 if (errors and args.validate) else 0

@@ -1,0 +1,104 @@
+"""Event schema for the run-scoped telemetry stream (docs/OBSERVABILITY.md).
+
+The reference's observability surface is printf: per-phase cudaEvent totals
+(``gaussian.cu:967``) and ad-hoc status prints scattered through ``main``.
+This module is the contract that replaces it -- every record a
+:class:`~cuda_gmm_mpi_tpu.telemetry.RunRecorder` emits is one JSON object
+per line, stamped with a schema version, and validates against the field
+tables below. ``bench.py``, ``gmm report``, and the regression tests all
+consume the stream through this contract, never by scraping stdout.
+
+Versioning: ``SCHEMA_VERSION`` bumps only on breaking changes (a removed or
+retyped required field). Adding optional fields is always allowed -- readers
+must ignore unknown fields.
+"""
+
+from __future__ import annotations
+
+from typing import Any, Dict, Iterable, List, Tuple
+
+SCHEMA_VERSION = 1
+
+# Stamped on every record by the recorder.
+COMMON_FIELDS = ("event", "schema", "ts", "run_id", "process")
+
+# event -> ((required fields), (optional well-known fields)). Optional
+# fields are documented for readers; unknown extras are always legal.
+EVENT_FIELDS: Dict[str, Tuple[Tuple[str, ...], Tuple[str, ...]]] = {
+    # One per fit (per init when n_init > 1): the run's identity card.
+    "run_start": (
+        ("platform", "num_events", "num_dimensions", "start_k", "epsilon"),
+        ("target_k", "process_count", "device_count", "local_device_count",
+         "mesh", "path", "dtype", "chunk_size", "covariance_type",
+         "criterion", "fused_sweep", "stream_events", "n_init", "init",
+         "memory_stats"),
+    ),
+    # One per EM iteration of each K (host-driven sweeps; the fused
+    # whole-sweep device program emits per-K records only).
+    "em_iter": (
+        ("k", "iter", "loglik", "delta", "epsilon", "wall_s", "timing"),
+        (),
+    ),
+    # One per completed K of the model-order sweep.
+    "em_done": (
+        ("k", "loglik", "score", "criterion", "iters", "seconds"),
+        (),
+    ),
+    # One per closest-pair merge between Ks.
+    "merge": (
+        ("k_active", "next_k", "min_distance"),
+        (),
+    ),
+    # Streaming (out-of-core) path: one per host->device block flush.
+    "chunk_flush": (
+        ("iter", "block", "chunks", "bytes"),
+        ("k",),
+    ),
+    # Rate-limited liveness marker for long phases.
+    "heartbeat": (
+        ("phase", "elapsed_s"),
+        ("k",),
+    ),
+    # One per fit: final scores, the 7-category phase profile, the
+    # compile-vs-execute split, and the metrics-registry snapshot.
+    "run_summary": (
+        ("ideal_k", "score", "criterion", "final_loglik", "total_iters",
+         "wall_s", "phase_profile", "compile", "metrics"),
+        ("per_process", "memory_stats"),
+    ),
+}
+
+
+def validate_record(rec: Any) -> List[str]:
+    """Schema errors for one decoded record ([] = valid).
+
+    Checks the common envelope (version, event type) and the per-event
+    required fields; unknown extra fields are legal by design.
+    """
+    errors: List[str] = []
+    if not isinstance(rec, dict):
+        return [f"record is {type(rec).__name__}, not an object"]
+    for f in COMMON_FIELDS:
+        if f not in rec:
+            errors.append(f"missing common field {f!r}")
+    if rec.get("schema") not in (None, SCHEMA_VERSION):
+        errors.append(
+            f"schema version {rec.get('schema')!r} != {SCHEMA_VERSION}")
+    event = rec.get("event")
+    spec = EVENT_FIELDS.get(event) if isinstance(event, str) else None
+    if spec is None:
+        errors.append(f"unknown event type {event!r}")
+        return errors
+    required, _ = spec
+    for f in required:
+        if f not in rec:
+            errors.append(f"{event}: missing required field {f!r}")
+    return errors
+
+
+def validate_stream(records: Iterable[Any]) -> List[str]:
+    """Flattened schema errors over a decoded stream, prefixed by index."""
+    errors = []
+    for i, rec in enumerate(records):
+        errors.extend(f"record {i}: {e}" for e in validate_record(rec))
+    return errors

@@ -1,0 +1,34 @@
+"""Version-bridging runtime helpers (JAX API drift).
+
+The supported JAX range spells some knobs differently; every call site
+that needs one goes through here so the bridge lives in exactly one
+place (the shard_map check_vma/check_rep bridge lives with its single
+call site in ``parallel.sharded_em``).
+"""
+
+from __future__ import annotations
+
+import os
+
+
+def force_cpu_devices(n: int, pin_platform: bool = True) -> None:
+    """Pin this process to the CPU platform with ``n`` virtual devices.
+
+    Newer JAX has the ``jax_num_cpu_devices`` config option; older
+    releases only honor the ``--xla_force_host_platform_device_count``
+    XLA flag, which is read when the CPU backend initializes -- so this
+    must run before ANY device use (jax may already be imported; a
+    preloading sitecustomize hook does exactly that on some images).
+    """
+    import jax
+
+    if pin_platform:
+        jax.config.update("jax_platforms", "cpu")
+    try:
+        jax.config.update("jax_num_cpu_devices", n)
+    except AttributeError:
+        flags = os.environ.get("XLA_FLAGS", "")
+        if "xla_force_host_platform_device_count" not in flags:
+            os.environ["XLA_FLAGS"] = (
+                flags + f" --xla_force_host_platform_device_count={n}"
+            ).strip()

@@ -10,16 +10,19 @@ levels selected at runtime from GMMConfig:
 
 ``metrics_line`` emits machine-readable one-line JSON records (loglik,
 rissanen, iteration timing) -- the structured upgrade over the reference's
-ad-hoc printf telemetry (SURVEY.md SS5.5).
+ad-hoc printf telemetry (SURVEY.md SS5.5). It is now a thin adapter over
+the telemetry subsystem's line writer (``telemetry.write_line``); the
+full run-scoped event stream lives in ``cuda_gmm_mpi_tpu.telemetry``.
 """
 
 from __future__ import annotations
 
-import json
 import logging
 import sys
 import time
 from typing import Any, Dict
+
+from ..telemetry import write_line
 
 _LOGGER_NAME = "cuda_gmm_mpi_tpu"
 
@@ -44,8 +47,13 @@ def get_logger(config=None) -> logging.Logger:
 
 
 def metrics_line(event: str, stream=None, **fields: Any) -> Dict[str, Any]:
-    """Emit one JSON metrics record; returns the record."""
+    """Emit one JSON metrics record to stderr; returns the record.
+
+    Legacy stderr surface, byte-compatible with its pre-telemetry output
+    (no schema/run-id stamping); the run-scoped JSONL stream is the
+    RunRecorder's job and the two never double-write the same sink.
+    """
     rec = {"event": event, "ts": round(time.time(), 3)}
     rec.update(fields)
-    print(json.dumps(rec), file=stream or sys.stderr)
+    write_line(rec, stream=stream or sys.stderr)
     return rec

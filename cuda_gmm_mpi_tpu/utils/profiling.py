@@ -21,6 +21,13 @@ Two usage modes:
   - coarse (always available): wrap phases via ``timer.phase(name)`` context
     managers around the jitted calls;
   - deep-dive: ``jax.profiler`` trace capture via ``trace(log_dir)``.
+
+Since the telemetry subsystem landed, PhaseTimer is a thin adapter over it:
+the report table renders through ``telemetry.report.render_phase_table``
+(one formatter for the live ``--profile`` print and the offline
+``gmm report``), every measured span is forwarded into the active
+RunRecorder's metrics registry as a ``phase.<name>`` histogram, and
+``snapshot()`` is the shape ``run_summary.phase_profile`` carries.
 """
 
 from __future__ import annotations
@@ -28,6 +35,9 @@ from __future__ import annotations
 import contextlib
 import time
 from typing import Dict, Optional
+
+from ..telemetry import current as _current_recorder
+from ..telemetry import render_phase_table
 
 CATEGORIES = ("e_step", "m_step", "constants", "reduce", "memcpy", "cpu", "mpi")
 
@@ -48,24 +58,25 @@ class PhaseTimer:
         try:
             yield
         finally:
-            self.seconds[name] += time.perf_counter() - t0
-            self.counts[name] += 1
+            self.add(name, time.perf_counter() - t0)
 
     def add(self, name: str, seconds: float, count: int = 1) -> None:
         self.seconds[name] = self.seconds.get(name, 0.0) + seconds
         self.counts[name] = self.counts.get(name, 0) + count
+        rec = _current_recorder()
+        if rec.active:
+            rec.metrics.observe(f"phase.{name}", seconds)
 
     def report(self) -> str:
         """Total + per-call average per category (gaussian.cu:967's layout)."""
-        lines = ["Phase profile (seconds total / calls / avg):"]
-        for name, total in self.seconds.items():
-            n = max(self.counts.get(name, 0), 1)
-            lines.append(f"  {name:<10s}\t{total:9.4f}\t{self.counts.get(name, 0):6d}"
-                         f"\t{total / n:9.6f}")
-        return "\n".join(lines)
+        return render_phase_table(self.seconds, self.counts)
 
     def as_dict(self) -> Dict[str, float]:
         return dict(self.seconds)
+
+    def snapshot(self) -> Dict[str, dict]:
+        """``run_summary.phase_profile`` payload: seconds + call counts."""
+        return {"seconds": dict(self.seconds), "counts": dict(self.counts)}
 
 
 @contextlib.contextmanager

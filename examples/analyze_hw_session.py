@@ -72,9 +72,12 @@ def parse_bench_logs(logdir):
 
 COMPONENT_ROW = re.compile(
     r"^(?P<shape>\w+)\s+(?P<comp>\w+)\s+(?P<ms>[0-9.]+)\s+ms/pass")
+# '--mesh' runs tag their reference row 'in-memory sharded'
+# (bench_streaming.py); missing that variant left the loglik pair
+# unparsed, permanently reporting "answer agreement unverified".
 STREAM_ROW = re.compile(
-    r"^(?P<mode>in-memory|streaming)\s+(?P<ms>[0-9.]+)\s+ms/iter\s+"
-    r"loglik=(?P<ll>-?[0-9.]+)")
+    r"^(?P<mode>in-memory(?: sharded)?|streaming)\s+(?P<ms>[0-9.]+)\s+"
+    r"ms/iter\s+loglik=(?P<ll>-?[0-9.]+)")
 STREAM_RATIO = re.compile(
     r"^streaming/in-memory ratio:\s*(?P<ratio>[0-9.]+)x")
 
@@ -107,7 +110,11 @@ def parse_stream_overlap(logdir):
             ratio = float(m["ratio"])
         m = STREAM_ROW.match(line)
         if m:
-            lls[m["mode"]] = float(m["ll"])
+            # Normalize 'in-memory sharded' onto the plain key: either
+            # variant is THE in-memory reference of its run.
+            mode = ("in-memory" if m["mode"].startswith("in-memory")
+                    else m["mode"])
+            lls[mode] = float(m["ll"])
     if ratio is None:
         return None
     drift = None

@@ -33,8 +33,9 @@ def main():
         # jax.devices() first would initialize -- or hang on -- whatever
         # accelerator plugin the image preloads; see tests/conftest.py).
         # On a real >=8-device platform run with GMM_EXAMPLE_PLATFORM=native.
-        jax.config.update("jax_platforms", "cpu")
-        jax.config.update("jax_num_cpu_devices", 8)
+        from cuda_gmm_mpi_tpu.utils.compat import force_cpu_devices
+
+        force_cpu_devices(8)
 
     from cuda_gmm_mpi_tpu import GMMConfig, fit_gmm
 

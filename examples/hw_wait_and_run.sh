@@ -35,7 +35,10 @@ relay_alive() {
   # to the relay's known data ports (e.g. '8471|8472') to match them
   # explicitly, or extend GMM_HW_IGNORE_PORTS with the extra local
   # listeners to ignore.
-  local ignore="48271|2024${GMM_HW_IGNORE_PORTS:+|$GMM_HW_IGNORE_PORTS}"
+  # Comma OR pipe separators, like RELAY_PORTS below: the raw value was
+  # interpolated verbatim before, so a comma-separated list ('8888,9999')
+  # became a single impossible port pattern and ignored nothing.
+  local ignore="48271|2024${GMM_HW_IGNORE_PORTS:+|${GMM_HW_IGNORE_PORTS//,/|}}"
   local ports
   ports=$(ss -tln 2>/dev/null | awk '{print $4}' | grep -oE '[0-9]+$' \
     | grep -vE "^(${ignore})$" | grep .)

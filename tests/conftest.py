@@ -24,7 +24,14 @@ if "xla_force_host_platform_device_count" not in _flags:
 import jax
 
 jax.config.update("jax_platforms", "cpu")
-jax.config.update("jax_num_cpu_devices", 8)
+try:
+    jax.config.update("jax_num_cpu_devices", 8)
+except AttributeError:
+    # Older JAX has no jax_num_cpu_devices option; the XLA_FLAGS fallback
+    # above (set before the first jax import) is what pins the 8 fake
+    # devices there. Without this guard the WHOLE suite fails at
+    # collection on such installs.
+    pass
 jax.config.update("jax_enable_x64", True)
 
 import numpy as np

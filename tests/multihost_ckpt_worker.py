@@ -31,7 +31,9 @@ def main() -> int:
     import jax
 
     jax.config.update("jax_platforms", "cpu")
-    jax.config.update("jax_num_cpu_devices", 2)
+    from cuda_gmm_mpi_tpu.utils.compat import force_cpu_devices
+
+    force_cpu_devices(2)
     jax.config.update("jax_enable_x64", True)
 
     from cuda_gmm_mpi_tpu.parallel import distributed

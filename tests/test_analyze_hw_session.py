@@ -121,10 +121,21 @@ def test_stream_overlap_answer_drift_voids_ratio(tmp_path):
     assert r.returncode == 0, r.stderr
     assert "ANSWER DRIFT" in r.stdout
     assert "overlap holds" not in r.stdout
-    # A ratio whose loglik pair didn't parse is unverified, not a pass.
+    # A '--mesh' run tags its reference row 'in-memory sharded'
+    # (bench_streaming.py); that variant must parse and verify like the
+    # plain tag (it used to fall through to "unverified" forever).
     (tmp_path / "stream_overlap.log").write_text(
         "in-memory sharded          10.00 ms/iter  loglik=-1000000\n"
-        "streaming                   8.00 ms/iter  loglik=-1000000\n"
+        "streaming                  11.00 ms/iter  loglik=-1000000\n"
+        "streaming/in-memory ratio: 1.10x\nDONE\n")
+    r = subprocess.run([sys.executable, SCRIPT, str(tmp_path)],
+                       capture_output=True, text=True, timeout=60)
+    assert "overlap holds" in r.stdout and "unverified" not in r.stdout
+    # A ratio whose loglik pair genuinely didn't parse stays unverified,
+    # not a pass.
+    (tmp_path / "stream_overlap.log").write_text(
+        "in-memory                  10.00 ms/iter\n"
+        "streaming                   8.00 ms/iter\n"
         "streaming/in-memory ratio: 0.80x\nDONE\n")
     r = subprocess.run([sys.executable, SCRIPT, str(tmp_path)],
                        capture_output=True, text=True, timeout=60)

@@ -107,6 +107,23 @@ def _load_bench():
     return mod
 
 
+def test_session_band_matches_perf_doc():
+    """bench.SESSION_BAND_MS_PER_ITER and docs/PERF.md's documented
+    session-variance band are maintained by hand in two places ("update
+    BOTH together" -- bench.py:50); this drift test makes forgetting one
+    side a test failure instead of a silently self-contradicting artifact."""
+    import re
+
+    text = open(os.path.join(REPO, "docs", "PERF.md"),
+                encoding="utf-8").read()
+    m = re.search(
+        r"session_band_ms_per_iter:\s*\[\s*([0-9.]+)\s*,\s*([0-9.]+)\s*\]",
+        text)
+    assert m, "docs/PERF.md no longer documents session_band_ms_per_iter"
+    doc_band = [float(m.group(1)), float(m.group(2))]
+    assert doc_band == _load_bench().SESSION_BAND_MS_PER_ITER
+
+
 @pytest.mark.parametrize("diag", [False, True])
 def test_numpy_baseline_matches_framework_iteration(diag):
     """vs_baseline is only honest if bench.py's NumPy iteration computes

@@ -40,12 +40,21 @@ def test_version_matches_pyproject():
     """_version.py and pyproject.toml are bumped together (the version
     deliberately lives in exactly these two places)."""
     import os
-    import tomllib
+    import re
 
     from cuda_gmm_mpi_tpu import __version__
 
     repo = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
-    with open(os.path.join(repo, "pyproject.toml"), "rb") as fh:
+    path = os.path.join(repo, "pyproject.toml")
+    try:
+        import tomllib  # stdlib only from Python 3.11
+    except ImportError:
+        m = re.search(r'^version\s*=\s*"([^"]+)"',
+                      open(path, encoding="utf-8").read(), re.M)
+        assert m, "no version field in pyproject.toml"
+        assert m.group(1) == __version__
+        return
+    with open(path, "rb") as fh:
         meta = tomllib.load(fh)
     assert meta["project"]["version"] == __version__
 

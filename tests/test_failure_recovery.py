@@ -34,7 +34,8 @@ mesh = sys.argv[2] if len(sys.argv) > 2 else ""
 mode = sys.argv[3] if len(sys.argv) > 3 else ""
 fused = mode == "fused"
 if mesh:
-    jax.config.update("jax_num_cpu_devices", 8)
+    from cuda_gmm_mpi_tpu.utils.compat import force_cpu_devices
+    force_cpu_devices(8)
 jax.config.update("jax_enable_x64", True)
 import numpy as np
 from cuda_gmm_mpi_tpu.config import GMMConfig

@@ -55,6 +55,13 @@ def test_ignore_ports_extend_not_replace(tmp_path):
     env = {"GMM_HW_IGNORE_PORTS": "8888"}
     assert run_relay_alive(tmp_path, [48271, 2024, 8888], env) != 0
     assert run_relay_alive(tmp_path, [48271, 2024, 8888, 35975], env) == 0
+    # Comma-separated lists must ignore EVERY listed port (verbatim
+    # interpolation made '8888,9999' one impossible pattern that ignored
+    # nothing, so two dev servers read as a live relay).
+    env = {"GMM_HW_IGNORE_PORTS": "8888,9999"}
+    assert run_relay_alive(tmp_path, [48271, 2024, 8888, 9999], env) != 0
+    assert run_relay_alive(tmp_path, [48271, 2024, 8888, 9999, 35975],
+                           env) == 0
 
 
 def test_explicit_relay_ports_match_only_those(tmp_path):

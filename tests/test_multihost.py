@@ -120,6 +120,10 @@ def test_two_process_collective_input_abort():
 def test_two_process_distributed_em_matches_single():
     outs = _run_workers(2)
     for rc, out, err in outs:
+        if rc != 0 and "aren't implemented on the CPU backend" in err:
+            # Older jaxlib CPU backends have no cross-process collectives
+            # at all; nothing multi-controller can run on this image.
+            pytest.skip("jax CPU backend lacks multiprocess collectives")
         assert rc == 0, f"worker failed (rc={rc}):\n{out}\n{err[-3000:]}"
     results = []
     for rc, out, err in outs:
@@ -185,7 +189,8 @@ import os, sys
 pid, nproc, port, outdir = int(sys.argv[1]), int(sys.argv[2]), sys.argv[3], sys.argv[4]
 import jax
 jax.config.update("jax_platforms", "cpu")
-jax.config.update("jax_num_cpu_devices", 1)
+from cuda_gmm_mpi_tpu.utils.compat import force_cpu_devices
+force_cpu_devices(1)
 jax.distributed.initialize(coordinator_address=f"127.0.0.1:{port}",
                            num_processes=nproc, process_id=pid)
 from cuda_gmm_mpi_tpu.parallel.distributed import (

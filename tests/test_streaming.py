@@ -116,7 +116,8 @@ def test_streaming_mesh_host_bounded_rss(tmp_path):
     code = r"""
 import jax
 jax.config.update("jax_platforms", "cpu")
-jax.config.update("jax_num_cpu_devices", 8)
+from cuda_gmm_mpi_tpu.utils.compat import force_cpu_devices
+force_cpu_devices(8)
 import numpy as np, resource
 from cuda_gmm_mpi_tpu.config import GMMConfig
 from cuda_gmm_mpi_tpu.models import fit_gmm

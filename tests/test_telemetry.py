@@ -1,0 +1,291 @@
+"""Telemetry subsystem: schema-validated JSONL streams from every path.
+
+Acceptance contract (ISSUE 1): a fit with ``--metrics-file out.jsonl``
+produces a schema-valid stream containing run_start, per-iteration
+em_iter (loglik, delta, wall time), per-K em_done, and a run_summary with
+the 7-category phase profile, compile/execute split, and the
+metrics-registry snapshot -- for the in-memory, streaming, and
+8-fake-device sharded paths (sharded records carrying process/device
+tags); ``gmm report`` renders the stream alone; and the legacy stderr
+surfaces (metrics_line, --profile) stay byte-compatible when no metrics
+file is given.
+"""
+
+import json
+
+import numpy as np
+import pytest
+
+from cuda_gmm_mpi_tpu import GMMConfig, fit_gmm, telemetry
+from cuda_gmm_mpi_tpu.cli import main as cli_main
+from cuda_gmm_mpi_tpu.telemetry import (MetricsRegistry, RunRecorder,
+                                        read_stream, validate_record,
+                                        validate_stream)
+from cuda_gmm_mpi_tpu.utils.profiling import CATEGORIES
+
+from .conftest import make_blobs
+
+
+@pytest.fixture
+def csv_file(tmp_path, rng):
+    data, _ = make_blobs(rng, n=400, d=3, k=3, dtype=np.float32)
+    p = tmp_path / "events.csv"
+    header = ",".join(f"d{i}" for i in range(3))
+    rows = "\n".join(",".join(f"{v:.6f}" for v in row) for row in data)
+    p.write_text(header + "\n" + rows + "\n")
+    return str(p)
+
+
+def _events(records):
+    return [r["event"] for r in records]
+
+
+def _check_stream(records, *, start_k, stop_k, path):
+    """The shared acceptance assertions for one fit's stream."""
+    assert validate_stream(records) == []
+    ev = _events(records)
+    assert ev[0] == "run_start" and ev[-1] == "run_summary"
+    assert ev.count("run_start") == 1 and ev.count("run_summary") == 1
+
+    start = records[0]
+    assert start["path"] == path
+    assert start["start_k"] == start_k and start["epsilon"] > 0
+
+    ks = [r["k"] for r in records if r["event"] == "em_done"]
+    assert ks == list(range(start_k, stop_k - 1, -1))
+    for r in records:
+        if r["event"] != "em_done":
+            continue
+        assert np.isfinite(r["loglik"]) and np.isfinite(r["score"])
+        assert r["iters"] >= 1 and r["seconds"] >= 0
+
+    iters = [r for r in records if r["event"] == "em_iter"]
+    assert len(iters) == sum(
+        r["iters"] for r in records if r["event"] == "em_done")
+    for r in iters:
+        assert np.isfinite(r["loglik"]) and np.isfinite(r["delta"])
+        assert r["wall_s"] >= 0 and r["epsilon"] > 0
+        assert r["timing"] in ("measured", "amortized")
+    # per-K iteration indices restart at 0 and count up
+    for k in set(r["k"] for r in iters):
+        idx = [r["iter"] for r in iters if r["k"] == k]
+        assert idx == list(range(len(idx)))
+
+    merges = [r for r in records if r["event"] == "merge"]
+    assert len(merges) == len(ks) - 1
+    for r in merges:
+        assert r["next_k"] == r["k_active"] - 1
+
+    summary = records[-1]
+    prof = summary["phase_profile"]
+    assert set(CATEGORIES) <= set(prof["seconds"])
+    assert set(CATEGORIES) <= set(prof["counts"])
+    comp = summary["compile"]
+    assert set(comp) == {"first_call_s", "warm_call_s", "est_compile_s"}
+    assert comp["first_call_s"] > 0 and comp["est_compile_s"] >= 0
+    counters = summary["metrics"]["counters"]
+    assert counters["em_iters"] == len(iters)
+    assert counters["h2d_bytes"] > 0
+    assert summary["metrics"]["series"]["active_k"] == ks
+    assert summary["total_iters"] == len(iters)
+    return records
+
+
+def test_cli_metrics_in_memory(csv_file, tmp_path):
+    path = str(tmp_path / "m.jsonl")
+    rc = cli_main(["4", csv_file, str(tmp_path / "o"), "2",
+                   "--min-iters=3", "--max-iters=3", "--chunk-size=128",
+                   f"--metrics-file={path}"])
+    assert rc == 0
+    recs = _check_stream(read_stream(path), start_k=4, stop_k=2,
+                         path="in-memory")
+    assert all(r["process"] == 0 for r in recs)
+    # in-memory EM is a single dispatch: per-iteration walls are amortized
+    assert all(r["timing"] == "amortized"
+               for r in recs if r["event"] == "em_iter")
+
+
+def test_cli_metrics_streaming(csv_file, tmp_path):
+    path = str(tmp_path / "m.jsonl")
+    rc = cli_main(["3", csv_file, str(tmp_path / "o"), "2",
+                   "--min-iters=3", "--max-iters=3", "--chunk-size=128",
+                   "--stream-events", f"--metrics-file={path}"])
+    assert rc == 0
+    recs = _check_stream(read_stream(path), start_k=3, stop_k=2,
+                         path="streaming")
+    # host-driven loop: REAL per-iteration walls
+    assert all(r["timing"] == "measured"
+               for r in recs if r["event"] == "em_iter")
+    flushes = [r for r in recs if r["event"] == "chunk_flush"]
+    assert flushes and all(r["bytes"] > 0 for r in flushes)
+    # per-K passes: initial E-step (iter 0) + one per EM iteration, each
+    # covering every chunk of the 400-event/128-chunk grid
+    blocks_per_pass = {r["block"] for r in flushes}
+    assert blocks_per_pass == {0, 1, 2, 3}
+
+
+def test_cli_metrics_sharded_mesh8(csv_file, tmp_path):
+    """8-fake-device sharded path: same stream contract, records carry
+    the process/mesh/path tags (the multi-host stream's self-description;
+    in-process the rank is 0 and the mesh is [8, 1])."""
+    path = str(tmp_path / "m.jsonl")
+    rc = cli_main(["3", csv_file, str(tmp_path / "o"), "2",
+                   "--min-iters=3", "--max-iters=3", "--chunk-size=32",
+                   "--mesh=8", f"--metrics-file={path}"])
+    assert rc == 0
+    recs = _check_stream(read_stream(path), start_k=3, stop_k=2,
+                         path="sharded")
+    assert recs[0]["local_device_count"] == 8
+    for r in recs:
+        assert r["process"] == 0
+        if r["event"] in ("em_iter", "em_done"):
+            assert r["mesh"] == [8, 1] and r["path"] == "sharded"
+
+
+def test_fused_sweep_emits_per_k_records(csv_file, tmp_path):
+    """The fused whole-sweep device program reports per-K granularity:
+    em_done records with REAL per-K seconds (emission-arrival deltas) and
+    no em_iter rows (its EM iterations never touch the host)."""
+    path = str(tmp_path / "m.jsonl")
+    rc = cli_main(["4", csv_file, str(tmp_path / "o"), "2",
+                   "--min-iters=3", "--max-iters=3", "--chunk-size=128",
+                   "--fused-sweep", f"--metrics-file={path}"])
+    assert rc == 0
+    recs = read_stream(path)
+    assert validate_stream(recs) == []
+    ev = _events(recs)
+    assert ev.count("em_done") == 3 and ev.count("em_iter") == 0
+    assert recs[0]["fused_sweep"] is True
+    assert all(r["seconds"] > 0 for r in recs if r["event"] == "em_done")
+    assert recs[-1]["event"] == "run_summary"
+    assert recs[-1]["metrics"]["series"]["active_k"] == [4, 3, 2]
+
+
+def test_gmm_report_renders_stream_alone(csv_file, tmp_path, capsys):
+    """`gmm report out.jsonl` renders the phase-profile table and loglik
+    trajectory from the stream alone (no pickle/state needed)."""
+    path = str(tmp_path / "m.jsonl")
+    assert cli_main(["4", csv_file, str(tmp_path / "o"), "2",
+                     "--min-iters=3", "--max-iters=3", "--chunk-size=128",
+                     f"--metrics-file={path}"]) == 0
+    capsys.readouterr()
+    assert cli_main(["report", path]) == 0
+    out = capsys.readouterr().out
+    assert "Phase profile" in out
+    for cat in CATEGORIES:
+        assert cat in out
+    assert "Loglik trajectory" in out
+    assert "Model-order sweep" in out
+    assert "Compile/execute split" in out
+    # trajectory rows present for every K of the sweep
+    for k in (4, 3, 2):
+        assert f"\n  {k:>5d} " in out or f"  {k:>5d} " in out
+    # --validate passes on a healthy stream; missing files are usage errors
+    assert cli_main(["report", path, "--validate"]) == 0
+    assert cli_main(["report", str(tmp_path / "nope.jsonl")]) == 2
+
+
+def test_metrics_file_fails_fast_and_predict_rejects(csv_file, tmp_path):
+    assert cli_main(["3", csv_file, str(tmp_path / "o"), "2",
+                     f"--metrics-file={tmp_path}/no/such/dir/m.jsonl"]) == 1
+    out = str(tmp_path / "m")
+    assert cli_main(["3", csv_file, out, "3", "--min-iters=2",
+                     "--max-iters=2", "--chunk-size=128"]) == 0
+    assert cli_main(["3", csv_file, str(tmp_path / "p"),
+                     f"--predict-from={out}.summary",
+                     f"--metrics-file={tmp_path}/m.jsonl"]) == 1
+
+
+def test_library_fit_and_restarts_share_one_stream(tmp_path, rng):
+    data, _ = make_blobs(rng, n=400, d=3, k=3, dtype=np.float32)
+    path = str(tmp_path / "m.jsonl")
+    cfg = GMMConfig(min_iters=2, max_iters=2, chunk_size=128, n_init=2,
+                    metrics_file=path, dtype="float64")
+    fit_gmm(data, 3, 2, cfg)
+    recs = read_stream(path)
+    assert validate_stream(recs) == []
+    assert len({r["run_id"] for r in recs}) == 1
+    assert _events(recs).count("run_start") == 2  # one per init
+    assert _events(recs).count("run_summary") == 2
+    assert sorted({r["init"] for r in recs if "init" in r}) == [0, 1]
+    assert recs[-1]["metrics"]["counters"]["restarts"] == 1
+
+
+def test_no_metrics_file_means_no_stream_and_same_stderr(tmp_path, rng,
+                                                        capsys):
+    """Off by default: no recorder activates, and metrics_line's stderr
+    format is byte-stable (the backward-compatibility contract for
+    existing scrapers)."""
+    from cuda_gmm_mpi_tpu.utils.logging_ import metrics_line
+
+    data, _ = make_blobs(rng, n=300, d=3, k=2, dtype=np.float32)
+    fit_gmm(data, 2, 2, GMMConfig(min_iters=2, max_iters=2, chunk_size=128))
+    assert not telemetry.current().active
+
+    rec = metrics_line("em_done", k=3, loglik=-1.5, iters=7)
+    err = capsys.readouterr().err.strip().splitlines()[-1]
+    parsed = json.loads(err)
+    assert parsed == rec
+    assert list(parsed) == ["event", "ts", "k", "loglik", "iters"]
+    assert "schema" not in parsed and "run_id" not in parsed
+
+
+def test_registry_and_schema_units():
+    reg = MetricsRegistry()
+    reg.count("a")
+    reg.count("a", 4)
+    reg.gauge("g", 7)
+    reg.observe("h", 2.0)
+    reg.observe("h", 4.0)
+    reg.series("s", 1)
+    reg.series("s", 2)
+    snap = reg.snapshot()
+    assert snap["counters"]["a"] == 5
+    assert snap["gauges"]["g"] == 7
+    assert snap["histograms"]["h"] == {"count": 2, "sum": 6.0,
+                                       "min": 2.0, "max": 4.0}
+    assert snap["series"]["s"] == [1, 2]
+
+    ok = {"event": "merge", "schema": 1, "ts": 0.0, "run_id": "x",
+          "process": 0, "k_active": 3, "next_k": 2, "min_distance": 0.5}
+    assert validate_record(ok) == []
+    bad = dict(ok, event="nope")
+    assert any("unknown event" in e for e in validate_record(bad))
+    missing = {k: v for k, v in ok.items() if k != "min_distance"}
+    assert any("min_distance" in e for e in validate_record(missing))
+    assert validate_record([1, 2]) != []
+
+
+def test_ambient_recorder_is_reused(tmp_path, rng):
+    """A library-activated recorder wins over config.metrics_file: the fit
+    rides the ambient stream instead of truncating a second file."""
+    data, _ = make_blobs(rng, n=300, d=3, k=2, dtype=np.float32)
+    path = str(tmp_path / "ambient.jsonl")
+    other = str(tmp_path / "ignored.jsonl")
+    with telemetry.use(RunRecorder(path)) as rec, rec:
+        fit_gmm(data, 2, 2, GMMConfig(min_iters=2, max_iters=2,
+                                      chunk_size=128, metrics_file=other))
+    recs = read_stream(path)
+    assert validate_stream(recs) == []
+    assert _events(recs).count("run_summary") == 1
+    import os
+
+    assert not os.path.exists(other)
+
+
+def test_em_iter_trajectory_matches_final_loglik(tmp_path, rng):
+    """The device-captured trajectory's last row IS the em_done loglik,
+    and deltas telescope: iteration-0's base is the initial E-step."""
+    data, _ = make_blobs(rng, n=400, d=3, k=3, dtype=np.float64)
+    path = str(tmp_path / "m.jsonl")
+    cfg = GMMConfig(min_iters=4, max_iters=4, chunk_size=128,
+                    dtype="float64", metrics_file=path)
+    r = fit_gmm(data, 3, 3, cfg)
+    recs = read_stream(path)
+    iters = [x for x in recs if x["event"] == "em_iter"]
+    done = [x for x in recs if x["event"] == "em_done"][0]
+    assert iters[-1]["loglik"] == pytest.approx(done["loglik"], rel=1e-12)
+    assert iters[-1]["loglik"] == pytest.approx(r.final_loglik, rel=1e-12)
+    # monotone non-decreasing loglik across the trajectory (EM guarantee)
+    lls = [x["loglik"] for x in iters]
+    assert all(b >= a - 1e-9 for a, b in zip(lls, lls[1:]))
