@@ -18,12 +18,14 @@ from .config import DEFAULT_CONFIG, GMMConfig
 from .estimator import GaussianMixture
 from .models import (GMMModel, GMMResult, compute_memberships, fit_gmm,
                      iter_memberships)
-from .state import GMMState, compact, zeros_state
+from .state import (GMMState, bucket_width, compact, compact_to,
+                    zeros_state)
 from .validation import InvalidInputError
 
 __all__ = [
     "DEFAULT_CONFIG", "GMMConfig", "GaussianMixture",
     "GMMModel", "GMMResult", "compute_memberships", "fit_gmm", "iter_memberships",
-    "GMMState", "compact", "zeros_state", "InvalidInputError",
+    "GMMState", "bucket_width", "compact", "compact_to", "zeros_state",
+    "InvalidInputError",
     "__version__",
 ]

@@ -133,6 +133,13 @@ def build_parser() -> argparse.ArgumentParser:
                    "program (fastest; composes with --checkpoint-dir and "
                    "--profile via per-K emission -- profile attribution is "
                    "coarse: whole-K spans land in e_step)")
+    t.add_argument("--sweep-k-buckets", default="pow2",
+                   choices=["pow2", "off"],
+                   help="cluster-width bucketing for the host-driven sweep: "
+                   "'pow2' (default) recompacts the state to power-of-two "
+                   "padded widths as K drops (~2x sweep-level FLOPs for "
+                   "<= ceil(log2 K0)+1 compiled EM widths); 'off' keeps one "
+                   "fixed width. The fused sweep is fixed-width by design")
     t.add_argument("--mesh", default=None,
                    help="device mesh 'DATA[,CLUSTER]', e.g. --mesh=4 or "
                    "--mesh=4,2; default: all devices on the event axis")
@@ -257,6 +264,7 @@ def main(argv=None) -> int:
             n_init=args.n_init,
             use_pallas=args.pallas,
             fused_sweep=args.fused_sweep,
+            sweep_k_buckets=args.sweep_k_buckets,
             device=args.device,
             mesh_shape=_parse_mesh(args.mesh),
             enable_debug=args.debug,
@@ -292,6 +300,7 @@ def main(argv=None) -> int:
             ("--init-from", args.init_from),
             ("--checkpoint-dir", args.checkpoint_dir),
             ("--fused-sweep", args.fused_sweep),
+            ("--sweep-k-buckets", args.sweep_k_buckets != "pow2"),
             ("--n-init", args.n_init != 1),
             ("--mesh", args.mesh),
             ("--seed-method", args.seed_method != "even"),

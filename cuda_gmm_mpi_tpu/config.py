@@ -77,11 +77,15 @@ class GMMConfig:
     # Mosaic rejects native Precision.HIGH).
     use_pallas: str = "auto"  # 'auto' | 'always' | 'never'
     # Hoist the [N, F] outer-product features out of the EM loop: built
-    # once per run and held in HBM (N*F*4 bytes -- 2.3 GB at 1M x 24),
-    # replacing every iteration's feature rebuild+write with a read. The
-    # XLA-path candidate for the measured xouter-traffic bottleneck
-    # (docs/PERF.md); bit-identical results. Full-covariance 'expanded'
-    # in-memory paths only.
+    # once per run and held in HBM, replacing every iteration's feature
+    # rebuild+write with a read. F depends on the quad layout: D*D floats
+    # per event under 'expanded' (2.3 GB at 1M x 24), D(D+1)/2 under
+    # 'packed' (~48% less HBM at D=24 -- the symmetric upper triangle
+    # carries the full information). The XLA-path candidate for the
+    # measured xouter-traffic bottleneck (docs/PERF.md); results are
+    # bit-identical to the unhoisted run OF THE SAME LAYOUT (each layout
+    # hoists exactly the expression its inline path computes).
+    # Full-covariance in-memory paths only.
     precompute_features: bool = False
     # Events per Pallas grid tile (the kernel's VMEM working set is
     # ~ block_b * D^2 floats for the outer products).
@@ -94,6 +98,17 @@ class GMMConfig:
     # spans land in e_step); other combinations fall back to the
     # host-driven sweep with a warning.
     fused_sweep: bool = False
+    # Cluster-width bucketing for the HOST-DRIVEN model-order sweep:
+    # 'pow2' (default) recompacts the state to the smallest power-of-two
+    # padded width >= the active count whenever a merge crosses a bucket
+    # boundary, so EM at k active clusters pays matmuls at width ~k instead
+    # of the full starting K0 (~2x sweep-level FLOPs/HBM traffic for at
+    # most ceil(log2 K0) + 1 compiled EM widths; docs/PERF.md). 'off'
+    # keeps the single fixed width (one compile, reference-shaped).
+    # The fused whole-sweep program is fixed-width by design and ignores
+    # this (models/fused_sweep.py documents the trade); multi-controller
+    # sweeps also stay fixed-width.
+    sweep_k_buckets: str = "pow2"
 
     # Out-of-core mode: event chunks stay in HOST memory and stream through
     # the device one chunk per E+M pass, so N is bounded by host RAM rather
@@ -137,8 +152,10 @@ class GMMConfig:
     # Independent restarts (sklearn's n_init): fit n_init times with
     # kmeans++ seeds seed, seed+1, ... and keep the best Rissanen score.
     # 1 = reference behavior (single deterministic init). Restarts share the
-    # compiled executables (no recompilation); host-side data prep and the
-    # device upload repeat per restart.
+    # compiled executables (no recompilation), and the chunked event data is
+    # prepared and uploaded ONCE -- restarts reuse the device-resident
+    # arrays (order_search._fit_with_restarts' per-model data cache); only
+    # seeding and the EM itself repeat per restart.
     n_init: int = 1
     # Numerical-sanitizer analog (SURVEY SS5.2: the reference has no race
     # detection / sanitizers; JAX's functional model removes data races, and
@@ -185,14 +202,20 @@ class GMMConfig:
             raise ValueError(
                 "stream_events streams per-chunk through the jnp path; "
                 "use_pallas='always' cannot be honored -- drop one flag")
+        if self.sweep_k_buckets not in ("pow2", "off"):
+            raise ValueError(
+                f"unknown sweep_k_buckets: {self.sweep_k_buckets!r} "
+                "(expected 'pow2' or 'off')")
         if self.precompute_features:
             if self.diag_only:
                 raise ValueError(
                     "precompute_features is a full-covariance optimization "
                     "(diag builds no [N, F] features)")
-            if self.quad_mode != "expanded":
+            if self.quad_mode == "centered":
                 raise ValueError(
-                    "precompute_features requires quad_mode='expanded'")
+                    "precompute_features requires quad_mode='expanded' or "
+                    "'packed' (the 'centered' staging has no loop-invariant "
+                    "feature matrix to hoist)")
             if self.use_pallas == "always":
                 raise ValueError(
                     "precompute_features is the XLA-path feature hoist; "

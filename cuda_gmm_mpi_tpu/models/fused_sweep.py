@@ -20,6 +20,15 @@ per-iteration ``em_iter`` records do not exist on this path by design --
 the EM iterations never touch the host (docs/OBSERVABILITY.md). Opt-in
 fast path (``GMMConfig.fused_sweep``); the host loop remains the default.
 
+FIXED-WIDTH BY DESIGN: the fused sweep runs every K at the starting padded
+width. Bucket recompaction (``sweep_k_buckets``, order_search's
+cluster-width shrinking as K drops) needs shape changes between Ks, which
+a single jitted ``lax.while_loop`` cannot express -- so the fused path
+trades the ~2x sweep-level FLOP saving for its zero-host-round-trip
+dispatch. The right pick is latency-dependent: host-driven + bucketed when
+compute dominates (CPU, large N/K), fused when per-K dispatch latency
+dominates (remote-TPU links, small per-K work).
+
 Semantics match the host sweep exactly (same save rule gaussian.cu:839, same
 termination conditions); parity is asserted in tests/test_fused_sweep.py.
 """
@@ -164,7 +173,7 @@ def fused_sweep(
         stop_now = k <= stop_number
         # Order reduction (dispatched unconditionally -- cheap relative to
         # EM -- and discarded on the stop path, like the host loop).
-        next_state, k_active, min_d = reduce_order_fn(s)
+        next_state, k_active, min_d, _ = reduce_order_fn(s)
         k_active = k_active.astype(jnp.int32)  # x64 mode promotes the sum
         can_merge = (k_active >= 2) & jnp.isfinite(min_d)
         # The host loop re-checks `k >= stop_number` at the top after

@@ -124,6 +124,9 @@ class GMMModel:
     # io_callback checkpoint hook); the sharded model's does not (callbacks
     # under shard_map observe per-device shards).
     supports_fused_emit = True
+    # Bucket widths must be a multiple of this (the cluster-mesh axis
+    # extent on sharded models; 1 = any width).
+    bucket_multiple = 1
 
     def __init__(self, config: GMMConfig = GMMConfig(),
                  reduce_stats: Optional[ReduceFn] = None,
@@ -145,14 +148,13 @@ class GMMModel:
             stats_fn = make_stats_fn(config)
         self.stats_fn = stats_fn
 
-        self._em_run = jax.jit(
-            functools.partial(em_while_loop, reduce_stats=reduce_stats,
-                              stats_fn=stats_fn,
-                              covariance_type=config.covariance_type,
-                              precompute_features=config.precompute_features,
-                              **kw)
-        )
-        self._em_run_traj = None  # built lazily on first trajectory request
+        # EM executables are memoized per (trajectory_len, donate) variant
+        # (cached_fused_sweep-style); within one variant jax.jit's own
+        # shape-keyed cache memoizes per padded width, so a bucketed sweep
+        # compiles one EM program per distinct bucket and reuses it for
+        # every K inside that bucket.
+        self._em_exec_cache: dict = {}
+        self._em_run = self._em_executable(0, False)
         self._estep_stats = jax.jit(
             functools.partial(self._estep_stats_impl, reduce_stats=reduce_stats,
                               stats_fn=stats_fn, **kw)
@@ -175,9 +177,26 @@ class GMMModel:
             stats = accumulate_stats(state, data_chunks, wts_chunks, **kw)
         return reduce_stats(stats) if reduce_stats else stats
 
+    def _em_executable(self, trajectory_len: int, donate: bool):
+        """Memoized jitted EM loop for one (trajectory, donation) variant."""
+        key = (trajectory_len, donate)
+        fn = self._em_exec_cache.get(key)
+        if fn is None:
+            fn = self._em_exec_cache[key] = jax.jit(
+                functools.partial(
+                    em_while_loop, reduce_stats=self.reduce_stats,
+                    stats_fn=self.stats_fn,
+                    covariance_type=self.config.covariance_type,
+                    precompute_features=self.config.precompute_features,
+                    trajectory_len=trajectory_len,
+                    **self._kw),
+                donate_argnums=(0,) if donate else (),
+            )
+        return fn
+
     def run_em(self, state, data_chunks, wts_chunks, epsilon: float,
                min_iters: Optional[int] = None, max_iters: Optional[int] = None,
-               *, trajectory: bool = False):
+               *, trajectory: bool = False, donate: bool = False):
         """Full EM at the current active-K. Returns (state, loglik, iters).
 
         ``min_iters``/``max_iters`` override the config's values without
@@ -189,24 +208,32 @@ class GMMModel:
         log (``em_while_loop`` ``trajectory_len`` contract, sized to the
         config's ``max_iters``): return becomes (state, loglik, iters,
         ll_log).
+
+        ``donate=True`` donates the INPUT state's buffers to the call
+        (``donate_argnums``): the EM carry reuses them in place, cutting
+        peak HBM and copy traffic by one state-size. The caller must not
+        touch the input state afterwards (it is deleted on backends that
+        support donation) -- the model-order sweep opts in because its
+        carry is rebound every K; default off so library callers keep the
+        safe aliasing-free semantics.
         """
         lo, hi = resolve_iters(self.config, min_iters, max_iters)
-        if trajectory:
-            if self._em_run_traj is None:
-                self._em_run_traj = jax.jit(functools.partial(
-                    em_while_loop, reduce_stats=self.reduce_stats,
-                    stats_fn=self.stats_fn,
-                    covariance_type=self.config.covariance_type,
-                    precompute_features=self.config.precompute_features,
-                    trajectory_len=int(self.config.max_iters),
-                    **self._kw))
-            run = self._em_run_traj
-        else:
-            run = self._em_run
+        run = self._em_executable(
+            int(self.config.max_iters) if trajectory else 0, donate)
         return run(
             state, data_chunks, wts_chunks,
             jnp.asarray(epsilon, data_chunks.dtype), lo, hi,
         )
+
+    def rebucket_state(self, state, num_clusters: int):
+        """Compact ``state`` to a narrower padded width on device (the
+        sweep's bucket recompaction; see state.compact_to). Width is
+        rounded up to ``bucket_multiple`` by the caller."""
+        from ..state import compact_to
+
+        if num_clusters >= state.num_clusters_padded:
+            return state
+        return compact_to(state, num_clusters)
 
     def estep_stats(self, state, data_chunks, wts_chunks) -> SuffStats:
         return self._estep_stats(state, data_chunks, wts_chunks)
@@ -312,10 +339,11 @@ def em_while_loop(
     holding them in HBM replaces every iteration's rebuild (a write of
     N x F per iteration) with a read -- the XLA-path candidate for the
     measured xouter-traffic bottleneck (docs/PERF.md). Costs N*F*4 bytes of
-    HBM residency (2.3 GB at the north-star); full-covariance 'expanded'
-    only, and a no-op under a custom stats_fn (the kernel builds features
-    in VMEM). Results are bit-identical either way (same values through
-    the same matmuls).
+    HBM residency (F = D*D expanded, D(D+1)/2 packed -- 2.3 GB vs 1.2 GB at
+    the north-star); full-covariance 'expanded'/'packed' only, and a no-op
+    under a custom stats_fn (the kernel builds features in VMEM). Results
+    are bit-identical either way within a layout (same values through the
+    same matmuls).
 
     ``trajectory_len > 0`` (static) additionally records the per-iteration
     loglik trajectory on device -- the telemetry subsystem's ``em_iter``
@@ -331,10 +359,16 @@ def em_while_loop(
 
     feats = None
     if (precompute_features and stats_fn is None and not diag_only
-            and quad_mode == "expanded"):
-        from ..ops.estep import expand_features
+            and quad_mode in ("expanded", "packed")):
+        from ..ops.estep import expand_features, pack_features
 
-        feats = jax.vmap(expand_features)(data_chunks)
+        # The hoisted layout follows quad_mode: [C, B, D*D] flattened outer
+        # products for 'expanded', [C, B, D(D+1)/2] upper-triangle products
+        # for 'packed' (~52% of the expanded residency) -- each built by the
+        # SAME function the inline path uses, which is what makes the
+        # per-layout bit-identity contract hold.
+        fe = pack_features if quad_mode == "packed" else expand_features
+        feats = jax.vmap(fe)(data_chunks)
 
     def estep(s) -> SuffStats:
         if stats_fn is not None:

@@ -29,7 +29,7 @@ from ..config import GMMConfig
 from ..ops.formulas import convergence_epsilon, model_score
 from ..validation import InvalidInputError, validate_finite
 from ..ops.merge import eliminate_and_reduce
-from ..state import GMMState, compact
+from ..state import GMMState, bucket_width, compact
 from .. import telemetry
 from ..telemetry import RunRecorder
 from ..utils.logging_ import get_logger, metrics_line
@@ -96,6 +96,20 @@ def _null_phase(_name):
     yield
 
 
+@functools.lru_cache(maxsize=None)
+def _elim_reduce_jit(diag_only: bool):
+    """Process-wide jitted eliminate_and_reduce (per diag flag).
+
+    A fresh ``jax.jit`` per fit would recompile the pair-scan program on
+    every fit -- and, with bucketed sweeps, once per bucket width INSIDE
+    the timed sweep. One shared jit keeps XLA's shape-keyed executable
+    cache alive across fits and widths (two entries total; states are
+    pytrees of plain arrays, so nothing pins device buffers here).
+    """
+    return jax.jit(functools.partial(eliminate_and_reduce,
+                                     diag_only=diag_only))
+
+
 def _emit_em_iters(rec, k, ll_log, iters, dt, epsilon, model):
     """Per-iteration ``em_iter`` records from one K's EM run.
 
@@ -122,7 +136,7 @@ def _emit_em_iters(rec, k, ll_log, iters, dt, epsilon, model):
 
 
 def _emit_run_summary(rec, config, timer, sweep_log, ideal_k, best_score,
-                      best_ll, em_walls):
+                      best_ll, em_walls, buckets=None):
     """Final ``run_summary`` record: scores, 7-category phase profile,
     compile/execute split, metrics-registry snapshot, and (multi-host)
     every rank's snapshot gathered to the one stream process 0 writes.
@@ -131,12 +145,18 @@ def _emit_run_summary(rec, config, timer, sweep_log, ideal_k, best_score,
     compiles the executable the later Ks reuse, so
     ``first_call_s - min(warm calls)`` bounds the compile cost (single-K
     runs carry nulls -- there is no warm call to difference against).
+
+    ``buckets`` (host-driven sweeps) describes the cluster-width bucketing:
+    ``{mode, em_widths, em_compiles, rebuckets}`` -- em_compiles is the
+    number of DISTINCT padded widths EM ran at, i.e. the number of EM
+    executables the sweep compiled.
     """
     if not rec.active:
         return
     first = em_walls[0] if em_walls else None
     warm = min(em_walls[1:]) if len(em_walls) > 1 else None
     fields = dict(
+        **({"buckets": buckets} if buckets is not None else {}),
         ideal_k=int(ideal_k),
         score=float(best_score),
         criterion=config.criterion,
@@ -442,9 +462,20 @@ def _fit_gmm(data, num_clusters, target_num_clusters, config, model,
 
     # One fused dispatch for the whole order-reduction step, so each K costs
     # a single blocking device->host sync (see eliminate_and_reduce).
-    elim_reduce_fn = jax.jit(
-        functools.partial(eliminate_and_reduce, diag_only=config.diag_only)
-    )
+    elim_reduce_fn = _elim_reduce_jit(config.diag_only)
+
+    # Bucketed cluster-width compaction: single-controller host-driven
+    # sweeps shrink the padded width to the active count's power-of-two
+    # bucket as merges cross boundaries, so EM at k active clusters pays
+    # matmuls at width ~k instead of the starting K0 (~2x sweep-level
+    # FLOPs for <= ceil(log2 K0) + 1 compiled widths; docs/PERF.md).
+    # Multi-controller sweeps stay fixed-width: the K-state is replicated
+    # per host and a per-rebucket cross-host re-placement buys nothing.
+    bucketing = (config.sweep_k_buckets == "pow2" and nproc == 1
+                 and hasattr(model, "rebucket_state"))
+    bucket_mult = int(getattr(model, "bucket_multiple", 1) or 1)
+    em_widths = []  # padded width of every EM run; distinct => one compile
+    n_rebuckets = 0
 
     sweep_log = []
     min_rissanen = np.inf
@@ -487,13 +518,19 @@ def _fit_gmm(data, num_clusters, target_num_clusters, config, model,
     while k >= stop_number:
         t0 = time.perf_counter()
         last_k = k <= stop_number
+        em_widths.append(int(state.num_clusters_padded))
         with phase("e_step"):  # fused E+M loop (m_step/constants folded in)
+            # donate=True: the EM carry is rebound every K, so the input
+            # state's buffers are handed to the device for in-place reuse
+            # (one state-size less peak HBM + copy traffic per K).
             if want_traj:
                 state, ll, iters, ll_log = model.run_em(
-                    state, chunks, wts, epsilon, trajectory=True)
+                    state, chunks, wts, epsilon, trajectory=True,
+                    donate=True)
             else:
                 ll_log = None
-                state, ll, iters = model.run_em(state, chunks, wts, epsilon)
+                state, ll, iters = model.run_em(state, chunks, wts, epsilon,
+                                                donate=True)
             if timer or last_k:
                 # Block on EM here so the e_step phase (and sweep_log's
                 # seconds) measure EM alone. Profiling trades away the
@@ -506,15 +543,15 @@ def _fit_gmm(data, num_clusters, target_num_clusters, config, model,
             # decision scalars in one blocking sync (each blocking transfer
             # is a full round trip on a remote-TPU link).
             with phase("reduce"):
-                next_state, k_active, min_d = elim_reduce_fn(state)
+                next_state, k_active, min_d, pair = elim_reduce_fn(state)
                 if timer:
-                    k_active_i, min_d_f = map(
-                        np.asarray, jax.device_get((k_active, min_d))
+                    k_active_i, min_d_f, pair_i = map(
+                        np.asarray, jax.device_get((k_active, min_d, pair))
                     )
                 else:
-                    ll_f, iters_i, k_active_i, min_d_f = map(
+                    ll_f, iters_i, k_active_i, min_d_f, pair_i = map(
                         np.asarray,
-                        jax.device_get((ll, iters, k_active, min_d)),
+                        jax.device_get((ll, iters, k_active, min_d, pair)),
                     )
         ll_f = float(ll_f)
         riss = model_score(ll_f, k, n_events, n_dims,
@@ -564,11 +601,33 @@ def _fit_gmm(data, num_clusters, target_num_clusters, config, model,
             log.warning("no valid merge pair at K=%d; stopping sweep", k)
             break
         if rec.active:
+            # ``pair``: the merged clusters' positions in the compacted
+            # (post-elimination) ordering -- stable across rebucketing,
+            # unlike raw padded-slot indices (eliminate_and_reduce).
             rec.emit("merge", k_active=int(k), next_k=int(k) - 1,
-                     min_distance=float(min_d_f))
+                     min_distance=float(min_d_f),
+                     pair=[int(pair_i[0]), int(pair_i[1])])
             rec.metrics.count("merges")
         state = next_state
         k -= 1
+
+        if bucketing:
+            cur_w = int(state.num_clusters_padded)
+            target = bucket_width(k, cur_w, multiple=bucket_mult)
+            if target < cur_w:
+                # Crossed a bucket boundary: rebuild the state at the
+                # narrower padded width on device (state.compact_to). The
+                # next EM call compiles once per NEW width and every K
+                # inside the bucket reuses it.
+                with phase("memcpy"):
+                    state = model.rebucket_state(state, target)
+                n_rebuckets += 1
+                log.debug("rebucket: k=%d width %d -> %d", k, cur_w,
+                          int(state.num_clusters_padded))
+                if rec.active:
+                    rec.metrics.count("rebuckets")
+                    rec.emit("rebucket", k_active=int(k), from_width=cur_w,
+                             to_width=int(state.num_clusters_padded))
 
         if ckpt is not None:
             rec.metrics.count("checkpoint_saves") if rec.active else None
@@ -594,8 +653,15 @@ def _fit_gmm(data, num_clusters, target_num_clusters, config, model,
         print(f"Final {config.criterion} score was: {min_rissanen}, "
               f"with {ideal_k} clusters.")
 
-    _emit_run_summary(rec, config, timer, sweep_log, n_active,
-                      float(min_rissanen), float(best_ll), em_walls)
+    _emit_run_summary(
+        rec, config, timer, sweep_log, n_active,
+        float(min_rissanen), float(best_ll), em_walls,
+        buckets=dict(
+            mode=(config.sweep_k_buckets if bucketing else "off"),
+            em_widths=sorted(set(em_widths), reverse=True),
+            em_compiles=len(set(em_widths)),
+            rebuckets=n_rebuckets,
+        ))
     return GMMResult(
         state=compact_state,
         ideal_num_clusters=n_active,
@@ -677,65 +743,89 @@ def _prepare_fit(data, num_clusters, config, model, phase, log,
             "sample_weight requires in-memory event data (FileSource/"
             "streamed inputs carry no weight column)")
 
+    # n_init > 1 restarts fit the SAME data repeatedly: _fit_with_restarts
+    # hangs a one-fit-scoped cache off the shared model so the load,
+    # validation, moments, chunk build, and -- the expensive part -- the
+    # host->device upload all happen once, and restarts 1..n-1 reuse the
+    # device-resident chunk arrays. Only the seeding (seed-dependent) and
+    # the per-restart state placement run again.
+    cache = getattr(model, "_restart_cache", None)
+    prepared = cache.get("prepared") if cache is not None else None
+    if prepared is not None:
+        (chunks, wts, chunks_np, wts_np, n_events, n_dims, shift,
+         start, stop, var_mean) = prepared
+    else:
+        with phase("cpu"):
+            if source is not None:
+                n_events, n_dims = source.shape
+            else:
+                data = np.ascontiguousarray(data)
+                n_events, n_dims = data.shape
+            data_axis = getattr(model, "data_size", 1)
+            start, stop, num_chunks = host_chunk_bounds(
+                n_events, config.chunk_size, data_axis, pid, nproc
+            )
+            local = (source.read_range(start, stop) if source is not None
+                     else data[start:stop])
+            local = np.ascontiguousarray(local)
+            local_weight = None
+            if sample_weight is not None:
+                sample_weight = np.asarray(sample_weight, np.float64)
+                if sample_weight.shape != (n_events,):
+                    raise ValueError(
+                        f"sample_weight must be [{n_events}], got "
+                        f"{sample_weight.shape}")
+                if (not np.isfinite(sample_weight).all()
+                        or (sample_weight < 0).any()):
+                    raise InvalidInputError(
+                        "sample_weight must be finite and nonnegative")
+                total_w = float(sample_weight.sum())
+                if total_w < num_clusters:
+                    # Weights are event multiplicities; the absolute Nk
+                    # thresholds (> 0.5 / >= 1, reference semantics) would
+                    # classify every cluster as empty and return a silently
+                    # degenerate model. (Every rank sees the full weight
+                    # array, so this decision is identical without a
+                    # collective.)
+                    raise InvalidInputError(
+                        f"sample_weight sums to {total_w:.4g} < num_clusters="
+                        f"{num_clusters}: weights are event multiplicities, "
+                        "not probabilities -- scale them up (e.g. multiply "
+                        "normalized weights by the event count)")
+                local_weight = sample_weight[start:stop]
+        # Before ANY arithmetic touches the data (the moments would just
+        # launder NaNs into the shift): reject rows non-finite now or after
+        # the cast to the compute dtype.
+        if config.validate_input:
+            validate_finite(local, start, collective=nproc > 1, dtype=dtype)
+
+        with phase("mpi"):  # cross-host allgather of tiny per-chunk partials
+            mean64, var64 = global_moments(local, config.chunk_size,
+                                           num_chunks)
+
+        with phase("cpu"):
+            # Global centering keeps the expanded quadratic form
+            # well-conditioned (shift-equivariant: EM on x-c equals EM on x,
+            # means shifted by c).
+            if config.center_data:
+                shift = mean64.astype(dtype)
+            else:
+                shift = np.zeros((n_dims,), dtype)
+            local = local.astype(dtype, copy=False)
+            if config.center_data:
+                local = local - shift[None, :]
+            var_mean = float(var64.mean())
+            chunks_np, wts_np = chunk_events(
+                local, config.chunk_size, num_chunks=num_chunks,
+                sample_weight=(None if local_weight is None
+                               else local_weight.astype(local.dtype)),
+            )
+
     with phase("cpu"):
-        if source is not None:
-            n_events, n_dims = source.shape
-        else:
-            data = np.ascontiguousarray(data)
-            n_events, n_dims = data.shape
-        data_axis = getattr(model, "data_size", 1)
-        start, stop, num_chunks = host_chunk_bounds(
-            n_events, config.chunk_size, data_axis, pid, nproc
-        )
-        local = (source.read_range(start, stop) if source is not None
-                 else data[start:stop])
-        local = np.ascontiguousarray(local)
-        local_weight = None
-        if sample_weight is not None:
-            sample_weight = np.asarray(sample_weight, np.float64)
-            if sample_weight.shape != (n_events,):
-                raise ValueError(
-                    f"sample_weight must be [{n_events}], got "
-                    f"{sample_weight.shape}")
-            if not np.isfinite(sample_weight).all() or (sample_weight < 0).any():
-                raise InvalidInputError(
-                    "sample_weight must be finite and nonnegative")
-            total_w = float(sample_weight.sum())
-            if total_w < num_clusters:
-                # Weights are event multiplicities; the absolute Nk
-                # thresholds (> 0.5 / >= 1, reference semantics) would
-                # classify every cluster as empty and return a silently
-                # degenerate model. (Every rank sees the full weight array,
-                # so this decision is identical without a collective.)
-                raise InvalidInputError(
-                    f"sample_weight sums to {total_w:.4g} < num_clusters="
-                    f"{num_clusters}: weights are event multiplicities, not "
-                    "probabilities -- scale them up (e.g. multiply "
-                    "normalized weights by the event count)")
-            local_weight = sample_weight[start:stop]
-    # Before ANY arithmetic touches the data (the moments would just launder
-    # NaNs into the shift): reject rows non-finite now or after the cast to
-    # the compute dtype.
-    if config.validate_input:
-        validate_finite(local, start, collective=nproc > 1, dtype=dtype)
-
-    with phase("mpi"):  # cross-host allgather of tiny per-chunk partials
-        mean64, var64 = global_moments(local, config.chunk_size, num_chunks)
-
-    with phase("cpu"):
-        # Global centering keeps the expanded quadratic form well-conditioned
-        # (shift-equivariant: EM on x-c equals EM on x, means shifted by c).
-        if config.center_data:
-            shift = mean64.astype(dtype)
-        else:
-            shift = np.zeros((n_dims,), dtype)
-        local = local.astype(dtype, copy=False)
-        if config.center_data:
-            local = local - shift[None, :]
-
         # Seed rows fetched in ORIGINAL coordinates, identically on every
         # host (net reference semantics: device seeding overwritten by the
-        # host full-data reseed, gaussian.cu:108-123).
+        # host full-data reseed, gaussian.cu:108-123). Per restart (the
+        # seed changes); everything above this point is restart-invariant.
         if init_means is not None:
             rows = np.asarray(init_means, dtype)
             if rows.shape != (num_clusters, n_dims):
@@ -754,30 +844,39 @@ def _prepare_fit(data, num_clusters, config, model, phase, log,
                 source.read_rows(idx) if source is not None else data[idx]
             )
         state = seed_state_from_parts(
-            rows.astype(dtype) - shift[None, :], n_events,
-            float(var64.mean()), num_clusters,
+            np.asarray(rows, dtype) - np.asarray(shift, dtype)[None, :],
+            n_events, var_mean, num_clusters,
             covariance_dynamic_range=config.covariance_dynamic_range,
             dtype=dtype,
         )
-        chunks_np, wts_np = chunk_events(
-            local, config.chunk_size, num_chunks=num_chunks,
-            sample_weight=(None if local_weight is None
-                           else local_weight.astype(local.dtype)),
-        )
 
+    rec = telemetry.current()
     with phase("memcpy"):
-        if hasattr(model, "prepare"):  # sharded path: pad K, place on mesh
+        if prepared is not None:
+            # Restart: the chunk arrays are already device-resident (or
+            # host-prepared, streaming); only the fresh seed state needs
+            # placement. Every model with a prepare() also has
+            # prepare_state() (the checkpoint-restore contract).
+            if hasattr(model, "prepare_state"):
+                state = model.prepare_state(
+                    jax.tree_util.tree_map(jnp.asarray, state))
+        elif hasattr(model, "prepare"):  # sharded path: pad K, place on mesh
             state, chunks, wts = model.prepare(
                 state, chunks_np, wts_np, host_local=(nproc > 1)
             )
         else:
             chunks, wts = jnp.asarray(chunks_np), jnp.asarray(wts_np)
-    rec = telemetry.current()
-    if rec.active and not config.stream_events:
-        # Streaming keeps the chunks host-side and accounts its transfers
-        # per flushed block instead (StreamingGMMModel._estep_all).
-        rec.metrics.count("h2d_bytes", int(np.asarray(chunks_np).nbytes)
-                          + int(np.asarray(wts_np).nbytes))
+    if prepared is None:
+        if rec.active and not config.stream_events:
+            # Streaming keeps the chunks host-side and accounts its
+            # transfers per flushed block instead
+            # (StreamingGMMModel._estep_all).
+            rec.metrics.count("h2d_bytes", int(np.asarray(chunks_np).nbytes)
+                              + int(np.asarray(wts_np).nbytes))
+        if cache is not None:
+            cache["prepared"] = (
+                chunks, wts, chunks_np, wts_np, n_events, n_dims,
+                np.asarray(shift), start, stop, var_mean)
     return (state, chunks, wts, chunks_np, wts_np, n_events, n_dims,
             np.asarray(shift), (start, stop))
 
@@ -810,31 +909,40 @@ def _fit_with_restarts(data, num_clusters, target_num_clusters, config,
             model = GMMModel(config)
     best = None
     rec = telemetry.current()
-    for i in range(config.n_init):
-        if rec.active:
-            # The restart index tags every record of this init's sub-fit;
-            # all inits share one stream (and one run_id).
-            rec.set_context(init=i)
-            rec.metrics.count("restarts") if i else None
-        sub = dataclasses.replace(
-            config, n_init=1,
-            seed_method=(config.seed_method if i == 0 else "kmeans++"),
-            seed=config.seed + i,
-            checkpoint_dir=(os.path.join(config.checkpoint_dir, f"init{i}")
-                            if config.checkpoint_dir else None),
-        )
-        r = fit_gmm(data, num_clusters, target_num_clusters, config=sub,
-                    model=model, verbose=verbose,
-                    init_means=(init_means if i == 0 else None),
-                    sample_weight=sample_weight)
-        if verbose:
-            print(f"init {i}: {config.criterion}={r.min_rissanen:.6e} "
-                  f"K={r.ideal_num_clusters}")
-        # NaN-safe best pick: a degenerate init (NaN rissanen) must never
-        # shadow later finite restarts ('finite < NaN' is False).
-        if (best is None or math.isnan(best.min_rissanen)
-                or r.min_rissanen < best.min_rissanen):
-            best = r
+    # One fit-scoped data cache on the shared model: init 0 prepares (and
+    # uploads) the chunked events once, restarts reuse the device-resident
+    # arrays (_prepare_fit). try/finally so an aborted restart can never
+    # leak a stale cache into a later fit with different data.
+    model._restart_cache = {}
+    try:
+        for i in range(config.n_init):
+            if rec.active:
+                # The restart index tags every record of this init's
+                # sub-fit; all inits share one stream (and one run_id).
+                rec.set_context(init=i)
+                rec.metrics.count("restarts") if i else None
+            sub = dataclasses.replace(
+                config, n_init=1,
+                seed_method=(config.seed_method if i == 0 else "kmeans++"),
+                seed=config.seed + i,
+                checkpoint_dir=(os.path.join(config.checkpoint_dir,
+                                             f"init{i}")
+                                if config.checkpoint_dir else None),
+            )
+            r = fit_gmm(data, num_clusters, target_num_clusters, config=sub,
+                        model=model, verbose=verbose,
+                        init_means=(init_means if i == 0 else None),
+                        sample_weight=sample_weight)
+            if verbose:
+                print(f"init {i}: {config.criterion}={r.min_rissanen:.6e} "
+                      f"K={r.ideal_num_clusters}")
+            # NaN-safe best pick: a degenerate init (NaN rissanen) must
+            # never shadow later finite restarts ('finite < NaN' is False).
+            if (best is None or math.isnan(best.min_rissanen)
+                    or r.min_rissanen < best.min_rissanen):
+                best = r
+    finally:
+        model._restart_cache = None
     if rec.active:
         rec.set_context(init=None)  # clear the tag for any later records
     if verbose:

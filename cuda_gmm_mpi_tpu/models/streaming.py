@@ -47,6 +47,7 @@ host-driven sweep.
 
 from __future__ import annotations
 
+import functools
 import time
 from typing import Optional
 
@@ -101,7 +102,11 @@ class StreamingGMMModel(GMMModel):
         def _stats(state, x, wts):
             return chunk_stats(state, x, wts, **kw)
 
-        @jax.jit
+        # The streaming reduce: donate the running accumulator so every
+        # per-block merge updates the SuffStats buffers in place instead of
+        # allocating a fresh set per block (the accumulator is loop-local
+        # in _estep_all and never read after the add).
+        @functools.partial(jax.jit, donate_argnums=(0,))
         def _add(a, b):
             return a + b  # SuffStats.__add__
 
@@ -115,8 +120,6 @@ class StreamingGMMModel(GMMModel):
         self._mstep = _mstep
 
         if self.mesh is not None:
-            import functools
-
             from ..ops.estep import posteriors
             from ..parallel.mesh import (
                 CLUSTER_AXIS, DATA_AXIS, state_pspecs,
@@ -369,13 +372,19 @@ class StreamingGMMModel(GMMModel):
 
     def run_em(self, state, chunks, wts, epsilon,
                min_iters: Optional[int] = None,
-               max_iters: Optional[int] = None, *, trajectory: bool = False):
+               max_iters: Optional[int] = None, *, trajectory: bool = False,
+               donate: bool = False):
         """Reference loop semantics (gaussian.cu:525-755), host-driven.
 
         ``trajectory=True`` returns (state, loglik, iters, ll_log) like the
         in-memory models' telemetry variant; being host-driven, the logliks
         come for free and ``last_iter_seconds`` carries REAL per-iteration
         wall times (the jitted paths can only amortize).
+
+        ``donate`` is accepted for interface parity with the jitted models;
+        the host-driven loop's donation lives in the streaming reduce
+        (``_add`` updates the statistics accumulator in place) and applies
+        regardless -- the loop carry here is rebound per pass either way.
         """
         lo, hi = resolve_iters(self.config, min_iters, max_iters)
         lo, hi = int(lo), int(hi)

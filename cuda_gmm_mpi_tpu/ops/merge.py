@@ -147,18 +147,28 @@ def merge_pair(state, i, j, diag_only: bool = False):
 def eliminate_and_reduce(state, diag_only: bool = False):
     """Fused empty-elimination + pair scan + merge, one device dispatch.
 
-    Returns ``(new_state, k_active_after_elim, min_distance)``. Exists so the
-    sweep driver can fetch all its per-K decision scalars in ONE host sync --
-    on a remote-TPU link every blocking transfer costs a round trip, and the
-    reference-shaped loop (eliminate, count, scan, merge as separate host
-    steps, gaussian.cu:857-907) would pay it 3-4 times per K.
+    Returns ``(new_state, k_active_after_elim, min_distance, pair)``. Exists
+    so the sweep driver can fetch all its per-K decision scalars in ONE host
+    sync -- on a remote-TPU link every blocking transfer costs a round trip,
+    and the reference-shaped loop (eliminate, count, scan, merge as separate
+    host steps, gaussian.cu:857-907) would pay it 3-4 times per K.
+
+    ``pair`` is the merged pair as an int32 [2] of COMPACTION-STABLE
+    indices: each slot index is remapped to its rank among the
+    post-elimination active slots, i.e. the position the cluster holds in
+    the compacted layout (state.compact / compact_to preserve that order).
+    Raw padded-slot indices would go stale the moment the sweep rebuckets
+    the state to a narrower width; these stay valid, and match the
+    reference's compacted c1 < c2 scan coordinates (gaussian.cu:882-894).
     """
     state = eliminate_empty(state)
     k_active = state.num_active()
-    new_state, _, min_d = reduce_order_step(state, diag_only=diag_only)
+    new_state, (i, j), min_d = reduce_order_step(state, diag_only=diag_only)
     # A merge with < 2 active clusters is impossible; reduce_order_step
     # already returns the state unchanged in that case (all-inf distances).
-    return new_state, k_active, min_d
+    rank = jnp.cumsum(state.active.astype(jnp.int32)) - 1
+    pair = jnp.stack([rank[i], rank[j]]).astype(jnp.int32)
+    return new_state, k_active, min_d, pair
 
 
 def reduce_order_step(state, diag_only: bool = False):

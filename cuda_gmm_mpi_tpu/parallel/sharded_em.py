@@ -230,28 +230,14 @@ class ShardedGMMModel:
             )
         self._stats_fn = stats_fn
         self._cluster_axis = cluster_axis
-        self._em_run_traj = None  # built lazily on first trajectory request
-        em_fn = functools.partial(
-            em_while_loop,
-            reduce_stats=make_psum_reduce(DATA_AXIS),
-            cluster_axis=cluster_axis,
-            stats_fn=stats_fn,
-            covariance_type=config.covariance_type,
-            precompute_features=config.precompute_features,
-            **kw,
-        )
-        sspec = state_pspecs()
-        scalar = P()
-        self._em_run = jax.jit(
-            shard_map(
-                em_fn,
-                mesh=self.mesh,
-                in_specs=(sspec, P(DATA_AXIS, None, None), P(DATA_AXIS, None),
-                          scalar, scalar, scalar),
-                out_specs=(sspec, scalar, scalar),
-                check_vma=False,
-            )
-        )
+        # Buckets must stay evenly partitionable over the cluster axis
+        # (order_search rounds widths up to this before rebucketing).
+        self.bucket_multiple = self.cluster_size
+        # EM executables per (trajectory_len, donate) variant; jax.jit's
+        # shape-keyed cache handles the per-bucket-width memoization within
+        # each variant (same contract as GMMModel._em_executable).
+        self._em_exec_cache: dict = {}
+        self._em_run = self._em_executable(0, False)
 
         # Posterior pass for output/inference: ALL local devices in parallel
         # (the reference computes final memberships on every GPU and gathers,
@@ -264,6 +250,7 @@ class ShardedGMMModel:
         self._inference_data_size = self._inference_mesh.shape[DATA_AXIS]
         post_fn = functools.partial(posteriors, cluster_axis=cluster_axis,
                                     **kw)
+        sspec = state_pspecs()
         self._post_sharded = jax.jit(
             shard_map(
                 lambda s, x: post_fn(s, x),
@@ -334,44 +321,77 @@ class ShardedGMMModel:
             ),
         )
 
+    def _em_executable(self, trajectory_len: int, donate: bool):
+        """Memoized SPMD EM loop per (trajectory, donation) variant.
+
+        After the psum the loglik (and the trajectory log) is replicated on
+        every shard, so those out-specs are fully replicated like the
+        scalars. ``donate`` forwards the state's buffers for in-place reuse
+        (same contract as GMMModel.run_em's ``donate``).
+        """
+        key = (trajectory_len, donate)
+        fn = self._em_exec_cache.get(key)
+        if fn is None:
+            em_fn = functools.partial(
+                em_while_loop,
+                reduce_stats=make_psum_reduce(DATA_AXIS),
+                cluster_axis=self._cluster_axis,
+                stats_fn=self._stats_fn,
+                covariance_type=self.config.covariance_type,
+                precompute_features=self.config.precompute_features,
+                trajectory_len=trajectory_len,
+                **self._kw,
+            )
+            sspec = state_pspecs()
+            scalar = P()
+            out_specs = (sspec, scalar, scalar)
+            if trajectory_len:
+                out_specs = out_specs + (scalar,)
+            fn = self._em_exec_cache[key] = jax.jit(
+                shard_map(
+                    em_fn,
+                    mesh=self.mesh,
+                    in_specs=(sspec, P(DATA_AXIS, None, None),
+                              P(DATA_AXIS, None), scalar, scalar, scalar),
+                    out_specs=out_specs,
+                    check_vma=False,
+                ),
+                donate_argnums=(0,) if donate else (),
+            )
+        return fn
+
     def run_em(self, state, data_chunks, wts_chunks, epsilon: float,
                min_iters: Optional[int] = None, max_iters: Optional[int] = None,
-               *, trajectory: bool = False):
+               *, trajectory: bool = False, donate: bool = False):
         lo, hi = resolve_iters(self.config, min_iters, max_iters)
-        run = self._em_run
-        if trajectory:
-            # Telemetry variant: same SPMD loop with the device-captured
-            # per-iteration loglik log (em_while_loop trajectory_len).
-            # After the psum the loglik is replicated on every shard, so
-            # the log's out-spec is fully replicated like the scalars.
-            if self._em_run_traj is None:
-                em_fn = functools.partial(
-                    em_while_loop,
-                    reduce_stats=make_psum_reduce(DATA_AXIS),
-                    cluster_axis=self._cluster_axis,
-                    stats_fn=self._stats_fn,
-                    covariance_type=self.config.covariance_type,
-                    precompute_features=self.config.precompute_features,
-                    trajectory_len=int(self.config.max_iters),
-                    **self._kw,
-                )
-                sspec = state_pspecs()
-                scalar = P()
-                self._em_run_traj = jax.jit(
-                    shard_map(
-                        em_fn,
-                        mesh=self.mesh,
-                        in_specs=(sspec, P(DATA_AXIS, None, None),
-                                  P(DATA_AXIS, None), scalar, scalar, scalar),
-                        out_specs=(sspec, scalar, scalar, scalar),
-                        check_vma=False,
-                    )
-                )
-            run = self._em_run_traj
+        run = self._em_executable(
+            int(self.config.max_iters) if trajectory else 0, donate)
         return run(
             state, data_chunks, wts_chunks,
             jnp.asarray(epsilon, data_chunks.dtype), lo, hi,
         )
+
+    def rebucket_state(self, state, num_clusters: int):
+        """Bucket recompaction on the mesh: compact the (tiny) K-state to
+        the new width and re-place it with the cluster-axis sharding.
+
+        ``num_clusters`` is rounded up to the cluster-axis extent so every
+        shard keeps an equal slice (the caller already rounds via
+        ``bucket_multiple``; this re-rounds defensively). Single-controller
+        only -- order_search keeps multi-controller sweeps fixed-width (a
+        per-rebucket cross-host reshard of a KxDxD state is not worth the
+        collective).
+        """
+        num_clusters = pad_clusters(num_clusters, self.cluster_size)
+        if num_clusters >= state.num_clusters_padded:
+            return state
+        from ..state import compact_to
+
+        narrow = compact_to(
+            jax.tree_util.tree_map(
+                lambda a: jnp.asarray(np.asarray(jax.device_get(a))), state),
+            num_clusters)
+        return self.prepare_state(narrow)
 
     def make_fused_sweep(self, with_emit: bool = False,
                          emit_light: bool = False, **static):
@@ -424,7 +444,7 @@ class ShardedGMMModel:
                                             tiled=True),
                     state,
                 )
-                new_full, k_active, min_d = eliminate_and_reduce(
+                new_full, k_active, min_d, pair = eliminate_and_reduce(
                     full, diag_only=diag_only
                 )
                 idx = lax.axis_index(cluster_axis)
@@ -435,7 +455,7 @@ class ShardedGMMModel:
                     ),
                     new_full,
                 )
-                return new_local, k_active, min_d
+                return new_local, k_active, min_d, pair
 
         def build():
             sweep_fn = functools.partial(

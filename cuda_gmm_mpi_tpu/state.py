@@ -3,8 +3,12 @@
 TPU-native re-design of the reference's ``clusters_t`` struct-of-arrays
 (``gaussian.h:62-76``): the same fields (N, pi, constant, avgvar, means, R, Rinv)
 plus an ``active`` mask that replaces the reference's realloc-and-shift cluster
-compaction (``gaussian.cu:866-874, 902-907``) with fixed shapes, so the whole
-model-order sweep runs under a single jit compilation instead of recompiling per K.
+compaction (``gaussian.cu:866-874, 902-907``) with fixed shapes, so EM never
+recompiles per K. The model-order sweep bucket-compacts between Ks
+(``compact_to`` + ``bucket_width``): the padded width shrinks to the active
+count's power-of-two bucket, bounding compiles at ceil(log2 K0) + 1 widths
+while cutting the masked-slot waste that a single fixed width pays at small K
+(docs/PERF.md "Bucketed cluster-width compaction").
 
 The big ``memberships`` array (N x M posteriors, ``gaussian.h:75``) is deliberately
 NOT part of the state: the fused E+M pass never materializes it (SURVEY.md SS7
@@ -14,6 +18,7 @@ NOT part of the state: the fused E+M pass never materializes it (SURVEY.md SS7
 from __future__ import annotations
 
 import dataclasses
+import functools
 from typing import Tuple
 
 import jax
@@ -77,6 +82,59 @@ def zeros_state(num_clusters: int, num_dimensions: int, dtype=jnp.float32) -> GM
         R=eye,
         Rinv=eye,
         active=jnp.zeros((K,), bool),
+    )
+
+
+def bucket_width(k_active: int, padded: int, multiple: int = 1,
+                 mode: str = "pow2") -> int:
+    """Padded width the sweep should run ``k_active`` clusters at.
+
+    ``pow2``: the smallest power of two >= k_active, rounded up to a
+    multiple of ``multiple`` (the cluster-mesh axis extent, so sharded
+    states stay evenly partitionable) and clamped to the current
+    ``padded`` width (buckets only ever shrink). ``off``: the current
+    width, i.e. no rebucketing. Bounds the distinct EM widths of a
+    K0 -> 1 sweep to ceil(log2 K0) + 1.
+    """
+    if mode == "off":
+        return padded
+    if mode != "pow2":
+        raise ValueError(f"unknown bucket mode {mode!r}")
+    w = 1 << max(0, k_active - 1).bit_length()  # smallest pow2 >= k_active
+    if multiple > 1:
+        w = ((w + multiple - 1) // multiple) * multiple
+    return min(w, padded)
+
+
+@functools.partial(jax.jit, static_argnames=("num_clusters",))
+def compact_to(state: GMMState, num_clusters: int) -> GMMState:
+    """Jittable shape-SHRINKING compaction: gather active rows to the front.
+
+    The device-side sibling of :func:`compact` with a STATIC output width,
+    so the model-order sweep can rebuild a narrower state when the active
+    count crosses a bucket boundary (order_search's ``sweep_k_buckets``)
+    without a host round trip. Active clusters keep their relative order
+    (the reference's left-shift compaction order, gaussian.cu:869-871);
+    trailing rows beyond the active count are filled with inactive slots
+    (in original order), which stay algebraically inert through the
+    ``active`` mask. ``num_clusters`` must be >= the active count --
+    callers derive it from the host-known k (``bucket_width``).
+    """
+    K = state.num_clusters_padded
+    if num_clusters > K:
+        raise ValueError(
+            f"compact_to grows the state ({K} -> {num_clusters}); use "
+            "parallel.sharded_em.pad_state_clusters to widen")
+    pos = jnp.arange(K, dtype=jnp.int32)
+    # Unique integer keys (active slots first, original order preserved on
+    # both sides) make the argsort deterministic without relying on a
+    # stable-sort guarantee.
+    idx = jnp.argsort(jnp.where(state.active, pos, pos + K))[:num_clusters]
+    take = lambda a: jnp.take(a, idx, axis=0)
+    return GMMState(
+        N=take(state.N), pi=take(state.pi), constant=take(state.constant),
+        avgvar=take(state.avgvar), means=take(state.means), R=take(state.R),
+        Rinv=take(state.Rinv), active=take(state.active),
     )
 
 

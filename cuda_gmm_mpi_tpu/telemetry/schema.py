@@ -44,9 +44,19 @@ EVENT_FIELDS: Dict[str, Tuple[Tuple[str, ...], Tuple[str, ...]]] = {
         ("k", "loglik", "score", "criterion", "iters", "seconds"),
         (),
     ),
-    # One per closest-pair merge between Ks.
+    # One per closest-pair merge between Ks. ``pair`` (optional) is the
+    # merged clusters' positions in the compacted (post-elimination)
+    # ordering -- stable across bucket recompaction, unlike padded-slot
+    # indices (ops/merge.eliminate_and_reduce).
     "merge": (
         ("k_active", "next_k", "min_distance"),
+        ("pair",),
+    ),
+    # One per bucket recompaction of the host-driven sweep (sweep_k_buckets):
+    # the state was rebuilt from padded width ``from_width`` down to
+    # ``to_width`` with ``k_active`` clusters live.
+    "rebucket": (
+        ("k_active", "from_width", "to_width"),
         (),
     ),
     # Streaming (out-of-core) path: one per host->device block flush.
@@ -61,10 +71,13 @@ EVENT_FIELDS: Dict[str, Tuple[Tuple[str, ...], Tuple[str, ...]]] = {
     ),
     # One per fit: final scores, the 7-category phase profile, the
     # compile-vs-execute split, and the metrics-registry snapshot.
+    # ``buckets`` (optional; host-driven sweeps) describes cluster-width
+    # bucketing: {mode, em_widths, em_compiles, rebuckets} -- em_compiles
+    # counts the DISTINCT padded widths EM compiled for.
     "run_summary": (
         ("ideal_k", "score", "criterion", "final_loglik", "total_iters",
          "wall_s", "phase_profile", "compile", "metrics"),
-        ("per_process", "memory_stats"),
+        ("per_process", "memory_stats", "buckets"),
     ),
 }
 

@@ -1,0 +1,304 @@
+"""Bucketed cluster-width compaction for the order-search sweep (ISSUE 2).
+
+Acceptance contract: bucketing on vs off yields the same selected K and
+per-K trajectories (within float tolerance; in practice bitwise on CPU),
+a K0 -> 1 sweep compiles at most ceil(log2 K0) + 1 distinct EM widths,
+donated EM buffers change no results and are never reused, and the
+restart path uploads the event chunks once.
+"""
+
+import numpy as np
+import pytest
+
+import jax
+import jax.numpy as jnp
+
+from cuda_gmm_mpi_tpu.config import GMMConfig
+from cuda_gmm_mpi_tpu.models import fit_gmm
+from cuda_gmm_mpi_tpu.state import bucket_width, compact_to, zeros_state
+
+from .conftest import make_blobs
+
+
+def cfg(**kw):
+    base = dict(min_iters=3, max_iters=3, chunk_size=256, dtype="float64")
+    base.update(kw)
+    return GMMConfig(**base)
+
+
+# ---------------------------------------------------------------- units
+
+
+def test_bucket_width_pow2_sequence():
+    assert [bucket_width(k, 64) for k in (64, 33, 32, 17, 16, 9, 8, 5, 4,
+                                          3, 2, 1)] == \
+        [64, 64, 32, 32, 16, 16, 8, 8, 4, 4, 2, 1]
+    # clamped to the current padded width (buckets only shrink)
+    assert bucket_width(100, 100) == 100
+    assert bucket_width(65, 100) == 100
+    # rounded up to the cluster-mesh multiple
+    assert bucket_width(3, 64, multiple=8) == 8
+    assert bucket_width(9, 64, multiple=8) == 16
+    # 'off' keeps the width
+    assert bucket_width(2, 64, mode="off") == 64
+    # a K0 -> 1 sweep visits at most ceil(log2 K0) + 1 widths
+    for k0 in (8, 32, 64, 100):
+        widths = {bucket_width(k, k0) for k in range(1, k0 + 1)}
+        assert len(widths) <= int(np.ceil(np.log2(k0))) + 1
+
+
+def test_compact_to_preserves_active_order():
+    s = zeros_state(8, 2, dtype=jnp.float64)
+    active = jnp.asarray([False, True, False, True, True, False, False, True])
+    s = s.replace(N=jnp.arange(8.0), active=active)
+    c = compact_to(s, 4)
+    # active rows 1, 3, 4, 7 land in slots 0..3 in original order
+    np.testing.assert_array_equal(np.asarray(c.N), [1.0, 3.0, 4.0, 7.0])
+    assert bool(np.asarray(c.active).all())
+    # extra slots are filled with inactive rows, still masked off
+    c6 = compact_to(s, 6)
+    np.testing.assert_array_equal(np.asarray(c6.N)[:4], [1.0, 3.0, 4.0, 7.0])
+    assert not np.asarray(c6.active)[4:].any()
+    with pytest.raises(ValueError):
+        compact_to(s, 9)  # growing is not compaction
+
+
+# ----------------------------------------------------- sweep parity (tier-1)
+
+
+@pytest.mark.parametrize("covariance_type", ["full", "diag"])
+def test_sweep_parity_bucketing_on_vs_off(rng, covariance_type):
+    """Same data, same seed: bucketing must not change the answer -- same
+    selected K, per-K loglik/criterion trajectories equal within tolerance,
+    for both covariance families."""
+    data, _ = make_blobs(rng, n=700, d=3, k=4)
+    r_on = fit_gmm(data, 12, 0, config=cfg(sweep_k_buckets="pow2",
+                                           covariance_type=covariance_type))
+    r_off = fit_gmm(data, 12, 0, config=cfg(sweep_k_buckets="off",
+                                            covariance_type=covariance_type))
+    assert r_on.ideal_num_clusters == r_off.ideal_num_clusters
+    assert [r[0] for r in r_on.sweep_log] == [r[0] for r in r_off.sweep_log]
+    for on, off in zip(r_on.sweep_log, r_off.sweep_log):
+        np.testing.assert_allclose(on[1], off[1], rtol=1e-5)   # loglik
+        np.testing.assert_allclose(on[2], off[2], rtol=1e-5)   # criterion
+        assert on[3] == off[3]                                 # iters
+    np.testing.assert_allclose(r_on.min_rissanen, r_off.min_rissanen,
+                               rtol=1e-10)
+    np.testing.assert_allclose(r_on.means, r_off.means, rtol=1e-7,
+                               atol=1e-9)
+
+
+def test_sweep_parity_sharded_cluster_axis(rng):
+    """Bucketing on a cluster-sharded mesh: widths round up to the cluster
+    axis extent and the answer matches the unbucketed mesh run."""
+    data, _ = make_blobs(rng, n=512, d=3, k=3)
+    r_on = fit_gmm(data, 6, 0, config=cfg(mesh_shape=(4, 2), chunk_size=64,
+                                          sweep_k_buckets="pow2"))
+    r_off = fit_gmm(data, 6, 0, config=cfg(mesh_shape=(4, 2), chunk_size=64,
+                                           sweep_k_buckets="off"))
+    assert r_on.ideal_num_clusters == r_off.ideal_num_clusters
+    np.testing.assert_allclose(r_on.min_rissanen, r_off.min_rissanen,
+                               rtol=1e-9)
+
+
+# --------------------------------------------------------- compile count
+
+
+def test_compile_count_k32_sweep(rng, tmp_path):
+    """A K=32 -> 1 sweep builds at most ceil(log2 32) + 1 = 6 distinct EM
+    widths (asserted from run_summary's bucket report AND the jit cache)."""
+    from cuda_gmm_mpi_tpu.models.gmm import GMMModel
+    from cuda_gmm_mpi_tpu.telemetry import read_stream
+
+    data, _ = make_blobs(rng, n=320, d=2, k=3)
+    path = str(tmp_path / "m.jsonl")
+    c = cfg(min_iters=2, max_iters=2, chunk_size=128, metrics_file=path)
+    model = GMMModel(c)
+    fit_gmm(data, 32, 0, config=c, model=model)
+    summ = [r for r in read_stream(path) if r["event"] == "run_summary"][-1]
+    buckets = summ["buckets"]
+    assert buckets["mode"] == "pow2"
+    assert buckets["em_compiles"] <= 6
+    assert buckets["em_widths"][0] == 32 and buckets["em_widths"][-1] >= 1
+    assert buckets["rebuckets"] == len(buckets["em_widths"]) - 1
+    # The jitted EM loop itself traced at most one shape per width (the
+    # telemetry sweep runs one (trajectory, donate) variant).
+    traced = [fn for fn in model._em_exec_cache.values()
+              if getattr(fn, "_cache_size", None) is not None]
+    assert traced and all(fn._cache_size() <= 6 for fn in traced)
+    # rebucket events narrate every boundary crossing
+    rebs = [r for r in read_stream(path) if r["event"] == "rebucket"]
+    assert len(rebs) == buckets["rebuckets"]
+    for r in rebs:
+        assert r["to_width"] < r["from_width"]
+        assert r["k_active"] <= r["to_width"]
+
+
+# -------------------------------------------------------------- donation
+
+
+def test_donation_results_unchanged_and_input_deleted(rng):
+    """donate=True: identical results, and the donated input state is not
+    reusable afterwards (deleted on backends that support donation --
+    CPU does on this jax; the sweep never touches a donated input)."""
+    from cuda_gmm_mpi_tpu.models.gmm import GMMModel, chunk_events
+    from cuda_gmm_mpi_tpu.ops.formulas import convergence_epsilon
+    from cuda_gmm_mpi_tpu.ops.seeding import seed_clusters_host
+
+    data, _ = make_blobs(rng, n=512, d=3, k=3, dtype=np.float64)
+    model = GMMModel(cfg())
+    chunks, wts = map(jnp.asarray, chunk_events(data, 256))
+    eps = convergence_epsilon(512, 3)
+    seed = seed_clusters_host(data, 4)
+
+    fresh = lambda: jax.tree_util.tree_map(
+        lambda a: jnp.array(np.asarray(a)), seed)  # real copies
+    s_ref, ll_ref, it_ref = model.run_em(fresh(), chunks, wts, eps)
+    donated_in = fresh()
+    s_don, ll_don, it_don = model.run_em(donated_in, chunks, wts, eps,
+                                         donate=True)
+    assert float(ll_don) == float(ll_ref) and int(it_don) == int(it_ref)
+    np.testing.assert_array_equal(np.asarray(s_don.means),
+                                  np.asarray(s_ref.means))
+    # the donated buffers must not be live afterwards
+    assert all(a.is_deleted()
+               for a in jax.tree_util.tree_leaves(donated_in))
+    # chunks were NOT donated: still valid for the next call
+    model.run_em(fresh(), chunks, wts, eps, donate=True)
+
+
+def test_full_sweep_with_donation_matches_result(rng):
+    """End-to-end: the donating sweep (default path) equals a fixed run's
+    known-good selection; nothing downstream reads a deleted buffer."""
+    data, _ = make_blobs(rng, n=600, d=2, k=3)
+    r = fit_gmm(data, 6, 0, config=cfg())
+    assert r.ideal_num_clusters >= 1
+    assert np.isfinite(r.final_loglik)
+    # the compacted best state is fully materialized (not donated away)
+    assert np.isfinite(np.asarray(r.state.means)).all()
+
+
+# ----------------------------------------------- restart upload hoisting
+
+
+def test_restarts_upload_chunks_once(rng, tmp_path):
+    """n_init > 1: the event chunks are placed on device once; restarts
+    reuse the resident arrays (h2d_bytes counts ONE upload)."""
+    from cuda_gmm_mpi_tpu.telemetry import read_stream
+
+    data, _ = make_blobs(rng, n=400, d=3, k=3)
+    single = str(tmp_path / "single.jsonl")
+    fit_gmm(data, 3, 3, config=cfg(chunk_size=128, metrics_file=single))
+    one_upload = [x for x in read_stream(single)
+                  if x["event"] == "run_summary"][-1][
+                      "metrics"]["counters"]["h2d_bytes"]
+    assert one_upload > 0
+
+    path = str(tmp_path / "multi.jsonl")
+    r = fit_gmm(data, 3, 3, config=cfg(n_init=3, chunk_size=128,
+                                       metrics_file=path))
+    assert r.ideal_num_clusters == 3
+    recs = read_stream(path)
+    summ = [x for x in recs if x["event"] == "run_summary"][-1]
+    # 3 inits, ONE upload (the counter accumulates across the whole stream)
+    assert summ["metrics"]["counters"]["h2d_bytes"] == one_upload
+    # restarts still produce their own run_start/run_summary records
+    assert sum(1 for x in recs if x["event"] == "run_start") == 3
+
+
+def test_restarts_same_result_as_before_hoist(rng):
+    """The hoist must not change results: n_init over identical data picks
+    the same best as independently seeded single fits."""
+    data, _ = make_blobs(rng, n=500, d=3, k=3)
+    kw = dict(min_iters=4, max_iters=4, chunk_size=128, dtype="float64")
+    singles = [
+        fit_gmm(data, 3, 3, config=GMMConfig(seed_method="kmeans++",
+                                             seed=s, **kw))
+        for s in range(2)
+    ]
+    multi = fit_gmm(data, 3, 3, config=GMMConfig(
+        n_init=2, seed=0, seed_method="kmeans++", **kw))
+    np.testing.assert_allclose(
+        multi.min_rissanen, min(s.min_rissanen for s in singles),
+        rtol=1e-12)
+
+
+# --------------------------------------------- packed precompute (satellite)
+
+
+def test_precompute_features_packed_parity(rng):
+    """precompute_features composes with quad_mode='packed' and is
+    bit-identical to the unhoisted packed run (per-layout contract)."""
+    data, _ = make_blobs(rng, n=400, d=4, k=3)
+    base = dict(min_iters=3, max_iters=3, chunk_size=128, dtype="float64",
+                quad_mode="packed")
+    r_hoist = fit_gmm(data, 4, 4,
+                      config=GMMConfig(precompute_features=True, **base))
+    r_plain = fit_gmm(data, 4, 4, config=GMMConfig(**base))
+    assert r_hoist.final_loglik == r_plain.final_loglik
+    np.testing.assert_array_equal(r_hoist.means, r_plain.means)
+    np.testing.assert_array_equal(np.asarray(r_hoist.state.R),
+                                  np.asarray(r_plain.state.R))
+    # 'centered' still has nothing to hoist
+    with pytest.raises(ValueError):
+        GMMConfig(precompute_features=True, quad_mode="centered")
+
+
+# ------------------------------------------------- bench sweep-mode contract
+
+
+def test_bench_sweep_mode_emits_ab(monkeypatch):
+    """bench.py --sweep emits the bucketed-vs-off A/B in its JSON."""
+    import bench
+
+    monkeypatch.setenv("GMM_BENCH_SWEEP_K", "6")
+    monkeypatch.setenv("GMM_BENCH_SWEEP_N", "600")
+    monkeypatch.setenv("GMM_BENCH_SWEEP_D", "3")
+    monkeypatch.setenv("GMM_BENCH_CHUNK", "256")
+    result = bench.run_sweep_bench("cpu", accel_unavailable=False)
+    sweep = result["sweep"]
+    assert set(sweep) >= {"k0", "bucketed", "off", "speedup",
+                          "ideal_k_equal", "ks_equal",
+                          "max_rel_loglik_diff"}
+    assert sweep["ideal_k_equal"] and sweep["ks_equal"]
+    assert sweep["max_rel_loglik_diff"] < 1e-5
+    for side in ("bucketed", "off"):
+        assert sweep[side]["wall_s"] > 0
+        assert len(sweep[side]["per_k_seconds"]) == len(sweep[side]["ks"])
+    assert result["unit"] == "s" and result["value"] > 0
+
+
+# ----------------------------------------------------- speed (acceptance)
+
+
+@pytest.mark.slow
+def test_bucketed_k64_sweep_measurably_faster(rng):
+    """Acceptance: a K=64 -> 1 CPU sweep with bucketing beats off on wall
+    clock with identical selection and 1e-5-relative trajectories."""
+    import time
+
+    from cuda_gmm_mpi_tpu.models.gmm import GMMModel
+
+    k0 = 64
+    centers = rng.normal(scale=8.0, size=(k0, 8))
+    data = (centers[rng.integers(0, k0, 20000)]
+            + rng.normal(size=(20000, 8))).astype(np.float32)
+
+    def timed(mode):
+        c = GMMConfig(min_iters=3, max_iters=3, chunk_size=4096,
+                      sweep_k_buckets=mode)
+        model = GMMModel(c)
+        warm = GMMConfig(min_iters=1, max_iters=1, chunk_size=4096,
+                         sweep_k_buckets=mode)
+        fit_gmm(data, k0, 0, warm, model=model)  # compile every width
+        t0 = time.perf_counter()
+        res = fit_gmm(data, k0, 0, c, model=model)
+        return time.perf_counter() - t0, res
+
+    t_on, r_on = timed("pow2")
+    t_off, r_off = timed("off")
+    assert r_on.ideal_num_clusters == r_off.ideal_num_clusters
+    assert [r[0] for r in r_on.sweep_log] == [r[0] for r in r_off.sweep_log]
+    for on, off in zip(r_on.sweep_log, r_off.sweep_log):
+        np.testing.assert_allclose(on[1], off[1], rtol=1e-5)
+    assert t_on < t_off, (t_on, t_off)

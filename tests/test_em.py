@@ -132,12 +132,16 @@ def test_precompute_features_bitwise_identical(blobs):
         np.testing.assert_array_equal(r1.final_loglik, r0.final_loglik,
                                       err_msg=str(extra))
 
-    # Guards: the flag is meaningless off the expanded full-covariance
-    # in-memory path and must say so.
+    # Guards: the flag is meaningless off the full-covariance in-memory
+    # paths and must say so. 'packed' is a supported layout now (the hoist
+    # stores the [N, D(D+1)/2] upper triangle; tests/test_bucketing.py
+    # asserts its per-layout bit-identity); 'centered' has no
+    # loop-invariant feature matrix to hoist.
     with pytest.raises(ValueError, match="full-covariance"):
         GMMConfig(precompute_features=True, diag_only=True)
+    GMMConfig(precompute_features=True, quad_mode="packed")  # allowed
     with pytest.raises(ValueError, match="expanded"):
-        GMMConfig(precompute_features=True, quad_mode="packed")
+        GMMConfig(precompute_features=True, quad_mode="centered")
     with pytest.raises(ValueError, match="Pallas"):
         GMMConfig(precompute_features=True, use_pallas="always")
     with pytest.raises(ValueError, match="stream"):

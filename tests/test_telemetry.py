@@ -75,8 +75,23 @@ def _check_stream(records, *, start_k, stop_k, path):
     assert len(merges) == len(ks) - 1
     for r in merges:
         assert r["next_k"] == r["k_active"] - 1
+        # compaction-stable merged-pair indices: positions in the
+        # post-elimination compacted ordering
+        assert len(r["pair"]) == 2
+        assert 0 <= r["pair"][0] < r["pair"][1] < r["k_active"]
+
+    rebuckets = [r for r in records if r["event"] == "rebucket"]
+    for r in rebuckets:
+        assert r["to_width"] < r["from_width"]
+        assert r["k_active"] <= r["to_width"]
 
     summary = records[-1]
+    buckets = summary.get("buckets")
+    if buckets is not None:  # host-driven sweeps report their widths
+        assert buckets["rebuckets"] == len(rebuckets)
+        assert buckets["em_compiles"] == len(buckets["em_widths"])
+        if buckets["mode"] == "off":
+            assert not rebuckets
     prof = summary["phase_profile"]
     assert set(CATEGORIES) <= set(prof["seconds"])
     assert set(CATEGORIES) <= set(prof["counts"])
@@ -155,6 +170,9 @@ def test_fused_sweep_emits_per_k_records(csv_file, tmp_path):
     assert validate_stream(recs) == []
     ev = _events(recs)
     assert ev.count("em_done") == 3 and ev.count("em_iter") == 0
+    # fixed-width by design: the fused program never rebuckets
+    assert ev.count("rebucket") == 0
+    assert "buckets" not in recs[-1]
     assert recs[0]["fused_sweep"] is True
     assert all(r["seconds"] > 0 for r in recs if r["event"] == "em_done")
     assert recs[-1]["event"] == "run_summary"
@@ -254,6 +272,13 @@ def test_registry_and_schema_units():
     missing = {k: v for k, v in ok.items() if k != "min_distance"}
     assert any("min_distance" in e for e in validate_record(missing))
     assert validate_record([1, 2]) != []
+
+    reb = {"event": "rebucket", "schema": 1, "ts": 0.0, "run_id": "x",
+           "process": 0, "k_active": 4, "from_width": 8, "to_width": 4}
+    assert validate_record(reb) == []
+    for f in ("k_active", "from_width", "to_width"):
+        partial = {k: v for k, v in reb.items() if k != f}
+        assert any(f in e for e in validate_record(partial)), f
 
 
 def test_ambient_recorder_is_reused(tmp_path, rng):
