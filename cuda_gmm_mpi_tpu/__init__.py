@@ -16,16 +16,18 @@ the file:line provenance cited throughout this package.
 from ._version import __version__
 from .config import DEFAULT_CONFIG, GMMConfig
 from .estimator import GaussianMixture
+from .health import NumericalFaultError
 from .models import (GMMModel, GMMResult, compute_memberships, fit_gmm,
                      iter_memberships)
-from .state import (GMMState, bucket_width, compact, compact_to,
-                    zeros_state)
+from .state import (GMMState, bucket_width, clone_state, compact,
+                    compact_to, zeros_state)
 from .validation import InvalidInputError
 
 __all__ = [
     "DEFAULT_CONFIG", "GMMConfig", "GaussianMixture",
     "GMMModel", "GMMResult", "compute_memberships", "fit_gmm", "iter_memberships",
-    "GMMState", "bucket_width", "compact", "compact_to", "zeros_state",
-    "InvalidInputError",
+    "GMMState", "bucket_width", "clone_state", "compact", "compact_to",
+    "zeros_state",
+    "InvalidInputError", "NumericalFaultError",
     "__version__",
 ]

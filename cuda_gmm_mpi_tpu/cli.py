@@ -164,6 +164,24 @@ def build_parser() -> argparse.ArgumentParser:
     t.add_argument("--checkpoint-keep", type=int, default=2,
                    help="retained checkpoint steps (newest + fallbacks); "
                    "older steps are pruned after each durable save")
+    t.add_argument("--checkpoint-retries", type=int, default=3,
+                   help="bounded retries (jittered backoff) for checkpoint "
+                   "writes -- a transient EIO no longer kills the run "
+                   "(telemetry records io_retry events)")
+    t.add_argument("--recovery", default="retry", choices=["retry", "off"],
+                   help="what a FATAL health flag (non-finite loglik/"
+                   "params) does: 'retry' rolls back and climbs the "
+                   "escalation ladder (regularize -> centered -> highest "
+                   "precision); 'off' raises immediately with a "
+                   "diagnostic bundle. Detection is always on "
+                   "(docs/ROBUSTNESS.md)")
+    t.add_argument("--max-recovery-attempts", type=int, default=3,
+                   help="escalation rungs attempted per fault before "
+                   "failing loudly")
+    t.add_argument("--recovery-reseed-empty", action="store_true",
+                   help="at a target-K fit, reseed empty clusters from "
+                   "worst-fit events instead of eliminating them "
+                   "(reference-style elimination is the default)")
     t.add_argument("--sweep-log", default=None, metavar="FILE.jsonl",
                    help="write the per-K sweep trajectory (num_clusters, "
                    "loglik, score, criterion, em_iters, seconds) as JSON "
@@ -274,6 +292,10 @@ def main(argv=None) -> int:
             metrics_file=args.metrics_file,
             checkpoint_dir=args.checkpoint_dir,
             checkpoint_keep=args.checkpoint_keep,
+            checkpoint_retries=args.checkpoint_retries,
+            recovery=args.recovery,
+            max_recovery_attempts=args.max_recovery_attempts,
+            recovery_reseed_empty=args.recovery_reseed_empty,
             debug_nans=args.debug_nans,
             validate_input=not args.no_validate_input,
             stream_events=args.stream_events,
@@ -409,6 +431,8 @@ def main(argv=None) -> int:
         if not _all_ranks_ok(ok, nproc):
             return 1
 
+    from .health import NumericalFaultError
+
     with trace(args.trace_dir):
         try:
             result = fit_gmm(
@@ -421,6 +445,13 @@ def main(argv=None) -> int:
             # still crash loudly with their tracebacks.
             print(str(e), file=sys.stderr)
             return 1
+        except NumericalFaultError as e:
+            # An unrecovered (or recovery-disabled) numerical fault: the
+            # loud-failure contract -- print the diagnostic bundle, exit
+            # nonzero, never write a poisoned model (docs/ROBUSTNESS.md).
+            print(f"Numerical fault -- no model written.\n{e}",
+                  file=sys.stderr)
+            return 3
 
     t_out0 = time.perf_counter()
     if pid == 0:

@@ -133,6 +133,43 @@ class GMMConfig:
     # Retained sweep-checkpoint steps (newest + fallbacks; utils/checkpoint
     # prunes older ones after each durable save). >= 1.
     checkpoint_keep: int = 2
+    # Bounded retry (with exponential jittered backoff) for checkpoint
+    # writes: a transient EIO on a network filesystem must not kill an
+    # hours-long sweep -- least of all from inside the fused sweep's
+    # ordered io_callback, where an exception aborts the device program.
+    # 0 disables retrying (first failure is final).
+    checkpoint_retries: int = 3
+
+    # --- numerical fault containment (health.py; docs/ROBUSTNESS.md) ---
+    # Health detection (the in-loop bitmask) is ALWAYS on -- it is a
+    # handful of elementwise ops per EM iteration against the loop's
+    # matmuls. ``recovery`` selects what a FATAL flag (non-finite
+    # loglik/params) does to the run:
+    #   'retry' (default): roll back to the K's input state and climb the
+    #     deterministic escalation ladder -- sanitize + raise the variance
+    #     floor -> quad_mode='centered' -> matmul_precision='highest' --
+    #     failing loudly (NumericalFaultError + diagnostic bundle) only
+    #     when the ladder is exhausted. The fused whole-sweep program
+    #     recovers by falling back to the host-driven sweep (a single
+    #     device program has no per-K host intervention point).
+    #   'off': detect and raise immediately. Either way a poisoned model
+    #     is never silently returned (the reference's failure mode).
+    recovery: str = "retry"
+    # Escalation rungs attempted per fault before giving up (<= 3 rungs
+    # exist; smaller values truncate the ladder).
+    max_recovery_attempts: int = 3
+    # Variance-floor multiplier per recovery attempt: attempt i retries
+    # with avgvar * boost**i (the runtime analog of lowering
+    # COVARIANCE_DYNAMIC_RANGE, gaussian.h:12).
+    recovery_boost: float = 10.0
+    # Reseed empty clusters from worst-fit events at a target-K fit
+    # instead of letting elimination shrink the model below the requested
+    # K. Off = reference semantics (empties are eliminated).
+    recovery_reseed_empty: bool = False
+    # Loglik-regression tolerance, in units of the convergence epsilon: a
+    # drop beyond scale*epsilon between EM iterations raises the (non-
+    # fatal) loglik_regression health flag.
+    health_regression_scale: float = 10.0
 
     # --- aux subsystems ---
     profile: bool = False
@@ -230,6 +267,18 @@ class GMMConfig:
             raise ValueError(f"unknown seed_method: {self.seed_method!r}")
         if self.checkpoint_keep < 1:
             raise ValueError("checkpoint_keep must be >= 1")
+        if self.recovery not in ("retry", "off"):
+            raise ValueError(
+                f"unknown recovery: {self.recovery!r} "
+                "(expected 'retry' or 'off')")
+        if self.max_recovery_attempts < 0:
+            raise ValueError("max_recovery_attempts must be >= 0")
+        if self.checkpoint_retries < 0:
+            raise ValueError("checkpoint_retries must be >= 0")
+        if self.recovery_boost < 1.0:
+            raise ValueError("recovery_boost must be >= 1")
+        if self.health_regression_scale <= 0:
+            raise ValueError("health_regression_scale must be > 0")
         if self.chunk_size < 1:
             raise ValueError("chunk_size must be >= 1")
         if self.pallas_block_b < 1:

@@ -1,0 +1,474 @@
+"""Numerical fault containment: health bitmask + rollback-and-retry recovery.
+
+The reference is fail-fast-or-silent (SURVEY.md SS5.3): a singular
+covariance aborts nothing, a NaN loglik makes the EM loop's
+``|change| > epsilon`` predicate false so the sweep "converges" on a
+poisoned model, and a NaN Rissanen score corrupts best-K selection without
+a trace. This module closes that hole in three layers:
+
+**Device side** -- a health vector of int32 counters (one lane per flag,
+below) rides the jitted EM loop's carry (``models.gmm.em_while_loop``):
+non-finite loglik/params, loglik regression beyond tolerance, empty
+clusters, covariance dynamic-range violations, and the (previously silent)
+count of log-sum-exp lanes sanitized in the E-step. Fatal lanes
+short-circuit the ``lax.while_loop`` condition, so a poisoned run stops
+iterating the moment the poison is observable instead of burning
+``max_iters`` on garbage. On a sharded mesh the lanes aggregate with a
+psum -- sum-is-OR in the nonzero semiring, and because every shard counts
+a disjoint slice (events over ``data``, clusters over ``cluster``) the
+summed counts equal the single-device run's exactly (the psum-OR parity
+contract, tests/test_health.py).
+
+**Host side** -- the sweep driver packs the counters into a flag word
+(:func:`pack_word`), emits ``health`` telemetry for any nonzero word, and
+on a fatal word either raises :class:`NumericalFaultError` with a
+diagnostic bundle (``recovery="off"``) or rolls back to the K's input
+state and retries up the deterministic escalation ladder
+(``recovery="retry"``): sanitize + raise the variance floor ->
+``quad_mode="centered"`` -> ``matmul_precision="highest"``. A successful
+rung's model is adopted for the rest of the sweep (sticky escalation: if
+the stabler numerics fixed it once, keep them). Exhaustion raises with
+the full attempt history.
+
+**Rehearsal** -- every path is testable on demand through the
+deterministic injection points in ``testing.faults``
+(docs/ROBUSTNESS.md).
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from typing import Any, Dict, List, Optional
+
+import numpy as np
+
+# ---------------------------------------------------------------------------
+# Flag lanes. The packed word is OR(1 << lane for lanes with count > 0).
+# ---------------------------------------------------------------------------
+
+NONFINITE_LOGLIK = 0   # fatal: NaN/Inf log-likelihood observed
+NONFINITE_PARAMS = 1   # fatal: NaN/Inf in an active cluster's parameters
+LOGLIK_REGRESSION = 2  # loglik dropped more than regression_scale * epsilon
+EMPTY_CLUSTER = 3      # active cluster with membership below the 0.5 floor
+COV_DYNAMIC_RANGE = 4  # covariance diagonal outside the configured range
+SANITIZED_LANES = 5    # non-finite log-sum-exp lanes sanitized in the E-step
+NONFINITE_SCORE = 6    # NaN/Inf model-order score (selection guard)
+NUM_FLAGS = 7
+
+FLAG_NAMES = (
+    "nonfinite_loglik", "nonfinite_params", "loglik_regression",
+    "empty_cluster", "cov_dynamic_range", "sanitized_lanes",
+    "nonfinite_score",
+)
+
+FATAL_MASK = (1 << NONFINITE_LOGLIK) | (1 << NONFINITE_PARAMS)
+
+# Membership floor below which an active cluster counts as empty/collapsed
+# (the reference's Nk > 0.5 emptiness threshold, gaussian.cu:865-874).
+MEMBERSHIP_FLOOR = 0.5
+
+
+# ---------------------------------------------------------------------------
+# Device-side counters (trace-safe; every function returns an int32
+# [NUM_FLAGS] vector that adds across iterations / shards).
+# ---------------------------------------------------------------------------
+
+def zero_counts():
+    import jax.numpy as jnp
+
+    return jnp.zeros((NUM_FLAGS,), jnp.int32)
+
+
+def _lane(idx: int, count):
+    """An all-zero counter vector with ``count`` in lane ``idx``."""
+    import jax.numpy as jnp
+
+    return jnp.zeros((NUM_FLAGS,), jnp.int32).at[idx].set(
+        jnp.asarray(count, jnp.int32))
+
+
+def em_iter_counts(loglik, loglik_prev=None, regression_tol=None):
+    """Loglik-derived lanes for one EM iteration (trace-safe).
+
+    ``loglik_prev``/``regression_tol`` arm the regression check (EM's
+    loglik is non-decreasing in exact arithmetic; a drop beyond the
+    tolerance is a numerical event worth flagging, though not fatal).
+    """
+    import jax.numpy as jnp
+
+    counts = _lane(NONFINITE_LOGLIK, ~jnp.isfinite(loglik))
+    if loglik_prev is not None and regression_tol is not None:
+        regressed = (jnp.isfinite(loglik) & jnp.isfinite(loglik_prev)
+                     & (loglik < loglik_prev - regression_tol))
+        counts = counts + _lane(LOGLIK_REGRESSION, regressed)
+    return counts
+
+
+def state_counts(state, Nk=None, *, dynamic_range: float = 1e3,
+                 cluster_axis: Optional[str] = None):
+    """Parameter-derived lanes for one state (trace-safe).
+
+    - ``nonfinite_params``: active clusters with any non-finite entry
+      across N/pi/constant/avgvar/means/R/Rinv.
+    - ``empty_cluster``: active clusters whose soft count (``Nk`` when
+      given -- the fresh statistics -- else ``state.N``) is below the
+      reference's 0.5 emptiness floor. Informational: the order search
+      eliminates empties as a matter of course (gaussian.cu:865-874).
+    - ``cov_dynamic_range``: active, non-empty clusters whose covariance
+      diagonal is non-positive or spans more than
+      ``dynamic_range**2`` max/min -- the runtime echo of the reference's
+      COVARIANCE_DYNAMIC_RANGE floor (gaussian.h:12), which bounds exactly
+      this ratio at seed time.
+
+    When the cluster axis is sharded each shard checks only its rows;
+    the psum over ``cluster_axis`` restores the global counts (each shard
+    holds a disjoint slice, so the sum is exact, and the result is
+    replicated -- the psum-OR aggregation of the module docstring).
+    """
+    import jax.numpy as jnp
+    from jax import lax
+
+    act = state.active
+    nk = state.N if Nk is None else Nk
+
+    row_bad = ~(
+        jnp.isfinite(state.N) & jnp.isfinite(state.pi)
+        & jnp.isfinite(state.constant) & jnp.isfinite(state.avgvar)
+        & jnp.all(jnp.isfinite(state.means), axis=-1)
+        & jnp.all(jnp.isfinite(state.R), axis=(-2, -1))
+        & jnp.all(jnp.isfinite(state.Rinv), axis=(-2, -1))
+    )
+    n_nonfinite = jnp.sum(act & row_bad, dtype=jnp.int32)
+
+    n_empty = jnp.sum(act & (nk < MEMBERSHIP_FLOOR), dtype=jnp.int32)
+
+    diag = jnp.diagonal(state.R, axis1=-2, axis2=-1)  # [K, D]
+    dmax = jnp.max(diag, axis=-1)
+    dmin = jnp.min(diag, axis=-1)
+    nonempty = act & (nk >= MEMBERSHIP_FLOOR)
+    ratio_bad = (dmin <= 0.0) | (dmax > (dynamic_range ** 2)
+                                 * jnp.maximum(dmin, 1e-300))
+    # Non-finite diagonals already count under nonfinite_params; keep the
+    # two lanes disjoint so their sum is interpretable.
+    ratio_bad = ratio_bad & jnp.all(jnp.isfinite(diag), axis=-1)
+    n_range = jnp.sum(nonempty & ratio_bad, dtype=jnp.int32)
+
+    counts = (_lane(NONFINITE_PARAMS, n_nonfinite)
+              + _lane(EMPTY_CLUSTER, n_empty)
+              + _lane(COV_DYNAMIC_RANGE, n_range))
+    if cluster_axis is not None:
+        counts = lax.psum(counts, cluster_axis)
+    return counts
+
+
+def fatal(counts):
+    """Trace-safe scalar bool: any fatal lane nonzero."""
+    return (counts[NONFINITE_LOGLIK] > 0) | (counts[NONFINITE_PARAMS] > 0)
+
+
+def pack_word_traced(counts):
+    """Trace-safe sibling of :func:`pack_word`: int32 flag word on device
+    (the fused sweep stores one per K in its device log)."""
+    import jax.numpy as jnp
+
+    lanes = jnp.asarray([1 << b for b in range(NUM_FLAGS)], jnp.int32)
+    return jnp.sum((counts > 0) * lanes, dtype=jnp.int32)
+
+
+# ---------------------------------------------------------------------------
+# Host-side word packing / description.
+# ---------------------------------------------------------------------------
+
+def pack_word(counts) -> int:
+    """Pack a counter vector into the int flag word (host-side)."""
+    c = np.asarray(counts).reshape(-1)
+    word = 0
+    for lane in range(min(c.shape[0], NUM_FLAGS)):
+        if c[lane] > 0:
+            word |= 1 << lane
+    return word
+
+
+def word_is_fatal(word: int) -> bool:
+    return bool(int(word) & FATAL_MASK)
+
+
+def flag_names(word: int) -> List[str]:
+    return [name for lane, name in enumerate(FLAG_NAMES)
+            if int(word) & (1 << lane)]
+
+
+def counts_dict(counts) -> Dict[str, int]:
+    c = np.asarray(counts).reshape(-1)
+    return {name: int(c[lane]) for lane, name in enumerate(FLAG_NAMES)
+            if lane < c.shape[0] and c[lane]}
+
+
+def health_summary(total_counts, recoveries: int = 0,
+                   io_retries: int = 0) -> Dict[str, Any]:
+    """The ``run_summary.health`` section / ``GMMResult.health`` payload."""
+    word = pack_word(total_counts)
+    return {
+        "flags": int(word),
+        "flag_names": flag_names(word),
+        "fatal": word_is_fatal(word),
+        "counters": counts_dict(total_counts),
+        "recoveries": int(recoveries),
+        "io_retries": int(io_retries),
+    }
+
+
+class NumericalFaultError(RuntimeError):
+    """A numerical fault was detected and could not (or must not) be
+    recovered. Carries the diagnostic ``bundle``: the flag word and
+    per-lane counters, the sweep position, and -- after an exhausted
+    escalation ladder -- the full per-attempt history."""
+
+    def __init__(self, message: str, bundle: Dict[str, Any]):
+        self.bundle = bundle
+        lines = [message]
+        for key in sorted(bundle):
+            lines.append(f"  {key}: {bundle[key]}")
+        super().__init__("\n".join(lines))
+
+
+def fault_bundle(counts, *, k=None, where: str = "em",
+                 attempts: Optional[list] = None,
+                 config=None) -> Dict[str, Any]:
+    word = pack_word(counts)
+    bundle: Dict[str, Any] = {
+        "flags": int(word),
+        "flag_names": flag_names(word),
+        "counters": counts_dict(counts),
+        "where": where,
+    }
+    if k is not None:
+        bundle["k"] = int(k)
+    if attempts is not None:
+        bundle["attempts"] = attempts
+    if config is not None:
+        bundle["config"] = {
+            "quad_mode": config.quad_mode,
+            "matmul_precision": config.matmul_precision,
+            "dtype": config.dtype,
+            "covariance_type": config.covariance_type,
+            "recovery": config.recovery,
+        }
+    return bundle
+
+
+# ---------------------------------------------------------------------------
+# Rollback-and-retry recovery (host side).
+# ---------------------------------------------------------------------------
+
+def escalation_ladder(config) -> List[Dict[str, Any]]:
+    """The deterministic recovery ladder, bounded by
+    ``max_recovery_attempts``. Every rung first rolls back to the K's
+    input state and sanitizes it (non-finite entries cleared, non-PD
+    covariances identity-reset, variance floor raised by
+    ``recovery_boost`` per attempt); rungs 2/3 additionally rebuild the
+    model with progressively stabler numerics."""
+    rungs = [
+        {"action": "regularize"},
+        {"action": "centered", "quad_mode": "centered"},
+        {"action": "highest", "quad_mode": "centered",
+         "matmul_precision": "highest"},
+    ]
+    return rungs[:max(0, int(config.max_recovery_attempts))]
+
+
+def repair_state(state, *, diag_only: bool = False, boost: float = 1.0):
+    """Sanitize a (host-local) rollback state for a retry.
+
+    Non-finite entries are cleared, the variance floor (``avgvar``, the
+    reference's COVARIANCE_DYNAMIC_RANGE diagonal loading) is raised by
+    ``boost``, and ``compute_constants`` re-derives Rinv/constant/pi --
+    which also identity-resets any covariance whose factorization fails
+    (the reference's empty-cluster reset, gaussian.cu:669-678), i.e. it
+    repairs singular covariances in the same move.
+    """
+    import jax.numpy as jnp
+
+    from .ops.constants import compute_constants
+
+    def fin(a, fill=0.0):
+        return jnp.where(jnp.isfinite(a), a, fill)
+
+    st = state.replace(
+        N=fin(state.N),
+        pi=fin(state.pi, 1e-10),
+        avgvar=fin(state.avgvar) * jnp.asarray(boost, state.avgvar.dtype),
+        means=fin(state.means),
+        R=fin(state.R),
+        constant=fin(state.constant),
+        Rinv=fin(state.Rinv),
+    )
+    return compute_constants(st, diag_only=diag_only)
+
+
+def rung_model(model, config, rung: Dict[str, Any]):
+    """The model to run a recovery rung on: the primary model for the
+    pure-regularization rung, else a same-class rebuild with the rung's
+    numerics overrides (cached per rung on the primary model, so a sweep
+    that recovers at the same rung repeatedly compiles once)."""
+    overrides: Dict[str, Any] = {}
+    if "quad_mode" in rung and config.quad_mode != rung["quad_mode"]:
+        overrides["quad_mode"] = rung["quad_mode"]
+    if ("matmul_precision" in rung
+            and config.matmul_precision != rung["matmul_precision"]):
+        overrides["matmul_precision"] = rung["matmul_precision"]
+    if not overrides:
+        return model, config
+    if config.precompute_features and overrides.get("quad_mode") == "centered":
+        # 'centered' has no loop-invariant feature matrix to hoist
+        # (config validation rejects the combination).
+        overrides["precompute_features"] = False
+    if config.use_pallas == "always":
+        # Recovery wants the most-conservative path; the kernel override
+        # must not pin the escalated run back onto experimental code.
+        overrides["use_pallas"] = "never"
+    cfg2 = dataclasses.replace(config, **overrides)
+
+    cache = model.__dict__.setdefault("_recovery_models", {})
+    key = tuple(sorted(overrides.items()))
+    m2 = cache.get(key)
+    if m2 is None:
+        from .models.gmm import GMMModel
+        from .models.streaming import StreamingGMMModel
+
+        if isinstance(model, StreamingGMMModel):
+            m2 = StreamingGMMModel(cfg2)
+        elif isinstance(model, GMMModel):
+            m2 = GMMModel(cfg2)
+        else:  # ShardedGMMModel: keep the SAME mesh (placed data stays valid)
+            m2 = type(model)(cfg2, mesh=model.mesh)
+        cache[key] = m2
+    return m2, cfg2
+
+
+def _host_state(state, model):
+    """Host-local numpy copy of a possibly mesh-placed / multi-host state."""
+    from .models.order_search import _host_state as impl
+
+    return impl(state, model)
+
+
+def recover_em(model, config, rollback, chunks, wts, epsilon, k, *,
+               trajectory: bool, rec, log, faulty_counts):
+    """Roll back and retry one K's EM up the escalation ladder.
+
+    Returns ``(model, state, loglik, iters, counts, ll_log)`` from the
+    first clean rung; the returned model is the rung's (callers adopt it
+    for the rest of the sweep -- sticky escalation). Raises
+    :class:`NumericalFaultError` when recovery is off, the ladder is
+    empty, or every rung stays fatal.
+    """
+    import jax
+    import jax.numpy as jnp
+
+    word = pack_word(faulty_counts)
+    if config.recovery != "retry":
+        raise NumericalFaultError(
+            f"numerical fault at K={int(k)} "
+            f"(flags={flag_names(word)}) and recovery is "
+            f"{config.recovery!r}",
+            fault_bundle(faulty_counts, k=k, config=config))
+
+    ladder = escalation_ladder(config)
+    attempts: List[Dict[str, Any]] = []
+    host_rollback = jax.tree_util.tree_map(
+        jnp.asarray, _host_state(rollback, model))
+    for attempt, rung in enumerate(ladder, start=1):
+        m2, cfg2 = rung_model(model, config, rung)
+        boost = float(config.recovery_boost) ** attempt
+        repaired = repair_state(host_rollback, diag_only=cfg2.diag_only,
+                                boost=boost)
+        if hasattr(m2, "prepare_state"):
+            repaired = m2.prepare_state(repaired)
+        out = m2.run_em(repaired, chunks, wts, epsilon,
+                        trajectory=trajectory)
+        if trajectory:
+            state, ll, iters, ll_log = out
+        else:
+            (state, ll, iters), ll_log = out, None
+        counts = np.asarray(jax.device_get(m2.last_health), np.int64)
+        ll_f, iters_i = float(jax.device_get(ll)), int(jax.device_get(iters))
+        ok = not word_is_fatal(pack_word(counts))
+        record = {
+            "attempt": attempt, "action": rung["action"], "boost": boost,
+            "flags": int(pack_word(counts)),
+            "flag_names": flag_names(pack_word(counts)),
+            "outcome": "recovered" if ok else "fatal",
+            "loglik": ll_f,
+        }
+        attempts.append(record)
+        if log is not None:
+            log.warning(
+                "recovery attempt %d (%s) at K=%d: %s", attempt,
+                rung["action"], int(k), record["outcome"])
+        if rec is not None and rec.active:
+            rec.emit("recovery", k=int(k), attempt=attempt,
+                     action=rung["action"], outcome=record["outcome"],
+                     flags=record["flags"],
+                     flag_names=record["flag_names"])
+            rec.metrics.count("recovery_attempts")
+            if ok:
+                rec.metrics.count("recoveries")
+        if ok:
+            return m2, state, ll_f, iters_i, counts, ll_log
+    raise NumericalFaultError(
+        f"numerical fault at K={int(k)} not recovered after "
+        f"{len(ladder)} escalation attempt(s) "
+        f"(flags={flag_names(word)})",
+        fault_bundle(faulty_counts, k=k, attempts=attempts, config=config))
+
+
+def reseed_empty_clusters(model, state, chunks, seed: int = 0):
+    """Reseed empty active clusters from the worst-fit events.
+
+    The reference ELIMINATES empties (gaussian.cu:865-874) -- that stays
+    the default. With ``recovery_reseed_empty`` a target-K fit instead
+    relocates each empty cluster's mean onto the events the current model
+    explains worst (lowest log-evidence in the probe block), giving EM a
+    chance to keep the requested K alive. Deterministic: the probe is the
+    first data block and ties resolve by row order. Returns
+    ``(new_state, n_reseeded)``.
+    """
+    import jax
+    import jax.numpy as jnp
+
+    host = jax.tree_util.tree_map(jnp.asarray, _host_state(state, model))
+    act = np.asarray(host.active)
+    nk = np.asarray(host.N)
+    empty = np.flatnonzero(act & (nk < MEMBERSHIP_FLOOR))
+    if empty.size == 0:
+        return state, 0
+
+    block = np.asarray(jax.device_get(chunks))
+    block = block.reshape(-1, block.shape[-1])[:model.inference_block]
+    _, logz = model.infer_posteriors(host, block)
+    logz = np.asarray(jax.device_get(logz))[:block.shape[0]]
+    worst = np.argsort(logz, kind="stable")[:empty.size]
+
+    means = np.asarray(host.means).copy()
+    R = np.asarray(host.R).copy()
+    N = np.asarray(host.N).copy()
+    live = np.flatnonzero(act & (nk >= MEMBERSHIP_FLOOR))
+    # A fresh covariance for the reseeded slots: the mean live covariance
+    # (identity if nothing is live), so the new cluster starts wide enough
+    # to capture neighbors of its worst-fit seed event.
+    R_seed = (R[live].mean(axis=0) if live.size
+              else np.eye(R.shape[-1], dtype=R.dtype))
+    for slot, row in zip(empty, worst):
+        means[slot] = block[row]
+        R[slot] = R_seed
+        N[slot] = 1.0
+    from .ops.constants import compute_constants
+
+    repaired = host.replace(
+        means=jnp.asarray(means), R=jnp.asarray(R), N=jnp.asarray(N))
+    repaired = compute_constants(repaired,
+                                 diag_only=model.config.diag_only)
+    if hasattr(model, "prepare_state"):
+        repaired = model.prepare_state(repaired)
+    return repaired, int(empty.size)

@@ -42,6 +42,7 @@ import jax.experimental
 import jax.numpy as jnp
 from jax import lax
 
+from .. import health
 from ..ops.formulas import model_score
 from ..ops.merge import eliminate_and_reduce
 from .gmm import em_while_loop
@@ -74,12 +75,24 @@ def fused_sweep(
     emit_light: bool = False,
     emit_gather_fn: Optional[Callable] = None,
     precompute_features: bool = False,
+    dynamic_range: float = 1e3,
+    regression_scale: float = 10.0,
 ):
     """Run the whole K-sweep on device.
 
-    Returns ``(best_state, best_ll, best_riss, log, steps)`` where ``log``
-    is a [start_k, 4] array of per-K rows ``(k, loglik, rissanen, em_iters)``
-    (rows beyond ``steps`` are zero).
+    Returns ``(best_state, best_ll, best_riss, log, steps, health)`` where
+    ``log`` is a [start_k, 5] array of per-K rows ``(k, loglik, rissanen,
+    em_iters, health_word)`` (rows beyond ``steps`` are zero) and
+    ``health`` the sweep's cumulative int32 counter vector (health.py).
+    A FATAL per-K health word (non-finite loglik/params -- em_while_loop
+    already short-circuited that K's EM) also stops the sweep: iterating
+    the order reduction on a poisoned state only spreads the poison, and
+    the host driver recovers by falling back to the host-driven sweep's
+    rollback-and-retry ladder (a single device program has no per-K host
+    intervention point). A non-finite score can never capture the
+    best-model slot (NaN compares false both ways, so an unguarded
+    step-0 save or a poisoned ``<`` would silently corrupt selection --
+    the NONFINITE_SCORE health lane records the skip).
 
     ``reduce_order_fn(state) -> (new_state, k_active, min_d)`` overrides the
     order-reduction step -- the hook through which the cluster-sharded path
@@ -124,6 +137,8 @@ def fused_sweep(
             cluster_axis=cluster_axis, stats_fn=stats_fn,
             covariance_type=covariance_type,
             precompute_features=precompute_features,
+            dynamic_range=dynamic_range,
+            regression_scale=regression_scale,
         )
 
     zero = jnp.zeros((), dtype)
@@ -133,9 +148,10 @@ def fused_sweep(
         best_state=state,
         best_ll=zero,
         best_riss=jnp.asarray(jnp.inf, score_dtype),
-        log=jnp.zeros((start_k, 4), dtype),
+        log=jnp.zeros((start_k, 5), dtype),
         step=jnp.asarray(0, jnp.int32),
         done=jnp.asarray(False),
+        health=jnp.zeros((health.NUM_FLAGS,), jnp.int32),
     )
     if resume is not None:
         carry0.update(
@@ -152,22 +168,29 @@ def fused_sweep(
 
     def body(c):
         k = c["k"]
-        s, ll, iters = em(c["state"])
+        s, ll, iters, h_k = em(c["state"])
         riss = riss_of(ll, k)
+        # A non-finite score must neither win (NaN < best is false, fine)
+        # nor be saved by the unconditional step-0 rule -- flag it instead.
+        score_ok = jnp.isfinite(riss)
+        h_k = h_k.at[health.NONFINITE_SCORE].add(
+            (~score_ok).astype(jnp.int32))
+        fatal_k = health.fatal(h_k)
 
         # Best-model save rule (gaussian.cu:839): first K, or better rissanen
-        # with no target, or K equals the target.
+        # with no target, or K equals the target -- and a finite score.
         save = (
             (c["step"] == 0)
             | ((riss < c["best_riss"]) & (target_k == 0))
             | (k == target_k)
-        )
+        ) & score_ok
         best_state = jax.tree_util.tree_map(
             lambda new, old: jnp.where(save, new, old), s, c["best_state"]
         )
         log = c["log"].at[c["step"]].set(
             jnp.stack([k.astype(dtype), ll.astype(dtype), riss.astype(dtype),
-                       iters.astype(dtype)])
+                       iters.astype(dtype),
+                       health.pack_word_traced(h_k).astype(dtype)])
         )
 
         stop_now = k <= stop_number
@@ -179,8 +202,10 @@ def fused_sweep(
         # The host loop re-checks `k >= stop_number` at the top after
         # merging: if elimination dropped the count below the target there
         # is no EM run at that K. Mirror it here or the fused path would run
-        # one extra EM below the target.
-        cont = (~stop_now) & can_merge & (k_active - 1 >= stop_number)
+        # one extra EM below the target. A fatal health word also ends the
+        # sweep (the host driver takes over recovery).
+        cont = (~stop_now) & can_merge & (k_active - 1 >= stop_number) \
+            & ~fatal_k
         new_state = jax.tree_util.tree_map(
             lambda a, b: jnp.where(cont, a, b), next_state, s
         )
@@ -193,6 +218,7 @@ def fused_sweep(
             log=log,
             step=c["step"] + 1,
             done=~cont,
+            health=c["health"] + h_k,
         )
         if emit_cb is not None:
             # Per-K host emission (checkpoint payload + log row).
@@ -211,6 +237,7 @@ def fused_sweep(
                     log=log,
                     next_k=new_carry["k"],
                     done=new_carry["done"],
+                    health=h_k,  # this K's health counters ride the emission
                 )
             # ``ordered=True`` sequences callbacks but does NOT make the
             # device wait for them -- an enqueued-only emission could drain
@@ -231,5 +258,5 @@ def fused_sweep(
     out = lax.while_loop(cond, body, carry0)
     return (
         out["best_state"], out["best_ll"], out["best_riss"],
-        out["log"], out["step"],
+        out["log"], out["step"], out["health"],
     )

@@ -32,9 +32,11 @@ from jax import lax
 
 import numpy as np
 
+from .. import health
 from ..config import GMMConfig
 from ..ops.mstep import SuffStats, accumulate_stats, apply_mstep
 from ..ops.estep import posteriors
+from ..testing import faults
 
 
 ReduceFn = Callable[[SuffStats], SuffStats]
@@ -134,6 +136,11 @@ class GMMModel:
         self.config = config
         self.reduce_stats = reduce_stats
         self._emit_target = None  # host sink for fused-sweep per-K emission
+        # Health counters of the most recent run_em (device int32
+        # [health.NUM_FLAGS]): the EM loop computes them in-carry and
+        # run_em stashes them here, keeping the (state, loglik, iters)
+        # return contract intact for existing callers.
+        self.last_health = None
 
         kw = dict(
             diag_only=config.diag_only,
@@ -189,6 +196,8 @@ class GMMModel:
                     covariance_type=self.config.covariance_type,
                     precompute_features=self.config.precompute_features,
                     trajectory_len=trajectory_len,
+                    dynamic_range=self.config.covariance_dynamic_range,
+                    regression_scale=self.config.health_regression_scale,
                     **self._kw),
                 donate_argnums=(0,) if donate else (),
             )
@@ -216,14 +225,21 @@ class GMMModel:
         support donation) -- the model-order sweep opts in because its
         carry is rebound every K; default off so library callers keep the
         safe aliasing-free semantics.
+
+        The run's health counters (non-finite loglik/params, regressions,
+        sanitized lanes...; health.py lane table) land on
+        ``self.last_health`` as a device int32 vector -- the return tuple
+        keeps its historical shape.
         """
         lo, hi = resolve_iters(self.config, min_iters, max_iters)
         run = self._em_executable(
             int(self.config.max_iters) if trajectory else 0, donate)
-        return run(
+        out = run(
             state, data_chunks, wts_chunks,
             jnp.asarray(epsilon, data_chunks.dtype), lo, hi,
         )
+        self.last_health = out[-1]
+        return out[:-1]
 
     def rebucket_state(self, state, num_clusters: int):
         """Compact ``state`` to a narrower padded width on device (the
@@ -325,6 +341,8 @@ def em_while_loop(
     covariance_type: str | None = None,
     precompute_features: bool = False,
     trajectory_len: int = 0,
+    dynamic_range: float = 1e3,
+    regression_scale: float = 10.0,
 ):
     """The whole per-K EM algorithm as one traced program.
 
@@ -353,9 +371,31 @@ def em_while_loop(
     E-step's loglik, slot i+1 iteration i's; unwritten slots are NaN, and
     iterations beyond the buffer are dropped (not an error), so a dynamic
     ``max_iters`` above the static buffer stays safe.
+
+    **Health containment** (health.py): an int32 [NUM_FLAGS] counter
+    vector rides the carry -- non-finite loglik/params, loglik regression
+    beyond ``regression_scale * epsilon``, empty clusters, covariance
+    dynamic-range violations (``dynamic_range``), and the E-step's
+    sanitized-lane count (SuffStats.sanitized). FATAL lanes (non-finite
+    loglik or params) short-circuit the while-loop condition: a poisoned
+    run stops at the iteration the poison became observable instead of
+    "converging" through the NaN-compares-false hole the reference has
+    (``|change| > epsilon`` is false for NaN change, gaussian.cu:532).
+    The convergence predicate itself is also spelled NaN-safe
+    (``~(|change| <= epsilon)`` treats a non-finite change as
+    NOT-converged). The counters are appended as the LAST element of the
+    return tuple; on a sharded mesh they come out replicated (psum-OR
+    aggregation: events over ``data`` through the stats psum, clusters
+    over ``cluster`` inside health.state_counts).
     """
     kw = dict(diag_only=diag_only, quad_mode=quad_mode,
               matmul_precision=matmul_precision, cluster_axis=cluster_axis)
+
+    # Deterministic fault injection (testing.faults): consumed at TRACE
+    # time, so the armed executable reproduces the fault on every reuse
+    # while a rebuilt (recovery-escalated) model traces clean.
+    _inj_nan = faults.take("nan_loglik")
+    _inj_nan_iter = int(_inj_nan["iter"]) if _inj_nan else None
 
     feats = None
     if (precompute_features and stats_fn is None and not diag_only
@@ -378,6 +418,19 @@ def em_while_loop(
                                      feats_chunks=feats, **kw)
         return reduce_stats(stats) if reduce_stats else stats
 
+    def health_counts(s, stats, ll, ll_prev=None):
+        reg_tol = (regression_scale * jnp.asarray(epsilon)
+                   if ll_prev is not None else None)
+        return (
+            health.em_iter_counts(ll, ll_prev, reg_tol)
+            + health.state_counts(s, Nk=stats.Nk,
+                                  dynamic_range=dynamic_range,
+                                  cluster_axis=cluster_axis)
+            + jnp.zeros((health.NUM_FLAGS,), jnp.int32)
+                 .at[health.SANITIZED_LANES]
+                 .set(stats.sanitized.astype(jnp.int32))
+        )
+
     stats0 = estep(state)  # initial E-step (gaussian.cu:487-516)
     change0 = jnp.asarray(2.0, stats0.loglik.dtype) * epsilon + 1.0  # :525
     if trajectory_len:
@@ -386,28 +439,41 @@ def em_while_loop(
         ll_log0 = ll_log0.at[0].set(stats0.loglik)
     else:
         ll_log0 = jnp.zeros((0,), stats0.loglik.dtype)
+    h0 = health_counts(state, stats0, stats0.loglik)
     carry0 = (state, stats0, stats0.loglik, change0,
-              jnp.asarray(0, jnp.int32), ll_log0)
+              jnp.asarray(0, jnp.int32), ll_log0, h0)
 
     def cond(carry):
-        _, _, _, change, iters, _ = carry
-        return (iters < min_iters) | (
-            (jnp.abs(change) > epsilon) & (iters < max_iters)
-        )  # gaussian.cu:532
+        _, _, _, change, iters, _, h = carry
+        # Fatal health flags short-circuit the loop: iterating on a
+        # poisoned carry only launders the NaN deeper into the model.
+        # ~(|change| <= eps) is the NaN-safe spelling of |change| > eps: a
+        # non-finite change reads as NOT converged (gaussian.cu:532's
+        # predicate is false for NaN, which made the reference "converge"
+        # on poison at min_iters).
+        return (~health.fatal(h)) & (
+            (iters < min_iters) | (
+                ~(jnp.abs(change) <= epsilon) & (iters < max_iters))
+        )
 
     def body(carry):
-        s, stats, ll_old, _, iters, ll_log = carry
+        s, stats, ll_old, _, iters, ll_log, h = carry
         s = apply_mstep(s, stats, diag_only=diag_only,
                         cluster_axis=cluster_axis,
                         covariance_type=covariance_type)  # :541-701
         stats_new = estep(s)  # :713-741
         ll = stats_new.loglik
+        if _inj_nan_iter is not None:
+            ll = jnp.where(iters + 1 == _inj_nan_iter,
+                           jnp.asarray(jnp.nan, ll.dtype), ll)
         if trajectory_len:
             # mode='drop': dynamic max_iters can exceed the static buffer.
             ll_log = ll_log.at[iters + 1].set(ll, mode="drop")
-        return (s, stats_new, ll, ll - ll_old, iters + 1, ll_log)  # :748-751
+        h = h + health_counts(s, stats_new, ll, ll_old)
+        return (s, stats_new, ll, ll - ll_old, iters + 1, ll_log,
+                h)  # :748-751
 
-    s, _, ll, _, iters, ll_log = lax.while_loop(cond, body, carry0)
+    s, _, ll, _, iters, ll_log, h = lax.while_loop(cond, body, carry0)
     if trajectory_len:
-        return s, ll, iters, ll_log
-    return s, ll, iters
+        return s, ll, iters, ll_log, h
+    return s, ll, iters, h

@@ -25,13 +25,15 @@ import jax
 import jax.numpy as jnp
 import numpy as np
 
+from .. import health
 from ..config import GMMConfig
 from ..ops.formulas import convergence_epsilon, model_score
 from ..validation import InvalidInputError, validate_finite
 from ..ops.merge import eliminate_and_reduce
-from ..state import GMMState, bucket_width, compact
+from ..state import GMMState, bucket_width, clone_state, compact
 from .. import telemetry
 from ..telemetry import RunRecorder
+from ..testing import faults
 from ..utils.logging_ import get_logger, metrics_line
 from ..utils.profiling import PhaseTimer
 from .gmm import GMMModel, chunk_events
@@ -135,8 +137,55 @@ def _emit_em_iters(rec, k, ll_log, iters, dt, epsilon, model):
                  timing="measured" if measured else "amortized")
 
 
+def _reseed_and_refit(model, config, state, chunks, wts, epsilon, k,
+                      want_traj, rec, log, primary):
+    """Reseed empty clusters from worst-fit events and refit at the same K
+    (``recovery_reseed_empty``; bounded by ``max_recovery_attempts``).
+
+    Returns the refit ``(state, loglik, iters, counts, ll_log)`` once the
+    empties are gone (or the attempt budget is spent); a refit that goes
+    FATAL discards itself and returns the pre-reseed result -- reseeding
+    is an improvement pass, never a correctness risk.
+    """
+    ll_f, iters_i, counts_np, ll_log = primary
+    best = (state, ll_f, iters_i, counts_np, ll_log)
+    for attempt in range(1, config.max_recovery_attempts + 1):
+        state2, n_reseeded = health.reseed_empty_clusters(model, best[0],
+                                                         chunks)
+        if not n_reseeded:
+            break
+        out = model.run_em(state2, chunks, wts, epsilon,
+                           trajectory=want_traj)
+        if want_traj:
+            new_state, ll, iters_a, ll_log_a = out
+        else:
+            (new_state, ll, iters_a), ll_log_a = out, None
+        counts_a = np.asarray(jax.device_get(model.last_health), np.int64)
+        ll_a = float(jax.device_get(ll))
+        fatal_a = health.word_is_fatal(health.pack_word(counts_a))
+        outcome = ("fatal" if fatal_a
+                   else "recovered" if counts_a[health.EMPTY_CLUSTER] == 0
+                   else "retry")
+        log.info("reseeded %d empty cluster(s) at K=%d (attempt %d): %s",
+                 n_reseeded, int(k), attempt, outcome)
+        if rec.active:
+            rec.emit("recovery", k=int(k), attempt=attempt,
+                     action="reseed_empty", outcome=outcome,
+                     flags=int(health.pack_word(counts_a)),
+                     flag_names=health.flag_names(
+                         health.pack_word(counts_a)))
+            rec.metrics.count("reseeds")
+        if fatal_a:
+            return best
+        best = (new_state, ll_a, np.asarray(int(jax.device_get(iters_a))),
+                counts_a, ll_log_a)
+        if counts_a[health.EMPTY_CLUSTER] == 0:
+            break
+    return best
+
+
 def _emit_run_summary(rec, config, timer, sweep_log, ideal_k, best_score,
-                      best_ll, em_walls, buckets=None):
+                      best_ll, em_walls, buckets=None, health_section=None):
     """Final ``run_summary`` record: scores, 7-category phase profile,
     compile/execute split, metrics-registry snapshot, and (multi-host)
     every rank's snapshot gathered to the one stream process 0 writes.
@@ -157,6 +206,7 @@ def _emit_run_summary(rec, config, timer, sweep_log, ideal_k, best_score,
     warm = min(em_walls[1:]) if len(em_walls) > 1 else None
     fields = dict(
         **({"buckets": buckets} if buckets is not None else {}),
+        **({"health": health_section} if health_section is not None else {}),
         ideal_k=int(ideal_k),
         score=float(best_score),
         criterion=config.criterion,
@@ -223,6 +273,10 @@ class GMMResult:
     # per-host slices; single-host = (0, num_events)). The output path uses
     # it to recompute exactly this host's memberships.
     host_range: Optional[tuple] = None
+    # Numerical-health summary of the run (health.health_summary): packed
+    # flag word + per-lane counters aggregated over every K, recovery and
+    # checkpoint-retry counts. A clean run reads {"flags": 0, ...}.
+    health: Optional[dict] = None
     # The fitted model (jitted executables already built) so the output path
     # reuses compiled posteriors instead of building a fresh GMMModel.
     model: Optional[object] = dataclasses.field(default=None, repr=False)
@@ -422,8 +476,12 @@ def _fit_gmm(data, num_clusters, target_num_clusters, config, model,
         # checkpoint_dir on a filesystem every rank can read (on TPU pods
         # that is GCS/NFS by construction; docs/DISTRIBUTED.md).
         ckpt = SweepCheckpointer(config.checkpoint_dir,
-                                 keep=config.checkpoint_keep)
+                                 keep=config.checkpoint_keep,
+                                 retries=config.checkpoint_retries)
 
+    # Health counters observed by a fused sweep that aborted on a fatal
+    # word (the host-driven rerun below folds them into its summary).
+    fused_fatal_counts = None
     if config.fused_sweep:
         # Checkpointing AND profiling both ride the per-K io_callback
         # emission (plain single-controller models); other combinations
@@ -453,12 +511,25 @@ def _fit_gmm(data, num_clusters, target_num_clusters, config, model,
                 # Profiling-only emission needs just the step scalars.
                 kwargs["emit_light"] = ckpt is None
             fused = maker(**kwargs)
-            return _run_fused_sweep(
+            fused_result = _run_fused_sweep(
                 fused, config, state, chunks, wts, epsilon,
                 num_clusters, stop_number, target_num_clusters,
                 n_events, n_dims, shift, verbose, host_range, model,
                 ckpt=ckpt, log=log, timer=timer,
             )
+            if isinstance(fused_result, GMMResult):
+                return fused_result
+            # A counter vector instead of a result = the device program
+            # stopped on a FATAL health word (recovery='retry'): a single
+            # device program has no per-K host intervention point, so
+            # recovery means rerunning through the host-driven sweep
+            # below, whose rollback-and-retry ladder handles the fault
+            # per K. (recovery='off' raised instead.) The observed
+            # counters fold into the rerun's run_summary.health.
+            fused_fatal_counts = np.asarray(fused_result, np.int64)
+            log.warning(
+                "fused sweep aborted on a fatal numerical fault; "
+                "re-running via the host-driven sweep's recovery ladder")
 
     # One fused dispatch for the whole order-reduction step, so each K costs
     # a single blocking device->host sync (see eliminate_and_reduce).
@@ -515,10 +586,26 @@ def _fit_gmm(data, num_clusters, target_num_clusters, config, model,
 
     want_traj = rec.active  # per-iteration loglik log rides the EM call
     em_walls = []  # per-K EM wall seconds (first includes compile)
+    # Numerical fault containment (health.py): per-K health counters are
+    # fetched alongside the sweep's decision scalars; a fatal word rolls
+    # back to this K's input state and climbs the escalation ladder
+    # (recovery='retry') or raises with a diagnostic bundle ('off').
+    recovery_on = config.recovery == "retry"
+    health_totals = np.zeros((health.NUM_FLAGS,), np.int64)
+    n_recoveries = 0
+    if fused_fatal_counts is not None:
+        # The aborted fused sweep's observed fault + its host_fallback
+        # recovery action (the 'recovery' event was already emitted).
+        health_totals += fused_fatal_counts
+        n_recoveries += 1
     while k >= stop_number:
         t0 = time.perf_counter()
         last_k = k <= stop_number
         em_widths.append(int(state.num_clusters_padded))
+        # Rollback point: run_em(donate=True) consumes the input state's
+        # buffers, so recovery needs a clone taken first (async device
+        # copy, one parameter-set of HBM).
+        rollback = clone_state(state) if recovery_on else None
         with phase("e_step"):  # fused E+M loop (m_step/constants folded in)
             # donate=True: the EM carry is rebound every K, so the input
             # state's buffers are handed to the device for in-place reuse
@@ -531,11 +618,13 @@ def _fit_gmm(data, num_clusters, target_num_clusters, config, model,
                 ll_log = None
                 state, ll, iters = model.run_em(state, chunks, wts, epsilon,
                                                 donate=True)
+            hw = model.last_health
             if timer or last_k:
                 # Block on EM here so the e_step phase (and sweep_log's
                 # seconds) measure EM alone. Profiling trades away the
                 # fused single-sync optimization below for attribution.
-                ll_f, iters_i = map(np.asarray, jax.device_get((ll, iters)))
+                ll_f, iters_i, counts_i = map(
+                    np.asarray, jax.device_get((ll, iters, hw)))
                 dt = time.perf_counter() - t0  # EM-only (synced above)
         if not last_k:
             # Order reduction (gaussian.cu:857-952): dispatch the fused
@@ -549,14 +638,82 @@ def _fit_gmm(data, num_clusters, target_num_clusters, config, model,
                         np.asarray, jax.device_get((k_active, min_d, pair))
                     )
                 else:
-                    ll_f, iters_i, k_active_i, min_d_f, pair_i = map(
+                    (ll_f, iters_i, counts_i, k_active_i, min_d_f,
+                     pair_i) = map(
                         np.asarray,
-                        jax.device_get((ll, iters, k_active, min_d, pair)),
+                        jax.device_get((ll, iters, hw, k_active, min_d,
+                                        pair)),
                     )
         ll_f = float(ll_f)
+        counts_np = np.asarray(counts_i, np.int64)
+        if health.word_is_fatal(health.pack_word(counts_np)):
+            # The observed fault goes into the totals and the event stream
+            # BEFORE recovery overwrites counts_np with the retried run's
+            # (usually clean) counters: run_summary.health must record
+            # what was seen, recoveries how it was handled.
+            health_totals += counts_np
+            fatal_word = health.pack_word(counts_np)
+            if rec.active:
+                rec.emit("health", k=int(k), where="em",
+                         flags=int(fatal_word),
+                         flag_names=health.flag_names(fatal_word),
+                         counters=health.counts_dict(counts_np))
+                rec.metrics.count("health_events")
+            # Fatal fault: roll back and retry up the escalation ladder
+            # (raises NumericalFaultError when recovery is off or the
+            # ladder is exhausted). The rung's model is adopted for the
+            # rest of the sweep (sticky escalation); the already-dispatched
+            # order reduction ran on the poisoned state, so redo it.
+            model, state, ll_f, iters_i, counts_np, ll_log = \
+                health.recover_em(
+                    model, config, rollback, chunks, wts, epsilon, k,
+                    trajectory=want_traj, rec=rec, log=log,
+                    faulty_counts=counts_np)
+            n_recoveries += 1
+            iters_i = np.asarray(iters_i)
+            dt = time.perf_counter() - t0
+            if not last_k:
+                with phase("reduce"):
+                    next_state, k_active, min_d, pair = elim_reduce_fn(state)
+                    k_active_i, min_d_f, pair_i = map(
+                        np.asarray, jax.device_get((k_active, min_d, pair)))
+        if (last_k and config.recovery_reseed_empty and target_num_clusters
+                and counts_np[health.EMPTY_CLUSTER] > 0):
+            # Target-K fit ended with empty clusters: reseed them from the
+            # worst-fit events and refit instead of letting elimination
+            # shrink the model below the requested K (opt-in; the
+            # reference-style default just eliminates, gaussian.cu:865-874).
+            state, ll_f, iters_i, counts_np, ll_log = _reseed_and_refit(
+                model, config, state, chunks, wts, epsilon, k,
+                want_traj, rec, log,
+                (ll_f, iters_i, counts_np, ll_log))
+            dt = time.perf_counter() - t0
+        health_totals += counts_np
+        word = health.pack_word(counts_np)
+        if word and rec.active:
+            rec.emit("health", k=int(k), where="em", flags=int(word),
+                     flag_names=health.flag_names(word),
+                     counters=health.counts_dict(counts_np))
+            rec.metrics.count("health_events")
         riss = model_score(ll_f, k, n_events, n_dims,
                            criterion=config.criterion,
                            covariance_type=config.covariance_type)
+        score_ok = math.isfinite(riss)
+        if not score_ok:
+            # NaN compares false both ways: an unguarded NaN score could
+            # capture the best-model slot at the first K and then never be
+            # displaced. Skip the save and record the skip.
+            health_totals[health.NONFINITE_SCORE] += 1
+            log.warning("non-finite %s score at K=%d; excluded from "
+                        "best-model selection", config.criterion, k)
+            if rec.active:
+                rec.emit("health", k=int(k), where="score",
+                         flags=1 << health.NONFINITE_SCORE,
+                         flag_names=[
+                             health.FLAG_NAMES[health.NONFINITE_SCORE]],
+                         counters={health.FLAG_NAMES[
+                             health.NONFINITE_SCORE]: 1})
+                rec.metrics.count("health_events")
         if not (timer or last_k):  # fused path: EM + reduce until ll on host
             dt = time.perf_counter() - t0
         if timer:
@@ -580,11 +737,11 @@ def _fit_gmm(data, num_clusters, target_num_clusters, config, model,
                      seconds=round(dt, 6))
             rec.heartbeat("sweep", k=int(k))
 
-        if (
+        if score_ok and (
             k == num_clusters
             or (riss < min_rissanen and target_num_clusters == 0)
             or k == target_num_clusters
-        ):  # gaussian.cu:839
+        ):  # gaussian.cu:839, NaN-score-guarded (health.NONFINITE_SCORE)
             min_rissanen, ideal_k = riss, k
             best_state, best_ll = state, ll_f
 
@@ -653,6 +810,9 @@ def _fit_gmm(data, num_clusters, target_num_clusters, config, model,
         print(f"Final {config.criterion} score was: {min_rissanen}, "
               f"with {ideal_k} clusters.")
 
+    health_section = health.health_summary(
+        health_totals, recoveries=n_recoveries,
+        io_retries=(ckpt.io_retries if ckpt is not None else 0))
     _emit_run_summary(
         rec, config, timer, sweep_log, n_active,
         float(min_rissanen), float(best_ll), em_walls,
@@ -661,7 +821,8 @@ def _fit_gmm(data, num_clusters, target_num_clusters, config, model,
             em_widths=sorted(set(em_widths), reverse=True),
             em_compiles=len(set(em_widths)),
             rebuckets=n_rebuckets,
-        ))
+        ),
+        health_section=health_section)
     return GMMResult(
         state=compact_state,
         ideal_num_clusters=n_active,
@@ -675,6 +836,7 @@ def _fit_gmm(data, num_clusters, target_num_clusters, config, model,
         profile=timer.as_dict() if timer else None,
         profile_report=timer.report() if timer else None,
         host_range=host_range,
+        health=health_section,
         model=model,
     )
 
@@ -849,6 +1011,10 @@ def _prepare_fit(data, num_clusters, config, model, phase, log,
             covariance_dynamic_range=config.covariance_dynamic_range,
             dtype=dtype,
         )
+        # Deterministic singular-covariance injection (testing.faults):
+        # applied to the host state BEFORE mesh placement, so every
+        # execution path sees the identical poisoned seed.
+        state = faults.maybe_poison_state(state)
 
     rec = telemetry.current()
     with phase("memcpy"):
@@ -992,13 +1158,22 @@ def _run_fused_sweep(fused, config, state, chunks, wts, epsilon,
                         jax.tree_util.tree_map(jnp.asarray, state))
                     best_state_r = model.prepare_state(
                         jax.tree_util.tree_map(jnp.asarray, best_state_r))
+                fused_log = np.asarray(restored["fused_log"])
+                if fused_log.shape[1] == 4:
+                    # Pre-containment checkpoints carry 4-column logs (no
+                    # per-K health word); pad so the compiled 5-column
+                    # program accepts them (restored Ks read as clean).
+                    fused_log = np.concatenate(
+                        [fused_log,
+                         np.zeros((fused_log.shape[0], 1),
+                                  fused_log.dtype)], axis=1)
                 resume = dict(
                     best_state=best_state_r,
                     k=int(restored["k"]),
                     step=int(restored["step"]) + 1,
                     best_ll=float(restored["best_ll"]),
                     best_riss=float(restored["best_riss"]),
-                    log=np.asarray(restored["fused_log"]),
+                    log=fused_log,
                 )
                 if log:
                     log.info("resumed fused sweep from checkpoint: next "
@@ -1062,16 +1237,48 @@ def _run_fused_sweep(fused, config, state, chunks, wts, epsilon,
     if with_emit:
         args.append(resume)
     try:
-        best_state, best_ll, best_riss, log_rows, steps = fused(*args)
-        best_state, best_ll, best_riss, log_rows, steps = jax.device_get(
-            (best_state, best_ll, best_riss, log_rows, steps)
+        (best_state, best_ll, best_riss, log_rows, steps,
+         health_counts) = fused(*args)
+        (best_state, best_ll, best_riss, log_rows, steps,
+         health_counts) = jax.device_get(
+            (best_state, best_ll, best_riss, log_rows, steps, health_counts)
         )
     finally:
         if with_emit:
             model._emit_target = None
     wall = time.perf_counter() - t0
+    health_counts = np.asarray(health_counts, np.int64)
 
     steps = int(steps)
+    rec = telemetry.current()
+    word = health.pack_word(health_counts)
+    if health.word_is_fatal(word):
+        rows_f = np.asarray(log_rows)[:steps]
+        k_fatal = int(rows_f[-1][0]) if steps else int(num_clusters)
+        if rec.active:
+            rec.emit("health", k=k_fatal, where="fused_sweep",
+                     flags=int(word), flag_names=health.flag_names(word),
+                     counters=health.counts_dict(health_counts))
+            rec.metrics.count("health_events")
+        if config.recovery != "retry":
+            raise health.NumericalFaultError(
+                f"numerical fault in the fused sweep at K={k_fatal} "
+                f"(flags={health.flag_names(word)}) and recovery is "
+                f"{config.recovery!r}",
+                health.fault_bundle(health_counts, k=k_fatal,
+                                    where="fused_sweep", config=config))
+        if rec.active:
+            rec.emit("recovery", k=k_fatal, attempt=1,
+                     action="host_fallback", outcome="rerun",
+                     flags=int(word),
+                     flag_names=health.flag_names(word))
+            rec.metrics.count("recovery_attempts")
+        if log is not None:
+            log.warning("fused sweep hit %s at K=%d",
+                        health.flag_names(word), k_fatal)
+        # Hand the observed counters back: the caller falls back to the
+        # host-driven sweep and folds them into its run_summary.health.
+        return health_counts
     per_k = wall / max(steps, 1)
     # With emission on, each step's host arrival time gives REAL per-K
     # seconds (delta from the previous emission; the first new step is
@@ -1114,21 +1321,30 @@ def _run_fused_sweep(fused, config, state, chunks, wts, epsilon,
             + "\n  (fused sweep: whole-K spans attributed to e_step)"
         )
 
-    rec = telemetry.current()
+    health_section = health.health_summary(health_counts)
     if rec.active:
         # The fused device program exposes per-K granularity only (its EM
         # iterations never touch the host), so the stream carries em_done
         # records -- with REAL per-K seconds from the emission arrivals --
         # but no em_iter rows; docs/OBSERVABILITY.md documents the gap.
-        for k_, ll_, riss_, it_, secs_ in sweep_log:
+        # Each K's packed health word rides the device log (column 4);
+        # nonzero words become health records here.
+        per_k_words = [int(row[4]) for row in np.asarray(log_rows)[:steps]]
+        for (k_, ll_, riss_, it_, secs_), word_k in zip(sweep_log,
+                                                        per_k_words):
             rec.metrics.count("em_iters", int(it_))
             rec.metrics.series("active_k", int(k_))
             rec.emit("em_done", k=int(k_), loglik=float(ll_),
                      score=float(riss_), criterion=config.criterion,
                      iters=int(it_), seconds=round(float(secs_), 6))
+            if word_k:
+                rec.emit("health", k=int(k_), where="em", flags=word_k,
+                         flag_names=health.flag_names(word_k))
+                rec.metrics.count("health_events")
         _emit_run_summary(rec, config, timer, sweep_log, n_active,
                           float(best_riss), float(best_ll),
-                          [s for _, s in sorted(step_secs.items())])
+                          [s for _, s in sorted(step_secs.items())],
+                          health_section=health_section)
 
     return GMMResult(
         state=compact_state,
@@ -1143,6 +1359,7 @@ def _run_fused_sweep(fused, config, state, chunks, wts, epsilon,
         profile=profile,
         profile_report=profile_report,
         host_range=host_range,
+        health=health_section,
         model=model,
     )
 

@@ -57,9 +57,11 @@ import numpy as np
 from jax import lax
 from jax.sharding import NamedSharding, PartitionSpec as P
 
+from .. import health
 from ..config import GMMConfig
 from ..ops.mstep import apply_mstep, chunk_stats
 from ..telemetry import current as current_recorder
+from ..testing import faults
 from .gmm import GMMModel, resolve_iters
 
 
@@ -176,6 +178,16 @@ class StreamingGMMModel(GMMModel):
         # loop, so these are measured, not amortized); the telemetry layer
         # reads them for the em_iter records.
         self.last_iter_seconds: list = []
+        self.last_health = None  # health counters of the latest run_em
+
+        dyn_range = config.covariance_dynamic_range
+
+        @jax.jit
+        def _state_health(state, Nk):
+            return health.state_counts(state, Nk=Nk,
+                                       dynamic_range=dyn_range)
+
+        self._state_health = _state_health
 
     def prepare(self, state, chunks_np, wts_np, host_local: bool = False):
         """Keep the chunk arrays HOST-side; only the state goes on device.
@@ -260,13 +272,15 @@ class StreamingGMMModel(GMMModel):
         a contiguous zero-copy view; un-prepared arrays fall back to the
         strided gather."""
         if self.mesh is None:
-            return (jnp.asarray(chunks[j]), jnp.asarray(wts[j]))
+            chunk, wrow = faults.maybe_poison_block(chunks[j], wts[j], j)
+            return (jnp.asarray(chunk), jnp.asarray(wrow))
         S = self._local_data_size
         if self._block_major:
             sel_c, sel_w = chunks[j * S:(j + 1) * S], wts[j * S:(j + 1) * S]
         else:
             sel_c = np.ascontiguousarray(chunks[j::blocks])
             sel_w = np.ascontiguousarray(wts[j::blocks])
+        sel_c, sel_w = faults.maybe_poison_block(sel_c, sel_w, j)
         if jax.process_count() > 1:
             # Each host contributes its local S chunks; the assembled
             # global block is [S_global, B, D] sharded over the data axis.
@@ -385,25 +399,61 @@ class StreamingGMMModel(GMMModel):
         the host-driven loop's donation lives in the streaming reduce
         (``_add`` updates the statistics accumulator in place) and applies
         regardless -- the loop carry here is rebound per pass either way.
+
+        Health containment mirrors ``em_while_loop``'s in-carry bitmask,
+        host-driven: non-finite loglik stops the loop immediately (fatal),
+        the convergence test is NaN-safe (``not |change| <= eps``), the
+        per-pass sanitized-lane counts accumulate from the statistics, and
+        the final state's parameter/range lanes are checked once at exit.
+        Counters land on ``self.last_health``.
         """
         lo, hi = resolve_iters(self.config, min_iters, max_iters)
         lo, hi = int(lo), int(hi)
         self._pass_index = 0
         self.last_iter_seconds = []
+        counts = np.zeros((health.NUM_FLAGS,), np.int64)
+        reg_tol = float(self.config.health_regression_scale) * float(epsilon)
+
+        def observe(ll, ll_prev=None):
+            """Loglik-lane bookkeeping; returns True when fatal."""
+            if not np.isfinite(ll):
+                counts[health.NONFINITE_LOGLIK] += 1
+                return True
+            if ll_prev is not None and np.isfinite(ll_prev) \
+                    and ll < ll_prev - reg_tol:
+                counts[health.LOGLIK_REGRESSION] += 1
+            return False
+
         stats = self._estep_all(state, chunks, wts)
         ll_old = float(stats.loglik)
+        counts[health.SANITIZED_LANES] += int(stats.sanitized)
+        fatal = observe(ll_old)
         lls = [ll_old]  # slot 0: initial E-step (em_while_loop's contract)
         change = abs(2.0 * float(epsilon)) + 1.0  # gaussian.cu:525
         iters = 0
-        while iters < lo or (abs(change) > epsilon and iters < hi):
+        inj = faults.peek("nan_loglik")  # runtime-consumed (host loop)
+        while not fatal and (
+                iters < lo or (not abs(change) <= epsilon and iters < hi)):
             t0 = time.perf_counter()
             state = self._mstep(state, stats)
             stats = self._estep_all(state, chunks, wts)
             ll = float(stats.loglik)
+            if inj is not None and iters + 1 == int(inj["iter"]) \
+                    and faults.take("nan_loglik") is not None:
+                ll = float("nan")
+            counts[health.SANITIZED_LANES] += int(stats.sanitized)
+            fatal = observe(ll, ll_old)
             self.last_iter_seconds.append(time.perf_counter() - t0)
             lls.append(ll)
             change, ll_old = ll - ll_old, ll
             iters += 1
+        # Parameter/empties/range lanes from the final state (the jitted
+        # loop checks every iteration; here one exit check keeps the
+        # host-driven path's per-iteration cost unchanged -- any NaN that
+        # reached the parameters also took the loglik non-finite above).
+        counts += np.asarray(jax.device_get(self._state_health(
+            state, stats.Nk)), np.int64)
+        self.last_health = jnp.asarray(counts, jnp.int32)
         out = (state, jnp.asarray(ll_old, chunks.dtype), jnp.asarray(iters))
         if trajectory:
             return out + (np.asarray(lls, np.float64),)

@@ -178,6 +178,7 @@ def posteriors(
     matmul_precision: str = "highest",
     xouter: jax.Array | None = None,
     cluster_axis: str | None = None,
+    with_sanitized: bool = False,
 ):
     """(w [B,K], logZ [B]): normalized responsibilities and per-event evidence.
 
@@ -190,21 +191,47 @@ def posteriors(
     two-stage collective -- ``pmax`` of the per-shard maxima, then ``psum`` of
     the shifted exponential sums -- and the returned ``w`` covers only the
     local cluster shard while ``logZ`` is identical on every shard.
+
+    ``with_sanitized`` additionally returns the COUNT of rows whose
+    log-sum-exp max had to be sanitized (int32 scalar, third element).
+    The max is taken AFTER the cross-shard ``pmax``, so a legitimately
+    all-inactive single shard never counts; a non-finite global max means
+    the densities themselves went non-finite (NaN parameters, overflow) --
+    the poisoning the health bitmask exists to surface
+    (``health.SANITIZED_LANES``; the pre-containment code zeroed these
+    lanes silently).
     """
     logp = log_densities(
         state, x, diag_only=diag_only, quad_mode=quad_mode,
         matmul_precision=matmul_precision, xouter=xouter,
     )
-    m = jnp.max(logp, axis=1, keepdims=True)
+    m_local = jnp.max(logp, axis=1, keepdims=True)
+    m = m_local
     if cluster_axis is not None:
         m = lax.pmax(m, cluster_axis)
     # All-inactive is impossible (>=1 active cluster globally), but a single
-    # SHARD can be all-inactive: guard the -inf max.
-    m = jnp.where(jnp.isfinite(m), m, 0.0)
+    # SHARD can be all-inactive: guard the -inf max. Post-pmax the guard
+    # only ever fires on genuinely poisoned lanes -- counted when asked.
+    bad = ~jnp.isfinite(m)
+    if with_sanitized and cluster_axis is not None:
+        # XLA's all-reduce max is allowed to DROP NaN (CPU does): a
+        # poisoned shard's NaN max can come back finite from the pmax, so
+        # the count must look at the pre-collective local maxima too. A
+        # local -inf is the legitimate all-inactive-shard value and never
+        # counts; NaN/+inf locals are poison, psum-OR'd across shards so
+        # every shard reports the single-device run's exact row count.
+        poison_local = jnp.isnan(m_local) | (m_local == jnp.inf)
+        bad_rows = bad | (lax.psum(poison_local.astype(jnp.int32),
+                                   cluster_axis) > 0)
+    else:
+        bad_rows = bad
+    m = jnp.where(bad, 0.0, m)
     expd = jnp.exp(logp - m)
     denom = jnp.sum(expd, axis=1, keepdims=True)
     if cluster_axis is not None:
         denom = lax.psum(denom, cluster_axis)
     logZ = (m + jnp.log(denom))[:, 0]
     w = expd / denom
+    if with_sanitized:
+        return w, logZ, jnp.sum(bad_rows, dtype=jnp.int32)
     return w, logZ

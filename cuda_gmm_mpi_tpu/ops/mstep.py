@@ -52,12 +52,24 @@ class SuffStats:
     M2:     [K,D,D] weighted outer-product sums (or [K,D] diagonal when
             diag_only -- the DIAG_ONLY path never forms off-diagonals,
             mirroring gaussian_kernel.cu:621-628)
+    sanitized: int32 scalar -- E-step lanes whose log-sum-exp max was
+            non-finite and had to be sanitized (health.SANITIZED_LANES;
+            previously zeroed silently). Rides the stats pytree so it
+            accumulates through the chunk scan, the streaming block adds,
+            and the cross-device psum exactly like the statistics it
+            taints -- each shard counts disjoint events, so the reduced
+            count equals the single-device run's.
     """
 
     loglik: jax.Array
     Nk: jax.Array
     M1: jax.Array
     M2: jax.Array
+    # Defaulted so pre-containment constructor call sites (and tests that
+    # build stats by hand) stay valid; the zero default means "nothing
+    # sanitized", which is exactly what a hand-built stats object asserts.
+    sanitized: jax.Array = dataclasses.field(
+        default_factory=lambda: jnp.zeros((), jnp.int32))
 
     def __add__(self, other: "SuffStats") -> "SuffStats":
         return SuffStats(
@@ -65,6 +77,7 @@ class SuffStats:
             self.Nk + other.Nk,
             self.M1 + other.M1,
             self.M2 + other.M2,
+            self.sanitized + other.sanitized,
         )
 
 
@@ -75,6 +88,7 @@ def zeros_stats(K: int, D: int, dtype, diag_only: bool = False) -> SuffStats:
         Nk=jnp.zeros((K,), dtype),
         M1=jnp.zeros((K, D), dtype),
         M2=jnp.zeros(m2_shape, dtype),
+        sanitized=jnp.zeros((), jnp.int32),
     )
 
 
@@ -112,10 +126,10 @@ def chunk_stats(
         elif not diag_only and quad_mode == "expanded":
             xouter = expand_features(x)
 
-    w, logZ = posteriors(
+    w, logZ, sanitized = posteriors(
         state, x, diag_only=diag_only, quad_mode=quad_mode,
         matmul_precision=matmul_precision, xouter=xouter,
-        cluster_axis=cluster_axis,
+        cluster_axis=cluster_axis, with_sanitized=True,
     )
     if wts is not None:
         w = w * wts[:, None]
@@ -135,7 +149,8 @@ def chunk_stats(
         if xouter is None:
             xouter = expand_features(x)
         M2 = jnp.einsum("nk,nf->kf", w, xouter, precision=prec).reshape(K, D, D)
-    return SuffStats(loglik=loglik, Nk=Nk, M1=M1, M2=M2)
+    return SuffStats(loglik=loglik, Nk=Nk, M1=M1, M2=M2,
+                     sanitized=sanitized)
 
 
 def accumulate_stats(

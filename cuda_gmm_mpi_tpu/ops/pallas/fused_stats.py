@@ -399,6 +399,10 @@ def fused_stats_pallas_sharded(
         Nk=nk[0].astype(dt),
         M1=m1.astype(dt),
         M2=(m2 if diag_only else m2.reshape(K, d, d)).astype(dt),
+        # The kernel's masked-lane trick (NEG_LARGE, not -inf) never
+        # produces a non-finite log-sum-exp max, so it has no lanes to
+        # sanitize; the health count is structurally zero here.
+        sanitized=jnp.zeros((), jnp.int32),
     )
 
 
@@ -472,4 +476,7 @@ def fused_stats_pallas(
         Nk=nk[0].astype(dt),
         M1=m1.astype(dt),
         M2=(m2 if diag_only else m2.reshape(K, d, d)).astype(dt),
+        # Masked lanes use NEG_LARGE (finite) in-kernel: nothing to
+        # sanitize, count structurally zero (see the sharded variant).
+        sanitized=jnp.zeros((), jnp.int32),
     )

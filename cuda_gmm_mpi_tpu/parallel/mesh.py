@@ -79,7 +79,7 @@ def stats_pspecs(diag_only: bool = False):
 
     m2 = P(CLUSTER_AXIS, None) if diag_only else P(CLUSTER_AXIS, None, None)
     return SuffStats(loglik=P(), Nk=P(CLUSTER_AXIS), M1=P(CLUSTER_AXIS, None),
-                     M2=m2)
+                     M2=m2, sanitized=P())
 
 
 def pad_clusters(num_clusters: int, cluster_size: int) -> int:

@@ -209,6 +209,7 @@ class ShardedGMMModel:
                  stats_fn=None):
         self.config = config
         self._emit_target = None  # host sink for fused-sweep per-K emission
+        self.last_health = None  # health counters of the latest run_em
         self.mesh = mesh if mesh is not None else make_mesh(config.mesh_shape)
         self.data_size = self.mesh.shape[DATA_AXIS]
         self.cluster_size = self.mesh.shape[CLUSTER_AXIS]
@@ -340,6 +341,8 @@ class ShardedGMMModel:
                 covariance_type=self.config.covariance_type,
                 precompute_features=self.config.precompute_features,
                 trajectory_len=trajectory_len,
+                dynamic_range=self.config.covariance_dynamic_range,
+                regression_scale=self.config.health_regression_scale,
                 **self._kw,
             )
             sspec = state_pspecs()
@@ -347,6 +350,10 @@ class ShardedGMMModel:
             out_specs = (sspec, scalar, scalar)
             if trajectory_len:
                 out_specs = out_specs + (scalar,)
+            # Trailing health counters: replicated by construction (the
+            # loglik lanes ride the data psum, the per-cluster-shard state
+            # lanes psum over the cluster axis inside health.state_counts).
+            out_specs = out_specs + (scalar,)
             fn = self._em_exec_cache[key] = jax.jit(
                 shard_map(
                     em_fn,
@@ -366,10 +373,12 @@ class ShardedGMMModel:
         lo, hi = resolve_iters(self.config, min_iters, max_iters)
         run = self._em_executable(
             int(self.config.max_iters) if trajectory else 0, donate)
-        return run(
+        out = run(
             state, data_chunks, wts_chunks,
             jnp.asarray(epsilon, data_chunks.dtype), lo, hi,
         )
+        self.last_health = out[-1]
+        return out[:-1]
 
     def rebucket_state(self, state, num_clusters: int):
         """Bucket recompaction on the mesh: compact the (tiny) K-state to
@@ -467,13 +476,16 @@ class ShardedGMMModel:
                 reduce_order_fn=reduce_order_fn, emit_cb=emit_cb,
                 emit_light=emit_light, emit_gather_fn=emit_gather_fn,
                 precompute_features=self.config.precompute_features,
+                dynamic_range=self.config.covariance_dynamic_range,
+                regression_scale=self.config.health_regression_scale,
                 **self._kw, **static,
             )
             sspec = state_pspecs()
             scalar = P()
             base_in = (sspec, P(DATA_AXIS, None, None),
                        P(DATA_AXIS, None), scalar, scalar, scalar)
-            out_specs = (sspec, scalar, scalar, scalar, scalar)
+            # Final scalar: the sweep's cumulative health counters.
+            out_specs = (sspec, scalar, scalar, scalar, scalar, scalar)
             # Resume changes the arg pytree (an extra sweep-position dict),
             # so the two variants are separate shard_maps; both live behind
             # one cached callable with the plain model's calling convention

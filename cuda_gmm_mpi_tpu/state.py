@@ -138,6 +138,19 @@ def compact_to(state: GMMState, num_clusters: int) -> GMMState:
     )
 
 
+def clone_state(state: GMMState) -> GMMState:
+    """Fresh-buffer copy of a state (async device copy; no host sync).
+
+    The recovery rollback point: the sweep donates each K's input state
+    into the EM call (``run_em(donate=True)`` reuses its buffers in
+    place), so rolling back after a detected numerical fault needs a
+    clone taken BEFORE the donation. A state is K x D x D-small -- the
+    clone costs ~one parameter-set of HBM, nothing against the event
+    data, and dispatches asynchronously.
+    """
+    return jax.tree_util.tree_map(jnp.copy, state)
+
+
 def compact(state: GMMState) -> Tuple[GMMState, int]:
     """Host-side compaction: drop inactive clusters, preserving relative order.
 

@@ -59,6 +59,10 @@ def render_report(records: List[dict], max_trajectory_rows: int = 400) -> str:
     chunks = [r for r in records if r.get("event") == "chunk_flush"]
     summaries = [r for r in records if r.get("event") == "run_summary"]
 
+    healths = [r for r in records if r.get("event") == "health"]
+    recoveries = [r for r in records if r.get("event") == "recovery"]
+    io_retries = [r for r in records if r.get("event") == "io_retry"]
+
     for s in starts:
         out.append(_fmt_run_start(s))
     if starts:
@@ -97,6 +101,27 @@ def render_report(records: List[dict], max_trajectory_rows: int = 400) -> str:
                    f"{total_bytes / 1e6:.1f} MB host->device")
         out.append("")
 
+    if healths or recoveries or io_retries:
+        out.append("Health / recovery (docs/ROBUSTNESS.md):")
+        for r in healths:
+            k = r.get("k")
+            names = ",".join(r.get("flag_names") or []) or "?"
+            where = r.get("where", "em")
+            out.append(f"  health   K={k if k is not None else '-':>4} "
+                       f"[{where}] flags=0x{int(r.get('flags', 0)):x} "
+                       f"({names})")
+        for r in recoveries:
+            out.append(f"  recovery K={r.get('k', '-'):>4} "
+                       f"attempt={r.get('attempt')} "
+                       f"action={r.get('action')} -> {r.get('outcome')}")
+        for r in io_retries:
+            tail = " GAVE UP" if r.get("gave_up") else ""
+            out.append(f"  io_retry {r.get('op')} "
+                       f"step={r.get('step', '-')} "
+                       f"attempt={r.get('attempt')}: "
+                       f"{r.get('error')}{tail}")
+        out.append("")
+
     for s in summaries:
         prof = s.get("phase_profile") or {}
         if prof.get("seconds"):
@@ -114,6 +139,18 @@ def render_report(records: List[dict], max_trajectory_rows: int = 400) -> str:
                 + (f"{warm:.3f}s" if warm is not None else "-")
                 + ", est. compile "
                 + (f"{est:.3f}s" if est is not None else "-"))
+        hs = s.get("health")
+        if hs is not None:
+            if hs.get("flags"):
+                out.append(
+                    "Health: flags=0x%x (%s)%s  recoveries=%d io_retries=%d"
+                    % (int(hs["flags"]),
+                       ",".join(hs.get("flag_names") or []),
+                       " FATAL" if hs.get("fatal") else "",
+                       int(hs.get("recoveries", 0)),
+                       int(hs.get("io_retries", 0))))
+            else:
+                out.append("Health: clean (all flags zero)")
         out.append(
             f"Best model: K={s.get('ideal_k')} "
             f"{s.get('criterion', 'score')}={s.get('score'):.6e} "

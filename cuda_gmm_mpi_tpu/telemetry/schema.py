@@ -69,15 +69,40 @@ EVENT_FIELDS: Dict[str, Tuple[Tuple[str, ...], Tuple[str, ...]]] = {
         ("phase", "elapsed_s"),
         ("k",),
     ),
+    # One per nonzero health word observed (health.py): ``flags`` is the
+    # packed bitmask, ``flag_names`` its decoded lanes, ``counters`` the
+    # per-lane counts, ``where`` the observation point (em / score /
+    # fused_sweep).
+    "health": (
+        ("flags", "flag_names"),
+        ("k", "counters", "where"),
+    ),
+    # One per recovery action: an escalation-ladder attempt after a fatal
+    # health word (action = regularize / centered / highest), the fused
+    # sweep's host_fallback, or a reseed_empty pass. ``outcome`` is
+    # recovered / fatal / retry / rerun.
+    "recovery": (
+        ("k", "attempt", "action", "outcome"),
+        ("flags", "flag_names"),
+    ),
+    # One per retried (or abandoned: gave_up=true) checkpoint write
+    # (utils/checkpoint.py bounded backoff).
+    "io_retry": (
+        ("op", "attempt", "error"),
+        ("step", "delay_s", "gave_up"),
+    ),
     # One per fit: final scores, the 7-category phase profile, the
     # compile-vs-execute split, and the metrics-registry snapshot.
     # ``buckets`` (optional; host-driven sweeps) describes cluster-width
     # bucketing: {mode, em_widths, em_compiles, rebuckets} -- em_compiles
     # counts the DISTINCT padded widths EM compiled for.
+    # ``health`` (optional): the numerical-containment summary --
+    # {flags, flag_names, fatal, counters, recoveries, io_retries};
+    # all-zero flags on a clean run (docs/ROBUSTNESS.md).
     "run_summary": (
         ("ideal_k", "score", "criterion", "final_loglik", "total_iters",
          "wall_s", "phase_profile", "compile", "metrics"),
-        ("per_process", "memory_stats", "buckets"),
+        ("per_process", "memory_stats", "buckets", "health"),
     ),
 }
 

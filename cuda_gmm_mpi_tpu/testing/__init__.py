@@ -1,0 +1,12 @@
+"""Test-support subpackage: deterministic fault injection (testing.faults).
+
+Shipped inside the package (not under tests/) because the injection points
+live in production modules -- the EM loop, the streaming block feeder, the
+checkpointer -- and those modules must be able to consult the active fault
+plan without importing the test tree. With no plan installed every hook is
+a near-free no-op (one module-attribute check).
+"""
+
+from . import faults
+
+__all__ = ["faults"]

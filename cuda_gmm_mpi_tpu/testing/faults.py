@@ -1,0 +1,187 @@
+"""Deterministic fault injection for the numerical-containment subsystem.
+
+The reference has no way to rehearse its failure modes: a singular
+covariance or NaN event appears only when real data produces one, so the
+recovery paths (docs/ROBUSTNESS.md) would otherwise ship untested. This
+module provides env/config-gated injection points that production code
+consults; each fires a bounded number of times (``times``, default 1), so a
+recovery retry observes the fault gone -- exactly the transient-fault shape
+the escalation ladder exists for.
+
+Supported fault kinds (the spec is ``{kind: {params...}}``):
+
+- ``nan_loglik``   ``{"iter": i, "times": n}`` -- the EM loop's loglik
+  becomes NaN at iteration ``i`` (1-based; the initial E-step is iteration
+  0). For the jitted EM loops the plan is consumed at TRACE time and the
+  injection is compiled into that executable, so a same-executable retry
+  re-observes the fault while a rebuilt (escalated) model traces clean --
+  ``times`` therefore counts *traced executables*, i.e. the escalation rung
+  that finally runs clean. The host-driven streaming loop consumes at
+  runtime per EM run.
+- ``singular_cov`` ``{"cluster": c, "times": n}`` -- the seeded state's
+  cluster ``c`` gets a singular covariance (R zeroed) with the poisoned
+  inverse (Rinv +inf) a real inversion of it would produce; consumed per
+  seeded fit.
+- ``poison_block`` ``{"block": j, "times": n}`` -- the streaming path's
+  host->device block ``j`` arrives as all-NaN (a torn read / bad DMA);
+  consumed per delivery, so the recovery retry streams clean data.
+- ``checkpoint_eio`` ``{"step": s, "times": n}`` -- the checkpoint write
+  for sweep step ``s`` (any step when omitted) raises ``OSError(EIO)``;
+  consumed per raise, so the bounded retry's n+1-th attempt succeeds.
+
+Activation: ``faults.use({...})`` (context manager, in-process tests) or
+the ``GMM_FAULTS`` env var holding the JSON spec (subprocess workers; read
+once, at the first hook that fires). No plan installed = every hook returns
+None immediately.
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import threading
+from typing import Any, Dict, Optional
+
+ENV_VAR = "GMM_FAULTS"
+
+KNOWN_KINDS = ("nan_loglik", "singular_cov", "poison_block", "checkpoint_eio")
+
+
+class FaultPlan:
+    """A mutable injection plan: per-kind params plus a firing budget."""
+
+    def __init__(self, spec: Dict[str, Dict[str, Any]]):
+        for kind in spec:
+            if kind not in KNOWN_KINDS:
+                raise ValueError(
+                    f"unknown fault kind {kind!r} (expected one of "
+                    f"{KNOWN_KINDS})")
+        self._lock = threading.Lock()
+        self._spec = {
+            kind: dict(cfg, _remaining=int(cfg.get("times", 1)))
+            for kind, cfg in spec.items()
+        }
+        self.fired: Dict[str, int] = {k: 0 for k in self._spec}
+
+    def peek(self, kind: str) -> Optional[Dict[str, Any]]:
+        """The kind's params if it still has budget (no consumption)."""
+        cfg = self._spec.get(kind)
+        if cfg is None or cfg["_remaining"] <= 0:
+            return None
+        return cfg
+
+    def take(self, kind: str, **match) -> Optional[Dict[str, Any]]:
+        """Consume one firing of ``kind`` if armed and every ``match``
+        key equals the plan's value (plan keys absent from the spec match
+        anything -- e.g. ``checkpoint_eio`` with no ``step`` fires on any
+        step). Returns the params dict or None."""
+        with self._lock:
+            cfg = self._spec.get(kind)
+            if cfg is None or cfg["_remaining"] <= 0:
+                return None
+            for key, val in match.items():
+                if key in cfg and int(cfg[key]) != int(val):
+                    return None
+            cfg["_remaining"] -= 1
+            self.fired[kind] = self.fired.get(kind, 0) + 1
+            return cfg
+
+
+_installed: Optional[FaultPlan] = None
+_env_checked = False
+_env_lock = threading.Lock()
+
+
+def install(spec: Optional[Dict[str, Dict[str, Any]]]) -> Optional[FaultPlan]:
+    """Install (or, with None, clear) the process-wide fault plan."""
+    global _installed, _env_checked
+    _installed = FaultPlan(spec) if spec is not None else None
+    _env_checked = True  # explicit install/clear overrides the env plan
+    return _installed
+
+
+def clear() -> None:
+    install(None)
+
+
+class use:
+    """Context manager: install a plan for the enclosed block, then clear.
+
+    The plan object is the as-target value, so tests can assert on
+    ``plan.fired`` after the block.
+    """
+
+    def __init__(self, spec: Dict[str, Dict[str, Any]]):
+        self._spec = spec
+
+    def __enter__(self) -> FaultPlan:
+        return install(self._spec)
+
+    def __exit__(self, *exc) -> None:
+        clear()
+
+
+def active() -> Optional[FaultPlan]:
+    """The current plan: an installed one, else GMM_FAULTS (parsed once)."""
+    global _installed, _env_checked
+    if _installed is not None:
+        return _installed
+    if not _env_checked:
+        with _env_lock:
+            if not _env_checked:
+                raw = os.environ.get(ENV_VAR)
+                if raw:
+                    _installed = FaultPlan(json.loads(raw))
+                _env_checked = True
+    return _installed
+
+
+def take(kind: str, **match) -> Optional[Dict[str, Any]]:
+    """Module-level shortcut: consume from the active plan (None = no-op)."""
+    plan = active()
+    return plan.take(kind, **match) if plan is not None else None
+
+
+def peek(kind: str) -> Optional[Dict[str, Any]]:
+    plan = active()
+    return plan.peek(kind) if plan is not None else None
+
+
+def raise_io_error(kind: str, **match) -> None:
+    """Raise an injected OSError(EIO) when ``kind`` is armed and matches."""
+    cfg = take(kind, **match)
+    if cfg is not None:
+        import errno
+
+        raise OSError(errno.EIO, f"injected {kind} fault", str(cfg))
+
+
+def maybe_poison_state(state):
+    """Apply an armed ``singular_cov`` fault to a freshly seeded state.
+
+    Zeroes cluster ``c``'s covariance and sets its inverse to +inf -- the
+    poisoned pair a real inversion of a singular R produces -- so the first
+    E-step's densities go non-finite and the health bitmask must catch it
+    (``nonfinite_params`` + ``nonfinite_loglik``).
+    """
+    cfg = take("singular_cov")
+    if cfg is None:
+        return state
+    import jax.numpy as jnp
+
+    c = int(cfg.get("cluster", 0))
+    return state.replace(
+        R=state.R.at[c].set(0.0),
+        Rinv=state.Rinv.at[c].set(jnp.inf),
+    )
+
+
+def maybe_poison_block(chunk, wts, block: int):
+    """Apply an armed ``poison_block`` fault to one streamed host block."""
+    cfg = take("poison_block", block=block)
+    if cfg is None:
+        return chunk, wts
+    import numpy as np
+
+    bad = np.full_like(np.asarray(chunk), np.nan)
+    return bad, wts

@@ -34,11 +34,19 @@ the newest step.
 from __future__ import annotations
 
 import os
-from typing import Any, Dict, Optional
+import random
+import time
+from typing import Any, Callable, Dict, Optional
 
 import numpy as np
 
 from ..state import GMMState
+from ..testing import faults
+
+# First-retry backoff; doubles per attempt with +-25% deterministic jitter
+# (seeded per (step, attempt), so concurrent rank-0 writers across a fleet
+# desynchronize without making tests flaky).
+RETRY_BASE_S = 0.05
 
 
 def _to_tree(state: GMMState) -> Dict[str, Any]:
@@ -64,13 +72,63 @@ class SweepCheckpointer:
     reference envelope) on the checkpoint filesystem.
     """
 
-    def __init__(self, directory: str, keep: int = 2):
+    def __init__(self, directory: str, keep: int = 2, retries: int = 3):
         import orbax.checkpoint as ocp
 
         self._dir = os.path.abspath(os.path.join(directory, "sweep"))
         os.makedirs(self._dir, exist_ok=True)
         self._ckpt = ocp.StandardCheckpointer()
         self._keep = max(1, keep)
+        self._retries = max(0, retries)
+        # Transient-failure retries observed so far (run_summary.health).
+        self.io_retries = 0
+
+    def _write_with_retries(self, op: str, step: int,
+                            write: Callable[[], None]) -> bool:
+        """Run ``write`` with bounded, jittered exponential backoff.
+
+        A transient ``OSError`` (EIO/ESTALE on a network checkpoint
+        filesystem) must not kill an hours-long sweep -- least of all from
+        inside the fused sweep's ordered ``io_callback``, where an
+        exception aborts the device program. Each failure emits an
+        ``io_retry`` telemetry record; exhaustion logs loudly and SKIPS
+        the save (a missing checkpoint degrades resume granularity, a
+        crashed run loses everything). Returns True when durable.
+        """
+        from .. import telemetry
+
+        delay = RETRY_BASE_S
+        for attempt in range(self._retries + 1):
+            try:
+                # Deterministic injection point (testing.faults:
+                # checkpoint_eio), budget-bounded so the retry observes
+                # the fault gone -- the transient-EIO shape.
+                faults.raise_io_error("checkpoint_eio", step=step)
+                write()
+                return True
+            except OSError as e:
+                gave_up = attempt == self._retries
+                rec = telemetry.current()
+                if rec.active:
+                    rec.emit("io_retry", op=op, step=int(step),
+                             attempt=attempt + 1, error=str(e),
+                             delay_s=(0.0 if gave_up else round(delay, 4)),
+                             gave_up=gave_up)
+                    rec.metrics.count("io_retries")
+                if gave_up:
+                    from .logging_ import get_logger
+
+                    get_logger().error(
+                        "checkpoint %s for step %d failed after %d "
+                        "attempt(s): %s -- continuing WITHOUT this "
+                        "checkpoint", op, step, attempt + 1, e)
+                    return False
+                self.io_retries += 1
+                # +-25% deterministic jitter around the exponential term.
+                jitter = 0.75 + 0.5 * random.Random(
+                    (int(step) << 8) | attempt).random()
+                time.sleep(delay * jitter)
+                delay *= 2.0
 
     def _prune(self, newest_step: int) -> None:
         """Drop steps older than the retention window. Called by the save
@@ -110,13 +168,25 @@ class SweepCheckpointer:
             pass
 
     def save(self, step: int, payload: Dict[str, Any]) -> None:
-        """payload: state, best_state (GMMState), plus plain scalars."""
+        """payload: state, best_state (GMMState), plus plain scalars.
+
+        Write failures retry with jittered backoff (``retries``); see
+        ``_write_with_retries``. Multi-host: every rank runs the same
+        bounded retry schedule, so the orbax collective stays aligned
+        across ranks whether an attempt fails or succeeds (injected
+        faults fire identically everywhere by construction).
+        """
         tree = dict(payload)
         tree["state"] = _to_tree(payload["state"])
         tree["best_state"] = _to_tree(payload["best_state"])
         path = os.path.join(self._dir, str(step))
-        self._ckpt.save(path, tree, force=True)
-        self._ckpt.wait_until_finished()
+
+        def write():
+            self._ckpt.save(path, tree, force=True)
+            self._ckpt.wait_until_finished()
+
+        if not self._write_with_retries("save", step, write):
+            return
         import jax
 
         if jax.process_index() == 0:
@@ -145,27 +215,34 @@ class SweepCheckpointer:
                     flat[f"{key}.{leaf}"] = np.asarray(arr)
             else:
                 flat[key] = np.asarray(val)
-        import tempfile
 
-        fd, tmp = tempfile.mkstemp(dir=self._dir, suffix=".tmp.npz")
-        with os.fdopen(fd, "wb") as f:
-            np.savez(f, **flat)
-            # The durability contract ("checkpoint s on disk before step
-            # s+1 computes", fused_sweep.py) must survive a HOST crash, not
-            # just a process kill: flush+fsync the data before the atomic
-            # rename, then fsync the directory so the rename itself is
-            # durable. The tmp name is mkstemp-unique so concurrent savers
-            # (racing callback threads) can never interleave writes into
-            # one file.
-            f.flush()
-            os.fsync(f.fileno())
-        os.replace(tmp, os.path.join(self._dir, f"{step}.npz"))
-        dir_fd = os.open(self._dir, os.O_RDONLY)
-        try:
-            os.fsync(dir_fd)
-        finally:
-            os.close(dir_fd)
-        self._prune(step)  # already process-0-only here
+        def write():
+            import tempfile
+
+            fd, tmp = tempfile.mkstemp(dir=self._dir, suffix=".tmp.npz")
+            with os.fdopen(fd, "wb") as f:
+                np.savez(f, **flat)
+                # The durability contract ("checkpoint s on disk before
+                # step s+1 computes", fused_sweep.py) must survive a HOST
+                # crash, not just a process kill: flush+fsync the data
+                # before the atomic rename, then fsync the directory so
+                # the rename itself is durable. The tmp name is
+                # mkstemp-unique so concurrent savers (racing callback
+                # threads) can never interleave writes into one file.
+                f.flush()
+                os.fsync(f.fileno())
+            os.replace(tmp, os.path.join(self._dir, f"{step}.npz"))
+            dir_fd = os.open(self._dir, os.O_RDONLY)
+            try:
+                os.fsync(dir_fd)
+            finally:
+                os.close(dir_fd)
+
+        # Bounded retry: this runs inside the ordered io_callback while
+        # the device program is blocked on it -- an escaped exception here
+        # would abort the whole job for a transient filesystem hiccup.
+        if self._write_with_retries("save_local", step, write):
+            self._prune(step)  # already process-0-only here
 
     def _all_steps(self) -> list:
         if not os.path.isdir(self._dir):

@@ -87,10 +87,20 @@ def test_cli_rejects_nonfinite_input(tmp_path):
     p2.write_text("a,b\n1.0,2.0\n1e39,3.0\n4.0,5.0\n")
     assert run_cli(["2", str(p2), str(tmp_path / "o"), "2",
                     "--min-iters=2", "--max-iters=2"]) == 1
-    # opt-out proceeds (the reference's silent-atof behavior)
+    # Opting out of input validation no longer reproduces the reference's
+    # silent-atof poisoning: the in-loop health bitmask catches the NaN
+    # loglik, the escalation ladder cannot fix genuinely poisoned DATA,
+    # and the run fails loudly (exit 3, diagnostic bundle, no model
+    # written) instead of returning NaN parameters (docs/ROBUSTNESS.md).
     assert run_cli(["2", str(p), str(tmp_path / "o"), "2",
                     "--min-iters=2", "--max-iters=2",
-                    "--no-validate-input"]) == 0
+                    "--no-validate-input"]) == 3
+    assert not (tmp_path / "o.summary").exists()
+    # recovery='off' raises the same loud failure without burning ladder
+    # attempts on unfixable data.
+    assert run_cli(["2", str(p), str(tmp_path / "o2"), "2",
+                    "--min-iters=2", "--max-iters=2",
+                    "--no-validate-input", "--recovery=off"]) == 3
 
 
 def test_cli_predict_from_validates_input(tmp_path, csv_file):
