@@ -21,6 +21,7 @@ from .models import (GMMModel, GMMResult, compute_memberships, fit_gmm,
                      iter_memberships)
 from .state import (GMMState, bucket_width, clone_state, compact,
                     compact_to, zeros_state)
+from .supervisor import PeerLostError, PreemptedError, RunSupervisor
 from .validation import InvalidInputError
 
 __all__ = [
@@ -29,5 +30,6 @@ __all__ = [
     "GMMState", "bucket_width", "clone_state", "compact", "compact_to",
     "zeros_state",
     "InvalidInputError", "NumericalFaultError",
+    "PeerLostError", "PreemptedError", "RunSupervisor",
     "__version__",
 ]

@@ -168,6 +168,32 @@ def build_parser() -> argparse.ArgumentParser:
                    help="bounded retries (jittered backoff) for checkpoint "
                    "writes -- a transient EIO no longer kills the run "
                    "(telemetry records io_retry events)")
+    t.add_argument("--max-runtime", type=float, default=None,
+                   metavar="SECONDS",
+                   help="wall-clock budget: reaching it acts like SIGTERM "
+                   "-- cooperative stop, emergency intra-K checkpoint "
+                   "(with --checkpoint-dir), exit 75 (EX_TEMPFAIL). "
+                   "Front-runs a batch scheduler's hard kill")
+    t.add_argument("--resume", default="auto", choices=["auto", "never"],
+                   help="checkpoint resume policy: 'auto' (default) "
+                   "resumes from the newest step INCLUDING a preempted "
+                   "run's mid-EM sub-step; 'never' starts fresh (new "
+                   "checkpoints are still written)")
+    t.add_argument("--preempt-poll-iters", type=int, default=25,
+                   help="EM iterations per supervised segment (stop-flag "
+                   "poll cadence mid-K; ~1/N E-step overhead, results "
+                   "bit-identical). Active with --checkpoint-dir")
+    t.add_argument("--peer-timeout", type=float, default=60.0,
+                   metavar="SECONDS",
+                   help="multi-host liveness watchdog: a peer rank whose "
+                   "heartbeat (on the checkpoint filesystem) is stale "
+                   "beyond this fails loudly with PeerLostError + an "
+                   "emergency checkpoint instead of hanging in the next "
+                   "collective; 0 disables")
+    t.add_argument("--allow-nonfinite", action="store_true",
+                   help="count-and-quarantine NaN/Inf input rows at load "
+                   "(they are DROPPED with a warning) instead of "
+                   "rejecting the file; single-process runs only")
     t.add_argument("--recovery", default="retry", choices=["retry", "off"],
                    help="what a FATAL health flag (non-finite loglik/"
                    "params) does: 'retry' rolls back and climbs the "
@@ -300,6 +326,10 @@ def main(argv=None) -> int:
             validate_input=not args.no_validate_input,
             stream_events=args.stream_events,
             precompute_features=args.precompute_features,
+            max_runtime_s=args.max_runtime,
+            resume=args.resume,
+            preempt_poll_iters=args.preempt_poll_iters,
+            peer_timeout_s=args.peer_timeout,
         )
     except ValueError as e:
         print(str(e), file=sys.stderr)
@@ -380,6 +410,12 @@ def main(argv=None) -> int:
         if not _all_ranks_ok(ok, nproc):
             return 1
 
+    if args.allow_nonfinite and nproc > 1:
+        # Quarantine drops rows, which would shift every host's slice
+        # bounds; multi-host runs must reject instead (validate_input).
+        print("--allow-nonfinite is a single-process mode", file=sys.stderr)
+        return 1
+
     t_io0 = time.perf_counter()
     if nproc > 1:
         # Per-host sharded loading: fit_gmm pulls only this host's slice
@@ -389,14 +425,29 @@ def main(argv=None) -> int:
             src = FileSource(path)
             src.shape  # force the header/shape parse inside the error guard
             return src
-        fit_input = _read_events_or_none(_open_source, args.infile)
+        fit_input, rc = _read_events_or_none(_open_source, args.infile)
         if fit_input is None:
-            return 1
+            return rc
         n_events, n_dims = fit_input.shape
     else:
-        fit_input = data = _read_events_or_none(read_data, args.infile)
+        def _read(path):
+            import numpy as np
+
+            from .io.readers import read_data as rd
+
+            # Ingest-time integrity screen (io/readers.py): with
+            # --allow-nonfinite bad rows are counted and dropped here;
+            # otherwise the fit-time validator rejects them (same
+            # collective-safe path multi-host uses).
+            return rd(path,
+                      screen=("quarantine" if args.allow_nonfinite
+                              else "off"),
+                      screen_dtype=np.dtype(config.dtype))
+        fit_input = data = None
+        data, rc = _read_events_or_none(_read, args.infile)
         if data is None:
-            return 1
+            return rc
+        fit_input = data
         n_events, n_dims = data.shape
     t_io = time.perf_counter() - t_io0
     if config.enable_print and pid == 0:
@@ -431,7 +482,44 @@ def main(argv=None) -> int:
         if not _all_ranks_ok(ok, nproc):
             return 1
 
+    from . import supervisor as supervisor_mod
     from .health import NumericalFaultError
+    from .supervisor import PeerLostError, PreemptedError
+    from .utils.checkpoint import CheckpointRestoreError
+
+    # The run supervisor turns SIGTERM/SIGINT and the --max-runtime
+    # deadline into a cooperative stop with an emergency intra-K
+    # checkpoint and exit 75 (EX_TEMPFAIL) -- the preemption-safe
+    # execution contract (docs/ROBUSTNESS.md "Run lifecycle"). It stays
+    # active through output writing so the multi-host assembly barriers
+    # are timeout-bounded while the liveness watchdog runs.
+    sup = supervisor_mod.RunSupervisor(max_runtime_s=config.max_runtime_s)
+    try:
+        with supervisor_mod.use(sup):
+            return _fit_and_write(args, config, fit_input, pid, nproc,
+                                  init_means, t_io)
+    except PreemptedError as e:
+        print(f"Preempted -- {e}", file=sys.stderr)
+        return supervisor_mod.EX_TEMPFAIL
+    except PeerLostError as e:
+        print(f"Peer lost -- {e}", file=sys.stderr)
+        return supervisor_mod.EX_TEMPFAIL
+    except CheckpointRestoreError as e:
+        print(f"Checkpoint unreadable -- {e}", file=sys.stderr)
+        return supervisor_mod.EX_IOERR
+
+
+def _fit_and_write(args, config, fit_input, pid, nproc, init_means,
+                   t_io) -> int:
+    """The supervised span of ``main``: fit, then write outputs."""
+    data = fit_input  # single-process: the in-memory array itself
+    from . import supervisor as supervisor_mod
+    from .health import NumericalFaultError
+    from .io import write_summary
+    from .io.writers import stream_results
+    from .models import fit_gmm, iter_memberships
+    from .utils.profiling import trace
+    from .validation import InvalidInputError
 
     with trace(args.trace_dir):
         try:
@@ -448,10 +536,11 @@ def main(argv=None) -> int:
         except NumericalFaultError as e:
             # An unrecovered (or recovery-disabled) numerical fault: the
             # loud-failure contract -- print the diagnostic bundle, exit
-            # nonzero, never write a poisoned model (docs/ROBUSTNESS.md).
+            # EX_SOFTWARE, never write a poisoned model
+            # (docs/ROBUSTNESS.md; docs/API.md exit-code table).
             print(f"Numerical fault -- no model written.\n{e}",
                   file=sys.stderr)
-            return 3
+            return supervisor_mod.EX_SOFTWARE
 
     t_out0 = time.perf_counter()
     if pid == 0:
@@ -530,9 +619,17 @@ def _predict_main(args, config) -> int:
         print(f"Cannot load model {args.predict_from!r}: {e}",
               file=sys.stderr)
         return 1
-    data = _read_events_or_none(read_data, args.infile)
+    def _read(path):
+        import numpy as np
+
+        return read_data(path,
+                         screen=("quarantine" if args.allow_nonfinite
+                                 else "off"),
+                         screen_dtype=np.dtype(config.dtype))
+
+    data, rc = _read_events_or_none(_read, args.infile)
     if data is None:
-        return 1
+        return rc
     if config.validate_input:
         import numpy as np
 
@@ -611,15 +708,24 @@ def _all_ranks_ok(ok: bool, nproc: int) -> bool:
 
 
 def _read_events_or_none(reader, path):
-    """Shared input-parse guard (gaussian.cu:204-205 message): returns the
-    reader's value, or None after printing the reference's abort message."""
+    """Shared input-parse guard (gaussian.cu:204-205 message): returns
+    ``(value, 0)``, or ``(None, exit_code)`` after printing the
+    reference's abort message. Unreadable or torn input (OSError, a
+    truncated BIN payload) maps to 74 (EX_IOERR); malformed CONTENT
+    (ragged rows, empty file) keeps the reference's exit 1."""
+    from .io.readers import TruncatedInputError
+
     try:
-        return reader(path)
+        return reader(path), 0
     except Exception as e:
         print("Error parsing input file. This could be due to an empty file "
               f"or an inconsistent number of dimensions. Aborting. ({e})",
               file=sys.stderr)
-        return None
+        from . import supervisor as supervisor_mod
+
+        if isinstance(e, (OSError, TruncatedInputError)):
+            return None, supervisor_mod.EX_IOERR
+        return None, 1
 
 
 def _print_clusters(result) -> None:

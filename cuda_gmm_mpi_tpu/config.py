@@ -140,6 +140,34 @@ class GMMConfig:
     # 0 disables retrying (first failure is final).
     checkpoint_retries: int = 3
 
+    # --- preemption-safe execution (supervisor.py; docs/ROBUSTNESS.md
+    # "Run lifecycle") ---
+    # Wall-clock budget in seconds: the run supervisor treats reaching it
+    # like a SIGTERM -- cooperative stop at the next poll point, emergency
+    # intra-K checkpoint, exit 75 (EX_TEMPFAIL). Front-runs a batch
+    # scheduler's hard kill limit with a clean, resumable exit. None = no
+    # deadline. Only observed while a supervisor is active (the CLI always
+    # activates one; library callers use supervisor.use()).
+    max_runtime_s: Optional[float] = None
+    # EM iterations per supervised segment: with a supervisor active AND
+    # checkpointing on, the jitted EM loop runs in host-polled segments of
+    # this many iterations so SIGTERM/deadline are observed mid-K (each
+    # boundary re-runs one E-step -- ~1/poll_iters overhead; results stay
+    # bit-identical to the single-dispatch loop). Unsupervised runs keep
+    # the zero-sync single dispatch.
+    preempt_poll_iters: int = 25
+    # Checkpoint resume policy: 'auto' (default) resumes from the newest
+    # step -- including an intra-K emergency sub-step, restarting inside
+    # the interrupted fit; 'never' ignores existing checkpoints (fresh
+    # sweep; new checkpoints are still written).
+    resume: str = "auto"
+    # Cross-host liveness watchdog timeout (multi-controller runs with a
+    # supervisor + checkpoint_dir): a peer whose heartbeat on the shared
+    # checkpoint filesystem goes stale beyond this raises PeerLostError
+    # with a local emergency checkpoint instead of hanging forever in the
+    # next collective. 0 disables the watchdog.
+    peer_timeout_s: float = 60.0
+
     # --- numerical fault containment (health.py; docs/ROBUSTNESS.md) ---
     # Health detection (the in-loop bitmask) is ALWAYS on -- it is a
     # handful of elementwise ops per EM iteration against the loop's
@@ -267,6 +295,16 @@ class GMMConfig:
             raise ValueError(f"unknown seed_method: {self.seed_method!r}")
         if self.checkpoint_keep < 1:
             raise ValueError("checkpoint_keep must be >= 1")
+        if self.max_runtime_s is not None and self.max_runtime_s <= 0:
+            raise ValueError("max_runtime_s must be > 0 (or None)")
+        if self.preempt_poll_iters < 1:
+            raise ValueError("preempt_poll_iters must be >= 1")
+        if self.resume not in ("auto", "never"):
+            raise ValueError(
+                f"unknown resume: {self.resume!r} "
+                "(expected 'auto' or 'never')")
+        if self.peer_timeout_s < 0:
+            raise ValueError("peer_timeout_s must be >= 0 (0 disables)")
         if self.recovery not in ("retry", "off"):
             raise ValueError(
                 f"unknown recovery: {self.recovery!r} "

@@ -29,28 +29,103 @@ from typing import Optional, Tuple
 import numpy as np
 
 
+class TruncatedInputError(ValueError):
+    """The input file is torn: a BIN header/payload shorter than its own
+    declared size (a partial copy, a crashed writer). A ValueError
+    subclass so existing parse-error handling still applies, but distinct
+    so the CLI can map unreadable/torn input to exit 74 (EX_IOERR) while
+    malformed CONTENT keeps the reference's exit 1."""
+
+
 def read_data(path: str, start: int = 0, stop: Optional[int] = None,
-              use_native: str = "auto") -> np.ndarray:
+              use_native: str = "auto", screen: str = "off",
+              screen_dtype=None) -> np.ndarray:
     """Read events [start, stop) as a float32 [rows, num_dimensions] array.
 
     Default range is the whole file. ``use_native``: 'auto' tries the C++
     reader and falls back to Python; 'always' requires it; 'never' forces the
     Python path.
+
+    ``screen`` is the ingest-time integrity gate: ``'reject'`` raises
+    :class:`~cuda_gmm_mpi_tpu.validation.InvalidInputError` on any NaN/Inf
+    row with a per-file, per-row message -- bad cytometry input fails HERE,
+    not as an EM health flag 40 iterations later; ``'quarantine'`` (the
+    CLI's ``--allow-nonfinite``) counts and DROPS the bad rows with a
+    warning; ``'off'`` (default) admits everything, matching the
+    reference's atof semantics. ``screen_dtype``: also treat values that
+    would overflow this (compute) dtype as non-finite, mirroring
+    ``validation.validate_finite``. Applies to BIN, CSV, and native reads
+    alike (the screen runs on the parsed rows).
     """
     _check_range(path, start, stop)
+    data = None
     if use_native != "never":
         from . import native
 
         if native.available():
-            if start == 0 and stop is None:
-                return native.read_data(path)
-            return native.read_range(path, start, stop)
-        if use_native == "always":
+            try:
+                if start == 0 and stop is None:
+                    data = native.read_data(path)
+                else:
+                    data = native.read_range(path, start, stop)
+            except ValueError:
+                if not path.endswith("bin"):
+                    raise
+                # Re-diagnose BIN failures through the Python reader: a
+                # torn header/payload must surface as TruncatedInputError
+                # (CLI exit 74, EX_IOERR), not the native reader's generic
+                # parse failure; a file the native path wrongly rejected
+                # still loads.
+                data = None
+        elif use_native == "always":
             raise RuntimeError("native gmm_io library unavailable "
                                "(use_native='always')")
-    if path.endswith("bin"):
-        return read_bin(path, start, stop)
-    return read_csv(path, start, stop)
+    if data is None:
+        data = (read_bin(path, start, stop) if path.endswith("bin")
+                else read_csv(path, start, stop))
+    if screen != "off":
+        data, _ = screen_nonfinite(data, path, mode=screen,
+                                   dtype=screen_dtype, start=start)
+    return data
+
+
+def screen_nonfinite(data: np.ndarray, path: str, *, mode: str = "reject",
+                     dtype=None, start: int = 0):
+    """Input-integrity screen: reject or quarantine NaN/Inf event rows.
+
+    Returns ``(data, n_dropped)``. ``mode='reject'`` raises
+    ``InvalidInputError`` naming the file and the first offending rows;
+    ``mode='quarantine'`` drops them (logged loudly) and returns the clean
+    remainder. ``dtype`` additionally treats magnitudes that overflow the
+    compute dtype (e.g. 1e39 under float32) as non-finite, so quarantined
+    data passes the fit-time validator too. Row numbers are 0-based data
+    rows (after the CSV header), offset by ``start`` for range reads.
+    """
+    if mode not in ("reject", "quarantine"):
+        raise ValueError(f"unknown screen mode: {mode!r}")
+    finite = np.isfinite(data)
+    if dtype is not None and np.dtype(dtype).itemsize < data.dtype.itemsize:
+        finite &= np.abs(data) <= np.finfo(dtype).max
+    row_ok = finite.all(axis=1)
+    bad = np.flatnonzero(~row_ok)
+    if bad.size == 0:
+        return data, 0
+    shown = ", ".join(str(start + int(b)) for b in bad[:5])
+    if mode == "reject":
+        from ..validation import InvalidInputError
+
+        raise InvalidInputError(
+            f"{path}: {bad.size} non-finite event row(s) at ingest "
+            f"(data rows {shown}{', ...' if bad.size > 5 else ''}); "
+            "NaN/Inf events poison every downstream statistic -- clean "
+            "the file, or quarantine with --allow-nonfinite")
+    from ..utils.logging_ import get_logger
+
+    get_logger().warning(
+        "%s: quarantined %d non-finite event row(s) at ingest (data rows "
+        "%s%s) -- they are EXCLUDED from the fit", path, bad.size, shown,
+        ", ..." if bad.size > 5 else "")
+    return np.ascontiguousarray(data[row_ok]), int(bad.size)
 
 
 def _check_range(path: str, start: int, stop: Optional[int]) -> None:
@@ -79,7 +154,7 @@ def data_shape(path: str, use_native: str = "auto") -> Tuple[int, int]:
         with open(path, "rb") as f:
             header = np.fromfile(f, dtype=np.int32, count=2)
         if header.size != 2:
-            raise ValueError(f"{path}: truncated BIN header")
+            raise TruncatedInputError(f"{path}: truncated BIN header")
         if header[0] <= 0 or header[1] <= 0:  # same contract as bin_shape()
             raise ValueError(f"{path}: malformed BIN header {header.tolist()}")
         return int(header[0]), int(header[1])
@@ -102,7 +177,7 @@ def read_bin(path: str, start: int = 0,
     with open(path, "rb") as f:
         header = np.fromfile(f, dtype=np.int32, count=2)
         if header.size != 2:
-            raise ValueError(f"{path}: truncated BIN header")
+            raise TruncatedInputError(f"{path}: truncated BIN header")
         num_events, num_dims = int(header[0]), int(header[1])
         if stop is None:
             stop = num_events
@@ -115,7 +190,7 @@ def read_bin(path: str, start: int = 0,
         rows = stop - start
         data = np.fromfile(f, dtype=np.float32, count=rows * num_dims)
     if data.size != rows * num_dims:
-        raise ValueError(f"{path}: truncated BIN payload")
+        raise TruncatedInputError(f"{path}: truncated BIN payload")
     return data.reshape(rows, num_dims)
 
 
@@ -246,7 +321,7 @@ def read_rows(path: str, indices, use_native: str = "auto") -> np.ndarray:
         with open(path, "rb") as f:
             header = np.fromfile(f, dtype=np.int32, count=2)
             if header.size != 2:
-                raise ValueError(f"{path}: truncated BIN header")
+                raise TruncatedInputError(f"{path}: truncated BIN header")
             num_events, num_dims = int(header[0]), int(header[1])
             if uniq[0] < 0 or uniq[-1] >= num_events:
                 raise ValueError(f"{path}: row index out of bounds")
@@ -255,7 +330,7 @@ def read_rows(path: str, indices, use_native: str = "auto") -> np.ndarray:
                 f.seek(8 + int(i) * num_dims * 4)
                 r = np.fromfile(f, dtype=np.float32, count=num_dims)
                 if r.size != num_dims:
-                    raise ValueError(f"{path}: truncated BIN payload")
+                    raise TruncatedInputError(f"{path}: truncated BIN payload")
                 rows[int(i)] = r
     else:
         want = set(int(i) for i in uniq)

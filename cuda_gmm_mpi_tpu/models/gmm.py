@@ -241,6 +241,106 @@ class GMMModel:
         self.last_health = out[-1]
         return out[:-1]
 
+    def run_em_resumable(self, state, data_chunks, wts_chunks, epsilon,
+                         min_iters: Optional[int] = None,
+                         max_iters: Optional[int] = None, *,
+                         poll_iters: int = 25,
+                         should_stop: Optional[Callable[[int], bool]] = None,
+                         block_stop: Optional[Callable] = None,
+                         resume: Optional[dict] = None,
+                         donate: bool = False):
+        """Reference EM semantics in host-polled segments (supervisor.py).
+
+        The single-dispatch ``run_em`` gives the host no intervention point
+        for 100 iterations; here the SAME compiled executable runs in
+        segments of ``poll_iters`` iterations (``min_iters``/``max_iters``
+        are dynamic args, so no recompile), and between segments the host
+        polls ``should_stop(done_iters)`` -- the supervisor's cooperative
+        stop flag -- and applies the loop's own NaN-safe continuation
+        predicate. Each boundary re-runs one E-step on the carried state
+        (estep of an unchanged state is deterministic, so the iteration
+        sequence -- and the final model -- is bit-identical to the
+        single-dispatch loop; the ~1/poll_iters extra E-steps are the price
+        of preemptibility). ``resume={"em_iter": i, "em_lls": [...]}``
+        restarts at iteration ``i`` from a restored mid-EM state.
+
+        Returns ``(state, loglik, iters, ll_log, stopped, extra)``:
+        ``ll_log`` follows ``em_while_loop``'s trajectory contract
+        ([config.max_iters + 1], NaN-padded); ``stopped`` is True when
+        ``should_stop`` tripped (the state is the segment-boundary state to
+        checkpoint); ``extra`` carries path-specific resume payload keys
+        (empty here; the streaming override adds its block accumulator).
+        Health counters accumulate across segments onto ``last_health``
+        (boundary re-E-steps recount state-derived lanes, so non-fatal
+        counters can read slightly higher than a single-dispatch run's;
+        fatal semantics are identical). ``block_stop`` is accepted for
+        interface parity with the streaming override and ignored.
+        """
+        lo, hi = resolve_iters(self.config, min_iters, max_iters)
+        lo, hi = int(lo), int(hi)
+        eps_f = abs(float(epsilon))
+        inj = faults.peek("preempt")
+        inj_iter = None
+        if inj is not None and "iter" in inj \
+                and int(inj.get("block", -1)) == -1:
+            inj_iter = int(inj["iter"])
+
+        done = 0
+        lls: list = []
+        if resume:
+            done = int(resume.get("em_iter", 0))
+            lls = [float(x) for x in
+                   np.asarray(resume.get("em_lls", ())).reshape(-1)]
+        counts_total = np.zeros((health.NUM_FLAGS,), np.int64)
+        stopped = False
+        while True:
+            if lls:  # boundary continuation test == the device cond
+                if done >= hi:
+                    break
+                if done >= lo and len(lls) >= 2 \
+                        and abs(lls[-1] - lls[-2]) <= eps_f:
+                    break
+            seg_end = min(done + max(int(poll_iters), 1), hi)
+            if inj_iter is not None and done < inj_iter < seg_end:
+                # Clamp the segment so a poll lands exactly on the armed
+                # preempt iteration (deterministic injection contract).
+                seg_end = inj_iter
+            seg_max = seg_end - done
+            seg_min = min(max(lo - done, 0), seg_max)
+            state, ll, iters, ll_log = self.run_em(
+                state, data_chunks, wts_chunks, epsilon,
+                min_iters=seg_min, max_iters=seg_max,
+                trajectory=True, donate=donate)
+            seg_iters = int(jax.device_get(iters))
+            seg_lls = np.asarray(jax.device_get(ll_log), np.float64)
+            counts_seg = np.asarray(jax.device_get(self.last_health),
+                                    np.int64)
+            counts_total += counts_seg
+            if lls:
+                # Slot 0 re-derives the previous segment's final loglik
+                # (the boundary E-step); keep only the new iterations.
+                lls.extend(float(x) for x in seg_lls[1:seg_iters + 1])
+            else:
+                lls.extend(float(x) for x in seg_lls[:seg_iters + 1])
+            done += seg_iters
+            if health.word_is_fatal(health.pack_word(counts_seg)):
+                break  # the caller's recovery ladder takes it from here
+            if should_stop is not None and should_stop(done):
+                stopped = True
+                break
+            if seg_iters < seg_max or seg_max == 0:
+                break  # device exited early: converged inside the segment
+        self.last_health = jnp.asarray(
+            np.minimum(counts_total, np.iinfo(np.int32).max), jnp.int32)
+        buf = np.full((int(self.config.max_iters) + 1,), np.nan, np.float64)
+        n = min(len(lls), buf.shape[0])
+        buf[:n] = lls[:n]
+        ll_out = lls[-1] if lls else float("nan")
+        # The exact-length trajectory rides the stop payload so the
+        # emergency checkpoint stores precisely the completed iterations.
+        extra = {"em_lls": np.asarray(lls, np.float64)} if stopped else {}
+        return state, ll_out, done, buf, stopped, extra
+
     def rebucket_state(self, state, num_clusters: int):
         """Compact ``state`` to a narrower padded width on device (the
         sweep's bucket recompaction; see state.compact_to). Width is

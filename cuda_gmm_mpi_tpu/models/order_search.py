@@ -25,7 +25,7 @@ import jax
 import jax.numpy as jnp
 import numpy as np
 
-from .. import health
+from .. import health, supervisor
 from ..config import GMMConfig
 from ..ops.formulas import convergence_epsilon, model_score
 from ..validation import InvalidInputError, validate_finite
@@ -135,6 +135,39 @@ def _emit_em_iters(rec, k, ll_log, iters, dt, epsilon, model):
                  epsilon=float(epsilon),
                  wall_s=round(float(wall), 6),
                  timing="measured" if measured else "amortized")
+
+
+def _shutdown_and_raise(sup, rec, log, ckpt, *, step, k=None, em_iter=None,
+                        payload=None, checkpointed=None):
+    """The cooperative stop's endgame: write the emergency intra-K
+    sub-step (when ``payload`` is given), emit the ``shutdown`` telemetry
+    record, and raise the stop as PreemptedError / PeerLostError
+    (supervisor.raise_stop) for the CLI's exit-75 contract."""
+    if payload is not None:
+        checkpointed = bool(
+            ckpt is not None
+            and ckpt.save_substep(int(step), int(em_iter), payload))
+    checkpointed = bool(checkpointed)
+    if rec.active:
+        fields = dict(reason=sup.stop_reason or "unknown",
+                      checkpointed=checkpointed)
+        if step is not None:
+            fields["step"] = int(step)
+        if k is not None:
+            fields["k"] = int(k)
+        if em_iter is not None:
+            fields["em_iter"] = int(em_iter)
+        rec.emit("shutdown", **fields)
+        if checkpointed:
+            rec.metrics.count("emergency_checkpoints")
+    log.warning(
+        "stopping (%s)%s: emergency checkpoint %s", sup.stop_reason,
+        (f" at K={k}" + (f" iteration {em_iter}" if em_iter is not None
+                         else "")) if k is not None else "",
+        "written" if checkpointed else
+        ("not needed (sweep position already durable)" if payload is None
+         and ckpt is not None else "unavailable"))
+    sup.raise_stop(step=step, em_iter=em_iter, checkpointed=checkpointed)
 
 
 def _reseed_and_refit(model, config, state, chunks, wts, epsilon, k,
@@ -357,16 +390,25 @@ def fit_gmm(
     docs/OBSERVABILITY.md. Already-active ambient recorders (library users
     wrapping fits in ``telemetry.use``) are reused, not replaced.
     """
-    if config.metrics_file and not telemetry.current().active:
-        # One recorder spans the whole fit, restarts included: the
-        # recursive n_init sub-fits find the ambient recorder active and
-        # ride it instead of truncating the stream per init.
-        rec = RunRecorder(config.metrics_file)
-        with telemetry.use(rec), rec:
-            return _fit_gmm(data, num_clusters, target_num_clusters, config,
-                            model, verbose, init_means, sample_weight)
-    return _fit_gmm(data, num_clusters, target_num_clusters, config, model,
-                    verbose, init_means, sample_weight)
+    with contextlib.ExitStack() as stack:
+        if config.metrics_file and not telemetry.current().active:
+            # One recorder spans the whole fit, restarts included: the
+            # recursive n_init sub-fits find the ambient recorder active
+            # and ride it instead of truncating the stream per init.
+            rec = RunRecorder(config.metrics_file)
+            stack.enter_context(telemetry.use(rec))
+            stack.enter_context(rec)
+        if config.max_runtime_s is not None \
+                and not supervisor.current().active:
+            # A deadline without an ambient supervisor (library call): run
+            # one scoped to this fit. No signal handlers -- hijacking a
+            # host application's SIGTERM from a library is the CLI's
+            # prerogative (it activates its own supervisor), not ours.
+            stack.enter_context(supervisor.use(supervisor.RunSupervisor(
+                max_runtime_s=config.max_runtime_s,
+                install_signals=False)))
+        return _fit_gmm(data, num_clusters, target_num_clusters, config,
+                        model, verbose, init_means, sample_weight)
 
 
 def _fit_gmm(data, num_clusters, target_num_clusters, config, model,
@@ -479,6 +521,20 @@ def _fit_gmm(data, num_clusters, target_num_clusters, config, model,
                                  keep=config.checkpoint_keep,
                                  retries=config.checkpoint_retries)
 
+    sup = supervisor.current()
+    if (sup.active and ckpt is not None and nproc > 1
+            and config.peer_timeout_s > 0):
+        # Cross-host liveness watchdog: rank heartbeats ride the shared
+        # checkpoint filesystem (multi-host runs already require one); a
+        # peer stale beyond peer_timeout_s raises PeerLostError with a
+        # local emergency checkpoint instead of hanging this rank forever
+        # in the next collective (supervisor.LivenessWatchdog).
+        sup.start_watchdog(
+            os.path.join(os.path.abspath(config.checkpoint_dir),
+                         "heartbeats"),
+            rank=jax.process_index(), nproc=nproc,
+            timeout_s=config.peer_timeout_s)
+
     # Health counters observed by a fused sweep that aborted on a fatal
     # word (the host-driven rerun below folds them into its summary).
     fused_fatal_counts = None
@@ -554,7 +610,9 @@ def _fit_gmm(data, num_clusters, target_num_clusters, config, model,
     k = num_clusters
     step = 0
 
-    if ckpt is not None:
+    resume_em = None
+    resume_sub_step = None
+    if ckpt is not None and config.resume != "never":
         restored = ckpt.restore()
         if restored is not None and "fused_log" in restored:
             log.warning("found a fused-sweep checkpoint; the host-driven "
@@ -583,6 +641,43 @@ def _fit_gmm(data, num_clusters, target_num_clusters, config, model,
                     restored.get("sweep_log", [])) else []
             log.info("resumed sweep from checkpoint: next K=%d", k)
             rec.metrics.count("resumes") if rec.active else None
+        # Intra-K emergency sub-step (a preempted run's mid-EM state): it
+        # outranks the full steps -- its step is the IN-FLIGHT one -- so
+        # --resume auto restarts inside the interrupted fit rather than
+        # at its beginning (supervisor.py / docs/ROBUSTNESS.md).
+        sub = ckpt.restore_substep()
+        if sub is not None and (
+                _resume_mismatch(sub, config, log)
+                or int(sub["num_clusters"]) != num_clusters
+                or int(sub["step"]) < step):
+            sub = None
+        if sub is not None:
+            state = sub["state"]
+            if hasattr(model, "prepare_state"):
+                state = model.prepare_state(
+                    jax.tree_util.tree_map(jnp.asarray, state))
+            best_state = sub["best_state"]
+            min_rissanen = float(sub["min_rissanen"])
+            ideal_k = int(sub["ideal_k"])
+            best_ll = float(sub["best_ll"])
+            k = int(sub["k"])
+            step = int(sub["step"])
+            sweep_log = [tuple(r) for r in np.asarray(
+                sub["sweep_log"]).tolist()] if len(
+                    sub.get("sweep_log", [])) else []
+            resume_sub_step = int(sub["step"])
+            resume_em = {"em_iter": int(sub["em_iter"]),
+                         "em_lls": np.asarray(sub.get("em_lls", ()),
+                                              np.float64)}
+            for key in ("stream_pass", "stream_block"):
+                if key in sub:
+                    resume_em[key] = int(sub[key])
+            if "stream_acc" in sub:
+                resume_em["stream_acc"] = sub["stream_acc"]
+            log.info("resuming INSIDE the interrupted fit: K=%d at EM "
+                     "iteration %d (intra-K sub-step %d.iter%d)",
+                     k, resume_em["em_iter"], step, resume_em["em_iter"])
+            rec.metrics.count("resumes") if rec.active else None
 
     want_traj = rec.active  # per-iteration loglik log rides the EM call
     em_walls = []  # per-K EM wall seconds (first includes compile)
@@ -598,7 +693,21 @@ def _fit_gmm(data, num_clusters, target_num_clusters, config, model,
         # recovery action (the 'recovery' event was already emitted).
         health_totals += fused_fatal_counts
         n_recoveries += 1
+    # Preemption-safe mode: with an active supervisor AND checkpointing,
+    # EM runs through the segmented driver so SIGTERM/deadline/peer-loss
+    # are observed mid-K and an intra-K emergency sub-step can be written
+    # (bit-identical results; supervisor.py). Unsupervised runs keep the
+    # zero-sync single-dispatch loop untouched.
+    supervised = (sup.active and ckpt is not None
+                  and hasattr(model, "run_em_resumable"))
     while k >= stop_number:
+        if sup.active and sup.poll(where="sweep", k=int(k)):
+            # Between-K stop: every completed K is already durable (the
+            # full-step save at the end of the previous loop iteration),
+            # so there is nothing to add -- emit and exit.
+            _shutdown_and_raise(sup, rec, log, ckpt,
+                                step=step - 1 if step else None, k=int(k),
+                                checkpointed=ckpt is not None and step > 0)
         t0 = time.perf_counter()
         last_k = k <= stop_number
         em_widths.append(int(state.num_clusters_padded))
@@ -610,7 +719,57 @@ def _fit_gmm(data, num_clusters, target_num_clusters, config, model,
             # donate=True: the EM carry is rebound every K, so the input
             # state's buffers are handed to the device for in-place reuse
             # (one state-size less peak HBM + copy traffic per K).
-            if want_traj:
+            if supervised or resume_em is not None:
+                (state, ll, iters, ll_log, em_stopped,
+                 stop_extra) = model.run_em_resumable(
+                    state, chunks, wts, epsilon,
+                    poll_iters=config.preempt_poll_iters,
+                    should_stop=(
+                        (lambda done, _k=int(k): sup.poll(
+                            where="em", k=_k, em_iter=done))
+                        if sup.active else None),
+                    block_stop=(
+                        (lambda p, b, _k=int(k): sup.poll_block(
+                            k=_k, em_iter=p, block=b))
+                        if sup.active else None),
+                    resume=resume_em, donate=True)
+                resume_em = None
+                hw = model.last_health
+                if em_stopped:
+                    done = int(iters)
+                    host_state = _host_state(state, model)
+                    # Before any K completed, best_state still aliases the
+                    # (donated, now-deleted) seed state; the mid-EM state
+                    # stands in -- the resumed first K re-runs the best-save
+                    # rule (k == num_clusters always saves) anyway.
+                    host_best = (_host_state(best_state, model)
+                                 if np.isfinite(best_ll) else host_state)
+                    payload = {
+                        "state": host_state,
+                        "best_state": host_best,
+                        "min_rissanen": float(min_rissanen),
+                        "ideal_k": int(ideal_k),
+                        "best_ll": float(best_ll),
+                        "k": int(k),
+                        "num_clusters": int(num_clusters),
+                        "criterion_code": _CRITERION_CODE[config.criterion],
+                        "cov_code": _COV_CODE[config.covariance_type],
+                        "sweep_log": np.asarray(sweep_log, np.float64),
+                    }
+                    payload.update(stop_extra)
+                    _shutdown_and_raise(sup, rec, log, ckpt, step=step,
+                                        k=int(k), em_iter=done,
+                                        payload=payload)
+                if resume_sub_step is not None and ckpt is not None:
+                    # The interrupted K just completed: its emergency
+                    # sub-step is superseded. The save paths prune too,
+                    # but the sweep's FINAL K never saves a full step, so
+                    # discard explicitly here.
+                    ckpt.discard_substeps(resume_sub_step)
+                    resume_sub_step = None
+                if not want_traj:
+                    ll_log = None
+            elif want_traj:
                 state, ll, iters, ll_log = model.run_em(
                     state, chunks, wts, epsilon, trajectory=True,
                     donate=True)
@@ -1138,7 +1297,7 @@ def _run_fused_sweep(fused, config, state, chunks, wts, epsilon,
 
     resume = None
     if ckpt is not None:
-        restored = ckpt.restore()
+        restored = ckpt.restore() if config.resume != "never" else None
         if restored is not None and _resume_mismatch(restored, config, log):
             restored = None
         if (restored is not None
@@ -1224,6 +1383,20 @@ def _run_fused_sweep(fused, config, state, chunks, wts, epsilon,
                 "criterion_code": _CRITERION_CODE[config.criterion],
                 "cov_code": _COV_CODE[config.covariance_type],
             })
+            sup = supervisor.current()
+            if sup.active and sup.stop_requested:
+                # The fused program's only host intervention point is this
+                # per-K emission: with this step's checkpoint durable,
+                # aborting the device program here is the graceful exit
+                # (per-K granularity -- a single device program has no
+                # mid-EM poll). The raise surfaces at the fused() call
+                # below, where it is converted to the preemption exit.
+                sup._emit_preempt(where="fused_emit", k=None,
+                                  em_iter=None)
+                raise supervisor.PreemptedError(
+                    "fused sweep stopped at per-K emission",
+                    reason=sup.stop_reason or "unknown", step=step,
+                    checkpointed=True)
 
         model._emit_target = emit
 
@@ -1243,6 +1416,27 @@ def _run_fused_sweep(fused, config, state, chunks, wts, epsilon,
          health_counts) = jax.device_get(
             (best_state, best_ll, best_riss, log_rows, steps, health_counts)
         )
+    except Exception as e:
+        # A cooperative stop raised inside the emission callback aborts
+        # the device program; the runtime may surface it as its own error
+        # type, so re-derive the preemption from the supervisor state.
+        sup = supervisor.current()
+        if sup.active and sup.stop_requested:
+            try:
+                # Drain the aborted program's effect tokens now (they hold
+                # the callback's exception) so interpreter exit does not
+                # trip over them in jax's atexit hook.
+                jax.effects_barrier()
+            except Exception:
+                pass
+            rec_ = telemetry.current()
+            if rec_.active:
+                rec_.emit("shutdown", reason=sup.stop_reason or "unknown",
+                          checkpointed=bool(ckpt is not None and emit_times))
+            sup.raise_stop(
+                step=(max(emit_times) if emit_times else None),
+                checkpointed=bool(ckpt is not None and emit_times))
+        raise
     finally:
         if with_emit:
             model._emit_target = None

@@ -65,6 +65,20 @@ from ..testing import faults
 from .gmm import GMMModel, resolve_iters
 
 
+class _StreamPreempt(Exception):
+    """Internal: a mid-pass cooperative stop. Carries the partially
+    reduced block accumulator (device SuffStats, pre-psum), the next
+    unprocessed block, and the pass index -- the streaming path's
+    emergency-checkpoint payload (supervisor.py / docs/ROBUSTNESS.md)."""
+
+    def __init__(self, acc, next_block: int, pass_idx: int):
+        super().__init__(f"stream pass {pass_idx} stopped before "
+                         f"block {next_block}")
+        self.acc = acc
+        self.next_block = next_block
+        self.pass_idx = pass_idx
+
+
 class StreamingGMMModel(GMMModel):
     """GMMModel with host-resident chunks and a host-driven EM loop."""
 
@@ -297,8 +311,19 @@ class StreamingGMMModel(GMMModel):
         return (jax.device_put(sel_c, self._x_sharding_stream),
                 jax.device_put(sel_w, self._w_sharding_stream))
 
-    def _estep_all(self, state, chunks, wts):
-        """One full-data fused E+M pass, streaming block by block."""
+    def _estep_all(self, state, chunks, wts, *, stop_check=None,
+                   start_block: int = 0, acc0=None):
+        """One full-data fused E+M pass, streaming block by block.
+
+        ``stop_check(pass_idx, block)`` (supervised runs, single-device
+        only) is polled after each non-final block; a truthy return raises
+        :class:`_StreamPreempt` carrying the partial accumulator so the
+        emergency checkpoint loses at most one block of compute.
+        ``start_block``/``acc0`` resume such an interrupted pass: blocks
+        before ``start_block`` are represented by the restored accumulator,
+        so the block-sequential addition order -- and the reduced
+        statistics -- stay bit-identical to an uninterrupted pass.
+        """
         n = chunks.shape[0]
         if self.mesh is None:
             blocks, stats_fn = n, self._chunk_stats_jit
@@ -330,9 +355,9 @@ class StreamingGMMModel(GMMModel):
         emit = rec.active
         pass_idx, self._pass_index = self._pass_index, self._pass_index + 1
         chunks_per_block = 1 if self.mesh is None else self._local_data_size
-        acc = None
-        nxt = self._put_block(chunks, wts, 0, blocks)
-        for j in range(blocks):
+        acc = acc0
+        nxt = self._put_block(chunks, wts, start_block, blocks)
+        for j in range(start_block, blocks):
             cur = nxt
             if j + 1 < blocks:
                 # Double-buffer: enqueue block j+1's copy BEFORE dispatching
@@ -349,6 +374,11 @@ class StreamingGMMModel(GMMModel):
                 rec.emit("chunk_flush", iter=pass_idx, block=j,
                          chunks=chunks_per_block, bytes=nbytes)
                 rec.heartbeat("stream")
+            if (stop_check is not None and j + 1 < blocks
+                    and stop_check(pass_idx, j)):
+                # Mid-pass cooperative stop (never on the final block --
+                # a finished pass is worth more than one block's latency).
+                raise _StreamPreempt(acc, j + 1, pass_idx)
         if self.mesh is not None:
             if self._reduce_fn is None:
                 self._reduce_fn = self._make_reduce(acc)
@@ -458,3 +488,156 @@ class StreamingGMMModel(GMMModel):
         if trajectory:
             return out + (np.asarray(lls, np.float64),)
         return out
+
+    def run_em_resumable(self, state, chunks, wts, epsilon,
+                         min_iters: Optional[int] = None,
+                         max_iters: Optional[int] = None, *,
+                         poll_iters: int = 25, should_stop=None,
+                         block_stop=None, resume: Optional[dict] = None,
+                         donate: bool = False):
+        """Supervised variant of the streaming loop (supervisor.py).
+
+        The host-driven loop is already a poll point per pass;
+        additionally ``block_stop(pass_idx, block)`` is consulted after
+        every streamed block (single-device streams only -- on a mesh the
+        per-shard accumulator is not host-local, so stops round up to the
+        pass boundary), and a mid-pass stop carries the partially reduced
+        block accumulator into the emergency checkpoint: a preempted
+        400M-event pass loses at most one block of compute, not the pass.
+        ``resume`` accepts the in-memory keys (``em_iter``/``em_lls``;
+        the boundary re-E-step recomputes the statistics the next M-step
+        needs, bit-identically) plus the streaming extras
+        (``stream_pass``, ``stream_block``, ``stream_acc``) written by
+        the mid-pass stop. ``poll_iters`` is ignored (every pass is a
+        host round-trip already). Returns the ``run_em_resumable``
+        contract: (state, loglik, iters, ll_log, stopped, extra).
+        """
+        import dataclasses as _dc
+
+        from ..ops.mstep import SuffStats
+
+        lo, hi = resolve_iters(self.config, min_iters, max_iters)
+        lo, hi = int(lo), int(hi)
+        self.last_iter_seconds = []
+        counts = np.zeros((health.NUM_FLAGS,), np.int64)
+        reg_tol = float(self.config.health_regression_scale) * float(epsilon)
+        eps_f = abs(float(epsilon))
+
+        def observe(ll, ll_prev=None):
+            if not np.isfinite(ll):
+                counts[health.NONFINITE_LOGLIK] += 1
+                return True
+            if ll_prev is not None and np.isfinite(ll_prev) \
+                    and ll < ll_prev - reg_tol:
+                counts[health.LOGLIK_REGRESSION] += 1
+            return False
+
+        bstop = block_stop if self.mesh is None else None
+
+        def stop_payload(sp: _StreamPreempt):
+            return {
+                "stream_pass": int(sp.pass_idx),
+                "stream_block": int(sp.next_block),
+                "stream_acc": {
+                    f.name: np.asarray(jax.device_get(
+                        getattr(sp.acc, f.name)))
+                    for f in _dc.fields(sp.acc)
+                },
+            }
+
+        def finish(stopped, extra, lls, iters, stats=None):
+            if stats is not None:
+                counts[:] += np.asarray(jax.device_get(self._state_health(
+                    state, stats.Nk)), np.int64)
+            if stopped:
+                extra = dict(extra, em_lls=np.asarray(lls, np.float64))
+            self.last_health = jnp.asarray(counts, jnp.int32)
+            buf = np.full((int(self.config.max_iters) + 1,), np.nan,
+                          np.float64)
+            n = min(len(lls), buf.shape[0])
+            buf[:n] = lls[:n]
+            ll_out = lls[-1] if lls else float("nan")
+            return state, ll_out, iters, buf, stopped, extra
+
+        # -- establish this position's statistics (fresh, boundary resume,
+        # or mid-pass resume with the restored partial accumulator) --
+        lls: list = []
+        iters = 0
+        resume = resume or {}
+        try:
+            if "stream_acc" in resume:
+                p = int(resume["stream_pass"])
+                acc0 = SuffStats(**{k: jnp.asarray(v) for k, v in
+                                    resume["stream_acc"].items()})
+                self._pass_index = p
+                lls = [float(x) for x in
+                       np.asarray(resume.get("em_lls", ())).reshape(-1)]
+                iters = max(p - 1, 0)
+                # The saved state is post-M-step of pass p (== the state
+                # the interrupted E-step was scanning); continue the pass
+                # from the first unprocessed block.
+                stats = self._estep_all(
+                    state, chunks, wts, stop_check=bstop,
+                    start_block=int(resume["stream_block"]), acc0=acc0)
+            elif resume:
+                # Boundary resume: the saved state is iteration ``done``'s
+                # post-E-step state, and an E-step leaves the state
+                # untouched -- so one recomputed pass rebuilds exactly the
+                # statistics the next M-step consumed in the uninterrupted
+                # run (the in-memory segmented driver's estep0 analog).
+                iters = int(resume.get("em_iter", 0))
+                lls = [float(x) for x in
+                       np.asarray(resume.get("em_lls", ())).reshape(-1)]
+                self._pass_index = iters
+                stats = self._estep_all(state, chunks, wts, stop_check=bstop)
+            else:
+                self._pass_index = 0
+                stats = self._estep_all(state, chunks, wts, stop_check=bstop)
+        except _StreamPreempt as sp:
+            return finish(True, stop_payload(sp), lls, iters)
+
+        if "stream_acc" in resume and int(resume["stream_pass"]) > 0:
+            # The resumed pass WAS iteration p: fold its loglik in now.
+            p = int(resume["stream_pass"])
+            ll = float(stats.loglik)
+            counts[health.SANITIZED_LANES] += int(stats.sanitized)
+            fatal = observe(ll, lls[-1] if lls else None)
+            lls.append(ll)
+            iters = p
+        else:
+            if not lls:  # fresh run (or mid-pass resume of pass 0)
+                ll0 = float(stats.loglik)
+                counts[health.SANITIZED_LANES] += int(stats.sanitized)
+                fatal = observe(ll0)
+                lls = [ll0]
+            else:
+                # Boundary resume: lls already ends with this pass's
+                # loglik (the recompute reproduces it bit-identically).
+                counts[health.SANITIZED_LANES] += int(stats.sanitized)
+                fatal = observe(lls[-1])
+        ll_old = lls[-1]
+        change = (lls[-1] - lls[-2]) if len(lls) >= 2 \
+            else abs(2.0 * eps_f) + 1.0
+
+        inj = faults.peek("nan_loglik")  # runtime-consumed (host loop)
+        while not fatal and (
+                iters < lo or (not abs(change) <= eps_f and iters < hi)):
+            if should_stop is not None and should_stop(iters):
+                return finish(True, {}, lls, iters, stats)
+            t0 = time.perf_counter()
+            state = self._mstep(state, stats)
+            try:
+                stats = self._estep_all(state, chunks, wts, stop_check=bstop)
+            except _StreamPreempt as sp:
+                return finish(True, stop_payload(sp), lls, iters)
+            ll = float(stats.loglik)
+            if inj is not None and iters + 1 == int(inj["iter"]) \
+                    and faults.take("nan_loglik") is not None:
+                ll = float("nan")
+            counts[health.SANITIZED_LANES] += int(stats.sanitized)
+            fatal = observe(ll, ll_old)
+            self.last_iter_seconds.append(time.perf_counter() - t0)
+            lls.append(ll)
+            change, ll_old = ll - ll_old, ll
+            iters += 1
+        return finish(False, {}, lls, iters, stats)

@@ -15,6 +15,7 @@ Single-host callers can use everything here unchanged (process_count==1).
 from __future__ import annotations
 
 import os
+import time
 import zlib
 from typing import Optional, Tuple
 
@@ -145,13 +146,90 @@ def allgather_json(obj) -> list:
     ]
 
 
-def barrier(name: str = "gmm_barrier") -> None:
+def barrier(name: str = "gmm_barrier",
+            timeout_s: Optional[float] = None) -> None:
     """Cross-host sync point (the MPI_Barrier analog -- needed only at host
-    filesystem rendezvous like output assembly, never inside compute)."""
-    if jax.process_count() > 1:
-        from jax.experimental import multihost_utils
+    filesystem rendezvous like output assembly, never inside compute).
 
+    With ``timeout_s`` -- passed explicitly, or implied by an active run
+    supervisor whose liveness watchdog is running -- the collective is
+    bounded: a dead or wedged peer raises
+    :class:`~cuda_gmm_mpi_tpu.supervisor.PeerLostError` after the timeout
+    instead of blocking this rank forever (the reference's failure mode:
+    one dead MPI rank hangs every ``MPI_Allreduce`` survivor). The
+    underlying collective cannot be cancelled; the raise abandons its
+    daemon thread, which is fine because the caller's next act is an
+    emergency checkpoint and a loud exit.
+    """
+    if jax.process_count() <= 1:
+        return
+    from jax.experimental import multihost_utils
+
+    if timeout_s is None:
+        from .. import supervisor
+
+        timeout_s = supervisor.current().collective_timeout_s
+    if not timeout_s:
         multihost_utils.sync_global_devices(name)
+        return
+
+    import threading
+
+    done = threading.Event()
+    err: list = []
+
+    def _run():
+        try:
+            multihost_utils.sync_global_devices(name)
+        except Exception as e:  # surfaced on the caller thread below
+            err.append(e)
+        finally:
+            done.set()
+
+    t = threading.Thread(target=_run, name=f"gmm-barrier-{name}",
+                         daemon=True)
+    t.start()
+    if not done.wait(float(timeout_s)):
+        from .. import supervisor
+
+        raise supervisor.PeerLostError(
+            f"barrier {name!r} timed out after {timeout_s:.1f}s: a peer "
+            "rank is dead or wedged", timeout_s=float(timeout_s))
+    if err:
+        raise err[0]
+
+
+# -- rank heartbeats (the liveness watchdog's exchange medium) --------------
+#
+# Deliberately filesystem-based, not a device collective: multi-host runs
+# already require a checkpoint filesystem every rank can reach
+# (docs/DISTRIBUTED.md), a background-thread collective would interleave
+# with the main thread's compute collectives, and a hung peer is exactly
+# the case where collectives stop returning. supervisor.LivenessWatchdog
+# drives these.
+
+def heartbeat_path(directory: str, rank: int) -> str:
+    return os.path.join(directory, f"rank{int(rank):05d}.hb")
+
+
+def write_rank_heartbeat(directory: str, rank: int) -> None:
+    """Atomically touch this rank's heartbeat file (tmp + rename, so a
+    reader never sees a partial write and mtime moves monotonically)."""
+    os.makedirs(directory, exist_ok=True)
+    path = heartbeat_path(directory, rank)
+    tmp = path + ".tmp"
+    with open(tmp, "w") as f:
+        f.write(f"{os.getpid()} {time.time():.3f}\n")
+    os.replace(tmp, path)
+
+
+def read_rank_heartbeat(directory: str, rank: int) -> Optional[float]:
+    """The peer's last-heartbeat mtime (seconds since epoch, the shared
+    filesystem's clock), or None if it never wrote one."""
+    try:
+        return os.stat(heartbeat_path(directory, rank)).st_mtime
+    except OSError:
+        return None
 
 
 def host_slice(num_events: int, process_id: int, process_count: int):

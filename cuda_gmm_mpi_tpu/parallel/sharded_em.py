@@ -31,7 +31,7 @@ from jax import lax
 from jax.sharding import NamedSharding, PartitionSpec as P
 
 from ..config import GMMConfig
-from ..models.gmm import em_while_loop, resolve_iters
+from ..models.gmm import GMMModel, em_while_loop, resolve_iters
 from ..ops.mstep import SuffStats
 from ..ops.estep import posteriors
 from .mesh import (
@@ -379,6 +379,14 @@ class ShardedGMMModel:
         )
         self.last_health = out[-1]
         return out[:-1]
+
+    # Supervised segmented EM (preemption-safe execution, supervisor.py):
+    # the driver consumes only run_em/last_health/config -- all provided
+    # here with GMMModel's exact semantics -- so the sharded model borrows
+    # the implementation verbatim. Mid-K stops and intra-K emergency
+    # checkpoints therefore work on a mesh too; health counters stay
+    # psum-exact per segment.
+    run_em_resumable = GMMModel.run_em_resumable
 
     def rebucket_state(self, state, num_clusters: int):
         """Bucket recompaction on the mesh: compact the (tiny) K-state to

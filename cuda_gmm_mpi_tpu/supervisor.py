@@ -1,0 +1,512 @@
+"""Preemption-safe execution: the run-scoped shutdown supervisor.
+
+The reference has no shutdown story at all: a batch-scheduler SIGTERM (or a
+wall-clock limit) kills ``gaussianMPI`` with every byte of sweep state still
+in host RAM (``gaussian.cu:262-275`` holds the best model until the final
+write), and a dead rank leaves the survivors blocked forever in the next
+``MPI_Allreduce``. On preemptible TPU slices -- the north-star deployment --
+eviction-with-grace-period is the COMMON case, so this module turns kills,
+deadlines, and peer loss into clean, resumable exits:
+
+- :class:`RunSupervisor` installs SIGTERM/SIGINT handlers and an optional
+  wall-clock deadline (``GMMConfig.max_runtime_s`` / ``--max-runtime``) and
+  exposes a cooperative stop flag. Signal handlers only SET the flag -- all
+  real work happens at the next poll point on the main thread, never in
+  signal context.
+- The host-driven sweep, the streaming block loop, and the segmented EM
+  driver (``GMMModel.run_em_resumable``) poll the flag between device
+  dispatches. On stop they write an *emergency checkpoint* -- the intra-K
+  sub-step of :class:`~cuda_gmm_mpi_tpu.utils.checkpoint.SweepCheckpointer`
+  carrying the mid-EM state, iteration count, loglik trajectory, and (for
+  streaming) the partially reduced block accumulator -- then raise
+  :class:`PreemptedError`, which the CLI maps to exit code 75
+  (``EX_TEMPFAIL``: preempted, resumable). ``--resume auto`` restores the
+  sub-step and restarts INSIDE the interrupted fit.
+- :class:`LivenessWatchdog` (multi-controller runs) exchanges rank
+  heartbeats through the shared checkpoint filesystem
+  (``parallel.distributed`` heartbeat primitives -- multi-host runs already
+  require one, docs/DISTRIBUTED.md) on the telemetry heartbeat cadence. A
+  peer whose heartbeat goes stale beyond ``peer_timeout_s`` produces a loud
+  :class:`PeerLostError` plus a local emergency checkpoint instead of an
+  indefinite collective hang; ``distributed.barrier`` becomes
+  timeout-bounded while a watchdog is active.
+
+Activation mirrors telemetry's ambient pattern: the CLI (or a library
+caller) wraps a fit in ``with supervisor.use(RunSupervisor(...)):`` and the
+instrumented layers find it via :func:`current`; the default ambient
+supervisor is inert. Telemetry events ``preempt`` / ``shutdown`` /
+``peer_lost`` document the lifecycle (docs/OBSERVABILITY.md); the full state
+diagram lives in docs/ROBUSTNESS.md ("Run lifecycle").
+
+Multi-host semantics: each rank polls its OWN signals/deadline (batch
+schedulers deliver SIGTERM to every rank of a preempted job; clocks may skew
+a deadline by seconds across hosts). The emergency sub-step write itself is
+process-0-only (the replicated sweep state is identical everywhere), and a
+rank that stops while its peers are wedged in a collective is exactly what
+the watchdog timeout exists to unblock.
+"""
+
+from __future__ import annotations
+
+import contextlib
+import os
+import signal
+import threading
+import time
+from typing import Any, Callable, Dict, List, Optional
+
+# sysexits.h codes -- the CLI exit-code contract (docs/API.md):
+EX_SOFTWARE = 70   # NumericalFaultError after recovery exhaustion
+EX_IOERR = 74      # unreadable/torn input or checkpoint IO failure
+EX_TEMPFAIL = 75   # preempted (signal/deadline/peer loss), resumable
+
+
+class PreemptedError(RuntimeError):
+    """The run was stopped cooperatively (signal or deadline) and, when a
+    checkpoint directory was configured, its intra-K state is durable on
+    disk. Maps to exit 75 (EX_TEMPFAIL): rerun with the same
+    ``--checkpoint-dir`` (and ``--resume auto``, the default) to continue
+    inside the interrupted fit."""
+
+    def __init__(self, message: str, *, reason: str = "signal",
+                 step: Optional[int] = None, em_iter: Optional[int] = None,
+                 checkpointed: bool = False):
+        super().__init__(message)
+        self.reason = reason
+        self.step = step
+        self.em_iter = em_iter
+        self.checkpointed = checkpointed
+
+
+class PeerLostError(RuntimeError):
+    """A peer rank of a multi-controller run stopped participating (no
+    heartbeat within ``peer_timeout_s``, or a collective barrier timed
+    out). The local rank checkpoints and exits 75 instead of blocking
+    forever in the next collective -- restart the whole job to resume."""
+
+    def __init__(self, message: str, *, rank: Optional[int] = None,
+                 age_s: Optional[float] = None,
+                 timeout_s: Optional[float] = None):
+        super().__init__(message)
+        self.rank = rank
+        self.age_s = age_s
+        self.timeout_s = timeout_s
+
+
+class RunSupervisor:
+    """Cooperative stop flag + signal handlers + deadline + watchdog.
+
+    ``max_runtime_s``: optional wall-clock budget measured from
+    :meth:`install` (the CLI's ``--max-runtime``); the deadline trips the
+    same stop flag a SIGTERM does, so a scheduler's hard kill limit can be
+    front-run with a clean checkpointed exit. ``install_signals=False``
+    supports library use from non-main threads (``signal.signal`` is
+    main-thread-only) and tests.
+    """
+
+    _HANDLED = (signal.SIGTERM, signal.SIGINT)
+
+    def __init__(self, max_runtime_s: Optional[float] = None,
+                 install_signals: bool = True):
+        self.max_runtime_s = max_runtime_s
+        self._install_signals = install_signals
+        self._stop = threading.Event()
+        self._reason: Optional[str] = None
+        self._lost_peer: Optional[Dict[str, Any]] = None
+        self._deadline: Optional[float] = None
+        self._old_handlers: Dict[int, Any] = {}
+        self._watchdog: Optional["LivenessWatchdog"] = None
+        self._preempt_emitted = False
+        self._stop_consumed = threading.Event()
+        self._lock = threading.Lock()
+
+    # -- lifecycle ---------------------------------------------------------
+
+    @property
+    def active(self) -> bool:
+        return True
+
+    def install(self) -> "RunSupervisor":
+        """Arm the deadline and (main thread only) the signal handlers."""
+        if self.max_runtime_s is not None:
+            self._deadline = time.monotonic() + float(self.max_runtime_s)
+        if self._install_signals:
+            try:
+                for sig in self._HANDLED:
+                    self._old_handlers[sig] = signal.signal(
+                        sig, self._on_signal)
+            except ValueError:
+                # Not the main thread: cooperative stop still works via
+                # deadline/watchdog/request_stop; signals stay default.
+                self._old_handlers.clear()
+        return self
+
+    def uninstall(self) -> None:
+        """Restore prior signal handlers and stop the watchdog."""
+        for sig, old in self._old_handlers.items():
+            try:
+                signal.signal(sig, old)
+            except (ValueError, OSError):
+                pass
+        self._old_handlers.clear()
+        self.stop_watchdog()
+
+    def _on_signal(self, signum, frame) -> None:
+        # Signal context: set the flag and nothing else (no locks, no IO).
+        # A second delivery falls through to the ORIGINAL handler so an
+        # operator's double Ctrl-C still kills a wedged run the hard way.
+        if self._stop.is_set():
+            old = self._old_handlers.get(signum)
+            if callable(old):
+                old(signum, frame)
+            elif old == signal.SIG_DFL:
+                signal.signal(signum, signal.SIG_DFL)
+                signal.raise_signal(signum)
+            return
+        self._reason = ("sigterm" if signum == signal.SIGTERM else "sigint")
+        self._stop.set()
+
+    # -- the stop flag -----------------------------------------------------
+
+    def request_stop(self, reason: str) -> None:
+        """Trip the stop flag programmatically (watchdog, tests)."""
+        if not self._stop.is_set():
+            self._reason = reason
+            self._stop.set()
+
+    @property
+    def stop_requested(self) -> bool:
+        self._check_deadline()
+        return self._stop.is_set()
+
+    @property
+    def stop_reason(self) -> Optional[str]:
+        return self._reason
+
+    @property
+    def lost_peer(self) -> Optional[Dict[str, Any]]:
+        """``{rank, age_s, timeout_s}`` once the watchdog flagged a peer."""
+        return self._lost_peer
+
+    def _check_deadline(self) -> None:
+        if (self._deadline is not None and not self._stop.is_set()
+                and time.monotonic() >= self._deadline):
+            self._reason = "deadline"
+            self._stop.set()
+
+    def poll(self, *, where: str, k: Optional[int] = None,
+             em_iter: Optional[int] = None) -> bool:
+        """The cooperative intervention point (main thread, between device
+        dispatches). Returns True when the run must stop now. Consults, in
+        order: the ``rank_hang`` fault injection (testing only -- wedges
+        THIS rank so a peer's watchdog can be rehearsed), the ``preempt``
+        injection (a deterministic stand-in for SIGTERM at a specific EM
+        iteration / streaming block), the signal flag, and the deadline.
+        Emits one ``preempt`` telemetry record on the first observation.
+        """
+        from .testing import faults
+
+        if faults.peek("rank_hang") is not None:
+            self._maybe_hang(where=where, em_iter=em_iter)
+        if not self._stop.is_set() and em_iter is not None:
+            # block=-1: a spec targeting a specific streaming block must
+            # only fire from poll_block, never at a segment boundary.
+            if faults.take("preempt", iter=em_iter, block=-1) is not None:
+                self._reason = "preempt_injected"
+                self._stop.set()
+        self._check_deadline()
+        if not self._stop.is_set():
+            return False
+        self._emit_preempt(where=where, k=k, em_iter=em_iter)
+        return True
+
+    def poll_block(self, *, k: Optional[int], em_iter: int,
+                   block: int) -> bool:
+        """Streaming-block-granularity poll: like :meth:`poll` but the
+        ``preempt`` injection can target a specific block of a specific
+        pass (``{"iter": i, "block": j}``)."""
+        from .testing import faults
+
+        if faults.peek("rank_hang") is not None:
+            self._maybe_hang(where="stream_block", em_iter=em_iter)
+        if not self._stop.is_set():
+            if faults.take("preempt", iter=em_iter, block=block) is not None:
+                self._reason = "preempt_injected"
+                self._stop.set()
+        self._check_deadline()
+        if not self._stop.is_set():
+            return False
+        self._emit_preempt(where="stream_block", k=k, em_iter=em_iter)
+        return True
+
+    def _maybe_hang(self, *, where: str, em_iter: Optional[int]) -> None:
+        """Honor an armed ``rank_hang`` injection: stop heartbeating and
+        wedge this rank right here (simulating a host stuck in a collective
+        or a swap death), so the PEER's watchdog path can be tested. The
+        process never returns from this; the test harness kills it."""
+        from .testing import faults
+
+        cfg = faults.peek("rank_hang")
+        if cfg is not None and "iter" in cfg and em_iter is None:
+            return  # iter-targeted spec: only EM-iteration polls match
+        try:
+            import jax
+
+            rank = int(jax.process_index())
+        except Exception:
+            rank = 0
+        match: Dict[str, Any] = {"rank": rank}
+        if em_iter is not None:
+            match["iter"] = em_iter
+        if faults.take("rank_hang", **match) is None:
+            return
+        if self._watchdog is not None:
+            self._watchdog.stop_writing()
+        from .utils.logging_ import get_logger
+
+        get_logger().warning(
+            "rank_hang fault injected at %s (rank %d): wedging this "
+            "process", where, rank)
+        while True:  # pragma: no cover - killed externally
+            time.sleep(3600.0)
+
+    def _emit_preempt(self, *, where: str, k=None, em_iter=None) -> None:
+        with self._lock:
+            if self._preempt_emitted:
+                return
+            self._preempt_emitted = True
+        from . import telemetry
+
+        rec = telemetry.current()
+        if rec.active:
+            fields: Dict[str, Any] = {"reason": self._reason, "where": where}
+            if k is not None:
+                fields["k"] = int(k)
+            if em_iter is not None:
+                fields["em_iter"] = int(em_iter)
+            if self._lost_peer is not None:
+                fields["peer"] = self._lost_peer
+            rec.emit("preempt", **fields)
+            rec.metrics.count("preempts")
+
+    # -- watchdog ----------------------------------------------------------
+
+    def start_watchdog(self, directory: str, *, rank: int, nproc: int,
+                       timeout_s: float,
+                       interval_s: Optional[float] = None) -> None:
+        """Start (idempotently) the cross-host liveness watchdog. Runs
+        until :meth:`uninstall`; a stale peer trips the stop flag with
+        reason ``peer_lost`` and the next poll raises
+        :class:`PeerLostError` after the emergency checkpoint."""
+        if self._watchdog is not None:
+            return
+
+        def on_lost(peer_rank: int, age_s: float) -> None:
+            self._lost_peer = {"rank": int(peer_rank),
+                               "age_s": round(float(age_s), 3),
+                               "timeout_s": float(timeout_s)}
+            from . import telemetry
+            from .utils.logging_ import get_logger
+
+            get_logger().error(
+                "peer rank %d heartbeat stale for %.1fs (timeout %.1fs): "
+                "stopping with an emergency checkpoint", peer_rank, age_s,
+                timeout_s)
+            rec = telemetry.current()
+            if rec.active:
+                rec.emit("peer_lost", rank=int(peer_rank),
+                         timeout_s=float(timeout_s),
+                         age_s=round(float(age_s), 3))
+                rec.metrics.count("peer_losses")
+            self.request_stop("peer_lost")
+            # Escalation: if the main thread never reaches raise_stop --
+            # it is wedged INSIDE a compute collective waiting on the very
+            # peer that died, so no poll point will ever run -- the
+            # cooperative stop cannot work. After a grace window, exit
+            # hard with the preemption code: the completed-K checkpoints
+            # on disk are the emergency state (a mid-collective EM carry
+            # is not host-observable), and a loud exit 75 beats an
+            # indefinite hang (the reference's dead-rank behavior).
+            grace = min(float(timeout_s), 30.0)
+
+            def _force_exit():
+                if self._stop_consumed.wait(grace):
+                    return
+                get_logger().error(
+                    "main thread did not observe peer loss within %.1fs "
+                    "(wedged in a collective?): forcing exit %d",
+                    grace, EX_TEMPFAIL)
+                try:
+                    rec2 = telemetry.current()
+                    if rec2.active:
+                        rec2.emit("shutdown", reason="peer_lost",
+                                  checkpointed=False, forced=True)
+                except Exception:
+                    pass
+                os._exit(EX_TEMPFAIL)
+
+            threading.Thread(target=_force_exit,
+                             name="gmm-peer-lost-exit",
+                             daemon=True).start()
+
+        self._watchdog = LivenessWatchdog(
+            directory, rank=rank, nproc=nproc, timeout_s=timeout_s,
+            interval_s=interval_s, on_peer_lost=on_lost)
+        self._watchdog.start()
+
+    def stop_watchdog(self) -> None:
+        if self._watchdog is not None:
+            self._watchdog.stop()
+            self._watchdog = None
+
+    @property
+    def collective_timeout_s(self) -> Optional[float]:
+        """Barrier timeout while the watchdog runs (None = unbounded).
+        ``distributed.barrier`` consults this so a filesystem-rendezvous
+        barrier cannot outlive a dead peer by more than the timeout."""
+        if self._watchdog is None:
+            return None
+        return float(self._watchdog.timeout_s)
+
+    def raise_stop(self, *, step: Optional[int] = None,
+                   em_iter: Optional[int] = None,
+                   checkpointed: bool = False) -> None:
+        """Raise the stop as the right exception type (peer loss vs
+        preemption) after the caller finished its emergency checkpoint."""
+        self._stop_consumed.set()
+        if self._reason == "peer_lost" and self._lost_peer is not None:
+            p = self._lost_peer
+            raise PeerLostError(
+                f"peer rank {p['rank']} lost (heartbeat stale "
+                f"{p['age_s']:.1f}s > timeout {p['timeout_s']:.1f}s); "
+                "emergency checkpoint "
+                + ("written" if checkpointed else "unavailable "
+                   "(no --checkpoint-dir)"),
+                rank=p["rank"], age_s=p["age_s"], timeout_s=p["timeout_s"])
+        raise PreemptedError(
+            f"run preempted ({self._reason}); "
+            + (f"resumable from step {step}"
+               + (f" iteration {em_iter}" if em_iter is not None else "")
+               if checkpointed else
+               "NOT resumable (no --checkpoint-dir)"),
+            reason=self._reason or "unknown", step=step, em_iter=em_iter,
+            checkpointed=checkpointed)
+
+    def __enter__(self) -> "RunSupervisor":
+        return self.install()
+
+    def __exit__(self, *exc) -> None:
+        self.uninstall()
+
+
+class _NullSupervisor(RunSupervisor):
+    """Inert ambient default: every poll is a cheap False."""
+
+    def __init__(self):
+        super().__init__(install_signals=False)
+
+    @property
+    def active(self) -> bool:
+        return False
+
+    def poll(self, **kw) -> bool:  # noqa: D102 - inert fast path
+        return False
+
+    def poll_block(self, **kw) -> bool:
+        return False
+
+
+class LivenessWatchdog(threading.Thread):
+    """Background heartbeat writer + peer staleness checker.
+
+    Each rank writes ``<dir>/rank<i>.hb`` every ``interval_s`` (default:
+    a quarter of the timeout, capped at the telemetry heartbeat floor of
+    5 s) and checks every peer's file age against ``timeout_s``. The
+    exchange medium is the shared checkpoint filesystem multi-host runs
+    already require (GCS/NFS on pods) -- deliberately NOT a device
+    collective: a collective heartbeat from a background thread would
+    interleave with the main thread's compute collectives, and a hung
+    peer is precisely the case where collectives stop returning. Ages
+    compare this host's clock to the file's mtime; NFS/GCS keep those
+    within seconds, and ``timeout_s`` should dwarf worst-case skew.
+    """
+
+    def __init__(self, directory: str, *, rank: int, nproc: int,
+                 timeout_s: float, interval_s: Optional[float] = None,
+                 on_peer_lost: Optional[Callable[[int, float], None]] = None):
+        super().__init__(name="gmm-liveness-watchdog", daemon=True)
+        self.directory = directory
+        self.rank = int(rank)
+        self.nproc = int(nproc)
+        self.timeout_s = float(timeout_s)
+        self.interval_s = float(interval_s if interval_s is not None
+                                else min(max(self.timeout_s / 4.0, 0.2), 5.0))
+        self._on_peer_lost = on_peer_lost
+        self._stopped = threading.Event()
+        self._writing = True
+        self._started_at = time.time()
+
+    def stop(self) -> None:
+        self._stopped.set()
+
+    def stop_writing(self) -> None:
+        """Keep the thread alive but stop heartbeating (``rank_hang``)."""
+        self._writing = False
+        self._stopped.set()
+
+    def run(self) -> None:  # pragma: no cover - exercised via subprocesses
+        from .parallel import distributed
+
+        while not self._stopped.is_set():
+            if self._writing:
+                try:
+                    distributed.write_rank_heartbeat(
+                        self.directory, self.rank)
+                except OSError:
+                    pass  # transient FS hiccup; next beat retries
+            lost = self.check_peers()
+            if lost is not None:
+                rank, age = lost
+                if self._on_peer_lost is not None:
+                    self._on_peer_lost(rank, age)
+                return
+            self._stopped.wait(self.interval_s)
+
+    def check_peers(self):
+        """(rank, age_s) of the stalest over-timeout peer, else None. A
+        peer that never wrote yet ages from this watchdog's start (ranks
+        come up seconds apart; the timeout doubles as the grace window)."""
+        from .parallel import distributed
+
+        now = time.time()
+        worst = None
+        for peer in range(self.nproc):
+            if peer == self.rank:
+                continue
+            mtime = distributed.read_rank_heartbeat(self.directory, peer)
+            age = now - (mtime if mtime is not None else self._started_at)
+            if age > self.timeout_s and (worst is None or age > worst[1]):
+                worst = (peer, age)
+        return worst
+
+
+_NULL = _NullSupervisor()
+_stack: List[RunSupervisor] = []
+
+
+def current() -> RunSupervisor:
+    """The ambient supervisor (inert unless a run activated one)."""
+    return _stack[-1] if _stack else _NULL
+
+
+@contextlib.contextmanager
+def use(sup: RunSupervisor):
+    """Make ``sup`` the ambient supervisor for the enclosed run (installs
+    handlers/deadline on entry, restores on exit)."""
+    _stack.append(sup)
+    sup.install()
+    try:
+        yield sup
+    finally:
+        _stack.pop()
+        sup.uninstall()

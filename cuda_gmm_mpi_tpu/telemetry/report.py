@@ -62,6 +62,9 @@ def render_report(records: List[dict], max_trajectory_rows: int = 400) -> str:
     healths = [r for r in records if r.get("event") == "health"]
     recoveries = [r for r in records if r.get("event") == "recovery"]
     io_retries = [r for r in records if r.get("event") == "io_retry"]
+    preempts = [r for r in records if r.get("event") == "preempt"]
+    shutdowns = [r for r in records if r.get("event") == "shutdown"]
+    peer_losts = [r for r in records if r.get("event") == "peer_lost"]
 
     for s in starts:
         out.append(_fmt_run_start(s))
@@ -120,6 +123,34 @@ def render_report(records: List[dict], max_trajectory_rows: int = 400) -> str:
                        f"step={r.get('step', '-')} "
                        f"attempt={r.get('attempt')}: "
                        f"{r.get('error')}{tail}")
+        out.append("")
+
+    if preempts or shutdowns or peer_losts:
+        out.append("Run lifecycle (preemption; docs/ROBUSTNESS.md):")
+        for r in peer_losts:
+            out.append(f"  peer_lost rank={r.get('rank')} heartbeat "
+                       f"stale {r.get('age_s', '?')}s > timeout "
+                       f"{r.get('timeout_s', '?')}s")
+        for r in preempts:
+            pos = ""
+            if r.get("k") is not None:
+                pos = f" at K={r['k']}"
+                if r.get("em_iter") is not None:
+                    pos += f" iter={r['em_iter']}"
+            out.append(f"  preempt  reason={r.get('reason')} "
+                       f"[{r.get('where', '?')}]{pos}")
+        for r in shutdowns:
+            if r.get("checkpointed"):
+                pos = ""
+                if r.get("step") is not None:
+                    pos = f" (step {r['step']}"
+                    pos += (f" iter {r['em_iter']})"
+                            if r.get("em_iter") is not None else ")")
+                ck = "checkpoint durable" + pos
+            else:
+                ck = "NO checkpoint (not resumable)"
+            out.append(f"  shutdown reason={r.get('reason')} -> exit 75, "
+                       f"{ck}")
         out.append("")
 
     for s in summaries:

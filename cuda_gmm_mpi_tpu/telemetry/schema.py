@@ -91,6 +91,29 @@ EVENT_FIELDS: Dict[str, Tuple[Tuple[str, ...], Tuple[str, ...]]] = {
         ("op", "attempt", "error"),
         ("step", "delay_s", "gave_up"),
     ),
+    # Preemption lifecycle (supervisor.py; docs/ROBUSTNESS.md "Run
+    # lifecycle"): the cooperative stop flag was first observed at a poll
+    # point. ``reason`` is sigterm / sigint / deadline / peer_lost /
+    # preempt_injected; ``where`` the poll site (sweep / em /
+    # stream_block / fused_emit).
+    "preempt": (
+        ("reason",),
+        ("where", "k", "em_iter", "peer"),
+    ),
+    # The stop's endgame, just before the process exits 75:
+    # ``checkpointed`` says whether the emergency intra-K sub-step (or,
+    # between Ks, the previous full step) is durable for --resume auto.
+    "shutdown": (
+        ("reason", "checkpointed"),
+        ("step", "k", "em_iter"),
+    ),
+    # The liveness watchdog flagged a dead/wedged peer rank: its
+    # heartbeat on the shared checkpoint filesystem aged past the
+    # timeout. Followed by a peer_lost-reason preempt/shutdown pair.
+    "peer_lost": (
+        ("rank", "timeout_s"),
+        ("age_s",),
+    ),
     # One per fit: final scores, the 7-category phase profile, the
     # compile-vs-execute split, and the metrics-registry snapshot.
     # ``buckets`` (optional; host-driven sweeps) describes cluster-width

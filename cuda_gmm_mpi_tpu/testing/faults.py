@@ -28,6 +28,18 @@ Supported fault kinds (the spec is ``{kind: {params...}}``):
 - ``checkpoint_eio`` ``{"step": s, "times": n}`` -- the checkpoint write
   for sweep step ``s`` (any step when omitted) raises ``OSError(EIO)``;
   consumed per raise, so the bounded retry's n+1-th attempt succeeds.
+- ``preempt`` ``{"iter": i, "block": j, "times": n}`` -- the run
+  supervisor's poll treats EM iteration ``i`` (optionally: streaming
+  block ``j`` of pass ``i``; segment-boundary polls match ``block: -1``)
+  as if SIGTERM had just arrived: deterministic stand-in for a real
+  preemption signal, driving the emergency-checkpoint + exit-75 path
+  (supervisor.py; consumed at the poll, host side).
+- ``rank_hang`` ``{"rank": r, "iter": i, "times": n}`` -- process ``r``
+  of a multi-controller run stops heartbeating and wedges at its next
+  supervisor poll (optionally at EM iteration ``i``), simulating a dead
+  or stuck host so the PEER's liveness watchdog (``PeerLostError`` +
+  emergency checkpoint) can be rehearsed. The wedged process never
+  returns; the test harness kills it.
 
 Activation: ``faults.use({...})`` (context manager, in-process tests) or
 the ``GMM_FAULTS`` env var holding the JSON spec (subprocess workers; read
@@ -44,7 +56,8 @@ from typing import Any, Dict, Optional
 
 ENV_VAR = "GMM_FAULTS"
 
-KNOWN_KINDS = ("nan_loglik", "singular_cov", "poison_block", "checkpoint_eio")
+KNOWN_KINDS = ("nan_loglik", "singular_cov", "poison_block",
+               "checkpoint_eio", "preempt", "rank_hang")
 
 
 class FaultPlan:

@@ -29,19 +29,53 @@ Two write paths share that layout:
 
 ``restore`` reads either format; mixing them in one directory resolves to
 the newest step.
+
+**Intra-K sub-steps** (preemption-safe execution, docs/ROBUSTNESS.md "Run
+lifecycle"): ``save_substep`` writes ``<step>.iter<i>.npz`` -- the emergency
+checkpoint of an EM fit interrupted mid-K at iteration ``i``, carrying the
+mid-EM state, the loglik trajectory so far, and (streaming) the partially
+reduced block accumulator. A sub-step is strictly newer than every full
+step below it; ``restore_substep`` finds the newest one so ``--resume
+auto`` restarts INSIDE the interrupted fit instead of at its beginning.
+Sub-steps use the callback-safe write path (process 0, atomic npz) because
+emergency saves must never start a cross-process collective: the peers may
+already be dead -- that can be WHY we are saving.
 """
 
 from __future__ import annotations
 
 import os
 import random
+import re
 import time
-from typing import Any, Callable, Dict, Optional
+from typing import Any, Callable, Dict, List, Optional, Tuple
 
 import numpy as np
 
 from ..state import GMMState
 from ..testing import faults
+
+
+class CheckpointRestoreError(OSError):
+    """Every checkpoint step in the directory was unreadable.
+
+    Aggregates the per-step failures (``.errors``: newest step first) so
+    the usually-most-informative newest-step error is never shadowed by
+    the oldest one the walk-back happened to end on; the newest failure is
+    also chained as ``__cause__``. The CLI maps this to exit 74
+    (EX_IOERR).
+    """
+
+    def __init__(self, message: str,
+                 errors: List[Tuple[int, BaseException]]):
+        self.errors = errors
+        lines = [message]
+        for step, err in errors:
+            lines.append(f"  step {step}: {type(err).__name__}: {err}")
+        super().__init__("\n".join(lines))
+
+
+_SUBSTEP_RE = re.compile(r"^(\d+)\.iter(\d+)\.npz$")
 
 # First-retry backoff; doubles per attempt with +-25% deterministic jitter
 # (seeded per (step, attempt), so concurrent rank-0 writers across a fleet
@@ -151,6 +185,15 @@ class SweepCheckpointer:
                         shutil.rmtree(d)
                 except OSError:
                     pass
+            # Intra-K sub-steps at or below the newest COMPLETED step are
+            # superseded (their K finished after the emergency save).
+            for s, i in self._substeps():
+                if s <= newest_step:
+                    try:
+                        os.remove(os.path.join(self._dir,
+                                               f"{s}.iter{i}.npz"))
+                    except OSError:
+                        pass
             # Orphaned tmp files from crashed save_local calls (killed
             # between mkstemp and replace) match neither pattern above;
             # they are dead the moment this process is saving again (one
@@ -205,6 +248,73 @@ class SweepCheckpointer:
 
         if jax.process_index() != 0:
             return
+        flat = self._flatten(payload)
+        target = os.path.join(self._dir, f"{step}.npz")
+
+        # Bounded retry: this runs inside the ordered io_callback while
+        # the device program is blocked on it -- an escaped exception here
+        # would abort the whole job for a transient filesystem hiccup.
+        if self._write_with_retries(
+                "save_local", step,
+                lambda: self._write_npz_atomic(target, flat)):
+            self._prune(step)  # already process-0-only here
+
+    def save_substep(self, step: int, em_iter: int,
+                     payload: Dict[str, Any]) -> bool:
+        """Emergency intra-K checkpoint: ``<step>.iter<em_iter>.npz``.
+
+        The preemption path's save (supervisor.py): the payload carries the
+        MID-EM state of the K being fitted at sweep step ``step``, the
+        iteration count and loglik trajectory so far (``em_iter`` /
+        ``em_lls``), and -- for the streaming path -- the partially reduced
+        block accumulator, so ``--resume auto`` restarts inside the
+        interrupted fit. Process 0 only, atomic npz, NO collective: the
+        peers may already be dead (peer-loss emergency saves), and a
+        stopping run must never block on one. A sub-step outranks every
+        full step below it at restore time (``restore_substep``); it is
+        pruned the moment its K completes. Returns True when durable.
+        """
+        import jax
+
+        if jax.process_index() != 0:
+            return True
+        flat = self._flatten(dict(payload, em_iter=np.int64(em_iter)))
+        target = os.path.join(self._dir, f"{step}.iter{em_iter}.npz")
+        ok = self._write_with_retries(
+            "save_substep", step,
+            lambda: self._write_npz_atomic(target, flat))
+        if ok:
+            # Older sub-steps of the same K are superseded (best-effort).
+            for s, i in self._substeps():
+                if s == step and i < em_iter:
+                    try:
+                        os.remove(os.path.join(self._dir,
+                                               f"{s}.iter{i}.npz"))
+                    except OSError:
+                        pass
+        return ok
+
+    def discard_substeps(self, step: int) -> None:
+        """Drop intra-K sub-steps at or below ``step``: that K completed,
+        so its emergency mid-EM state is superseded. The save paths prune
+        these as a side effect, but the sweep's FINAL K has no full-step
+        save -- the resumed fit calls this directly so a finished run
+        never leaves a live-looking sub-step behind. Process 0 only,
+        best-effort."""
+        import jax
+
+        if jax.process_index() != 0:
+            return
+        for s, i in self._substeps():
+            if s <= step:
+                try:
+                    os.remove(os.path.join(self._dir, f"{s}.iter{i}.npz"))
+                except OSError:
+                    pass
+
+    def _flatten(self, payload: Dict[str, Any]) -> Dict[str, Any]:
+        """One-level flatten of the payload (GMMStates expanded to leaf
+        arrays) into npz-ready ``group.leaf`` keys."""
         tree = dict(payload)
         tree["state"] = _to_tree(payload["state"])
         tree["best_state"] = _to_tree(payload["best_state"])
@@ -215,34 +325,29 @@ class SweepCheckpointer:
                     flat[f"{key}.{leaf}"] = np.asarray(arr)
             else:
                 flat[key] = np.asarray(val)
+        return flat
 
-        def write():
-            import tempfile
+    def _write_npz_atomic(self, target: str, flat: Dict[str, Any]) -> None:
+        import tempfile
 
-            fd, tmp = tempfile.mkstemp(dir=self._dir, suffix=".tmp.npz")
-            with os.fdopen(fd, "wb") as f:
-                np.savez(f, **flat)
-                # The durability contract ("checkpoint s on disk before
-                # step s+1 computes", fused_sweep.py) must survive a HOST
-                # crash, not just a process kill: flush+fsync the data
-                # before the atomic rename, then fsync the directory so
-                # the rename itself is durable. The tmp name is
-                # mkstemp-unique so concurrent savers (racing callback
-                # threads) can never interleave writes into one file.
-                f.flush()
-                os.fsync(f.fileno())
-            os.replace(tmp, os.path.join(self._dir, f"{step}.npz"))
-            dir_fd = os.open(self._dir, os.O_RDONLY)
-            try:
-                os.fsync(dir_fd)
-            finally:
-                os.close(dir_fd)
-
-        # Bounded retry: this runs inside the ordered io_callback while
-        # the device program is blocked on it -- an escaped exception here
-        # would abort the whole job for a transient filesystem hiccup.
-        if self._write_with_retries("save_local", step, write):
-            self._prune(step)  # already process-0-only here
+        fd, tmp = tempfile.mkstemp(dir=self._dir, suffix=".tmp.npz")
+        with os.fdopen(fd, "wb") as f:
+            np.savez(f, **flat)
+            # The durability contract ("checkpoint s on disk before
+            # step s+1 computes", fused_sweep.py) must survive a HOST
+            # crash, not just a process kill: flush+fsync the data
+            # before the atomic rename, then fsync the directory so
+            # the rename itself is durable. The tmp name is
+            # mkstemp-unique so concurrent savers (racing callback
+            # threads) can never interleave writes into one file.
+            f.flush()
+            os.fsync(f.fileno())
+        os.replace(tmp, target)
+        dir_fd = os.open(self._dir, os.O_RDONLY)
+        try:
+            os.fsync(dir_fd)
+        finally:
+            os.close(dir_fd)
 
     def _all_steps(self) -> list:
         if not os.path.isdir(self._dir):
@@ -251,6 +356,17 @@ class SweepCheckpointer:
         steps += [int(f[:-4]) for f in os.listdir(self._dir)
                   if f.endswith(".npz") and f[:-4].isdigit()]
         return steps
+
+    def _substeps(self) -> List[Tuple[int, int]]:
+        """(step, em_iter) of every intra-K sub-step file on disk."""
+        if not os.path.isdir(self._dir):
+            return []
+        out = []
+        for f in os.listdir(self._dir):
+            m = _SUBSTEP_RE.match(f)
+            if m:
+                out.append((int(m.group(1)), int(m.group(2))))
+        return out
 
     def latest_step(self) -> Optional[int]:
         steps = self._all_steps()
@@ -261,11 +377,15 @@ class SweepCheckpointer:
         ``step``, an unreadable newest checkpoint (e.g. torn by a crash on
         a filesystem without rename atomicity) falls back to the next
         older one instead of wedging resume -- losing one step beats
-        losing the run."""
+        losing the run. When EVERY step is unreadable the failures are
+        aggregated into one :class:`CheckpointRestoreError` (newest first,
+        newest chained as ``__cause__``) -- the newest step's error is
+        usually the informative one and must not be shadowed by whichever
+        ancient step the walk-back died on."""
         if step is not None:
             return self._restore_step(step)
-        steps = self._all_steps()
-        for s in sorted(steps, reverse=True):
+        failures: List[Tuple[int, BaseException]] = []
+        for s in sorted(self._all_steps(), reverse=True):
             try:
                 return self._restore_step(s)
             except Exception as e:
@@ -274,28 +394,72 @@ class SweepCheckpointer:
                 # resume from a much older step.
                 import warnings
 
+                failures.append((s, e))
                 warnings.warn(
                     f"checkpoint step {s} unreadable "
                     f"({type(e).__name__}: {e}); falling back to the "
                     "previous step", RuntimeWarning)
-                if s == min(steps):
-                    raise
+        if failures:
+            raise CheckpointRestoreError(
+                f"all {len(failures)} checkpoint step(s) under "
+                f"{self._dir} are unreadable", failures) from failures[0][1]
+        return None
+
+    def restore_substep(self) -> Optional[Dict[str, Any]]:
+        """The newest LIVE intra-K sub-step's payload (with ``step`` and
+        ``em_iter`` set), or None.
+
+        A sub-step at or below the newest full step is stale -- its K
+        completed after the emergency save -- and is ignored (the next
+        durable full save prunes it). An unreadable sub-step (torn by a
+        crash during the emergency write) warns and falls back to older
+        live sub-steps, then to None: resume then restarts that K from
+        its beginning via the full-step walk-back, which is the correct
+        degraded behavior, not an error.
+        """
+        latest_full = self.latest_step()
+        for s, i in sorted(self._substeps(), reverse=True):
+            if latest_full is not None and s <= latest_full:
+                break  # stale: that K completed after this emergency save
+            path = os.path.join(self._dir, f"{s}.iter{i}.npz")
+            try:
+                tree = _load_npz_tree(path)
+            except Exception as e:
+                import warnings
+
+                warnings.warn(
+                    f"intra-K sub-step {s}.iter{i} unreadable "
+                    f"({type(e).__name__}: {e}); resuming that K from its "
+                    "beginning instead", RuntimeWarning)
+                continue
+            tree["step"] = s
+            tree["em_iter"] = i
+            return tree
         return None
 
     def _restore_step(self, step: int) -> Dict[str, Any]:
         npz = os.path.join(self._dir, f"{step}.npz")
         if os.path.exists(npz):
-            with np.load(npz) as z:
-                tree: Dict[str, Any] = {}
-                for key in z.files:
-                    if "." in key:
-                        group, leaf = key.split(".", 1)
-                        tree.setdefault(group, {})[leaf] = z[key]
-                    else:
-                        tree[key] = z[key]
+            tree = _load_npz_tree(npz)
         else:
             tree = self._ckpt.restore(os.path.join(self._dir, str(step)))
-        tree["state"] = _from_tree(tree["state"])
-        tree["best_state"] = _from_tree(tree["best_state"])
+            tree["state"] = _from_tree(tree["state"])
+            tree["best_state"] = _from_tree(tree["best_state"])
         tree["step"] = step
         return tree
+
+
+def _load_npz_tree(path: str) -> Dict[str, Any]:
+    """Un-flatten one npz checkpoint: ``group.leaf`` keys regroup into
+    dicts, the two GMMState groups are rebuilt as states."""
+    with np.load(path) as z:
+        tree: Dict[str, Any] = {}
+        for key in z.files:
+            if "." in key:
+                group, leaf = key.split(".", 1)
+                tree.setdefault(group, {})[leaf] = z[key]
+            else:
+                tree[key] = z[key]
+    tree["state"] = _from_tree(tree["state"])
+    tree["best_state"] = _from_tree(tree["best_state"])
+    return tree

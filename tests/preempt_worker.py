@@ -1,0 +1,75 @@
+"""Worker for the 2-process peer-loss watchdog test (tests/test_preemption.py).
+
+Each worker is one "host" of a simulated 2-host cluster running a supervised
+sweep (supervisor.use + checkpointing, so the liveness watchdog starts). The
+test arms ``GMM_FAULTS={"rank_hang": {"rank": 1, "iter": N}}`` on rank 1
+only: that rank stops heartbeating and wedges at its EM-iteration-N poll,
+simulating a dead/stuck host. Rank 0 must NOT block forever in the next
+collective (the reference's dead-MPI-rank behavior): its watchdog flags the
+stale heartbeat within ``peer_timeout_s`` and the process exits 75
+(EX_TEMPFAIL) -- cooperatively via PeerLostError when a poll point is
+reachable, or through the supervisor's forced-exit escalation when the main
+thread is wedged inside a collective.
+
+Usage: python preempt_worker.py <pid> <nproc> <port> <ckdir>
+Prints ``RESULT {json}`` on (unexpected) clean completion.
+"""
+
+import json
+import sys
+
+
+def main() -> int:
+    pid, nproc, port, ckdir = (
+        int(sys.argv[1]), int(sys.argv[2]), sys.argv[3], sys.argv[4],
+    )
+
+    import jax
+
+    jax.config.update("jax_platforms", "cpu")
+    from cuda_gmm_mpi_tpu.utils.compat import force_cpu_devices
+
+    force_cpu_devices(2)
+    jax.config.update("jax_enable_x64", True)
+
+    from cuda_gmm_mpi_tpu.parallel import distributed
+
+    distributed.initialize(
+        coordinator_address=f"127.0.0.1:{port}",
+        num_processes=nproc,
+        process_id=pid,
+    )
+
+    import numpy as np
+
+    from cuda_gmm_mpi_tpu import supervisor
+    from cuda_gmm_mpi_tpu.config import GMMConfig
+    from cuda_gmm_mpi_tpu.models import fit_gmm
+
+    rng = np.random.default_rng(77)
+    centers = rng.normal(scale=9.0, size=(4, 3))
+    data = (centers[rng.integers(0, 4, 4096)]
+            + rng.normal(size=(4096, 3))).astype(np.float64)
+
+    cfg = GMMConfig(min_iters=40, max_iters=40, chunk_size=64,
+                    dtype="float64", checkpoint_dir=ckdir,
+                    peer_timeout_s=6.0, preempt_poll_iters=2)
+    try:
+        with supervisor.use(supervisor.RunSupervisor()):
+            r = fit_gmm(data, 10, 2, config=cfg)
+    except supervisor.PeerLostError as e:
+        print(f"PEER_LOST {e}", flush=True)
+        return supervisor.EX_TEMPFAIL
+    except supervisor.PreemptedError as e:
+        print(f"PREEMPTED {e}", flush=True)
+        return supervisor.EX_TEMPFAIL
+    print("RESULT " + json.dumps({
+        "pid": pid,
+        "ideal_k": r.ideal_num_clusters,
+    }), flush=True)
+    jax.distributed.shutdown()
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

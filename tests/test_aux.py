@@ -76,6 +76,71 @@ def test_checkpoint_restore_skips_torn_newest(rng, tmp_path):
                                   np.asarray(good["state"].means))
 
 
+def test_checkpoint_restore_all_torn_aggregates_errors(tmp_path):
+    """When EVERY step is unreadable the walk-back must not re-raise only
+    the oldest step's error (the old bug): the failures aggregate into one
+    CheckpointRestoreError, newest step first, with the newest --- usually
+    most informative --- failure chained as __cause__."""
+    from cuda_gmm_mpi_tpu.utils.checkpoint import (CheckpointRestoreError,
+                                                   SweepCheckpointer)
+
+    ck = SweepCheckpointer(str(tmp_path / "ck"))
+    sweep = tmp_path / "ck" / "sweep"
+    (sweep / "0.npz").write_bytes(b"torn")  # the SOLE checkpoint is torn
+    with pytest.warns(RuntimeWarning):
+        with pytest.raises(CheckpointRestoreError) as ei:
+            ck.restore()
+    err = ei.value
+    assert [s for s, _ in err.errors] == [0]
+    assert err.__cause__ is err.errors[0][1]
+    assert "step 0" in str(err)
+
+    (sweep / "1.npz").write_bytes(b"also torn")
+    with pytest.warns(RuntimeWarning):
+        with pytest.raises(CheckpointRestoreError) as ei2:
+            ck.restore()
+    assert [s for s, _ in ei2.value.errors] == [1, 0]  # newest first
+    assert ei2.value.__cause__ is ei2.value.errors[0][1]
+
+    # an EMPTY directory is not an error -- just nothing to resume
+    assert SweepCheckpointer(str(tmp_path / "empty")).restore() is None
+
+
+def test_crash_window_prune_sweeps_orphans(rng, tmp_path):
+    """Kill between a durable save_local and its _prune: the leftovers (an
+    older step, a superseded intra-K sub-step, a mkstemp .tmp.npz orphan)
+    must not confuse resume -- it picks the newest step -- and the NEXT
+    durable save sweeps all of them."""
+    import shutil
+
+    from cuda_gmm_mpi_tpu.utils.checkpoint import SweepCheckpointer
+
+    data, _ = make_blobs(rng, n=400, d=2, k=3)
+    ck = tmp_path / "ck"
+    fit_gmm(data, 6, 2,
+            config=fast_cfg(checkpoint_dir=str(ck), fused_sweep=True))
+    sweep = ck / "sweep"
+    ckpt = SweepCheckpointer(str(ck))
+    newest = ckpt.latest_step()
+    assert newest is not None and newest >= 1
+    # Re-create the crash window's debris as if _prune never ran:
+    shutil.copy(sweep / f"{newest}.npz", sweep / "0.npz")
+    shutil.copy(sweep / f"{newest}.npz", sweep / "0.iter3.npz")
+    (sweep / "deadbeef.tmp.npz").write_bytes(b"torn tmp payload")
+
+    # Resume picks the newest step; the sub-step at/below it is stale
+    # (its K completed after the emergency save) and is ignored.
+    assert ckpt.restore()["step"] == newest
+    assert ckpt.restore_substep() is None
+
+    payload = {k: v for k, v in ckpt.restore(newest).items() if k != "step"}
+    ckpt.save_local(newest + 1, payload)
+    names = {f.name for f in sweep.iterdir()}
+    assert f"{newest + 1}.npz" in names
+    assert "0.npz" not in names and "0.iter3.npz" not in names
+    assert not any(n.endswith(".tmp.npz") for n in names)
+
+
 def test_checkpoint_retention_bounds_disk(rng, tmp_path):
     """Only the retention window (default 2 steps) survives a sweep: a
     K=512 run must not leave ~500 dead checkpoints on the (possibly GCS)

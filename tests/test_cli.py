@@ -90,17 +90,18 @@ def test_cli_rejects_nonfinite_input(tmp_path):
     # Opting out of input validation no longer reproduces the reference's
     # silent-atof poisoning: the in-loop health bitmask catches the NaN
     # loglik, the escalation ladder cannot fix genuinely poisoned DATA,
-    # and the run fails loudly (exit 3, diagnostic bundle, no model
-    # written) instead of returning NaN parameters (docs/ROBUSTNESS.md).
+    # and the run fails loudly (exit 70, EX_SOFTWARE, diagnostic bundle,
+    # no model written) instead of returning NaN parameters
+    # (docs/ROBUSTNESS.md; docs/API.md exit-code table).
     assert run_cli(["2", str(p), str(tmp_path / "o"), "2",
                     "--min-iters=2", "--max-iters=2",
-                    "--no-validate-input"]) == 3
+                    "--no-validate-input"]) == 70
     assert not (tmp_path / "o.summary").exists()
     # recovery='off' raises the same loud failure without burning ladder
     # attempts on unfixable data.
     assert run_cli(["2", str(p), str(tmp_path / "o2"), "2",
                     "--min-iters=2", "--max-iters=2",
-                    "--no-validate-input", "--recovery=off"]) == 3
+                    "--no-validate-input", "--recovery=off"]) == 70
 
 
 def test_cli_predict_from_validates_input(tmp_path, csv_file):
@@ -261,6 +262,82 @@ def test_cli_no_output(csv_file, tmp_path):
     # semantics, gaussian.cu:1015, 1042)
     assert (tmp_path / "noout.summary").read_text() == ""
     assert not (tmp_path / "noout.results").exists()
+
+
+def test_cli_exit_74_on_torn_input(tmp_path, rng):
+    """Unreadable/torn INPUT (a truncated BIN payload -- partial copy,
+    crashed writer) maps to 74 (EX_IOERR), distinct from malformed
+    content's reference exit 1 (docs/API.md exit-code table)."""
+    data, _ = make_blobs(rng, n=200, d=3, k=2, dtype=np.float32)
+    p = tmp_path / "torn.bin"
+    write_bin(str(p), data)
+    raw = p.read_bytes()
+    p.write_bytes(raw[: len(raw) // 2])  # header intact, payload torn
+    assert run_cli(["2", str(p), str(tmp_path / "o"), "2",
+                    "--min-iters=2", "--max-iters=2"]) == 74
+    # malformed CONTENT (ragged rows) keeps the reference's exit 1
+    bad = tmp_path / "ragged.csv"
+    bad.write_text("a,b,c\n1,2,3\n4,5\n")
+    assert run_cli(["2", str(bad), str(tmp_path / "o"), "2"]) == 1
+
+
+def test_cli_exit_74_on_unreadable_checkpoints(csv_file, tmp_path):
+    """When EVERY checkpoint step is unreadable, resume fails with
+    CheckpointRestoreError -> exit 74 (EX_IOERR) instead of silently
+    starting the sweep over."""
+    ck = tmp_path / "ck"
+    assert run_cli(["4", csv_file, str(tmp_path / "o"), "2",
+                    "--min-iters=2", "--max-iters=2", "--chunk-size=256",
+                    "--fused-sweep", f"--checkpoint-dir={ck}"]) == 0
+    sweep = ck / "sweep"
+    npzs = [f for f in sweep.iterdir() if f.suffix == ".npz"]
+    assert npzs
+    for f in npzs:  # tear every retained step
+        f.write_bytes(b"not an npz")
+    assert run_cli(["4", csv_file, str(tmp_path / "o2"), "2",
+                    "--min-iters=2", "--max-iters=2", "--chunk-size=256",
+                    "--fused-sweep", f"--checkpoint-dir={ck}"]) == 74
+
+
+def test_cli_exit_75_on_preemption(csv_file, tmp_path):
+    """A cooperative stop (here: the deterministic preempt injection
+    standing in for SIGTERM) exits 75 (EX_TEMPFAIL) with the intra-K
+    sub-step durable; the real-signal variant lives in
+    tests/test_preemption.py."""
+    from cuda_gmm_mpi_tpu.testing import faults
+
+    ck = tmp_path / "ck"
+    with faults.use({"preempt": {"iter": 2}}):
+        rc = run_cli(["4", csv_file, str(tmp_path / "o"), "2",
+                      "--min-iters=3", "--max-iters=3", "--chunk-size=256",
+                      f"--checkpoint-dir={ck}"])
+    assert rc == 75
+    assert not (tmp_path / "o.summary").exists()
+    assert [f for f in (ck / "sweep").iterdir() if ".iter" in f.name]
+    # rerun (--resume auto default) completes from inside the fit
+    assert run_cli(["4", csv_file, str(tmp_path / "o"), "2",
+                    "--min-iters=3", "--max-iters=3", "--chunk-size=256",
+                    f"--checkpoint-dir={ck}"]) == 0
+    assert (tmp_path / "o.summary").exists()
+
+
+def test_cli_allow_nonfinite_quarantines_rows(tmp_path):
+    """--allow-nonfinite drops NaN/Inf rows at ingest (count-and-
+    quarantine) instead of rejecting the file; the fit then runs on the
+    clean remainder."""
+    rows = ["a,b"] + [f"{x:.3f},{x + 1.0:.3f}" for x in
+                      np.linspace(0.0, 9.0, 60)]
+    rows[7] = "nan,3.0"
+    rows[13] = "1e39,2.0"  # overflows compute float32: quarantined too
+    p = tmp_path / "dirty.csv"
+    p.write_text("\n".join(rows) + "\n")
+    assert run_cli(["2", str(p), str(tmp_path / "o"), "2",
+                    "--min-iters=2", "--max-iters=2"]) == 1
+    assert run_cli(["2", str(p), str(tmp_path / "o"), "2", "--min-iters=2",
+                    "--max-iters=2", "--allow-nonfinite"]) == 0
+    # every SURVIVING event got memberships: 60 data rows - 2 quarantined
+    results = (tmp_path / "o.results").read_text().splitlines()
+    assert len(results) == 58
 
 
 def test_cli_profile_and_trace_dir(csv_file, tmp_path, capsys):

@@ -1,0 +1,475 @@
+"""Preemption-safe execution (supervisor.py; docs/ROBUSTNESS.md "Run
+lifecycle"): cooperative stop -> emergency intra-K checkpoint -> exit 75 ->
+--resume auto, plus the multi-host liveness watchdog.
+
+The reference dies on SIGTERM with every byte of sweep state in host RAM
+(gaussian.cu:262-275) and a dead MPI rank hangs every survivor's next
+collective. Here a SIGTERM mid-EM must exit 75 (EX_TEMPFAIL) with a durable
+``<step>.iter<i>.npz`` sub-step, the resumed run must reproduce the
+uninterrupted run's model BIT-identically, and a lost peer must fail loudly
+within the watchdog timeout instead of blocking forever.
+"""
+
+import os
+import signal
+import subprocess
+import sys
+import time
+
+import numpy as np
+import pytest
+
+from cuda_gmm_mpi_tpu import GMMConfig, fit_gmm, supervisor
+from cuda_gmm_mpi_tpu.supervisor import (PeerLostError, PreemptedError,
+                                         RunSupervisor)
+from cuda_gmm_mpi_tpu.testing import faults
+
+from .conftest import communicate_or_kill, make_blobs, worker_env
+
+
+def _cfg(ck, **kw):
+    base = dict(min_iters=8, max_iters=8, chunk_size=512, dtype="float64",
+                checkpoint_dir=ck, preempt_poll_iters=2)
+    base.update(kw)
+    return GMMConfig(**base)
+
+
+def _substeps(ck):
+    """Intra-K emergency sub-step files (``<step>.iter<i>.npz``) on disk."""
+    d = os.path.join(ck, "sweep")
+    if not os.path.isdir(d):
+        return []
+    return sorted(f for f in os.listdir(d)
+                  if ".iter" in f and f.endswith(".npz"))
+
+
+def _full_steps(ck):
+    d = os.path.join(ck, "sweep")
+    if not os.path.isdir(d):
+        return []
+    return [f for f in os.listdir(d)
+            if f.isdigit() or (f.endswith(".npz") and f[:-4].isdigit()
+                               and ".iter" not in f)]
+
+
+def _sup():
+    return RunSupervisor(install_signals=False)
+
+
+@pytest.fixture
+def blobs3(rng):
+    centers = rng.normal(scale=8.0, size=(3, 3))
+    data = (centers[rng.integers(0, 3, 3000)]
+            + rng.normal(size=(3000, 3))).astype(np.float64)
+    return data
+
+
+def test_injected_preempt_mid_em_then_bit_identical_resume(tmp_path, blobs3):
+    """The tentpole contract, in-process and deterministic: a cooperative
+    stop at EM iteration 3 writes the intra-K sub-step, raises
+    PreemptedError (checkpointed, step/iter attached), and --resume auto
+    reproduces the uninterrupted run's selected model bit-identically.
+    Also proves the segmented supervised EM driver itself is bit-identical
+    to the unsupervised single-dispatch loop."""
+    from cuda_gmm_mpi_tpu.telemetry import read_stream, validate_stream
+    from cuda_gmm_mpi_tpu.telemetry.report import render_report
+
+    ck_ref, ck = str(tmp_path / "ref"), str(tmp_path / "ck")
+    with supervisor.use(_sup()):
+        ref = fit_gmm(blobs3, 6, 2, config=_cfg(ck_ref))
+
+    # The supervised segmented EM driver changes no results: a plain
+    # unsupervised run (single-dispatch loop, no checkpointing) agrees
+    # bit-for-bit.
+    plain = fit_gmm(blobs3, 6, 2, config=GMMConfig(
+        min_iters=8, max_iters=8, chunk_size=512, dtype="float64"))
+    assert plain.min_rissanen == ref.min_rissanen
+    np.testing.assert_array_equal(np.asarray(plain.means),
+                                  np.asarray(ref.means))
+
+    mf = tmp_path / "m.jsonl"
+    with pytest.raises(PreemptedError) as ei:
+        with faults.use({"preempt": {"iter": 3}}) as plan:
+            with supervisor.use(_sup()):
+                fit_gmm(blobs3, 6, 2,
+                        config=_cfg(ck, metrics_file=str(mf)))
+    assert plan.fired["preempt"] == 1
+    e = ei.value
+    assert e.reason == "preempt_injected"
+    assert e.checkpointed and e.step == 0 and e.em_iter == 3
+    assert _substeps(ck) == ["0.iter3.npz"]
+
+    # Lifecycle telemetry: one preempt + one shutdown record, both valid.
+    records = read_stream(str(mf))
+    assert validate_stream(records) == []
+    pre = [r for r in records if r["event"] == "preempt"]
+    shut = [r for r in records if r["event"] == "shutdown"]
+    assert len(pre) == 1 and pre[0]["reason"] == "preempt_injected"
+    assert pre[0]["where"] == "em" and pre[0]["em_iter"] == 3
+    assert len(shut) == 1 and shut[0]["checkpointed"]
+    rep = render_report(records)
+    assert "preempt" in rep and "exit 75" in rep
+
+    # --resume auto (the default) restarts INSIDE the interrupted fit.
+    with supervisor.use(_sup()):
+        res = fit_gmm(blobs3, 6, 2, config=_cfg(ck))
+    assert res.ideal_num_clusters == ref.ideal_num_clusters
+    assert res.min_rissanen == ref.min_rissanen
+    assert res.final_loglik == ref.final_loglik
+    np.testing.assert_array_equal(np.asarray(res.means),
+                                  np.asarray(ref.means))
+    # The sub-step is pruned once its K completed and saved durably.
+    assert _substeps(ck) == []
+
+
+def test_injected_preempt_streaming_mid_block(tmp_path, rng):
+    """Streaming path: a stop targeted at pass 2, block 4 checkpoints the
+    partially reduced block accumulator (stream_acc/stream_pass/
+    stream_block in the sub-step) and the resumed run -- which replays the
+    pass from the first unprocessed block -- stays bit-identical."""
+    centers = rng.normal(scale=8.0, size=(3, 3))
+    data = (centers[rng.integers(0, 3, 4096)]
+            + rng.normal(size=(4096, 3))).astype(np.float64)
+    ck_ref, ck = str(tmp_path / "ref"), str(tmp_path / "ck")
+    kw = dict(min_iters=5, max_iters=5, chunk_size=256, stream_events=True)
+
+    with supervisor.use(_sup()):
+        ref = fit_gmm(data, 5, 2, config=_cfg(ck_ref, **kw))
+
+    with pytest.raises(PreemptedError) as ei:
+        with faults.use({"preempt": {"iter": 2, "block": 4}}):
+            with supervisor.use(_sup()):
+                fit_gmm(data, 5, 2, config=_cfg(ck, **kw))
+    assert ei.value.checkpointed
+    subs = _substeps(ck)
+    assert len(subs) == 1
+    with np.load(os.path.join(ck, "sweep", subs[0])) as z:
+        keys = set(z.files)
+        assert {"stream_pass", "stream_block",
+                "stream_acc.Nk", "stream_acc.M1", "stream_acc.M2"} <= keys
+        assert int(z["stream_pass"]) == 2 and int(z["stream_block"]) == 5
+
+    with supervisor.use(_sup()):
+        res = fit_gmm(data, 5, 2, config=_cfg(ck, **kw))
+    assert res.min_rissanen == ref.min_rissanen
+    np.testing.assert_array_equal(np.asarray(res.means),
+                                  np.asarray(ref.means))
+
+
+def test_injected_preempt_sharded_mesh(tmp_path, rng):
+    """The supervised segmented driver works on a (4,2) sharded mesh too
+    (ShardedGMMModel borrows run_em_resumable): mid-EM stop, intra-K
+    sub-step, bit-identical resume -- health counts stay psum-exact."""
+    centers = rng.normal(scale=8.0, size=(3, 3))
+    data = (centers[rng.integers(0, 3, 4096)]
+            + rng.normal(size=(4096, 3))).astype(np.float64)
+    kw = dict(min_iters=6, max_iters=6, chunk_size=256, mesh_shape=(4, 2))
+    ck_ref, ck = str(tmp_path / "ref"), str(tmp_path / "ck")
+    with supervisor.use(_sup()):
+        ref = fit_gmm(data, 6, 2, config=_cfg(ck_ref, **kw))
+    with pytest.raises(PreemptedError) as ei:
+        with faults.use({"preempt": {"iter": 3}}):
+            with supervisor.use(_sup()):
+                fit_gmm(data, 6, 2, config=_cfg(ck, **kw))
+    assert ei.value.checkpointed and ei.value.em_iter == 3
+    assert _substeps(ck) == ["0.iter3.npz"]
+    with supervisor.use(_sup()):
+        res = fit_gmm(data, 6, 2, config=_cfg(ck, **kw))
+    assert res.min_rissanen == ref.min_rissanen
+    np.testing.assert_array_equal(np.asarray(res.means),
+                                  np.asarray(ref.means))
+
+
+def test_fused_sweep_stops_at_emission(tmp_path, blobs3):
+    """The fused whole-sweep device program's only host intervention point
+    is its per-K emission callback: a deadline observed there aborts the
+    program with the completed K's checkpoint durable (per-K granularity,
+    no sub-step) and the rerun resumes to the same answer."""
+    ck = str(tmp_path / "ck")
+    kw = dict(min_iters=6, max_iters=6, chunk_size=256, fused_sweep=True)
+    with pytest.raises(PreemptedError) as ei:
+        with supervisor.use(RunSupervisor(install_signals=False,
+                                          max_runtime_s=1e-3)):
+            fit_gmm(blobs3, 6, 2, config=_cfg(ck, **kw))
+    assert ei.value.reason == "deadline" and ei.value.checkpointed
+    assert _full_steps(ck) and not _substeps(ck)
+
+    res = fit_gmm(blobs3, 6, 2, config=_cfg(ck, **kw))
+    ref = fit_gmm(blobs3, 6, 2, config=_cfg(str(tmp_path / "ref"), **kw))
+    assert res.min_rissanen == ref.min_rissanen
+    np.testing.assert_array_equal(np.asarray(res.means),
+                                  np.asarray(ref.means))
+
+
+def test_resume_never_starts_fresh(tmp_path, blobs3):
+    """resume='never' ignores the interrupted run's checkpoints (sub-step
+    included): the sweep restarts at the top K and re-runs every step."""
+    ck = str(tmp_path / "ck")
+    with pytest.raises(PreemptedError):
+        with faults.use({"preempt": {"iter": 3}}):
+            with supervisor.use(_sup()):
+                fit_gmm(blobs3, 6, 2, config=_cfg(ck))
+    assert _substeps(ck)
+
+    r = fit_gmm(blobs3, 6, 2, config=_cfg(ck, resume="never"))
+    assert r.sweep_log[0][0] == 6            # restarted at the top
+    assert len(r.sweep_log) == 5             # ...and ran every K itself
+    # 'never' still writes new checkpoints for the NEXT resume.
+    assert _full_steps(ck)
+
+
+def test_deadline_preempts_library_run(tmp_path, blobs3):
+    """GMMConfig.max_runtime_s alone (library call, no ambient supervisor)
+    activates a signals-free supervisor whose deadline trips the same
+    cooperative stop a SIGTERM does."""
+    with pytest.raises(PreemptedError) as ei:
+        fit_gmm(blobs3, 6, 2, config=_cfg(
+            str(tmp_path / "ck"), max_runtime_s=1e-3))
+    assert ei.value.reason == "deadline"
+
+
+def test_watchdog_detects_stale_peer(tmp_path):
+    """LivenessWatchdog.check_peers flags the peer whose heartbeat file
+    aged past the timeout, and a fresh heartbeat clears it."""
+    from cuda_gmm_mpi_tpu.parallel import distributed
+    from cuda_gmm_mpi_tpu.supervisor import LivenessWatchdog
+
+    d = str(tmp_path)
+    distributed.write_rank_heartbeat(d, 0)
+    distributed.write_rank_heartbeat(d, 1)
+    w = LivenessWatchdog(d, rank=0, nproc=2, timeout_s=5.0)
+    assert w.check_peers() is None
+    old = time.time() - 60.0
+    os.utime(distributed.heartbeat_path(d, 1), (old, old))
+    lost = w.check_peers()
+    assert lost is not None
+    rank, age = lost
+    assert rank == 1 and age > 5.0
+    distributed.write_rank_heartbeat(d, 1)
+    assert w.check_peers() is None
+
+
+def test_watchdog_peer_loss_trips_stop_and_raises(tmp_path):
+    """End-to-end in one process: a watchdog whose peer never heartbeats
+    trips the stop flag with reason peer_lost within the timeout, and
+    raise_stop surfaces it as PeerLostError carrying the peer diagnosis."""
+    sup = _sup()
+    sup.install()
+    try:
+        # Peer rank 1 never writes: it ages from watchdog start and the
+        # timeout doubles as the startup grace window.
+        sup.start_watchdog(str(tmp_path / "hb"), rank=0, nproc=2,
+                           timeout_s=2.5, interval_s=0.1)
+        deadline = time.time() + 20.0
+        while not sup.stop_requested and time.time() < deadline:
+            time.sleep(0.01)
+        assert sup.stop_requested and sup.stop_reason == "peer_lost"
+        assert sup.lost_peer and sup.lost_peer["rank"] == 1
+        assert sup.collective_timeout_s == 2.5  # barrier bound while alive
+        assert sup.poll(where="em", k=4, em_iter=2)
+        with pytest.raises(PeerLostError) as ei:
+            sup.raise_stop(step=1, em_iter=2, checkpointed=True)
+        assert ei.value.rank == 1 and ei.value.timeout_s == 2.5
+    finally:
+        sup.uninstall()
+
+
+def test_raise_stop_maps_reasons():
+    """signal/deadline reasons raise PreemptedError; peer_lost raises
+    PeerLostError -- the CLI maps both to exit 75."""
+    sup = _sup()
+    sup.request_stop("sigterm")
+    with pytest.raises(PreemptedError) as ei:
+        sup.raise_stop(step=2, em_iter=7, checkpointed=True)
+    assert ei.value.reason == "sigterm" and ei.value.em_iter == 7
+
+    sup2 = _sup()
+    sup2._lost_peer = {"rank": 1, "age_s": 9.5, "timeout_s": 5.0}
+    sup2.request_stop("peer_lost")
+    with pytest.raises(PeerLostError):
+        sup2.raise_stop(step=0, checkpointed=False)
+
+
+# -- subprocess harnesses ---------------------------------------------------
+
+CLI = [sys.executable, "-m", "cuda_gmm_mpi_tpu.cli"]
+PEER_WORKER = os.path.join(os.path.dirname(__file__), "preempt_worker.py")
+
+
+def _cli_args(infile, out, ck):
+    # Sized so each K's EM spans seconds on CPU (wide mid-EM window) while
+    # the full sweep is only two Ks; buckets off keeps the loop free of
+    # between-K recompiles, so ~all wall time is inside run_em_resumable.
+    return ["4", infile, str(out), "3", "--device=cpu", "--dtype=float64",
+            "--min-iters=40", "--max-iters=40", "--sweep-k-buckets=off",
+            "--preempt-poll-iters=2", f"--checkpoint-dir={ck}"]
+
+
+def test_sigterm_mid_em_exits_75_then_bit_identical_resume(tmp_path, rng):
+    """The acceptance contract with a REAL signal: SIGTERM a running CLI
+    sweep mid-EM-fit, assert exit 75 within the grace window plus a
+    durable intra-K sub-step, then assert the resumed run's final model
+    files are byte-identical to an uninterrupted run's."""
+    from cuda_gmm_mpi_tpu.io.readers import write_bin
+
+    centers = rng.normal(scale=9.0, size=(4, 3))
+    n = 80_000
+    data = (centers[rng.integers(0, 4, n)]
+            + rng.normal(size=(n, 3))).astype(np.float32)
+    infile = str(tmp_path / "events.bin")
+    write_bin(infile, data)
+
+    def spawn(out, ck):
+        return subprocess.Popen(CLI + _cli_args(infile, out, ck),
+                                stdout=subprocess.PIPE,
+                                stderr=subprocess.PIPE,
+                                env=worker_env(), text=True)
+
+    # SIGTERM lands at a random point of K=3's multi-second EM (we wait
+    # for K=4's completed step 0 first), so the stop is mid-EM with high
+    # probability -- but a kill in the ms-wide between-K window is legal
+    # (exit 75, no sub-step), so retry the interrupted phase until the
+    # sub-step materializes.
+    ck = None
+    for attempt in range(3):
+        ck_try = str(tmp_path / f"ck{attempt}")
+        p = spawn(tmp_path / f"int{attempt}", ck_try)
+        deadline = time.time() + 300.0
+        try:
+            while time.time() < deadline:
+                if _full_steps(ck_try):
+                    break
+                if p.poll() is not None:
+                    out_, err_ = p.communicate()
+                    raise AssertionError(
+                        f"worker exited before SIGTERM (rc={p.returncode})"
+                        f":\n{out_}\n{err_[-3000:]}")
+                time.sleep(0.05)
+            else:
+                raise AssertionError("no checkpoint step appeared")
+            time.sleep(0.4)  # well inside K=3's EM
+            p.send_signal(signal.SIGTERM)
+            out_, err_ = communicate_or_kill(p, timeout=120)
+        finally:
+            if p.poll() is None:
+                p.kill()
+                p.wait(timeout=60)
+        assert p.returncode == 75, (
+            f"expected EX_TEMPFAIL:\n{out_}\n{err_[-3000:]}")
+        assert "Preempted" in err_
+        if _substeps(ck_try):
+            ck = ck_try
+            break
+    assert ck is not None, "SIGTERM never landed mid-EM in 3 attempts"
+
+    # Resume completes the sweep (exit 0) from inside the interrupted fit.
+    out_res = tmp_path / "resumed"
+    p2 = spawn(out_res, ck)
+    o2, e2 = communicate_or_kill(p2, timeout=600)
+    assert p2.returncode == 0, f"resume failed:\n{o2}\n{e2[-3000:]}"
+    assert _substeps(ck) == []  # consumed + pruned by the completed K
+
+    # Ground truth: uninterrupted run, fresh checkpoint dir.
+    out_ref = tmp_path / "ref"
+    p3 = spawn(out_ref, str(tmp_path / "ck_ref"))
+    o3, e3 = communicate_or_kill(p3, timeout=600)
+    assert p3.returncode == 0, f"reference failed:\n{o3}\n{e3[-3000:]}"
+
+    assert (tmp_path / "resumed.summary").read_bytes() == \
+        (tmp_path / "ref.summary").read_bytes()
+    assert (tmp_path / "resumed.results").read_bytes() == \
+        (tmp_path / "ref.results").read_bytes()
+
+
+@pytest.mark.slow
+def test_two_process_rank_hang_watchdog(tmp_path):
+    """A 2-host run where rank 1 stops heartbeating and wedges mid-EM
+    (rank_hang injection): rank 0's liveness watchdog must detect the
+    stale peer within peer_timeout_s and exit 75 loudly -- cooperatively
+    via PeerLostError if a poll point is reachable, else through the
+    forced-exit escalation -- instead of blocking forever in the next
+    collective (the reference's dead-MPI-rank behavior)."""
+    import json
+    import socket
+
+    with socket.socket() as s:
+        s.bind(("127.0.0.1", 0))
+        port = s.getsockname()[1]
+
+    ck = str(tmp_path / "ck")
+    procs = []
+    for i in range(2):
+        env = worker_env()
+        if i == 1:
+            env["GMM_FAULTS"] = json.dumps(
+                {"rank_hang": {"rank": 1, "iter": 4}})
+        procs.append(subprocess.Popen(
+            [sys.executable, PEER_WORKER, str(i), "2", str(port), ck],
+            stdout=subprocess.PIPE, stderr=subprocess.PIPE, env=env,
+            text=True))
+    try:
+        # Rank 0 must exit 75 on its own; rank 1 is wedged by design and
+        # is killed by the harness afterwards.
+        out0, err0 = communicate_or_kill(procs[0], timeout=600)
+        if "Multiprocess computations aren't implemented" in out0 + err0:
+            pytest.skip("CPU backend lacks multi-process collectives "
+                        "(same limitation as tests/test_multihost.py)")
+        assert procs[0].returncode == 75, (
+            f"rank 0 rc={procs[0].returncode}:\n{out0}\n{err0[-3000:]}")
+        assert ("PEER_LOST" in out0 or "heartbeat stale" in err0), \
+            f"no peer-loss diagnosis:\n{out0}\n{err0[-3000:]}"
+        assert procs[1].poll() is None, "rank 1 was supposed to be wedged"
+    finally:
+        for p in procs:
+            if p.poll() is None:
+                p.kill()
+            p.wait(timeout=60)
+
+
+WATCHDOG_WORKER = r"""
+import sys, time
+rank, hbdir = int(sys.argv[1]), sys.argv[2]
+from cuda_gmm_mpi_tpu import supervisor
+
+sup = supervisor.RunSupervisor()
+sup.install()
+sup.start_watchdog(hbdir, rank=rank, nproc=2, timeout_s=4.0,
+                   interval_s=0.5)
+if rank == 1:
+    time.sleep(2.0)       # heartbeat a few rounds...
+    sup.stop_watchdog()   # ...then "die": the heartbeat goes stale
+# Both ranks now simulate a main thread wedged inside a collective that
+# will never return (no poll point is ever reached): only the watchdog's
+# forced-exit escalation can end rank 0.
+time.sleep(600)
+"""
+
+
+@pytest.mark.slow
+def test_two_process_watchdog_forced_exit(tmp_path):
+    """The watchdog's last line of defense, across real processes and a
+    real shared heartbeat directory (no device collectives, so it runs on
+    any backend): when the peer dies AND the main thread is wedged where
+    no poll point can run, the forced-exit escalation ends rank 0 with
+    exit 75 within timeout + grace instead of hanging forever."""
+    hb = str(tmp_path / "hb")
+    procs = [subprocess.Popen(
+        [sys.executable, "-c", WATCHDOG_WORKER, str(i), hb],
+        stdout=subprocess.PIPE, stderr=subprocess.PIPE, env=worker_env(),
+        text=True) for i in range(2)]
+    try:
+        t0 = time.time()
+        out0, err0 = communicate_or_kill(procs[0], timeout=120)
+        took = time.time() - t0
+        assert procs[0].returncode == 75, (
+            f"rank 0 rc={procs[0].returncode}:\n{out0}\n{err0[-3000:]}")
+        assert "heartbeat stale" in err0 and "forcing exit" in err0, err0
+        # died at ~2s + timeout 4s + grace 4s, never anywhere near the
+        # wedged sleep: detection really was timeout-bounded
+        assert took < 60.0
+        assert procs[1].poll() is None  # the dead peer stays wedged
+    finally:
+        for p in procs:
+            if p.poll() is None:
+                p.kill()
+            p.wait(timeout=60)
