@@ -24,6 +24,15 @@ wall ratio (the bucketing speedup), NOT the NumPy baseline. Size knobs:
 GMM_BENCH_SWEEP_K (default 64), GMM_BENCH_SWEEP_N (default 1M accel /
 20k CPU), GMM_BENCH_SWEEP_D (24 accel / 16 CPU).
 
+Restart mode (``--restarts`` or GMM_BENCH_RESTARTS=R): batched-vs-
+sequential n_init A/B -- the same K0 -> 1 order search fitted with R
+restarts vmapped into single-dispatch batched EM
+(``restart_batch_size=R``) vs run as R sequential fits
+(``restart_batch_size=1``), same data and seeds. The JSON carries both
+walls plus winner parity (same init index / selected K / relative score
+diff); ``vs_baseline`` is the sequential/batched wall ratio. Size knobs:
+GMM_BENCH_RESTART_{N,D,K,ITERS} (see run_restart_bench).
+
 Env knobs: GMM_BENCH_CPU=1 (deliberate CPU run, rc 0); GMM_BENCH_PRECISION
 (matmul precision override); GMM_BENCH_PRECOMPUTE=1/0 (feature-hoist A/B,
 full-covariance in-memory configs; defaults ON for CPU runs -- the NumPy
@@ -316,6 +325,100 @@ def run_sweep_bench(platform: str, accel_unavailable: bool) -> dict:
     return result
 
 
+def run_restart_bench(platform: str, accel_unavailable: bool) -> dict:
+    """The --restarts mode: batched-vs-sequential n_init A/B.
+
+    Fits the SAME data with the SAME seeds through the full K0 -> 1 order
+    search twice: once with the restarts batched into single-dispatch
+    vmapped EM (``restart_batch_size=R``), once sequentially
+    (``restart_batch_size=1`` -- the degenerate case). Both sides are
+    warmed with a 1-iteration-per-K pass on their own model so compile
+    time stays out of the timed walls (min/max_iters are dynamic args).
+    ``vs_baseline`` is the sequential/batched wall ratio (the batching
+    speedup), and the record carries winner parity (same init index, same
+    selected K, relative score diff) -- the speedup is only meaningful if
+    both drivers pick the identical winner.
+
+    Size knobs: GMM_BENCH_RESTARTS (R, default 4), GMM_BENCH_RESTART_N
+    (default 200k accel / 20k CPU), GMM_BENCH_RESTART_D (16 / 8),
+    GMM_BENCH_RESTART_K (32 / 16), GMM_BENCH_RESTART_ITERS (5 / 4).
+    """
+    on_accel = platform not in ("cpu",)
+    r_init = int(os.environ.get("GMM_BENCH_RESTARTS") or 4)
+    n = int(os.environ.get("GMM_BENCH_RESTART_N")
+            or (200_000 if on_accel else 20_000))
+    d = int(os.environ.get("GMM_BENCH_RESTART_D") or (16 if on_accel else 8))
+    k0 = int(os.environ.get("GMM_BENCH_RESTART_K")
+             or (32 if on_accel else 16))
+    iters = int(os.environ.get("GMM_BENCH_RESTART_ITERS")
+                or (5 if on_accel else 4))
+    chunk = int(os.environ.get("GMM_BENCH_CHUNK")
+                or (131072 if on_accel else 4096))
+    chunk = min(chunk, n)
+
+    from cuda_gmm_mpi_tpu.config import GMMConfig
+    from cuda_gmm_mpi_tpu.models.gmm import GMMModel
+    from cuda_gmm_mpi_tpu.models.order_search import fit_gmm
+
+    rng = np.random.default_rng(42)
+    centers = rng.normal(scale=8.0, size=(k0, d))
+    data = (
+        centers[rng.integers(0, k0, n)]
+        + rng.normal(scale=1.0, size=(n, d))
+    ).astype(np.float32)
+
+    def one(batch: int):
+        cfg = GMMConfig(min_iters=iters, max_iters=iters, chunk_size=chunk,
+                        n_init=r_init, seed=0, restart_batch_size=batch)
+        model = GMMModel(cfg)
+        warm = GMMConfig(min_iters=1, max_iters=1, chunk_size=chunk,
+                         n_init=r_init, seed=0, restart_batch_size=batch)
+        fit_gmm(data, k0, 0, warm, model=model)
+        t0 = time.perf_counter()
+        res = fit_gmm(data, k0, 0, cfg, model=model)
+        wall = time.perf_counter() - t0
+        return {
+            "wall_s": round(wall, 3),
+            "winner_init": (int(res.init_index)
+                            if res.init_index is not None else None),
+            "ideal_k": int(res.ideal_num_clusters),
+            "score": float(res.min_rissanen),
+            "final_loglik": float(res.final_loglik),
+        }
+
+    batched = one(r_init)
+    sequential = one(1)
+    speedup = sequential["wall_s"] / max(batched["wall_s"], 1e-9)
+    rel_score = (abs(batched["score"] - sequential["score"])
+                 / max(abs(sequential["score"]), 1e-30))
+    result = {
+        "metric": f"n_init={r_init} restart wall ({n}x{d}, K={k0}->1, "
+                  f"{platform})",
+        "value": batched["wall_s"],
+        "unit": "s",
+        # A/B ratio (sequential / batched), NOT the NumPy baseline.
+        "vs_baseline": round(speedup, 3),
+        "accelerator_unavailable": accel_unavailable,
+        "restarts": {
+            "n_init": r_init, "n": n, "d": d, "k0": k0,
+            "em_iters_per_k": iters, "chunk_size": chunk,
+            "batched": batched,
+            "sequential": sequential,
+            "speedup": round(speedup, 3),
+            "winner_equal": (batched["winner_init"]
+                             == sequential["winner_init"]),
+            "ideal_k_equal": batched["ideal_k"] == sequential["ideal_k"],
+            "rel_score_diff": rel_score,
+        },
+        "measured_utc": time.strftime("%Y-%m-%dT%H:%M:%SZ", time.gmtime()),
+    }
+    if accel_unavailable:
+        result["platform_note"] = (
+            "accelerator tunnel unavailable (probe failed after retries); "
+            "this is a CPU-fallback measurement, not an accelerator result")
+    return result
+
+
 CONFIGS = {
     # BASELINE.md benchmark config matrix (1-5); "north" = the north-star;
     # 6 = the reference's first-class envelope (MAX_CLUSTERS=512,
@@ -341,6 +444,8 @@ def main() -> int:
             cfg_name = a.split("=", 1)[1]
     want_sweep = ("--sweep" in sys.argv[1:]
                   or os.environ.get("GMM_BENCH_SWEEP") == "1")
+    want_restarts = ("--restarts" in sys.argv[1:]
+                     or bool(os.environ.get("GMM_BENCH_RESTARTS")))
     spec = CONFIGS.get(cfg_name)
     if spec is None:
         print(
@@ -423,6 +528,14 @@ def main() -> int:
         # The headline-workload mode: bucketed-vs-off order-search A/B
         # (ignores --config's fixed-K shape; sized by GMM_BENCH_SWEEP_*).
         result = run_sweep_bench(platform, accel_unavailable)
+        watchdog.cancel()
+        print(json.dumps(result))
+        return 3 if accel_unavailable else 0
+
+    if want_restarts:
+        # Batched-vs-sequential n_init A/B (ignores --config; sized by
+        # GMM_BENCH_RESTART_* / GMM_BENCH_RESTARTS).
+        result = run_restart_bench(platform, accel_unavailable)
         watchdog.cancel()
         print(json.dumps(result))
         return 3 if accel_unavailable else 0

@@ -121,6 +121,15 @@ def build_parser() -> argparse.ArgumentParser:
     t.add_argument("--n-init", type=int, default=1,
                    help="independent restarts with varied kmeans++ seeds; "
                    "best Rissanen kept (1 = reference single-init)")
+    t.add_argument("--restart-batch-size", type=int, default=None,
+                   metavar="R",
+                   help="restarts per batched-EM dispatch: the n_init "
+                   "restarts vmap over a leading restart axis and run as "
+                   "one compiled program per batch (R x arithmetic "
+                   "intensity, zero extra uploads). Default: auto-sized "
+                   "from a host-memory heuristic (GMM_RESTART_MEM_BYTES / "
+                   "GMM_RESTART_BATCH_SIZE override); 1 = sequential "
+                   "restarts (identical winner, just slower)")
     t.add_argument("--pallas", default="auto", choices=["auto", "always", "never"],
                    help="use the experimental Pallas fused kernel ('auto' "
                         "routes to the XLA path; see docs/PERF.md)")
@@ -306,6 +315,7 @@ def main(argv=None) -> int:
             seed_method=args.seed_method,
             seed=args.seed,
             n_init=args.n_init,
+            restart_batch_size=args.restart_batch_size,
             use_pallas=args.pallas,
             fused_sweep=args.fused_sweep,
             sweep_k_buckets=args.sweep_k_buckets,
@@ -354,6 +364,7 @@ def main(argv=None) -> int:
             ("--fused-sweep", args.fused_sweep),
             ("--sweep-k-buckets", args.sweep_k_buckets != "pow2"),
             ("--n-init", args.n_init != 1),
+            ("--restart-batch-size", args.restart_batch_size is not None),
             ("--mesh", args.mesh),
             ("--seed-method", args.seed_method != "even"),
             ("--stream-events", args.stream_events),

@@ -222,6 +222,18 @@ class GMMConfig:
     # arrays (order_search._fit_with_restarts' per-model data cache); only
     # seeding and the EM itself repeat per restart.
     n_init: int = 1
+    # Restarts per batched-EM dispatch (models/restarts.py): the n_init
+    # restarts are vmapped over a leading restart axis and run as ONE
+    # compiled EM program per batch -- [R, B, K] E-step matmuls with R x
+    # the arithmetic intensity at zero extra host->device cost (the
+    # restart cache uploads the data once). None (default) auto-sizes the
+    # batch from a psutil-free host-memory heuristic (the [R, B, K]
+    # posterior buffer is the constraint; GMM_RESTART_MEM_BYTES overrides
+    # the budget, GMM_RESTART_BATCH_SIZE the size itself). 1 = the
+    # sequential path (one fit per init -- the degenerate case; selects
+    # the identical winner at the same seeds). Streaming and fused-sweep
+    # restarts always run sequentially.
+    restart_batch_size: Optional[int] = None
     # Numerical-sanitizer analog (SURVEY SS5.2: the reference has no race
     # detection / sanitizers; JAX's functional model removes data races, and
     # this enables the remaining useful check -- trap NaN/Inf at the op that
@@ -323,6 +335,9 @@ class GMMConfig:
             raise ValueError("pallas_block_b must be >= 1")
         if self.n_init < 1:
             raise ValueError("n_init must be >= 1")
+        if self.restart_batch_size is not None and self.restart_batch_size < 1:
+            raise ValueError("restart_batch_size must be >= 1 (or None for "
+                             "the host-memory auto cap)")
 
 
 DEFAULT_CONFIG = GMMConfig()

@@ -205,10 +205,17 @@ def counts_dict(counts) -> Dict[str, int]:
 
 
 def health_summary(total_counts, recoveries: int = 0,
-                   io_retries: int = 0) -> Dict[str, Any]:
-    """The ``run_summary.health`` section / ``GMMResult.health`` payload."""
+                   io_retries: int = 0,
+                   restart_drops: int = 0) -> Dict[str, Any]:
+    """The ``run_summary.health`` section / ``GMMResult.health`` payload.
+
+    ``restart_drops`` counts restarts dropped from a batched n_init run
+    by the drop-one-keep-survivors containment path (a poisoned restart
+    leaves the batch instead of rolling back its siblings;
+    models/restarts.py).
+    """
     word = pack_word(total_counts)
-    return {
+    out = {
         "flags": int(word),
         "flag_names": flag_names(word),
         "fatal": word_is_fatal(word),
@@ -216,6 +223,9 @@ def health_summary(total_counts, recoveries: int = 0,
         "recoveries": int(recoveries),
         "io_retries": int(io_retries),
     }
+    if restart_drops:
+        out["restart_drops"] = int(restart_drops)
+    return out
 
 
 class NumericalFaultError(RuntimeError):

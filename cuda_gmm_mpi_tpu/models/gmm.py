@@ -64,6 +64,23 @@ def resolve_iters(config: GMMConfig, min_iters: Optional[int],
     )
 
 
+def resolve_iters_batched(config: GMMConfig, num_restarts: int,
+                          min_iters, max_iters):
+    """Per-restart iteration bounds as dynamic int32 [R] vectors.
+
+    Scalars (or None -> the config's values) broadcast to every restart;
+    per-restart vectors pass through. A restart whose ``max_iters`` is 0
+    runs zero EM iterations -- the batched drivers' freeze-out handle for
+    converged / dropped restarts (the loop condition is false from the
+    start, so its lane's carry passes through untouched).
+    """
+    lo = config.min_iters if min_iters is None else min_iters
+    hi = config.max_iters if max_iters is None else max_iters
+    lo = jnp.broadcast_to(jnp.asarray(lo, jnp.int32), (num_restarts,))
+    hi = jnp.broadcast_to(jnp.asarray(hi, jnp.int32), (num_restarts,))
+    return jnp.minimum(lo, hi), hi
+
+
 def chunk_events(
     data: np.ndarray, chunk_size: int, num_shards: int = 1,
     num_chunks: Optional[int] = None,
@@ -129,6 +146,10 @@ class GMMModel:
     # Bucket widths must be a multiple of this (the cluster-mesh axis
     # extent on sharded models; 1 = any width).
     bucket_multiple = 1
+    # Batched n_init restarts (models/restarts.py): the EM loop vmaps
+    # over a leading restart axis. Streaming overrides this off (its EM
+    # is a host-driven per-block loop with no single program to vmap).
+    supports_batched_restarts = True
 
     def __init__(self, config: GMMConfig = GMMConfig(),
                  reduce_stats: Optional[ReduceFn] = None,
@@ -341,6 +362,215 @@ class GMMModel:
         extra = {"em_lls": np.asarray(lls, np.float64)} if stopped else {}
         return state, ll_out, done, buf, stopped, extra
 
+    def _em_batched_executable(self, trajectory_len: int, donate: bool):
+        """Memoized jitted BATCHED EM loop: ``em_while_loop`` vmapped over
+        a leading restart axis (state + per-restart iteration bounds
+        batched; the chunked data, weights, and epsilon are shared --
+        closure-captured by the vmapped function, so XLA computes every
+        data-derived value once, not per restart).
+
+        ``lax.while_loop``'s batching rule is the masked freeze-out: the
+        loop runs until EVERY restart's condition is false, and finished
+        restarts' carries are frozen via ``select`` -- a converged (or
+        fatal, or ``max_iters=0``-frozen) restart stops updating while its
+        siblings keep iterating. One executable serves every restart batch
+        of equal shape (jit's shape-keyed cache, same contract as the
+        per-K executables)."""
+        key = ("batched", trajectory_len, donate)
+        fn = self._em_exec_cache.get(key)
+        if fn is None:
+            em_fn = functools.partial(
+                em_while_loop, reduce_stats=self.reduce_stats,
+                stats_fn=self.stats_fn,
+                covariance_type=self.config.covariance_type,
+                precompute_features=self.config.precompute_features,
+                trajectory_len=trajectory_len,
+                dynamic_range=self.config.covariance_dynamic_range,
+                regression_scale=self.config.health_regression_scale,
+                **self._kw)
+
+            def batched(states, rids, data_chunks, wts_chunks, epsilon,
+                        lo_r, hi_r):
+                run_one = lambda s, rid, lo, hi: em_fn(
+                    s, data_chunks, wts_chunks, epsilon, lo, hi,
+                    restart_id=rid)
+                return jax.vmap(run_one, in_axes=(0, 0, 0, 0))(
+                    states, rids, lo_r, hi_r)
+
+            fn = self._em_exec_cache[key] = jax.jit(
+                batched, donate_argnums=(0,) if donate else ())
+        return fn
+
+    def run_em_batched(self, states, data_chunks, wts_chunks, epsilon: float,
+                       min_iters=None, max_iters=None, *,
+                       trajectory: bool = False, donate: bool = False):
+        """Full EM for a BATCH of restarts in one dispatch.
+
+        ``states`` is a GMMState whose every leaf carries a leading
+        restart axis R (models/restarts.py builds it from the vmapped
+        seeding). ``min_iters``/``max_iters`` accept scalars or [R]
+        vectors -- a restart with ``max_iters=0`` is frozen (zero
+        iterations, state passed through bit-identically), which is how
+        the drivers keep finished restarts inert inside a live batch.
+
+        Returns ``(states, loglik [R], iters [R])`` (+ ``ll_log [R,
+        max_iters+1]`` with ``trajectory=True``); per-restart health
+        counters land on ``last_health`` as int32 [R, NUM_FLAGS] -- one
+        poisoned restart flags its own row only, so the restart driver
+        can drop it and keep the survivors (health.py drop-one contract).
+        """
+        R = int(states.N.shape[0])
+        lo_r, hi_r = resolve_iters_batched(self.config, R, min_iters,
+                                           max_iters)
+        run = self._em_batched_executable(
+            int(self.config.max_iters) if trajectory else 0, donate)
+        out = run(states, jnp.arange(R, dtype=jnp.int32),
+                  data_chunks, wts_chunks,
+                  jnp.asarray(epsilon, data_chunks.dtype), lo_r, hi_r)
+        self.last_health = out[-1]
+        return out[:-1]
+
+    def run_em_batched_resumable(self, states, data_chunks, wts_chunks,
+                                 epsilon, min_iters: Optional[int] = None,
+                                 max_iters: Optional[int] = None, *,
+                                 poll_iters: int = 25,
+                                 should_stop: Optional[Callable[[int],
+                                                               bool]] = None,
+                                 freeze=None,
+                                 resume: Optional[dict] = None,
+                                 donate: bool = False):
+        """Batched sibling of :meth:`run_em_resumable`: the SAME batched
+        executable runs in host-polled segments so SIGTERM / deadline are
+        observed mid-batch and the emergency checkpoint carries ALL R
+        trajectories (supervisor.py contract).
+
+        Per-restart freeze-out spans segments: a restart that converges
+        (or goes fatal) inside a segment is frozen for every later one by
+        setting its segment ``max_iters`` to 0, so the iteration sequence
+        of every restart is bit-identical to the single-dispatch batched
+        loop (each boundary re-runs one deterministic E-step, exactly the
+        scalar driver's trade). ``freeze`` ([R] bool) pre-freezes lanes
+        the caller already finished (the restart sweep's done restarts).
+
+        Returns ``(states, loglik [R], iters [R], ll_logs
+        [R, config.max_iters + 1], stopped, extra)``; ``extra`` (on a
+        stop) carries the resume payload: NaN-padded per-restart loglik
+        rows ``em_lls`` [R, L] with lengths ``em_lens``, plus the
+        ``em_frozen`` / ``em_fatal`` masks. Health counters accumulate on
+        ``last_health`` as [R, NUM_FLAGS], counting each restart only
+        while it was live (frozen lanes' boundary re-E-steps are not
+        charged to them).
+        """
+        R = int(states.N.shape[0])
+        lo, hi = resolve_iters(self.config, min_iters, max_iters)
+        lo, hi = int(lo), int(hi)
+        eps_f = abs(float(epsilon))
+        inj = faults.peek("preempt")
+        inj_iter = None
+        if inj is not None and "iter" in inj \
+                and int(inj.get("block", -1)) == -1:
+            inj_iter = int(inj["iter"])
+
+        frozen = (np.zeros((R,), bool) if freeze is None
+                  else np.asarray(freeze, bool).copy())
+        fatal = np.zeros((R,), bool)
+        done = 0
+        lls: list = [[] for _ in range(R)]
+        if resume:
+            done = int(resume.get("em_iter", 0))
+            rows = np.asarray(resume.get("em_lls", np.zeros((R, 0))),
+                              np.float64).reshape(R, -1)
+            lens = np.asarray(resume.get("em_lens",
+                                         [rows.shape[1]] * R), np.int64)
+            lls = [[float(x) for x in rows[r][:int(lens[r])]]
+                   for r in range(R)]
+            if "em_frozen" in resume:
+                frozen |= np.asarray(resume["em_frozen"], bool)
+            if "em_fatal" in resume:
+                fatal |= np.asarray(resume["em_fatal"], bool)
+        counts_total = np.zeros((R, health.NUM_FLAGS), np.int64)
+        stopped = False
+        while True:
+            if any(lls[r] for r in range(R)):
+                # Boundary continuation test == the device cond, applied
+                # per restart: converged lanes freeze for good.
+                for r in range(R):
+                    if frozen[r] or not lls[r]:
+                        continue
+                    if done >= lo and len(lls[r]) >= 2 \
+                            and abs(lls[r][-1] - lls[r][-2]) <= eps_f:
+                        frozen[r] = True
+                if done >= hi or bool(frozen.all()):
+                    break
+            seg_end = min(done + max(int(poll_iters), 1), hi)
+            if inj_iter is not None and done < inj_iter < seg_end:
+                # Clamp so a poll lands exactly on the armed preempt
+                # iteration (deterministic injection contract).
+                seg_end = inj_iter
+            seg_max = seg_end - done
+            seg_min = min(max(lo - done, 0), seg_max)
+            live = ~frozen
+            lo_r = np.where(live, seg_min, 0).astype(np.int32)
+            hi_r = np.where(live, seg_max, 0).astype(np.int32)
+            states, ll_d, iters_d, ll_log_d = self.run_em_batched(
+                states, data_chunks, wts_chunks, epsilon,
+                min_iters=lo_r, max_iters=hi_r,
+                trajectory=True, donate=donate)
+            seg_iters = np.asarray(jax.device_get(iters_d), np.int64)
+            seg_lls = np.asarray(jax.device_get(ll_log_d), np.float64)
+            counts_seg = np.asarray(jax.device_get(self.last_health),
+                                    np.int64)
+            counts_total[live] += counts_seg[live]
+            all_fatal = bool(live.any())
+            for r in range(R):
+                if not live[r]:
+                    continue
+                n_r = int(seg_iters[r])
+                if lls[r]:
+                    # Slot 0 re-derives the previous segment's final
+                    # loglik (the boundary E-step); keep the new ones.
+                    lls[r].extend(float(x) for x in seg_lls[r][1:n_r + 1])
+                else:
+                    lls[r].extend(float(x) for x in seg_lls[r][:n_r + 1])
+                if health.word_is_fatal(health.pack_word(counts_seg[r])):
+                    fatal[r] = frozen[r] = True
+                else:
+                    all_fatal = False
+            done += seg_max
+            if all_fatal:
+                break  # every live restart poisoned: caller's ladder
+            if should_stop is not None and should_stop(done):
+                stopped = True
+                break
+            if seg_max == 0:
+                break  # nothing left to run (all lanes pre-frozen)
+        self.last_health = jnp.asarray(
+            np.minimum(counts_total, np.iinfo(np.int32).max), jnp.int32)
+        T = int(self.config.max_iters) + 1
+        bufs = np.full((R, T), np.nan, np.float64)
+        iters_out = np.zeros((R,), np.int64)
+        ll_out = np.full((R,), np.nan, np.float64)
+        for r in range(R):
+            n = min(len(lls[r]), T)
+            bufs[r, :n] = lls[r][:n]
+            iters_out[r] = max(len(lls[r]) - 1, 0)
+            if lls[r]:
+                ll_out[r] = lls[r][-1]
+        extra = {}
+        if stopped:
+            L = max((len(l) for l in lls), default=0)
+            em_lls = np.full((R, max(L, 1)), np.nan, np.float64)
+            for r in range(R):
+                em_lls[r, :len(lls[r])] = lls[r]
+            extra = {
+                "em_iter": np.int64(done),
+                "em_lls": em_lls,
+                "em_lens": np.asarray([len(l) for l in lls], np.int64),
+                "em_frozen": frozen.astype(np.int8),
+                "em_fatal": fatal.astype(np.int8),
+            }
+        return states, ll_out, iters_out, bufs, stopped, extra
+
     def rebucket_state(self, state, num_clusters: int):
         """Compact ``state`` to a narrower padded width on device (the
         sweep's bucket recompaction; see state.compact_to). Width is
@@ -443,6 +673,7 @@ def em_while_loop(
     trajectory_len: int = 0,
     dynamic_range: float = 1e3,
     regression_scale: float = 10.0,
+    restart_id=None,
 ):
     """The whole per-K EM algorithm as one traced program.
 
@@ -493,9 +724,18 @@ def em_while_loop(
 
     # Deterministic fault injection (testing.faults): consumed at TRACE
     # time, so the armed executable reproduces the fault on every reuse
-    # while a rebuilt (recovery-escalated) model traces clean.
-    _inj_nan = faults.take("nan_loglik")
+    # while a rebuilt (recovery-escalated) model traces clean. A
+    # ``restart``-keyed plan targets ONE lane of the batched restart loop
+    # (``restart_id`` is the vmapped per-restart index there); it never
+    # fires in a loop that has no restart axis.
+    _inj_nan = faults.peek("nan_loglik")
+    if _inj_nan is not None and "restart" in _inj_nan and restart_id is None:
+        _inj_nan = None
+    else:
+        _inj_nan = faults.take("nan_loglik")
     _inj_nan_iter = int(_inj_nan["iter"]) if _inj_nan else None
+    _inj_nan_restart = (int(_inj_nan["restart"])
+                        if _inj_nan and "restart" in _inj_nan else None)
 
     feats = None
     if (precompute_features and stats_fn is None and not diag_only
@@ -564,8 +804,10 @@ def em_while_loop(
         stats_new = estep(s)  # :713-741
         ll = stats_new.loglik
         if _inj_nan_iter is not None:
-            ll = jnp.where(iters + 1 == _inj_nan_iter,
-                           jnp.asarray(jnp.nan, ll.dtype), ll)
+            hit = iters + 1 == _inj_nan_iter
+            if _inj_nan_restart is not None and restart_id is not None:
+                hit = hit & (restart_id == _inj_nan_restart)
+            ll = jnp.where(hit, jnp.asarray(jnp.nan, ll.dtype), ll)
         if trajectory_len:
             # mode='drop': dynamic max_iters can exceed the static buffer.
             ll_log = ll_log.at[iters + 1].set(ll, mode="drop")

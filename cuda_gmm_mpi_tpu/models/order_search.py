@@ -310,6 +310,11 @@ class GMMResult:
     # flag word + per-lane counters aggregated over every K, recovery and
     # checkpoint-retry counts. A clean run reads {"flags": 0, ...}.
     health: Optional[dict] = None
+    # Which init won an n_init > 1 fit (0-based restart index; None for
+    # single-init fits). The batched and sequential restart paths must
+    # agree on this at identical seeds (the winner-parity contract,
+    # models/restarts.py).
+    init_index: Optional[int] = None
     # The fitted model (jitted executables already built) so the output path
     # reuses compiled posteriors instead of building a fresh GMMModel.
     model: Optional[object] = dataclasses.field(default=None, repr=False)
@@ -1025,8 +1030,62 @@ def _host_state(state, model):
     return jax.device_get(state)
 
 
+def _seed_rows(data, source, num_clusters, n_dims, n_events, dtype, *,
+               seed_method, seed, init_means=None):
+    """One restart's K seed rows in ORIGINAL data coordinates.
+
+    The single row recipe behind every init: ``init_means`` verbatim, the
+    kmeans++ D^2-weighted draw (deterministic per ``seed``), or the
+    reference's evenly-spaced rows. Shared by ``_prepare_fit`` and the
+    batched restart driver (models/restarts.py) so the batched path's
+    per-restart seeds are bit-identical to the sequential path's by
+    construction, never by parallel maintenance.
+    """
+    from ..ops.seeding import (
+        kmeanspp_from_pool, kmeanspp_pool, seed_means_indices,
+    )
+
+    if init_means is not None:
+        rows = np.asarray(init_means, dtype)
+        if rows.shape != (num_clusters, n_dims):
+            raise ValueError(
+                f"init_means must be [{num_clusters}, {n_dims}], got "
+                f"{rows.shape}")
+        return rows
+    if seed_method == "kmeans++":
+        pool, rng = kmeanspp_pool(n_events, seed=seed)
+        x_pool = np.asarray(
+            source.read_rows(pool) if source is not None else data[pool]
+        )
+        return x_pool[kmeanspp_from_pool(x_pool, num_clusters, rng)]
+    # 'even': float32 index math of gaussian.cu:110-121
+    idx = np.asarray(seed_means_indices(n_events, num_clusters))
+    return np.asarray(
+        source.read_rows(idx) if source is not None else data[idx]
+    )
+
+
+def _data_fingerprint(data, source, sample_weight):
+    """Identity key guarding the restart cache against stale device arrays.
+
+    The cache hangs off the MODEL, so a model reused across fits with
+    different data must never be served the previous fit's uploaded
+    chunks: the fingerprint ties the cached upload to the input object
+    (id), its shape, and its dtype, plus the sample_weight's identity.
+    (id() alone can be recycled after gc -- shape/dtype narrow that hole
+    to byte-compatible arrays, and the restart cache is fit-scoped in
+    normal use; the guard is for models shared across fits.)
+    """
+    obj = source if source is not None else data
+    shape = tuple(obj.shape)
+    dtype = str(getattr(obj, "dtype", ""))
+    w = (None if sample_weight is None
+         else (id(sample_weight), tuple(np.asarray(sample_weight).shape)))
+    return (id(obj), shape, dtype, w)
+
+
 def _prepare_fit(data, num_clusters, config, model, phase, log,
-                 init_means=None, sample_weight=None):
+                 init_means=None, sample_weight=None, skip_seeding=False):
     """Load, center, seed, chunk, and place the data -- one path for all
     four cases (ndarray or FileSource input x single- or multi-process run).
 
@@ -1044,10 +1103,7 @@ def _prepare_fit(data, num_clusters, config, model, phase, log,
     (``prepare(host_local=True)``) -- replacing the reference's
     read-on-rank-0 + MPI_Bcast-the-whole-dataset (gaussian.cu:186-207).
     """
-    from ..ops.seeding import (
-        kmeanspp_from_pool, kmeanspp_pool, seed_means_indices,
-        seed_state_from_parts,
-    )
+    from ..ops.seeding import seed_state_from_parts
     from ..parallel.distributed import global_moments, host_chunk_bounds
 
     pid, nproc = jax.process_index(), jax.process_count()
@@ -1071,7 +1127,14 @@ def _prepare_fit(data, num_clusters, config, model, phase, log,
     # device-resident chunk arrays. Only the seeding (seed-dependent) and
     # the per-restart state placement run again.
     cache = getattr(model, "_restart_cache", None)
+    fingerprint = _data_fingerprint(data, source, sample_weight)
     prepared = cache.get("prepared") if cache is not None else None
+    if prepared is not None and cache.get("fingerprint") != fingerprint:
+        # The model was reused with DIFFERENT data while its restart
+        # cache was live: serving the previous fit's device arrays would
+        # silently fit the wrong dataset. Drop the stale entry.
+        prepared = None
+        cache.pop("prepared", None)
     if prepared is not None:
         (chunks, wts, chunks_np, wts_np, n_events, n_dims, shift,
          start, stop, var_mean) = prepared
@@ -1142,38 +1205,29 @@ def _prepare_fit(data, num_clusters, config, model, phase, log,
                                else local_weight.astype(local.dtype)),
             )
 
-    with phase("cpu"):
-        # Seed rows fetched in ORIGINAL coordinates, identically on every
-        # host (net reference semantics: device seeding overwritten by the
-        # host full-data reseed, gaussian.cu:108-123). Per restart (the
-        # seed changes); everything above this point is restart-invariant.
-        if init_means is not None:
-            rows = np.asarray(init_means, dtype)
-            if rows.shape != (num_clusters, n_dims):
-                raise ValueError(
-                    f"init_means must be [{num_clusters}, {n_dims}], got "
-                    f"{rows.shape}")
-        elif config.seed_method == "kmeans++":
-            pool, rng = kmeanspp_pool(n_events, seed=config.seed)
-            x_pool = np.asarray(
-                source.read_rows(pool) if source is not None else data[pool]
+    state = None
+    if not skip_seeding:
+        with phase("cpu"):
+            # Seed rows fetched in ORIGINAL coordinates, identically on
+            # every host (net reference semantics: device seeding
+            # overwritten by the host full-data reseed, gaussian.cu:
+            # 108-123). Per restart (the seed changes); everything above
+            # this point is restart-invariant. The batched restart driver
+            # passes skip_seeding=True and runs this same recipe itself,
+            # once per restart lane (models/restarts.py).
+            rows = _seed_rows(data, source, num_clusters, n_dims, n_events,
+                              dtype, seed_method=config.seed_method,
+                              seed=config.seed, init_means=init_means)
+            state = seed_state_from_parts(
+                np.asarray(rows, dtype) - np.asarray(shift, dtype)[None, :],
+                n_events, var_mean, num_clusters,
+                covariance_dynamic_range=config.covariance_dynamic_range,
+                dtype=dtype,
             )
-            rows = x_pool[kmeanspp_from_pool(x_pool, num_clusters, rng)]
-        else:  # 'even': float32 index math of gaussian.cu:110-121
-            idx = np.asarray(seed_means_indices(n_events, num_clusters))
-            rows = np.asarray(
-                source.read_rows(idx) if source is not None else data[idx]
-            )
-        state = seed_state_from_parts(
-            np.asarray(rows, dtype) - np.asarray(shift, dtype)[None, :],
-            n_events, var_mean, num_clusters,
-            covariance_dynamic_range=config.covariance_dynamic_range,
-            dtype=dtype,
-        )
-        # Deterministic singular-covariance injection (testing.faults):
-        # applied to the host state BEFORE mesh placement, so every
-        # execution path sees the identical poisoned seed.
-        state = faults.maybe_poison_state(state)
+            # Deterministic singular-covariance injection (testing.faults):
+            # applied to the host state BEFORE mesh placement, so every
+            # execution path sees the identical poisoned seed.
+            state = faults.maybe_poison_state(state)
 
     rec = telemetry.current()
     with phase("memcpy"):
@@ -1182,13 +1236,22 @@ def _prepare_fit(data, num_clusters, config, model, phase, log,
             # host-prepared, streaming); only the fresh seed state needs
             # placement. Every model with a prepare() also has
             # prepare_state() (the checkpoint-restore contract).
-            if hasattr(model, "prepare_state"):
+            if state is not None and hasattr(model, "prepare_state"):
                 state = model.prepare_state(
                     jax.tree_util.tree_map(jnp.asarray, state))
         elif hasattr(model, "prepare"):  # sharded path: pad K, place on mesh
-            state, chunks, wts = model.prepare(
-                state, chunks_np, wts_np, host_local=(nproc > 1)
+            place = state
+            if place is None:
+                # skip_seeding (batched restarts): the data still needs
+                # its mesh placement; a throwaway zero state stands in
+                # for prepare()'s state argument and is discarded.
+                from ..state import zeros_state
+
+                place = zeros_state(num_clusters, n_dims, dtype)
+            placed, chunks, wts = model.prepare(
+                place, chunks_np, wts_np, host_local=(nproc > 1)
             )
+            state = placed if state is not None else None
         else:
             chunks, wts = jnp.asarray(chunks_np), jnp.asarray(wts_np)
     if prepared is None:
@@ -1202,6 +1265,7 @@ def _prepare_fit(data, num_clusters, config, model, phase, log,
             cache["prepared"] = (
                 chunks, wts, chunks_np, wts_np, n_events, n_dims,
                 np.asarray(shift), start, stop, var_mean)
+            cache["fingerprint"] = fingerprint
     return (state, chunks, wts, chunks_np, wts_np, n_events, n_dims,
             np.asarray(shift), (start, stop))
 
@@ -1232,7 +1296,25 @@ def _fit_with_restarts(data, num_clusters, target_num_clusters, config,
             model = ShardedGMMModel(config)
         else:
             model = GMMModel(config)
+
+    from .restarts import fit_restarts_batched, resolve_restart_batch_size
+
+    batch_size = resolve_restart_batch_size(config, model, data,
+                                            num_clusters, log=log)
+    if batch_size > 1:
+        # Single-dispatch batched restarts: vmapped seeding + EM over the
+        # n_init axis (models/restarts.py). restart_batch_size=1 (or an
+        # unsupported path) keeps the sequential loop below -- the
+        # degenerate case the batched driver is winner-parity-tested
+        # against.
+        return fit_restarts_batched(
+            data, num_clusters, target_num_clusters, config, model,
+            verbose, init_means=init_means, sample_weight=sample_weight,
+            batch_size=batch_size)
+
     best = None
+    best_i = None
+    init_scores = []  # per-init best criterion score (restart_select)
     rec = telemetry.current()
     # One fit-scoped data cache on the shared model: init 0 prepares (and
     # uploads) the chunked events once, restarts reuse the device-resident
@@ -1261,15 +1343,22 @@ def _fit_with_restarts(data, num_clusters, target_num_clusters, config,
             if verbose:
                 print(f"init {i}: {config.criterion}={r.min_rissanen:.6e} "
                       f"K={r.ideal_num_clusters}")
+            init_scores.append(float(r.min_rissanen))
             # NaN-safe best pick: a degenerate init (NaN rissanen) must
             # never shadow later finite restarts ('finite < NaN' is False).
             if (best is None or math.isnan(best.min_rissanen)
                     or r.min_rissanen < best.min_rissanen):
-                best = r
+                best, best_i = r, i
     finally:
         model._restart_cache = None
+    best.init_index = best_i
     if rec.active:
         rec.set_context(init=None)  # clear the tag for any later records
+        rec.emit("restart_select", winner=int(best_i),
+                 scores=[s if math.isfinite(s) else None
+                         for s in init_scores],
+                 criterion=config.criterion,
+                 mode="sequential", batch_size=1)
     if verbose:
         print(f"best of {config.n_init} inits: "
               f"{config.criterion}={best.min_rissanen:.6e} "

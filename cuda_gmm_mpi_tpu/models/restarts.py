@@ -1,0 +1,895 @@
+"""Single-dispatch batched restarts: vmapped EM + seeding over n_init.
+
+The n_init restarts of ``order_search._fit_with_restarts`` are independent
+fits of the SAME device-resident data -- running them as R sequential
+sweeps re-dispatches the same EM while-loop R times, and at K <~ 100 the
+per-restart [B, K] E-step matmuls leave the MXU underfed. This driver runs
+a whole batch of restarts as ONE compiled program per sweep step:
+
+  - seeding: the per-restart seed ROWS keep the sequential path's host
+    recipe bit-identically (``order_search._seed_rows`` -- same kmeans++
+    RNG streams at seeds ``seed + i``), and the state build vmaps over the
+    restart axis (``ops.seeding.seed_states_batched``);
+  - EM: ``GMMModel.run_em_batched`` vmaps ``em_while_loop`` over a leading
+    restart axis with masked freeze-out -- ``lax.while_loop``'s batching
+    rule runs until EVERY restart converges (or hits max_iters) and
+    freezes finished lanes via ``select``, so each lane's iteration
+    sequence equals its solo run's;
+  - order reduction: ``eliminate_and_reduce`` vmapped, with per-lane merge
+    application (finished lanes keep their state via ``where``);
+  - health: per-restart counter ROWS ([R, NUM_FLAGS]) -- one poisoned
+    restart is DROPPED from the batch (its siblings keep their results)
+    and the escalation ladder runs only when every live lane goes fatal;
+  - preemption: ``run_em_batched_resumable`` runs the same executable in
+    host-polled segments; a SIGTERM mid-batch checkpoints all R
+    trajectories in one emergency sub-step and ``--resume auto`` restores
+    them bit-identically;
+  - sharded models reuse the same batched loop with the restart axis
+    replicated and the data axis sharded (shard_map(vmap(...))).
+
+The batched sweep is FIXED-WIDTH (no ``sweep_k_buckets`` recompaction):
+lanes reach different active counts at the same step, and one compiled
+program must serve the whole batch -- the same trade the fused sweep
+makes. ``restart_batch_size=1`` keeps the sequential driver, which is the
+degenerate case this one is winner-parity-tested against
+(tests/test_batched_restarts.py).
+
+Memory model: the batch size is bounded by the [R, B, K] posterior buffer
+(plus the [R, B, F] feature intermediates) of one fused E+M chunk pass.
+``resolve_restart_batch_size`` auto-caps R from a psutil-free host-memory
+probe (sysconf); GMM_RESTART_MEM_BYTES overrides the budget and
+GMM_RESTART_BATCH_SIZE the size itself (docs/PERF.md "Restart batching").
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import functools
+import math
+import os
+import time
+from typing import List, Optional
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from .. import health, supervisor, telemetry
+from ..ops.formulas import convergence_epsilon, model_score
+from ..ops.merge import eliminate_and_reduce
+from ..ops.seeding import seed_states_batched
+from ..state import clone_state, compact
+from ..testing import faults
+from ..utils.logging_ import get_logger
+
+
+# ---------------------------------------------------------------------------
+# Batch sizing (the tier-1-safe default: auto caps by host memory).
+# ---------------------------------------------------------------------------
+
+def _host_memory_bytes() -> Optional[int]:
+    """Total host memory via sysconf -- deliberately psutil-free (the
+    container bakes no extra deps). None when the platform hides it."""
+    try:
+        pages = os.sysconf("SC_PHYS_PAGES")
+        page = os.sysconf("SC_PAGE_SIZE")
+    except (AttributeError, ValueError, OSError):
+        return None
+    if pages <= 0 or page <= 0:
+        return None
+    return int(pages) * int(page)
+
+
+def restart_batch_auto_cap(config, n_events: int, n_dims: int,
+                           num_clusters: int) -> int:
+    """Largest restart batch the memory budget admits.
+
+    Per-restart working set of one fused E+M chunk pass: the [B, K]
+    posteriors, the [B, F] quadratic-form features (F = D^2 expanded --
+    the dominant intermediate), and a few K x D x D statistics buffers,
+    with a 3x multiplier for XLA temporaries and double-buffering. The
+    budget defaults to 1/4 of host memory (CPU tier-1 runs device = host;
+    on real accelerators HBM is the binding constraint and the explicit
+    knobs take over): GMM_RESTART_MEM_BYTES overrides it directly.
+    """
+    env = os.environ.get("GMM_RESTART_MEM_BYTES")
+    if env not in (None, ""):
+        budget = int(env)
+    else:
+        host = _host_memory_bytes()
+        budget = host // 4 if host else 2 << 30
+    itemsize = np.dtype(config.dtype).itemsize
+    B = max(1, min(int(config.chunk_size), int(n_events)))
+    K, D = int(num_clusters), int(n_dims)
+    per_restart = itemsize * (B * (K + D * D + D) * 3 + K * D * D * 4)
+    return max(1, int(budget // max(per_restart, 1)))
+
+
+def resolve_restart_batch_size(config, model, data, num_clusters=None,
+                               log=None) -> int:
+    """The restart batch size this fit will actually run.
+
+    1 (the sequential driver) when restarts cannot batch on this path --
+    streaming (no single EM program to vmap), fused sweeps (each init runs
+    the whole-sweep device program), or a model without the batched loop.
+    Otherwise GMM_RESTART_BATCH_SIZE > config.restart_batch_size > the
+    host-memory auto cap, clamped to [1, n_init].
+    """
+    if config.n_init <= 1:
+        return 1
+    why = None
+    if config.stream_events:
+        why = "stream_events has no single EM program to vmap"
+    elif config.fused_sweep:
+        why = "fused_sweep runs the whole-sweep device program per init"
+    elif not getattr(model, "supports_batched_restarts", False):
+        why = f"{type(model).__name__} has no batched EM loop"
+    if why is not None:
+        if log is not None and (config.restart_batch_size or 1) > 1:
+            log.info("batched restarts disabled (%s); running the %d "
+                     "inits sequentially", why, config.n_init)
+        return 1
+    env = os.environ.get("GMM_RESTART_BATCH_SIZE")
+    if env not in (None, ""):
+        requested = int(env)
+    elif config.restart_batch_size is not None:
+        requested = int(config.restart_batch_size)
+    else:
+        try:
+            n_events, n_dims = data.shape
+        except (AttributeError, ValueError):
+            return 1
+        requested = restart_batch_auto_cap(
+            config, int(n_events), int(n_dims),
+            int(num_clusters or config.max_clusters))
+    return max(1, min(requested, config.n_init))
+
+
+# ---------------------------------------------------------------------------
+# Batched state placement / host copies (plain and sharded models).
+# ---------------------------------------------------------------------------
+
+def _place_batched(model, host_states: List):
+    """One restart-batched device state from R per-lane host states."""
+    if hasattr(model, "prepare_states_batched"):
+        return model.prepare_states_batched(host_states)
+    return jax.tree_util.tree_map(
+        lambda *xs: jnp.stack([jnp.asarray(x) for x in xs]), *host_states)
+
+
+def _place_batched_state(model, batched_host):
+    """Re-place an already-batched HOST state (checkpoint restore)."""
+    R = int(np.asarray(batched_host.N).shape[0])
+    lanes = [
+        jax.tree_util.tree_map(lambda a: jnp.asarray(np.asarray(a)[r]),
+                               batched_host)
+        for r in range(R)
+    ]
+    return _place_batched(model, lanes)
+
+
+def _host_batched(model, states):
+    """Host-local copy of a restart-batched state (checkpoint payloads)."""
+    if hasattr(model, "host_batched_state"):
+        return model.host_batched_state(states)
+    return jax.device_get(states)
+
+
+@functools.lru_cache(maxsize=None)
+def _elim_reduce_batched_jit(diag_only: bool):
+    """Process-wide jitted vmapped eliminate_and_reduce (per diag flag) --
+    same executable-cache rationale as order_search._elim_reduce_jit."""
+    return jax.jit(jax.vmap(
+        functools.partial(eliminate_and_reduce, diag_only=diag_only)))
+
+
+def _where_lanes(mask_np, new_states, old_states):
+    """Per-lane select: lanes with ``mask`` take ``new``, others keep
+    ``old`` (frozen lanes of a batched sweep step)."""
+    mask = jnp.asarray(np.asarray(mask_np, bool))
+
+    def sel(old, new):
+        m = mask.reshape((mask.shape[0],) + (1,) * (new.ndim - 1))
+        return jnp.where(m, new, old)
+
+    return jax.tree_util.tree_map(sel, old_states, new_states)
+
+
+def _json_scores(scores) -> list:
+    """JSON-safe score list (non-finite -> None) for restart_select."""
+    return [float(s) if s is not None and math.isfinite(float(s)) else None
+            for s in scores]
+
+
+# ---------------------------------------------------------------------------
+# Batched recovery ladder (every live lane fatal).
+# ---------------------------------------------------------------------------
+
+def _recover_batched(model, config, rollback, chunks, wts, epsilon, k_r,
+                     live, *, trajectory, rec, log, faulty_counts,
+                     batch_indices):
+    """Climb the escalation ladder for a WHOLE-batch fatal EM step.
+
+    Mirrors ``health.recover_em`` lane-wise: every live lane's rollback
+    state is repaired (sanitize + boosted variance floor) and the batch
+    retries on the rung's model. The first rung with ANY clean live lane
+    wins -- still-fatal lanes are handed back for the drop path (the
+    batched containment contract: survivors are never rolled back for a
+    sibling). Returns ``(model, states, ll, iters, counts, ll_logs,
+    clean_live)``; raises :class:`health.NumericalFaultError` when
+    recovery is off or the ladder is exhausted.
+    """
+    R = int(live.shape[0])
+    total = np.asarray(faulty_counts, np.int64)[live].sum(axis=0)
+    k_top = int(np.max(np.asarray(k_r)[live]))
+    if config.recovery != "retry":
+        raise health.NumericalFaultError(
+            f"numerical fault in every live restart of the batch at "
+            f"K={k_top} (flags={health.flag_names(health.pack_word(total))})"
+            f" and recovery is {config.recovery!r}",
+            health.fault_bundle(total, k=k_top, where="batched_restarts",
+                                config=config))
+    ladder = health.escalation_ladder(config)
+    host_rb = _host_batched(model, rollback)
+    lanes = [
+        jax.tree_util.tree_map(lambda a: jnp.asarray(np.asarray(a)[r]),
+                               host_rb)
+        for r in range(R)
+    ]
+    attempts: List[dict] = []
+    for attempt, rung in enumerate(ladder, start=1):
+        m2, cfg2 = health.rung_model(model, config, rung)
+        boost = float(config.recovery_boost) ** attempt
+        repaired = [
+            (health.repair_state(lanes[r], diag_only=cfg2.diag_only,
+                                 boost=boost) if live[r] else lanes[r])
+            for r in range(R)
+        ]
+        states2 = _place_batched(m2, repaired)
+        lo_r = np.where(live, min(config.min_iters, config.max_iters),
+                        0).astype(np.int32)
+        hi_r = np.where(live, config.max_iters, 0).astype(np.int32)
+        out = m2.run_em_batched(states2, chunks, wts, epsilon,
+                                min_iters=lo_r, max_iters=hi_r,
+                                trajectory=trajectory)
+        if trajectory:
+            states2, ll_d, iters_d, ll_logs = out
+        else:
+            (states2, ll_d, iters_d), ll_logs = out, None
+        counts = np.asarray(jax.device_get(m2.last_health), np.int64)
+        ll_np = np.asarray(jax.device_get(ll_d), np.float64)
+        iters_np = np.asarray(jax.device_get(iters_d), np.int64)
+        clean = np.asarray([
+            not health.word_is_fatal(health.pack_word(counts[r]))
+            for r in range(R)
+        ])
+        clean_live = live & clean
+        record = {"attempt": attempt, "action": rung["action"],
+                  "boost": boost,
+                  "clean": int(clean_live.sum()), "live": int(live.sum())}
+        attempts.append(record)
+        if log is not None:
+            log.warning("batched recovery attempt %d (%s): %d/%d restarts "
+                        "clean", attempt, rung["action"],
+                        record["clean"], record["live"])
+        if rec is not None and rec.active:
+            for r in np.flatnonzero(live):
+                word_r = health.pack_word(counts[r])
+                rec.set_context(init=int(batch_indices[r]))
+                rec.emit("recovery", k=int(k_r[r]), attempt=attempt,
+                         action=rung["action"],
+                         outcome="recovered" if clean[r] else "fatal",
+                         flags=int(word_r),
+                         flag_names=health.flag_names(word_r))
+                rec.metrics.count("recovery_attempts")
+            rec.set_context(init=None)
+            if clean_live.any():
+                rec.metrics.count("recoveries")
+        if clean_live.any():
+            return m2, states2, ll_np, iters_np, counts, ll_logs, clean_live
+    raise health.NumericalFaultError(
+        f"numerical fault in every restart of the batch at K={k_top} not "
+        f"recovered after {len(ladder)} escalation attempt(s)",
+        health.fault_bundle(total, k=k_top, where="batched_restarts",
+                            attempts=attempts, config=config))
+
+
+# ---------------------------------------------------------------------------
+# The batched restart driver.
+# ---------------------------------------------------------------------------
+
+def fit_restarts_batched(data, num_clusters, target_num_clusters, config,
+                         model, verbose, init_means=None,
+                         sample_weight=None, batch_size=2):
+    """n_init restarts in memory-bounded batches of one vmapped sweep each.
+
+    The order_search entry point for ``restart_batch_size > 1`` (see the
+    module docstring); must select the identical winner as the sequential
+    driver at the same seeds (``GMMResult.init_index`` carries the pick).
+    """
+    from .order_search import (
+        GMMResult, _emit_run_summary, _null_phase, _prepare_fit,
+    )
+
+    log = get_logger(config)
+    rec = telemetry.current()
+    stop_number = target_num_clusters if target_num_clusters > 0 else 1
+    R_total = config.n_init
+    if config.seed_method != "kmeans++":
+        log.info("n_init=%d: init 0 uses seed_method=%r, restarts use "
+                 "'kmeans++'", R_total, config.seed_method)
+    log.info("batched restarts: %d inits in batches of %d", R_total,
+             batch_size)
+
+    verbose = config.enable_print if verbose is None else verbose
+    source = data if hasattr(data, "read_range") else None
+
+    # One fit-scoped data cache on the model (try/finally so an aborted
+    # batch can never leak stale device arrays into a later fit).
+    model._restart_cache = {}
+    try:
+        sub0 = dataclasses.replace(config, n_init=1)
+        (_, chunks, wts, _cnp, _wnp, n_events, n_dims, shift,
+         host_range) = _prepare_fit(
+            data, num_clusters, sub0, model, _null_phase, log,
+            init_means=init_means, sample_weight=sample_weight,
+            skip_seeding=True)
+        var_mean = model._restart_cache["prepared"][9]
+        epsilon = convergence_epsilon(n_events, n_dims,
+                                      config.epsilon_scale)
+        if verbose:
+            print(f"epsilon = {epsilon}")  # gaussian.cu:462
+
+        if rec.active:
+            mesh = getattr(model, "mesh", None)
+            rec.set_context(
+                path="sharded" if mesh is not None else "in-memory",
+                mesh=(list(mesh.shape.values()) if mesh is not None
+                      else None),
+            )
+
+        all_scores: list = [None] * R_total
+        dropped_inits: list = []
+        health_totals = np.zeros((health.NUM_FLAGS,), np.int64)
+        n_recoveries = 0
+        n_drops = 0
+        io_retries = 0
+        em_walls: list = []
+        winner = None  # the running first-best batch winner's payload
+        for b0 in range(0, R_total, batch_size):
+            idxs = list(range(b0, min(b0 + batch_size, R_total)))
+            if rec.active:
+                # The stream keeps the sequential contract -- one
+                # run_start (and below, one run_summary) PER INIT, each
+                # init-tagged -- so `gmm report` and every existing
+                # consumer read a batched fit identically.
+                for g in idxs:
+                    rec.set_context(init=g)
+                    if g:
+                        rec.metrics.count("restarts")
+                    rec.emit(
+                        "run_start",
+                        platform=jax.devices()[0].platform,
+                        num_events=int(n_events),
+                        num_dimensions=int(n_dims),
+                        start_k=int(num_clusters),
+                        target_k=int(target_num_clusters),
+                        epsilon=float(epsilon),
+                        process_count=int(jax.process_count()),
+                        device_count=int(jax.device_count()),
+                        local_device_count=int(jax.local_device_count()),
+                        dtype=config.dtype,
+                        chunk_size=int(config.chunk_size),
+                        covariance_type=config.covariance_type,
+                        criterion=config.criterion,
+                        fused_sweep=False, stream_events=False,
+                        n_init=int(R_total),
+                        restart_batch_size=int(batch_size),
+                        memory_stats=telemetry.memory_stats(),
+                    )
+                rec.set_context(init=None)
+            ckpt = None
+            if config.checkpoint_dir:
+                from ..utils.checkpoint import SweepCheckpointer
+
+                ckpt = SweepCheckpointer(
+                    os.path.join(config.checkpoint_dir, f"batch{b0}"),
+                    keep=config.checkpoint_keep,
+                    retries=config.checkpoint_retries)
+            out = _run_batch(
+                model, config, data, source, num_clusters, stop_number,
+                target_num_clusters, chunks, wts, n_events, n_dims, shift,
+                var_mean, epsilon, idxs, init_means, verbose, rec, log,
+                ckpt)
+            model = out["model"]  # sticky escalation spans batches
+            health_totals += out["health_totals"]
+            n_recoveries += out["recoveries"]
+            n_drops += out["drops"]
+            em_walls.extend(out["em_walls"])
+            if ckpt is not None:
+                io_retries += ckpt.io_retries
+            for j, g in enumerate(idxs):
+                all_scores[g] = float(out["min_riss"][j])
+                if out["dropped"][j]:
+                    dropped_inits.append(int(g))
+                if rec.active:
+                    rec.set_context(init=g)
+                    _emit_run_summary(
+                        rec, config, None, out["sweep_logs"][j],
+                        int(out["n_active"][j]),
+                        float(out["min_riss"][j]),
+                        float(out["best_ll"][j]),
+                        [row[4] for row in out["sweep_logs"][j]],
+                        buckets=dict(
+                            mode="off",
+                            em_widths=[int(out["winner"]["width"])],
+                            em_compiles=1, rebuckets=0),
+                        health_section=health.health_summary(
+                            out["health_lane"][j],
+                            recoveries=out["recoveries"],
+                            restart_drops=int(out["dropped"][j])))
+                    rec.set_context(init=None)
+                if verbose:
+                    print(f"init {g}: {config.criterion}="
+                          f"{out['min_riss'][j]:.6e} "
+                          f"K={out['n_active'][j]}")
+            # The sequential first-best rule, composed across batches:
+            # within the batch _run_batch already picked first-best, so
+            # comparing batch winners in batch order is equivalent.
+            w = out["winner"]
+            if (winner is None or math.isnan(winner["min_riss"])
+                    or w["min_riss"] < winner["min_riss"]):
+                winner = w
+    finally:
+        model._restart_cache = None
+
+    if rec.active:
+        rec.set_context(init=None)
+        rec.emit("restart_select", winner=int(winner["init"]),
+                 scores=_json_scores(all_scores),
+                 criterion=config.criterion, mode="batched",
+                 batch_size=int(batch_size),
+                 dropped=dropped_inits)
+    health_section = health.health_summary(
+        health_totals, recoveries=n_recoveries, io_retries=io_retries,
+        restart_drops=n_drops)
+    if verbose:
+        print(f"best of {R_total} inits: "
+              f"{config.criterion}={winner['min_riss']:.6e} "
+              f"K={winner['n_active']}")
+    return GMMResult(
+        state=winner["state"],
+        ideal_num_clusters=winner["n_active"],
+        min_rissanen=float(winner["min_riss"]),
+        final_loglik=float(winner["best_ll"]),
+        epsilon=epsilon,
+        num_events=n_events,
+        num_dimensions=n_dims,
+        data_shift=np.asarray(shift),
+        sweep_log=winner["sweep_log"],
+        profile=None,
+        profile_report=None,
+        host_range=host_range,
+        health=health_section,
+        model=model,
+        init_index=int(winner["init"]),
+    )
+
+
+def _run_batch(model, config, data, source, num_clusters, stop_number,
+               target_num_clusters, chunks, wts, n_events, n_dims, shift,
+               var_mean, epsilon, batch_indices, init_means, verbose, rec,
+               log, ckpt):
+    """One batch of restarts through the whole vmapped model-order sweep."""
+    from .order_search import (
+        _COV_CODE, _CRITERION_CODE, _emit_em_iters, _resume_mismatch,
+        _seed_rows, _shutdown_and_raise,
+    )
+
+    sup = supervisor.current()
+    R = len(batch_indices)
+    dtype = np.dtype(config.dtype)
+
+    # --- vmapped seeding: host rows (sequential-identical RNG), one
+    # batched device build ---------------------------------------------
+    rows = []
+    for g in batch_indices:
+        method = config.seed_method if g == 0 else "kmeans++"
+        rows.append(np.asarray(_seed_rows(
+            data, source, num_clusters, n_dims, n_events, dtype,
+            seed_method=method, seed=config.seed + g,
+            init_means=(init_means if g == 0 else None)), dtype))
+    rows = np.stack(rows) - np.asarray(shift, dtype)[None, None, :]
+    host_batched = seed_states_batched(
+        rows, n_events, var_mean, num_clusters,
+        covariance_dynamic_range=config.covariance_dynamic_range,
+        dtype=dtype)
+    # Deterministic singular-covariance injection: lane 0 of the batch
+    # (the sequential path poisons the first seeded fit).
+    pois = faults.take("singular_cov")
+    if pois is not None:
+        c = int(pois.get("cluster", 0))
+        host_batched = host_batched.replace(
+            R=host_batched.R.at[0, c].set(0.0),
+            Rinv=host_batched.Rinv.at[0, c].set(jnp.inf))
+    states = _place_batched_state(model, host_batched)
+    width = int(np.asarray(host_batched.N).shape[-1])
+
+    # --- per-restart sweep scalars --------------------------------------
+    k_r = np.full((R,), num_clusters, np.int64)
+    alive = np.ones((R,), bool)
+    dropped = np.zeros((R,), bool)
+    min_riss_r = np.full((R,), np.inf)
+    ideal_k_r = np.full((R,), num_clusters, np.int64)
+    best_ll_r = np.full((R,), -np.inf)
+    sweep_logs: List[list] = [[] for _ in range(R)]
+    # First EM call donates the seed buffers; best_states must not alias.
+    best_states = clone_state(states)
+
+    health_lane = np.zeros((R, health.NUM_FLAGS), np.int64)
+    n_recoveries = 0
+    n_drops = 0
+    em_walls: list = []
+    recovery_on = config.recovery == "retry"
+    want_traj = rec.active
+    supervised = sup.active and ckpt is not None
+    elim = _elim_reduce_batched_jit(config.diag_only)
+
+    # --- resume ----------------------------------------------------------
+    step = 0
+    resume_em = None
+    resume_sub_step = None
+    if ckpt is not None and config.resume != "never":
+        restored = ckpt.restore()
+        if restored is not None and (
+                "batched" not in restored
+                or int(np.asarray(restored["num_clusters"])) != num_clusters
+                or int(np.asarray(restored["state"].N).shape[0]) != R
+                or _resume_mismatch(restored, config, log)):
+            restored = None
+        if restored is not None:
+            states = _place_batched_state(model, restored["state"])
+            best_states = _place_batched_state(model,
+                                               restored["best_state"])
+            k_r = np.asarray(restored["k"], np.int64).copy()
+            alive = np.asarray(restored["alive"], bool).copy()
+            dropped = np.asarray(restored["dropped"], bool).copy()
+            min_riss_r = np.asarray(restored["min_rissanen"],
+                                    np.float64).copy()
+            ideal_k_r = np.asarray(restored["ideal_k"], np.int64).copy()
+            best_ll_r = np.asarray(restored["best_ll"], np.float64).copy()
+            lens = np.asarray(restored["sweep_len"], np.int64)
+            rows_log = np.asarray(restored["sweep_log"], np.float64)
+            sweep_logs = [
+                [tuple(row) for row in rows_log[r][:int(lens[r])]]
+                for r in range(R)
+            ]
+            step = int(np.asarray(restored["step"])) + 1
+            log.info("resumed batched restart sweep from checkpoint: "
+                     "step %d", step)
+            rec.metrics.count("resumes") if rec.active else None
+        sub = ckpt.restore_substep()
+        if sub is not None and (
+                "batched" not in sub
+                or int(np.asarray(sub["num_clusters"])) != num_clusters
+                or int(np.asarray(sub["state"].N).shape[0]) != R
+                or int(np.asarray(sub["step"])) < step
+                or _resume_mismatch(sub, config, log)):
+            sub = None
+        if sub is not None:
+            states = _place_batched_state(model, sub["state"])
+            best_states = _place_batched_state(model, sub["best_state"])
+            k_r = np.asarray(sub["k"], np.int64).copy()
+            alive = np.asarray(sub["alive"], bool).copy()
+            dropped = np.asarray(sub["dropped"], bool).copy()
+            min_riss_r = np.asarray(sub["min_rissanen"],
+                                    np.float64).copy()
+            ideal_k_r = np.asarray(sub["ideal_k"], np.int64).copy()
+            best_ll_r = np.asarray(sub["best_ll"], np.float64).copy()
+            lens = np.asarray(sub["sweep_len"], np.int64)
+            rows_log = np.asarray(sub["sweep_log"], np.float64)
+            sweep_logs = [
+                [tuple(row) for row in rows_log[r][:int(lens[r])]]
+                for r in range(R)
+            ]
+            step = int(np.asarray(sub["step"]))
+            resume_sub_step = step
+            resume_em = {
+                "em_iter": int(np.asarray(sub["em_iter"])),
+                "em_lls": np.asarray(sub["em_lls"], np.float64),
+                "em_lens": np.asarray(sub["em_lens"], np.int64),
+                "em_frozen": np.asarray(sub["em_frozen"], np.int8),
+                "em_fatal": np.asarray(sub["em_fatal"], np.int8),
+            }
+            log.info("resuming INSIDE the interrupted batched fit: EM "
+                     "iteration %d (sub-step %d)", resume_em["em_iter"],
+                     step)
+            rec.metrics.count("resumes") if rec.active else None
+
+    def host_payload():
+        return {
+            "state": _host_batched(model, states),
+            "best_state": _host_batched(model, best_states),
+            "min_rissanen": np.asarray(min_riss_r, np.float64),
+            "ideal_k": np.asarray(ideal_k_r, np.int64),
+            "best_ll": np.asarray(best_ll_r, np.float64),
+            "k": np.asarray(k_r, np.int64),
+            "alive": alive.astype(np.int64),
+            "dropped": dropped.astype(np.int64),
+            "num_clusters": int(num_clusters),
+            "criterion_code": _CRITERION_CODE[config.criterion],
+            "cov_code": _COV_CODE[config.covariance_type],
+            "batched": 1,
+            "batch_indices": np.asarray(batch_indices, np.int64),
+            "sweep_log": _pad_sweep_logs(sweep_logs),
+            "sweep_len": np.asarray([len(l) for l in sweep_logs],
+                                    np.int64),
+        }
+
+    # --- the batched sweep ----------------------------------------------
+    while alive.any():
+        k_top = int(k_r[alive].max())
+        if sup.active and sup.poll(where="sweep", k=k_top):
+            _shutdown_and_raise(sup, rec, log, ckpt,
+                                step=step - 1 if step else None, k=k_top,
+                                checkpointed=ckpt is not None and step > 0)
+        t0 = time.perf_counter()
+        live = alive.copy()
+        lo_r = np.where(live, min(config.min_iters, config.max_iters),
+                        0).astype(np.int32)
+        hi_r = np.where(live, config.max_iters, 0).astype(np.int32)
+        rollback = clone_state(states) if recovery_on else None
+        ll_logs = None
+        if supervised or resume_em is not None:
+            (states, ll_d, iters_d, ll_logs, em_stopped,
+             stop_extra) = model.run_em_batched_resumable(
+                states, chunks, wts, epsilon,
+                poll_iters=config.preempt_poll_iters,
+                should_stop=(
+                    (lambda done, _k=k_top: sup.poll(
+                        where="em", k=_k, em_iter=done))
+                    if sup.active else None),
+                freeze=~live, resume=resume_em, donate=True)
+            resume_em = None
+            if em_stopped:
+                payload = host_payload()
+                payload.update(stop_extra)
+                _shutdown_and_raise(
+                    sup, rec, log, ckpt, step=step, k=k_top,
+                    em_iter=int(stop_extra.get("em_iter", 0)),
+                    payload=payload)
+            if resume_sub_step is not None and ckpt is not None:
+                ckpt.discard_substeps(resume_sub_step)
+                resume_sub_step = None
+            if not want_traj:
+                ll_logs = None
+        elif want_traj:
+            states, ll_d, iters_d, ll_logs = model.run_em_batched(
+                states, chunks, wts, epsilon, min_iters=lo_r,
+                max_iters=hi_r, trajectory=True, donate=True)
+        else:
+            states, ll_d, iters_d = model.run_em_batched(
+                states, chunks, wts, epsilon, min_iters=lo_r,
+                max_iters=hi_r, donate=True)
+        counts = np.asarray(jax.device_get(model.last_health), np.int64)
+        counts = counts.reshape(R, health.NUM_FLAGS)
+
+        # Order reduction dispatched for every lane (finished lanes'
+        # outputs are ignored), then ONE blocking sync for all decision
+        # scalars -- the batched mirror of the sequential fused sync.
+        next_states, k_active_d, min_d_d, pair_d = elim(states)
+        ll_np, iters_np, k_active_np, min_d_np, pair_np = map(
+            np.asarray,
+            jax.device_get((ll_d, iters_d, k_active_d, min_d_d, pair_d)))
+        dt = time.perf_counter() - t0
+
+        # --- per-restart fault containment ---------------------------
+        fatal_r = np.asarray([
+            health.word_is_fatal(health.pack_word(counts[r]))
+            for r in range(R)
+        ]) & live
+        if fatal_r.any():
+            for r in np.flatnonzero(fatal_r):
+                health_lane[r] += counts[r]
+                word = health.pack_word(counts[r])
+                if rec.active:
+                    rec.set_context(init=int(batch_indices[r]))
+                    rec.emit("health", k=int(k_r[r]), where="em",
+                             flags=int(word),
+                             flag_names=health.flag_names(word),
+                             counters=health.counts_dict(counts[r]))
+                    rec.metrics.count("health_events")
+                    rec.set_context(init=None)
+            if not (live & ~fatal_r).any():
+                # EVERY live restart fatal: only now does the escalation
+                # ladder run (rolls the whole batch back).
+                (model, states, ll_np, iters_np, counts, ll_logs,
+                 clean_live) = _recover_batched(
+                    model, config, rollback, chunks, wts, epsilon, k_r,
+                    live, trajectory=want_traj, rec=rec, log=log,
+                    faulty_counts=counts, batch_indices=batch_indices)
+                n_recoveries += 1
+                still_fatal = live & ~clean_live
+                live = clean_live
+                if still_fatal.any():
+                    alive &= ~still_fatal
+                    dropped |= still_fatal
+                    n_drops += int(still_fatal.sum())
+                next_states, k_active_d, min_d_d, pair_d = elim(states)
+                k_active_np, min_d_np, pair_np = map(
+                    np.asarray,
+                    jax.device_get((k_active_d, min_d_d, pair_d)))
+                dt = time.perf_counter() - t0
+            else:
+                # Drop-one-keep-survivors: the poisoned lanes leave the
+                # batch; their siblings' results this step stand.
+                for r in np.flatnonzero(fatal_r):
+                    log.warning(
+                        "restart %d hit a fatal numerical fault at K=%d; "
+                        "dropped from the batch (survivors continue)",
+                        int(batch_indices[r]), int(k_r[r]))
+                    if rec.active:
+                        rec.set_context(init=int(batch_indices[r]))
+                        rec.emit("recovery", k=int(k_r[r]), attempt=1,
+                                 action="drop_restart", outcome="dropped",
+                                 flags=int(health.pack_word(counts[r])),
+                                 flag_names=health.flag_names(
+                                     health.pack_word(counts[r])))
+                        rec.metrics.count("restart_drops")
+                        rec.set_context(init=None)
+                alive &= ~fatal_r
+                dropped |= fatal_r
+                n_drops += int(fatal_r.sum())
+                live &= ~fatal_r
+
+        # --- scoring + best-model save per live lane ------------------
+        improved = np.zeros((R,), bool)
+        for r in np.flatnonzero(live):
+            g = int(batch_indices[r])
+            health_lane[r] += counts[r]
+            word = health.pack_word(counts[r])
+            ll_f = float(ll_np[r])
+            riss = model_score(ll_f, int(k_r[r]), n_events, n_dims,
+                               criterion=config.criterion,
+                               covariance_type=config.covariance_type)
+            score_ok = math.isfinite(riss)
+            if not score_ok:
+                health_lane[r, health.NONFINITE_SCORE] += 1
+                log.warning("non-finite %s score at K=%d (init %d); "
+                            "excluded from best-model selection",
+                            config.criterion, int(k_r[r]), g)
+            sweep_logs[r].append((int(k_r[r]), ll_f, riss,
+                                  int(iters_np[r]), dt))
+            if rec.active:
+                rec.set_context(init=g)
+                if word:
+                    rec.emit("health", k=int(k_r[r]), where="em",
+                             flags=int(word),
+                             flag_names=health.flag_names(word),
+                             counters=health.counts_dict(counts[r]))
+                    rec.metrics.count("health_events")
+                if not score_ok:
+                    rec.emit(
+                        "health", k=int(k_r[r]), where="score",
+                        flags=1 << health.NONFINITE_SCORE,
+                        flag_names=[
+                            health.FLAG_NAMES[health.NONFINITE_SCORE]],
+                        counters={health.FLAG_NAMES[
+                            health.NONFINITE_SCORE]: 1})
+                    rec.metrics.count("health_events")
+                rec.metrics.count("em_iters", int(iters_np[r]))
+                rec.metrics.series("active_k", int(k_r[r]))
+                if ll_logs is not None:
+                    # Wall seconds are the whole batched step's, amortized
+                    # per iteration inside (_emit_em_iters's contract).
+                    _emit_em_iters(rec, int(k_r[r]), ll_logs[r],
+                                   int(iters_np[r]), dt, epsilon, model)
+                rec.emit("em_done", k=int(k_r[r]), loglik=ll_f,
+                         score=float(riss), criterion=config.criterion,
+                         iters=int(iters_np[r]), seconds=round(dt, 6))
+                rec.set_context(init=None)
+            if verbose:
+                print(f"init {g} K={int(k_r[r])}: loglik={ll_f:.6e} "
+                      f"{config.criterion}={riss:.6e} "
+                      f"iters={int(iters_np[r])} ({dt:.2f}s)")
+            if score_ok and (
+                k_r[r] == num_clusters
+                or (riss < min_riss_r[r] and target_num_clusters == 0)
+                or k_r[r] == target_num_clusters
+            ):  # gaussian.cu:839, per lane, NaN-score-guarded
+                improved[r] = True
+                min_riss_r[r] = riss
+                ideal_k_r[r] = k_r[r]
+                best_ll_r[r] = ll_f
+        em_walls.append(dt)
+        if rec.active:
+            rec.heartbeat("sweep", k=k_top)
+        if improved.any():
+            best_states = _where_lanes(improved, states, best_states)
+
+        # --- sweep advance per lane ----------------------------------
+        finished = live & (k_r <= stop_number)
+        alive &= ~finished
+        live &= ~finished
+        if not alive.any():
+            break
+        merge_mask = np.zeros((R,), bool)
+        for r in np.flatnonzero(live):
+            k_new = int(k_active_np[r])
+            if k_new < 2:
+                alive[r] = False
+                continue
+            if not np.isfinite(float(min_d_np[r])):
+                log.warning("no valid merge pair at K=%d (init %d); "
+                            "stopping that restart's sweep", k_new,
+                            int(batch_indices[r]))
+                alive[r] = False
+                continue
+            if rec.active:
+                rec.set_context(init=int(batch_indices[r]))
+                rec.emit("merge", k_active=k_new, next_k=k_new - 1,
+                         min_distance=float(min_d_np[r]),
+                         pair=[int(pair_np[r][0]), int(pair_np[r][1])])
+                rec.metrics.count("merges")
+                rec.set_context(init=None)
+            merge_mask[r] = True
+            k_r[r] = k_new - 1
+            if k_r[r] < stop_number:
+                alive[r] = False
+        if merge_mask.any():
+            states = _where_lanes(merge_mask, next_states, states)
+
+        if ckpt is not None and alive.any():
+            rec.metrics.count("checkpoint_saves") if rec.active else None
+            ckpt.save(step, host_payload())
+        step += 1
+
+    # --- batch winner (the sequential first-best rule, in lane order) ---
+    widx = 0
+    for r in range(1, R):
+        if math.isnan(min_riss_r[widx]) or min_riss_r[r] < min_riss_r[widx]:
+            widx = r
+    host_best = _host_batched(model, best_states)
+    lane = jax.tree_util.tree_map(
+        lambda a: jnp.asarray(np.asarray(a)[widx]), host_best)
+    compact_state, n_active_w = compact(lane)
+    n_active = np.zeros((R,), np.int64)
+    for r in range(R):
+        if r == widx:
+            n_active[r] = n_active_w
+        else:
+            n_active[r] = int(ideal_k_r[r])
+    return {
+        "model": model,
+        "min_riss": min_riss_r,
+        "best_ll": best_ll_r,
+        "ideal_k": ideal_k_r,
+        "n_active": n_active,
+        "dropped": dropped,
+        "sweep_logs": sweep_logs,
+        "health_lane": health_lane,
+        "health_totals": health_lane.sum(axis=0),
+        "recoveries": n_recoveries,
+        "drops": n_drops,
+        "em_walls": em_walls,
+        "winner": {
+            "init": int(batch_indices[widx]),
+            "min_riss": float(min_riss_r[widx]),
+            "best_ll": float(best_ll_r[widx]),
+            "state": compact_state,
+            "n_active": int(n_active_w),
+            "sweep_log": sweep_logs[widx],
+            "width": width,
+        },
+    }
+
+
+def _pad_sweep_logs(sweep_logs: List[list]) -> np.ndarray:
+    """[R, S, 5] NaN-padded per-restart sweep rows (checkpoint payload)."""
+    R = len(sweep_logs)
+    S = max((len(l) for l in sweep_logs), default=0)
+    out = np.full((R, max(S, 1), 5), np.nan, np.float64)
+    for r, rows in enumerate(sweep_logs):
+        for i, row in enumerate(rows):
+            out[r, i, :] = np.asarray(row, np.float64)
+    return out

@@ -84,6 +84,10 @@ class StreamingGMMModel(GMMModel):
 
     supports_fused_emit = False
     make_fused_sweep = None  # no fused sweep: data is not on device
+    # No batched restarts: the streaming EM "loop" is a host-driven
+    # per-block dispatch sequence, not one program a restart axis can
+    # vmap over (restarts fall back to the sequential driver).
+    supports_batched_restarts = False
     data_size = 1  # overridden per-instance when a mesh is configured
     cluster_size = 1  # events-only sharding (prepare_inference contract)
 

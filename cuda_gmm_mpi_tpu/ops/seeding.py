@@ -165,6 +165,39 @@ def seed_state_from_parts(
     )
 
 
+def seed_states_batched(
+    means_rows_batch,
+    n_events: int,
+    data_var_mean: float,
+    num_clusters: int,
+    num_clusters_padded: int | None = None,
+    covariance_dynamic_range: float = 1e3,
+    dtype=None,
+):
+    """Batched seeding: the state build vmapped over a leading restart axis.
+
+    ``means_rows_batch`` is [R, K, D] -- one restart's seed rows per lane,
+    already shifted into fit coordinates (the per-restart ROW SELECTION
+    stays on host so the kmeans++ RNG streams are bit-identical to the
+    sequential path's; only the state construction -- identity R, uniform
+    pi, avgvar floor, and the per-cluster Cholesky constants -- batches).
+    Returns a GMMState whose every leaf has the leading restart axis, the
+    seed-state contract of the batched restart driver
+    (``GMMModel.run_em_batched``).
+    """
+    import numpy as np
+
+    means_rows_batch = np.ascontiguousarray(means_rows_batch)
+    dtype = jnp.dtype(dtype or means_rows_batch.dtype)
+    avgvar = jnp.asarray(
+        data_var_mean / covariance_dynamic_range, dtype)
+    Kp = num_clusters_padded or num_clusters
+    build = jax.vmap(
+        lambda rows: _build_seed_state(rows, n_events, num_clusters, Kp,
+                                       avgvar, dtype))
+    return build(jnp.asarray(means_rows_batch, dtype))
+
+
 def seed_clusters(
     data: jax.Array,
     num_clusters: int,

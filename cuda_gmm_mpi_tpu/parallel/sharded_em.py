@@ -174,6 +174,15 @@ def memberships_sharded(model, state, data_chunks,
     return w
 
 
+def batched_state_pspecs():
+    """PartitionSpecs for a restart-batched GMMState: the leading restart
+    axis is replicated (every shard holds all R lanes of its cluster
+    slice); the K axis keeps its cluster sharding."""
+    return jax.tree_util.tree_map(
+        lambda s: P(*((None,) + tuple(s))), state_pspecs()
+    )
+
+
 def make_psum_reduce(data_axis: str = DATA_AXIS):
     """Stats reduction hook: one psum of the whole SuffStats pytree.
 
@@ -387,6 +396,108 @@ class ShardedGMMModel:
     # checkpoints therefore work on a mesh too; health counters stay
     # psum-exact per segment.
     run_em_resumable = GMMModel.run_em_resumable
+
+    # Batched n_init restarts on the mesh: the restart axis is replicated,
+    # the data axis stays sharded -- the vmap rides INSIDE the shard_map,
+    # so the per-restart psums batch into one fused collective per stats
+    # reduction, and every device runs all R lanes over its event shard.
+    supports_batched_restarts = True
+    run_em_batched = GMMModel.run_em_batched
+    run_em_batched_resumable = GMMModel.run_em_batched_resumable
+
+    def _em_batched_executable(self, trajectory_len: int, donate: bool):
+        """shard_map(vmap(em_while_loop)) per (trajectory, donate) variant
+        (the mesh sibling of GMMModel._em_batched_executable; see the
+        class-level batched-restart comment for the axis layout)."""
+        key = ("batched", trajectory_len, donate)
+        fn = self._em_exec_cache.get(key)
+        if fn is None:
+            em_fn = functools.partial(
+                em_while_loop,
+                reduce_stats=make_psum_reduce(DATA_AXIS),
+                cluster_axis=self._cluster_axis,
+                stats_fn=self._stats_fn,
+                covariance_type=self.config.covariance_type,
+                precompute_features=self.config.precompute_features,
+                trajectory_len=trajectory_len,
+                dynamic_range=self.config.covariance_dynamic_range,
+                regression_scale=self.config.health_regression_scale,
+                **self._kw,
+            )
+
+            def batched(states, rids, data_chunks, wts_chunks, epsilon,
+                        lo_r, hi_r):
+                run_one = lambda s, rid, lo, hi: em_fn(
+                    s, data_chunks, wts_chunks, epsilon, lo, hi,
+                    restart_id=rid)
+                return jax.vmap(run_one, in_axes=(0, 0, 0, 0))(
+                    states, rids, lo_r, hi_r)
+
+            bspec = batched_state_pspecs()
+            scalar = P()
+            out_specs = (bspec, scalar, scalar)
+            if trajectory_len:
+                out_specs = out_specs + (scalar,)
+            out_specs = out_specs + (scalar,)  # [R, NUM_FLAGS] health
+            fn = self._em_exec_cache[key] = jax.jit(
+                shard_map(
+                    batched,
+                    mesh=self.mesh,
+                    in_specs=(bspec, scalar, P(DATA_AXIS, None, None),
+                              P(DATA_AXIS, None), scalar, scalar, scalar),
+                    out_specs=out_specs,
+                    check_vma=False,
+                ),
+                donate_argnums=(0,) if donate else (),
+            )
+        return fn
+
+    def prepare_states_batched(self, host_states):
+        """Stack R host seed states into one restart-batched state and
+        place it on the mesh (restart axis replicated, K axis
+        cluster-sharded). Each lane is padded to the cluster-axis extent
+        first, exactly like :meth:`prepare_state` does for one state."""
+        padded = [
+            pad_state_clusters(
+                jax.tree_util.tree_map(jnp.asarray, s), self.cluster_size)
+            for s in host_states
+        ]
+        batched = jax.tree_util.tree_map(
+            lambda *xs: jnp.stack(xs), *padded)
+        bspec = batched_state_pspecs()
+        if jax.process_count() > 1:
+            from jax.experimental import multihost_utils
+
+            local_cluster = self.mesh.local_mesh.shape[CLUSTER_AXIS]
+            if local_cluster != self.cluster_size:
+                raise NotImplementedError(
+                    "multi-host runs require the cluster mesh axis to fit "
+                    "within one host; put hosts on the data axis")
+            return multihost_utils.host_local_array_to_global_array(
+                batched, self.mesh, bspec
+            )
+        return jax.device_put(
+            batched,
+            jax.tree_util.tree_map(
+                lambda s: NamedSharding(self.mesh, s), bspec
+            ),
+        )
+
+    def host_batched_state(self, states):
+        """Host-local numpy copy of a restart-batched (possibly global
+        multi-host) state -- the batched sibling of
+        order_search._host_state, used by checkpoints and the batched
+        recovery ladder."""
+        leaves = jax.tree_util.tree_leaves(states)
+        if jax.process_count() > 1 and any(
+                isinstance(l, jax.Array) and not l.is_fully_addressable
+                for l in leaves):
+            from jax.experimental import multihost_utils
+
+            states = multihost_utils.global_array_to_host_local_array(
+                states, self.mesh, batched_state_pspecs()
+            )
+        return jax.device_get(states)
 
     def rebucket_state(self, state, num_clusters: int):
         """Bucket recompaction on the mesh: compact the (tiny) K-state to

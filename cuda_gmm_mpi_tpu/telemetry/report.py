@@ -59,6 +59,7 @@ def render_report(records: List[dict], max_trajectory_rows: int = 400) -> str:
     chunks = [r for r in records if r.get("event") == "chunk_flush"]
     summaries = [r for r in records if r.get("event") == "run_summary"]
 
+    selects = [r for r in records if r.get("event") == "restart_select"]
     healths = [r for r in records if r.get("event") == "health"]
     recoveries = [r for r in records if r.get("event") == "recovery"]
     io_retries = [r for r in records if r.get("event") == "io_retry"]
@@ -102,6 +103,24 @@ def render_report(records: List[dict], max_trajectory_rows: int = 400) -> str:
         total_bytes = sum(int(r.get("bytes", 0)) for r in chunks)
         out.append(f"Streaming: {len(chunks)} block flushes, "
                    f"{total_bytes / 1e6:.1f} MB host->device")
+        out.append("")
+
+    for r in selects:
+        scores = r.get("scores") or []
+        out.append(f"Restart selection ({r.get('mode', '?')}, "
+                   f"batch_size={r.get('batch_size', '?')}): "
+                   f"winner init {r.get('winner')} of {len(scores)}")
+        for i, s in enumerate(scores):
+            marks = []
+            if i == r.get("winner"):
+                marks.append("winner")
+            if i in (r.get("dropped") or []):
+                marks.append("DROPPED")
+            tail = f"  ({', '.join(marks)})" if marks else ""
+            sval = f"{s:.6e}" if isinstance(s, (int, float)) else "-"
+            out.append(f"  init {i:>3d}  "
+                       f"{r.get('criterion', 'score')}={sval}{tail}")
+    if selects:
         out.append("")
 
     if healths or recoveries or io_retries:

@@ -31,7 +31,7 @@ EVENT_FIELDS: Dict[str, Tuple[Tuple[str, ...], Tuple[str, ...]]] = {
         ("target_k", "process_count", "device_count", "local_device_count",
          "mesh", "path", "dtype", "chunk_size", "covariance_type",
          "criterion", "fused_sweep", "stream_events", "n_init", "init",
-         "memory_stats"),
+         "restart_batch_size", "memory_stats"),
     ),
     # One per EM iteration of each K (host-driven sweeps; the fused
     # whole-sweep device program emits per-K records only).
@@ -113,6 +113,16 @@ EVENT_FIELDS: Dict[str, Tuple[Tuple[str, ...], Tuple[str, ...]]] = {
     "peer_lost": (
         ("rank", "timeout_s"),
         ("age_s",),
+    ),
+    # One per n_init > 1 fit (stream rev v1.4): which restart won and
+    # every init's best criterion score (NaN/Inf scores are null).
+    # ``mode`` is batched / sequential; ``batch_size`` the restart batch
+    # the winner ran in (1 = the sequential driver); ``dropped`` lists
+    # init indices removed by the drop-one-keep-survivors fault path
+    # (models/restarts.py).
+    "restart_select": (
+        ("winner", "scores", "criterion"),
+        ("mode", "batch_size", "dropped"),
     ),
     # One per fit: final scores, the 7-category phase profile, the
     # compile-vs-execute split, and the metrics-registry snapshot.

@@ -10,14 +10,17 @@ the escalation ladder exists for.
 
 Supported fault kinds (the spec is ``{kind: {params...}}``):
 
-- ``nan_loglik``   ``{"iter": i, "times": n}`` -- the EM loop's loglik
-  becomes NaN at iteration ``i`` (1-based; the initial E-step is iteration
-  0). For the jitted EM loops the plan is consumed at TRACE time and the
-  injection is compiled into that executable, so a same-executable retry
-  re-observes the fault while a rebuilt (escalated) model traces clean --
-  ``times`` therefore counts *traced executables*, i.e. the escalation rung
-  that finally runs clean. The host-driven streaming loop consumes at
-  runtime per EM run.
+- ``nan_loglik``   ``{"iter": i, "restart": r, "times": n}`` -- the EM
+  loop's loglik becomes NaN at iteration ``i`` (1-based; the initial
+  E-step is iteration 0). For the jitted EM loops the plan is consumed at
+  TRACE time and the injection is compiled into that executable, so a
+  same-executable retry re-observes the fault while a rebuilt (escalated)
+  model traces clean -- ``times`` therefore counts *traced executables*,
+  i.e. the escalation rung that finally runs clean. The host-driven
+  streaming loop consumes at runtime per EM run. ``restart`` (optional)
+  targets ONE lane of the batched restart loop (the drop-one-keep-
+  survivors rehearsal, models/restarts.py); a plan with ``restart`` set
+  never fires in an EM loop that has no restart axis.
 - ``singular_cov`` ``{"cluster": c, "times": n}`` -- the seeded state's
   cluster ``c`` gets a singular covariance (R zeroed) with the poisoned
   inverse (Rinv +inf) a real inversion of it would produce; consumed per

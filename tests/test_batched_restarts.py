@@ -1,0 +1,354 @@
+"""Single-dispatch batched restarts (models/restarts.py).
+
+The contract under test: batching the n_init restarts into one vmapped EM
+program changes WALL TIME, not ANSWERS -- identical winner (init index and
+selected K) and bit-comparable parameters vs the sequential
+``restart_batch_size=1`` degenerate case at the same seeds; one compiled
+EM executable serves every restart batch of equal shape; a converged
+restart freezes out (its lane stops updating) while siblings iterate; one
+poisoned restart is dropped from the batch instead of rolling back its
+survivors; and a mid-batch preemption checkpoints all R trajectories and
+resumes bit-identically.
+"""
+
+import os
+
+import numpy as np
+import pytest
+
+from cuda_gmm_mpi_tpu import GMMConfig, fit_gmm, supervisor
+from cuda_gmm_mpi_tpu.models.gmm import GMMModel, chunk_events
+from cuda_gmm_mpi_tpu.supervisor import PreemptedError, RunSupervisor
+from cuda_gmm_mpi_tpu.testing import faults
+
+from .conftest import make_blobs
+
+
+def cfg(**kw):
+    base = dict(min_iters=4, max_iters=4, chunk_size=256, dtype="float64")
+    base.update(kw)
+    return GMMConfig(**base)
+
+
+# ------------------------------------------------------------- parity
+
+
+def test_batched_vs_sequential_parity_plain(rng):
+    """Full K0 -> 1 sweep, 3 restarts: the batched driver must select the
+    identical winner as the sequential one at the same seeds, with
+    bit-comparable best-model parameters."""
+    data, _ = make_blobs(rng, n=900, d=3, k=4)
+    kw = dict(n_init=3, seed=0, min_iters=6, max_iters=6, chunk_size=256,
+              dtype="float64")
+    seq = fit_gmm(data, 6, 0, config=GMMConfig(restart_batch_size=1, **kw))
+    bat = fit_gmm(data, 6, 0, config=GMMConfig(restart_batch_size=3, **kw))
+    assert bat.init_index == seq.init_index
+    assert bat.ideal_num_clusters == seq.ideal_num_clusters
+    np.testing.assert_allclose(bat.min_rissanen, seq.min_rissanen,
+                               rtol=1e-10)
+    np.testing.assert_allclose(bat.final_loglik, seq.final_loglik,
+                               rtol=1e-10)
+    np.testing.assert_allclose(bat.means, seq.means, rtol=1e-8, atol=1e-8)
+    np.testing.assert_allclose(bat.covariances, seq.covariances,
+                               rtol=1e-7, atol=1e-8)
+    # the sweep rows of the winner agree K by K
+    assert [r[0] for r in bat.sweep_log] == [r[0] for r in seq.sweep_log]
+    for b, s in zip(bat.sweep_log, seq.sweep_log):
+        np.testing.assert_allclose(b[1], s[1], rtol=1e-9)
+
+
+def test_batched_target_k_and_uneven_batches(rng):
+    """n_init=3 in batches of 2 (a full batch + a remainder batch) at a
+    target K still picks the sequential winner."""
+    data, _ = make_blobs(rng, n=600, d=3, k=3)
+    kw = dict(n_init=3, seed=0, min_iters=5, max_iters=5, chunk_size=256,
+              dtype="float64")
+    seq = fit_gmm(data, 4, 3, config=GMMConfig(restart_batch_size=1, **kw))
+    bat = fit_gmm(data, 4, 3, config=GMMConfig(restart_batch_size=2, **kw))
+    assert bat.init_index == seq.init_index
+    np.testing.assert_allclose(bat.min_rissanen, seq.min_rissanen,
+                               rtol=1e-10)
+
+
+@pytest.mark.parametrize("mesh", [(2, 1), (2, 2)])
+def test_batched_vs_sequential_parity_sharded(rng, mesh):
+    """The sharded model runs the same batched loop (restart axis
+    replicated, data axis sharded, clusters optionally sharded) and must
+    agree with its own sequential restarts."""
+    data, _ = make_blobs(rng, n=512, d=3, k=4)
+    kw = dict(n_init=2, seed=0, min_iters=4, max_iters=4, chunk_size=64,
+              dtype="float64", mesh_shape=mesh)
+    seq = fit_gmm(data, 4, 4, config=GMMConfig(restart_batch_size=1, **kw))
+    bat = fit_gmm(data, 4, 4, config=GMMConfig(restart_batch_size=2, **kw))
+    assert bat.init_index == seq.init_index
+    np.testing.assert_allclose(bat.min_rissanen, seq.min_rissanen,
+                               rtol=1e-9)
+    np.testing.assert_allclose(bat.means, seq.means, rtol=1e-7, atol=1e-7)
+
+
+# ------------------------------------------------- compile-count guard
+
+
+def test_one_executable_serves_all_equal_shape_batches(rng):
+    """n_init=4 in two batches of 2: the batched EM executable compiles
+    ONCE and serves both batches (jit's shape-keyed cache; the batched
+    sweep is fixed-width by design)."""
+    data, _ = make_blobs(rng, n=400, d=3, k=3)
+    c = cfg(n_init=4, seed=0, restart_batch_size=2)
+    model = GMMModel(c)
+    fit_gmm(data, 4, 3, config=c, model=model)
+    batched_fns = {k: fn for k, fn in model._em_exec_cache.items()
+                   if isinstance(k, tuple) and k and k[0] == "batched"}
+    assert batched_fns, "the fit never used the batched EM executable"
+    traced = [fn for fn in batched_fns.values()
+              if getattr(fn, "_cache_size", None) is not None]
+    assert traced and all(fn._cache_size() == 1 for fn in traced)
+
+
+# ------------------------------------------------------------ freeze-out
+
+
+def test_freeze_out_converged_restart_stops_updating(rng):
+    """A restart that converges early freezes: its trajectory log has no
+    entries beyond its own iteration count while a sibling keeps
+    iterating, and its final params equal its solo run's (the batched
+    while-loop's masked freeze-out)."""
+    import jax
+    import jax.numpy as jnp
+
+    from cuda_gmm_mpi_tpu.ops.formulas import convergence_epsilon
+    from cuda_gmm_mpi_tpu.ops.seeding import seed_clusters_host
+
+    data, _ = make_blobs(rng, n=1200, d=3, k=3)
+    c = GMMConfig(min_iters=1, max_iters=30, chunk_size=512,
+                  dtype="float64")
+    model = GMMModel(c)
+    chunks, wts = map(jnp.asarray, chunk_events(data, c.chunk_size))
+    eps = convergence_epsilon(len(data), 3)
+
+    fresh = seed_clusters_host(data, 3, dtype=np.float64)
+    # Pre-converge one lane: EM to (near) fixpoint, then reuse as a seed.
+    conv, _, _ = model.run_em(fresh, chunks, wts, eps, min_iters=30,
+                              max_iters=30)
+    batched = jax.tree_util.tree_map(lambda a, b: jnp.stack([a, b]),
+                                     conv, fresh)
+    out_s, ll_s, it_s, log_s = model.run_em_batched(
+        batched, chunks, wts, eps, trajectory=True)
+    it_s = np.asarray(it_s)
+    log_s = np.asarray(log_s)
+    assert it_s[0] < it_s[1], it_s  # lane 0 froze early, lane 1 kept going
+    # frozen lane's trajectory slots beyond its own count stay NaN while
+    # the live lane wrote values there
+    probe = int(it_s[0]) + 1
+    assert np.isnan(log_s[0, probe + 1:]).all()
+    assert np.isfinite(log_s[1, probe + 1:int(it_s[1]) + 1]).all()
+    # the frozen lane's result equals its solo run (bit-comparable)
+    solo, ll_solo, it_solo = model.run_em(conv, chunks, wts, eps)
+    assert int(it_solo) == int(it_s[0])
+    np.testing.assert_allclose(np.asarray(out_s.means)[0],
+                               np.asarray(solo.means), rtol=1e-12)
+
+
+# --------------------------------------- restart-cache fingerprint guard
+
+
+def test_restart_cache_rejects_stale_data(rng):
+    """Regression (PR 5 satellite): the restart cache is keyed on the
+    model instance -- a model reused with DIFFERENT same-shaped data must
+    not be served the previous fit's uploaded device arrays."""
+    data_a, _ = make_blobs(rng, n=400, d=3, k=3)
+    data_b = np.ascontiguousarray(data_a[::-1] + 3.0)  # same shape/dtype
+    c = cfg()
+    model = GMMModel(c)
+    # A live cache spanning two fits is the library-user pattern the
+    # fingerprint exists for (order_search clears its own per-fit cache).
+    model._restart_cache = {}
+    try:
+        fit_gmm(data_a, 3, 3, config=c, model=model)
+        got = fit_gmm(data_b, 3, 3, config=c, model=model)
+    finally:
+        model._restart_cache = None
+    want = fit_gmm(data_b, 3, 3, config=c)
+    np.testing.assert_allclose(got.min_rissanen, want.min_rissanen,
+                               rtol=1e-12)
+    np.testing.assert_allclose(
+        np.asarray(got.means), np.asarray(want.means), rtol=1e-9)
+
+
+# --------------------------------------------- drop-one fault containment
+
+
+def test_drop_one_restart_keeps_survivors(rng, tmp_path):
+    """A nan_loglik fault targeted at restart 1 of a 3-lane batch drops
+    THAT lane only: the fit completes from the survivors, the winner is a
+    clean lane, and the stream records the drop (tier-1 rehearsal of the
+    drop-one-keep-survivors health path)."""
+    from cuda_gmm_mpi_tpu.telemetry import read_stream, validate_stream
+
+    data, _ = make_blobs(rng, n=600, d=3, k=3)
+    mf = str(tmp_path / "m.jsonl")
+    kw = dict(n_init=3, seed=0, restart_batch_size=3, metrics_file=mf)
+    with faults.use({"nan_loglik": {"iter": 2, "restart": 1}}) as plan:
+        r = fit_gmm(data, 3, 3, config=cfg(**kw))
+    assert plan.fired["nan_loglik"] == 1
+    assert r.init_index != 1
+    assert np.isfinite(r.min_rissanen)
+    assert r.health["restart_drops"] == 1
+    assert r.health["fatal"]  # the observed fault is recorded, not hidden
+
+    recs = read_stream(mf)
+    assert validate_stream(recs) == []
+    drops = [x for x in recs if x["event"] == "recovery"
+             and x.get("action") == "drop_restart"]
+    assert len(drops) == 1 and drops[0]["init"] == 1
+    assert drops[0]["outcome"] == "dropped"
+    sel = [x for x in recs if x["event"] == "restart_select"][-1]
+    assert sel["dropped"] == [1]
+    assert sel["winner"] == r.init_index
+    # parity with an unfaulted sequential run over the surviving seeds:
+    # the survivors' results are untouched by the sibling's fault
+    clean = fit_gmm(data, 3, 3, config=cfg(
+        n_init=3, seed=0, restart_batch_size=1))
+    if clean.init_index != 1:  # winner survived the drop -> same pick
+        assert sel["winner"] == clean.init_index
+        np.testing.assert_allclose(r.min_rissanen, clean.min_rissanen,
+                                   rtol=1e-9)
+
+
+def test_whole_batch_fatal_escalates_ladder(rng):
+    """Every lane fatal (a singular seed covariance poisons lane 0 of a
+    1-lane... use an untargeted nan_loglik so ALL lanes fault): the
+    escalation ladder runs -- and recovers -- instead of dropping the
+    whole batch."""
+    data, _ = make_blobs(rng, n=600, d=3, k=3)
+    with faults.use({"nan_loglik": {"iter": 2}}) as plan:
+        r = fit_gmm(data, 3, 3, config=cfg(
+            n_init=2, seed=0, restart_batch_size=2))
+    assert plan.fired["nan_loglik"] == 1
+    assert np.isfinite(r.min_rissanen)
+    assert r.health["recoveries"] >= 1
+    assert "restart_drops" not in r.health
+
+
+# ----------------------------------------------- telemetry stream shape
+
+
+def test_batched_stream_keeps_per_init_contract(rng, tmp_path):
+    """The batched driver's stream is shaped like the sequential one: one
+    run_start and one run_summary PER INIT (init-tagged), per-restart
+    em_iter trajectories, one upload, and the closing restart_select."""
+    from cuda_gmm_mpi_tpu.telemetry import read_stream, validate_stream
+    from cuda_gmm_mpi_tpu.telemetry.report import render_report
+
+    data, _ = make_blobs(rng, n=400, d=3, k=3)
+    mf = str(tmp_path / "m.jsonl")
+    r = fit_gmm(data, 3, 3, config=cfg(n_init=3, seed=0,
+                                       restart_batch_size=3,
+                                       metrics_file=mf))
+    recs = read_stream(mf)
+    assert validate_stream(recs) == []
+    events = [x["event"] for x in recs]
+    assert events.count("run_start") == 3
+    assert events.count("run_summary") == 3
+    assert sorted({x["init"] for x in recs if "init" in x}) == [0, 1, 2]
+    starts = [x for x in recs if x["event"] == "run_start"]
+    assert all(x["restart_batch_size"] == 3 for x in starts)
+    # per-restart em_iter trajectories, tagged by init
+    iters = [x for x in recs if x["event"] == "em_iter"]
+    assert {x["init"] for x in iters} == {0, 1, 2}
+    summ = [x for x in recs if x["event"] == "run_summary"][-1]
+    assert summ["metrics"]["counters"]["restarts"] == 2
+    assert summ["metrics"]["counters"]["h2d_bytes"] > 0
+    sel = [x for x in recs if x["event"] == "restart_select"][-1]
+    assert sel["mode"] == "batched" and sel["batch_size"] == 3
+    assert sel["winner"] == r.init_index
+    assert len(sel["scores"]) == 3
+    rep = render_report(recs)
+    assert "Restart selection" in rep and "winner init" in rep
+
+
+# ------------------------------------------------ preemption + resume
+
+
+def test_preempt_mid_batch_then_bit_identical_resume(rng, tmp_path):
+    """A cooperative stop mid-batched-EM writes ONE emergency sub-step
+    carrying all R restart trajectories, and --resume auto reproduces the
+    uninterrupted batched run's model bit-identically."""
+    data, _ = make_blobs(rng, n=900, d=3, k=3)
+    kw = dict(n_init=2, seed=0, restart_batch_size=2, min_iters=8,
+              max_iters=8, chunk_size=512, dtype="float64",
+              preempt_poll_iters=2)
+    ck_ref, ck = str(tmp_path / "ref"), str(tmp_path / "ck")
+
+    def sup():
+        return RunSupervisor(install_signals=False)
+
+    with supervisor.use(sup()):
+        ref = fit_gmm(data, 5, 2, config=GMMConfig(checkpoint_dir=ck_ref,
+                                                   **kw))
+    with pytest.raises(PreemptedError) as ei:
+        with faults.use({"preempt": {"iter": 4}}) as plan:
+            with supervisor.use(sup()):
+                fit_gmm(data, 5, 2, config=GMMConfig(checkpoint_dir=ck,
+                                                     **kw))
+    assert plan.fired["preempt"] == 1
+    assert ei.value.checkpointed and ei.value.em_iter == 4
+    subs = [f for f in os.listdir(os.path.join(ck, "batch0", "sweep"))
+            if ".iter" in f]
+    assert subs == ["0.iter4.npz"]
+
+    with supervisor.use(sup()):
+        res = fit_gmm(data, 5, 2, config=GMMConfig(checkpoint_dir=ck,
+                                                   **kw))
+    assert res.init_index == ref.init_index
+    assert res.min_rissanen == ref.min_rissanen
+    assert res.final_loglik == ref.final_loglik
+    np.testing.assert_array_equal(np.asarray(res.means),
+                                  np.asarray(ref.means))
+    # supervised batched EM changes nothing vs the unsupervised batch
+    plain = fit_gmm(data, 5, 2, config=GMMConfig(**kw))
+    assert plain.min_rissanen == ref.min_rissanen
+
+
+# --------------------------------------------------- batch-size resolve
+
+
+def test_restart_batch_size_resolution(rng):
+    """Env override > config > auto cap; unsupported paths fall back to
+    sequential; everything clamps to [1, n_init]."""
+    from cuda_gmm_mpi_tpu.models.restarts import (
+        restart_batch_auto_cap, resolve_restart_batch_size,
+    )
+
+    data = np.zeros((1000, 4))
+    c = cfg(n_init=4)
+    model = GMMModel(c)
+    assert resolve_restart_batch_size(c, model, data, 8) >= 1
+    assert resolve_restart_batch_size(
+        cfg(n_init=4, restart_batch_size=3), model, data, 8) == 3
+    assert resolve_restart_batch_size(
+        cfg(n_init=2, restart_batch_size=64), model, data, 8) == 2
+    assert resolve_restart_batch_size(cfg(), model, data, 8) == 1
+    # streaming / fused-sweep paths run sequentially
+    assert resolve_restart_batch_size(
+        cfg(n_init=4, stream_events=True, restart_batch_size=4),
+        model, data, 8) == 1
+    assert resolve_restart_batch_size(
+        cfg(n_init=4, fused_sweep=True, restart_batch_size=4),
+        model, data, 8) == 1
+    # env overrides config
+    os.environ["GMM_RESTART_BATCH_SIZE"] = "2"
+    try:
+        assert resolve_restart_batch_size(
+            cfg(n_init=4, restart_batch_size=4), model, data, 8) == 2
+    finally:
+        del os.environ["GMM_RESTART_BATCH_SIZE"]
+    # the auto cap shrinks with the memory budget
+    os.environ["GMM_RESTART_MEM_BYTES"] = str(1 << 20)
+    try:
+        small = restart_batch_auto_cap(c, 1_000_000, 24, 100)
+    finally:
+        del os.environ["GMM_RESTART_MEM_BYTES"]
+    assert small == 1
+    big = restart_batch_auto_cap(c, 1000, 4, 8)
+    assert big > small

@@ -177,6 +177,34 @@ def test_numpy_baseline_matches_framework_iteration(diag):
                                rtol=1e-9)
 
 
+def test_restart_ab_mode_contract():
+    """--restarts (GMM_BENCH_RESTARTS) emits ONE JSON record carrying
+    both walls AND winner parity in the same run -- the same contract
+    style as the --sweep mode. Tiny shape so the A/B stays tier-1-fast."""
+    r = _run({
+        "GMM_BENCH_CPU": "1",
+        "GMM_BENCH_RESTARTS": "2",
+        "GMM_BENCH_RESTART_N": "2000",
+        "GMM_BENCH_RESTART_D": "4",
+        "GMM_BENCH_RESTART_K": "4",
+        "GMM_BENCH_RESTART_ITERS": "2",
+    }, timeout=600)
+    assert r.returncode == 0, r.stderr
+    j = _json_line(r.stdout)
+    assert j["unit"] == "s" and j["value"] > 0
+    assert j["accelerator_unavailable"] is False
+    ab = j["restarts"]
+    assert ab["n_init"] == 2
+    assert ab["batched"]["wall_s"] > 0 and ab["sequential"]["wall_s"] > 0
+    # winner parity asserted in the SAME record as the walls
+    assert ab["winner_equal"] is True
+    assert ab["ideal_k_equal"] is True
+    assert ab["rel_score_diff"] < 1e-6
+    assert j["vs_baseline"] == ab["speedup"]
+    for side in ("batched", "sequential"):
+        assert ab[side]["winner_init"] is not None
+
+
 @pytest.mark.slow
 def test_deliberate_cpu_run_measures_with_rc0():
     """GMM_BENCH_CPU=1 is the deliberate-CPU contract: rc 0, a real

@@ -226,7 +226,12 @@ def test_library_fit_and_restarts_share_one_stream(tmp_path, rng):
     assert _events(recs).count("run_start") == 2  # one per init
     assert _events(recs).count("run_summary") == 2
     assert sorted({r["init"] for r in recs if "init" in r}) == [0, 1]
-    assert recs[-1]["metrics"]["counters"]["restarts"] == 1
+    summ = [r for r in recs if r["event"] == "run_summary"][-1]
+    assert summ["metrics"]["counters"]["restarts"] == 1
+    # the stream closes with the winner audit (restart_select, rev v1.4)
+    sel = [r for r in recs if r["event"] == "restart_select"]
+    assert len(sel) == 1 and len(sel[0]["scores"]) == 2
+    assert sel[0]["winner"] in (0, 1)
 
 
 def test_no_metrics_file_means_no_stream_and_same_stderr(tmp_path, rng,
