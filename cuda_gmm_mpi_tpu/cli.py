@@ -131,8 +131,16 @@ def build_parser() -> argparse.ArgumentParser:
                    "GMM_RESTART_BATCH_SIZE override); 1 = sequential "
                    "restarts (identical winner, just slower)")
     t.add_argument("--pallas", default="auto", choices=["auto", "always", "never"],
-                   help="use the experimental Pallas fused kernel ('auto' "
-                        "routes to the XLA path; see docs/PERF.md)")
+                   help="legacy spelling of --estep-backend ('always' == "
+                        "pallas, 'never' == jnp; see docs/PERF.md)")
+    t.add_argument("--estep-backend", default="auto",
+                   choices=["auto", "pallas", "jnp"],
+                   help="E-step/statistics backend: 'pallas' runs the fused "
+                   "E+M kernel (batched + unbatched, M-step epilogue "
+                   "fused; interpret mode off-TPU), 'jnp' pins the XLA "
+                   "path, 'auto' routes per docs/PERF.md. The backend "
+                   "that actually ran lands on the telemetry stream as "
+                   "em_backend")
     t.add_argument("--precompute-features", action="store_true",
                    help="hoist the [N, F] outer-product features out of the "
                    "EM loop (built once, held in HBM: N*F*4 bytes); "
@@ -317,6 +325,7 @@ def main(argv=None) -> int:
             n_init=args.n_init,
             restart_batch_size=args.restart_batch_size,
             use_pallas=args.pallas,
+            estep_backend=args.estep_backend,
             fused_sweep=args.fused_sweep,
             sweep_k_buckets=args.sweep_k_buckets,
             device=args.device,

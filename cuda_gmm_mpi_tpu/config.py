@@ -74,8 +74,22 @@ class GMMConfig:
     # the XLA path everywhere -- at matched precision XLA met or beat the
     # kernel at every measured shape. All precisions are supported
     # in-kernel ('high' is a manual 3-dot bf16_3x decomposition, since
-    # Mosaic rejects native Precision.HIGH).
+    # Mosaic rejects native Precision.HIGH). Legacy spelling of
+    # ``estep_backend`` below; the two are kept coherent in __post_init__
+    # ('always' == 'pallas', 'never' == 'jnp').
     use_pallas: str = "auto"  # 'auto' | 'always' | 'never'
+    # E-step/statistics backend (docs/PERF.md "Fused EM iteration"):
+    # 'pallas' runs the fused E+M kernel -- batched ([R, N, D] restart
+    # axis) and unbatched, with the M-step parameter update fused as a
+    # kernel epilogue on 'full'/'diag' covariance -- so one EM iteration
+    # is a single kernel round-trip over the events; off-TPU it executes
+    # in interpret mode (slow, tier-1-testable). 'jnp' pins the XLA path.
+    # 'auto' currently resolves to 'jnp' everywhere (the round-3 matched-
+    # precision routing decision stands until the batched kernel is
+    # re-measured on hardware; bench.py --envelope is the measurement).
+    # The backend that actually ran is emitted as ``em_backend`` on the
+    # telemetry stream (docs/OBSERVABILITY.md).
+    estep_backend: str = "auto"  # 'auto' | 'pallas' | 'jnp'
     # Hoist the [N, F] outer-product features out of the EM loop: built
     # once per run and held in HBM, replacing every iteration's feature
     # rebuild+write with a read. F depends on the quad layout: D*D floats
@@ -270,6 +284,29 @@ class GMMConfig:
                 "it cannot combine with diag_only=True")
         if self.use_pallas not in ("auto", "always", "never"):
             raise ValueError(f"unknown use_pallas: {self.use_pallas!r}")
+        if self.estep_backend not in ("auto", "pallas", "jnp"):
+            raise ValueError(
+                f"unknown estep_backend: {self.estep_backend!r} "
+                "(expected 'auto', 'pallas' or 'jnp')")
+        # use_pallas is the legacy spelling of estep_backend: keep them
+        # coherent whichever way the caller set it (explicit contradictions
+        # fail loudly rather than silently preferring one).
+        if self.estep_backend == "auto":
+            if self.use_pallas == "always":
+                object.__setattr__(self, "estep_backend", "pallas")
+            elif self.use_pallas == "never":
+                object.__setattr__(self, "estep_backend", "jnp")
+        elif ((self.estep_backend == "pallas"
+               and self.use_pallas == "never")
+              or (self.estep_backend == "jnp"
+                  and self.use_pallas == "always")):
+            raise ValueError(
+                f"estep_backend={self.estep_backend!r} contradicts "
+                f"use_pallas={self.use_pallas!r} -- drop one flag")
+        elif self.estep_backend == "pallas":
+            object.__setattr__(self, "use_pallas", "always")
+        elif self.estep_backend == "jnp":
+            object.__setattr__(self, "use_pallas", "never")
         if (self.stream_events and self.mesh_shape is not None
                 and self.mesh_shape[1] != 1):
             raise ValueError(

@@ -333,10 +333,13 @@ def rung_model(model, config, rung: Dict[str, Any]):
         # 'centered' has no loop-invariant feature matrix to hoist
         # (config validation rejects the combination).
         overrides["precompute_features"] = False
-    if config.use_pallas == "always":
+    if config.use_pallas == "always" or config.estep_backend == "pallas":
         # Recovery wants the most-conservative path; the kernel override
         # must not pin the escalated run back onto experimental code.
+        # (Both spellings overridden together -- __post_init__ rejects a
+        # contradictory pair.)
         overrides["use_pallas"] = "never"
+        overrides["estep_backend"] = "jnp"
     cfg2 = dataclasses.replace(config, **overrides)
 
     cache = model.__dict__.setdefault("_recovery_models", {})

@@ -171,9 +171,27 @@ class GMMModel:
         self._kw = kw
 
         if stats_fn is None:
-            from ..ops.pallas import make_stats_fn
+            from ..ops.pallas import (
+                make_batched_stats_fn, make_mstep_fn, make_stats_fn,
+                resolve_estep_backend,
+            )
 
+            # Resolved E-step backend + reason: what actually runs (the
+            # telemetry stream's em_backend field -- a silent jnp fallback
+            # away from a requested kernel is observable, not invisible).
+            self.estep_backend, self.estep_backend_reason = \
+                resolve_estep_backend(config)
             stats_fn = make_stats_fn(config)
+            # Batched (leading restart axis) kernel + fused M-step
+            # epilogue hooks; None routes through vmap / apply_mstep.
+            self.batched_stats_fn = make_batched_stats_fn(config)
+            self._mstep_fn = make_mstep_fn(config)
+            self._mstep_fn_batched = make_mstep_fn(config, batched=True)
+        else:
+            self.estep_backend = "custom"
+            self.estep_backend_reason = "caller-supplied stats_fn"
+            self.batched_stats_fn = None
+            self._mstep_fn = self._mstep_fn_batched = None
         self.stats_fn = stats_fn
 
         # EM executables are memoized per (trajectory_len, donate) variant
@@ -213,7 +231,7 @@ class GMMModel:
             fn = self._em_exec_cache[key] = jax.jit(
                 functools.partial(
                     em_while_loop, reduce_stats=self.reduce_stats,
-                    stats_fn=self.stats_fn,
+                    stats_fn=self.stats_fn, mstep_fn=self._mstep_fn,
                     covariance_type=self.config.covariance_type,
                     precompute_features=self.config.precompute_features,
                     trajectory_len=trajectory_len,
@@ -375,10 +393,33 @@ class GMMModel:
         fatal, or ``max_iters=0``-frozen) restart stops updating while its
         siblings keep iterating. One executable serves every restart batch
         of equal shape (jit's shape-keyed cache, same contract as the
-        per-K executables)."""
+        per-K executables).
+
+        With the Pallas backend (``batched_stats_fn`` set) the vmap is
+        replaced by ``em_while_loop_batched``: the SAME freeze-out
+        semantics, but each iteration's statistics for ALL R restarts are
+        one batched kernel launch (grid restarts x event tiles) and the
+        M-step update runs in the fused epilogue kernel -- one kernel
+        round-trip per iteration for the whole batch."""
         key = ("batched", trajectory_len, donate)
         fn = self._em_exec_cache.get(key)
         if fn is None:
+            if self.batched_stats_fn is not None:
+                fn = jax.jit(
+                    functools.partial(
+                        em_while_loop_batched,
+                        batched_stats_fn=self.batched_stats_fn,
+                        mstep_fn=self._mstep_fn_batched,
+                        reduce_stats=self.reduce_stats,
+                        covariance_type=self.config.covariance_type,
+                        trajectory_len=trajectory_len,
+                        dynamic_range=self.config.covariance_dynamic_range,
+                        regression_scale=(
+                            self.config.health_regression_scale),
+                        **self._kw),
+                    donate_argnums=(0,) if donate else ())
+                self._em_exec_cache[key] = fn
+                return fn
             em_fn = functools.partial(
                 em_while_loop, reduce_stats=self.reduce_stats,
                 stats_fn=self.stats_fn,
@@ -403,7 +444,8 @@ class GMMModel:
 
     def run_em_batched(self, states, data_chunks, wts_chunks, epsilon: float,
                        min_iters=None, max_iters=None, *,
-                       trajectory: bool = False, donate: bool = False):
+                       trajectory: bool = False, donate: bool = False,
+                       r_bucket: Optional[int] = None):
         """Full EM for a BATCH of restarts in one dispatch.
 
         ``states`` is a GMMState whose every leaf carries a leading
@@ -412,6 +454,15 @@ class GMMModel:
         vectors -- a restart with ``max_iters=0`` is frozen (zero
         iterations, state passed through bit-identically), which is how
         the drivers keep finished restarts inert inside a live batch.
+
+        ``r_bucket`` pads the batch UP to that many lanes with frozen
+        duplicates of lane 0 (``max_iters=0``: zero iterations, outputs
+        sliced back to R) so a ragged tail batch reuses the full-size
+        batch's compiled executable instead of tracing a second one --
+        the R-bucket half of the batched-executable memoization
+        (K/D/dtype/precision are keyed by jit's shape cache and the
+        kernel's static args). Live lanes' iteration sequences are
+        unaffected: a frozen pad lane never holds the while-loop open.
 
         Returns ``(states, loglik [R], iters [R])`` (+ ``ll_log [R,
         max_iters+1]`` with ``trajectory=True``); per-restart health
@@ -422,11 +473,23 @@ class GMMModel:
         R = int(states.N.shape[0])
         lo_r, hi_r = resolve_iters_batched(self.config, R, min_iters,
                                            max_iters)
+        pad = 0
+        if r_bucket is not None and int(r_bucket) > R:
+            pad = int(r_bucket) - R
+            states = jax.tree_util.tree_map(
+                lambda a: jnp.concatenate(
+                    [a, jnp.broadcast_to(a[:1], (pad,) + a.shape[1:])]),
+                states)
+            frozen = jnp.zeros((pad,), jnp.int32)
+            lo_r = jnp.concatenate([lo_r, frozen])
+            hi_r = jnp.concatenate([hi_r, frozen])
         run = self._em_batched_executable(
             int(self.config.max_iters) if trajectory else 0, donate)
-        out = run(states, jnp.arange(R, dtype=jnp.int32),
+        out = run(states, jnp.arange(R + pad, dtype=jnp.int32),
                   data_chunks, wts_chunks,
                   jnp.asarray(epsilon, data_chunks.dtype), lo_r, hi_r)
+        if pad:
+            out = jax.tree_util.tree_map(lambda a: a[:R], out)
         self.last_health = out[-1]
         return out[:-1]
 
@@ -438,7 +501,8 @@ class GMMModel:
                                                                bool]] = None,
                                  freeze=None,
                                  resume: Optional[dict] = None,
-                                 donate: bool = False):
+                                 donate: bool = False,
+                                 r_bucket: Optional[int] = None):
         """Batched sibling of :meth:`run_em_resumable`: the SAME batched
         executable runs in host-polled segments so SIGTERM / deadline are
         observed mid-batch and the emergency checkpoint carries ALL R
@@ -515,7 +579,7 @@ class GMMModel:
             states, ll_d, iters_d, ll_log_d = self.run_em_batched(
                 states, data_chunks, wts_chunks, epsilon,
                 min_iters=lo_r, max_iters=hi_r,
-                trajectory=True, donate=donate)
+                trajectory=True, donate=donate, r_bucket=r_bucket)
             seg_iters = np.asarray(jax.device_get(iters_d), np.int64)
             seg_lls = np.asarray(jax.device_get(ll_log_d), np.float64)
             counts_seg = np.asarray(jax.device_get(self.last_health),
@@ -668,6 +732,7 @@ def em_while_loop(
     matmul_precision: str = "highest",
     cluster_axis: str | None = None,
     stats_fn: Optional[Callable] = None,
+    mstep_fn: Optional[Callable] = None,
     covariance_type: str | None = None,
     precompute_features: bool = False,
     trajectory_len: int = 0,
@@ -680,8 +745,13 @@ def em_while_loop(
     ``stats_fn(state, data_chunks, wts_chunks) -> SuffStats`` overrides the
     jnp fused pass -- the hook through which the Pallas TPU kernel
     (ops/pallas/fused_stats.py) replaces XLA-generated code on the hot path.
-    ``covariance_type`` selects the M-step covariance constraint
-    (ops/mstep.py apply_mstep); the E-step/statistics path is shared.
+    ``mstep_fn(state, stats) -> state`` likewise overrides the jnp
+    parameter update (apply_mstep + constants) -- the fused M-step
+    epilogue kernel rides this hook, so backend 'pallas' completes a full
+    EM iteration without a separate XLA M-step dispatch on the
+    statistics. ``covariance_type`` selects the M-step covariance
+    constraint (ops/mstep.py apply_mstep); the E-step/statistics path is
+    shared.
 
     ``precompute_features`` hoists the [C, B, F] outer-product features out
     of the EM loop: they depend only on the data, so building them once and
@@ -798,9 +868,12 @@ def em_while_loop(
 
     def body(carry):
         s, stats, ll_old, _, iters, ll_log, h = carry
-        s = apply_mstep(s, stats, diag_only=diag_only,
-                        cluster_axis=cluster_axis,
-                        covariance_type=covariance_type)  # :541-701
+        if mstep_fn is not None:
+            s = mstep_fn(s, stats)  # fused epilogue kernel (:541-701)
+        else:
+            s = apply_mstep(s, stats, diag_only=diag_only,
+                            cluster_axis=cluster_axis,
+                            covariance_type=covariance_type)  # :541-701
         stats_new = estep(s)  # :713-741
         ll = stats_new.loglik
         if _inj_nan_iter is not None:
@@ -814,6 +887,147 @@ def em_while_loop(
         h = h + health_counts(s, stats_new, ll, ll_old)
         return (s, stats_new, ll, ll - ll_old, iters + 1, ll_log,
                 h)  # :748-751
+
+    s, _, ll, _, iters, ll_log, h = lax.while_loop(cond, body, carry0)
+    if trajectory_len:
+        return s, ll, iters, ll_log, h
+    return s, ll, iters, h
+
+
+def em_while_loop_batched(
+    states,
+    rids,
+    data_chunks,
+    wts_chunks,
+    epsilon,
+    min_iters_r,
+    max_iters_r,
+    *,
+    batched_stats_fn: Callable,
+    mstep_fn: Optional[Callable] = None,
+    reduce_stats: Optional[ReduceFn] = None,
+    diag_only: bool = False,
+    quad_mode: str = "expanded",
+    matmul_precision: str = "highest",
+    cluster_axis: str | None = None,
+    covariance_type: str | None = None,
+    trajectory_len: int = 0,
+    dynamic_range: float = 1e3,
+    regression_scale: float = 10.0,
+):
+    """Restart-batched EM as ONE explicit while-loop over the whole batch.
+
+    The hand-written equivalent of ``jax.vmap(em_while_loop)``'s batched
+    while-loop (same masked freeze-out: the loop runs until every lane's
+    condition is false, finished lanes' carries are frozen via per-lane
+    ``where``), restructured so the per-iteration work is BATCHED calls
+    instead of a vmapped body:
+
+      - statistics: ``batched_stats_fn(states, chunks, wts, lane_mask)``
+        -- the leading-R Pallas kernel (ops/pallas/fused_stats.py), one
+        launch covering every restart with the event data read once; the
+        per-lane freeze-out mask is folded into the kernel's event mask
+        so frozen/fatal lanes contribute exact zeros;
+      - M-step: ``mstep_fn(states, stats)`` -- the fused epilogue kernel
+        over the restart grid (falls back to vmapped ``apply_mstep`` for
+        covariance families the kernel does not cover).
+
+    The iteration semantics (per-lane min/max bounds, NaN-safe
+    convergence, fatal-health short-circuit, trajectory capture, fault
+    injection by restart index, per-lane [R, NUM_FLAGS] health rows)
+    mirror ``em_while_loop`` exactly -- the batched-restart drivers call
+    either loop through the same ``run_em_batched`` contract and must not
+    be able to tell them apart except by speed.
+    """
+    R = states.N.shape[0]
+    kw = dict(diag_only=diag_only, quad_mode=quad_mode,
+              matmul_precision=matmul_precision, cluster_axis=cluster_axis)
+
+    if mstep_fn is None:
+        mstep_fn = jax.vmap(functools.partial(
+            apply_mstep, diag_only=diag_only, cluster_axis=cluster_axis,
+            covariance_type=covariance_type))
+
+    # Deterministic fault injection: the batched loop always has a restart
+    # axis, so restart-keyed plans target one lane by index (the mirror of
+    # em_while_loop's restart_id contract under vmap).
+    _inj_nan = faults.take("nan_loglik")
+    _inj_nan_iter = int(_inj_nan["iter"]) if _inj_nan else None
+    _inj_nan_restart = (int(_inj_nan["restart"])
+                        if _inj_nan and "restart" in _inj_nan else None)
+
+    def estep(ss, lane_mask=None):
+        stats = batched_stats_fn(ss, data_chunks, wts_chunks,
+                                 lane_mask=lane_mask)
+        return reduce_stats(stats) if reduce_stats else stats
+
+    zeros_h = jnp.zeros((health.NUM_FLAGS,), jnp.int32)
+
+    def _h_lane(s, stats_lane, ll, ll_prev, reg_tol):
+        return (
+            health.em_iter_counts(ll, ll_prev, reg_tol)
+            + health.state_counts(s, Nk=stats_lane.Nk,
+                                  dynamic_range=dynamic_range,
+                                  cluster_axis=cluster_axis)
+            + zeros_h.at[health.SANITIZED_LANES]
+                     .set(stats_lane.sanitized.astype(jnp.int32))
+        )
+
+    h0_fn = jax.vmap(lambda s, st, ll: _h_lane(s, st, ll, None, None))
+    reg_tol = regression_scale * jnp.asarray(epsilon)
+    hstep_fn = jax.vmap(
+        lambda s, st, ll, llp: _h_lane(s, st, ll, llp, reg_tol))
+
+    stats0 = estep(states)  # initial E-step, all lanes live
+    ll0 = stats0.loglik                                   # [R]
+    change0 = jnp.full((R,), 2.0, ll0.dtype) * epsilon + 1.0  # :525
+    if trajectory_len:
+        ll_log0 = jnp.full((R, trajectory_len + 1), jnp.nan, ll0.dtype)
+        ll_log0 = ll_log0.at[:, 0].set(ll0)
+    else:
+        ll_log0 = jnp.zeros((R, 0), ll0.dtype)
+    h0 = h0_fn(states, stats0, ll0)                       # [R, NUM_FLAGS]
+    carry0 = (states, stats0, ll0, change0,
+              jnp.zeros((R,), jnp.int32), ll_log0, h0)
+
+    def live_lanes(carry):
+        _, _, _, change, iters, _, h = carry
+        fatal = jax.vmap(health.fatal)(h)                 # [R] bool
+        # The per-lane spelling of em_while_loop's cond (NaN-safe
+        # convergence, fatal short-circuit), against per-lane bounds.
+        return (~fatal) & (
+            (iters < min_iters_r) | (
+                ~(jnp.abs(change) <= epsilon) & (iters < max_iters_r))
+        )
+
+    def cond(carry):
+        return jnp.any(live_lanes(carry))
+
+    def body(carry):
+        s, stats, ll_old, _, iters, ll_log, h = carry
+        live = live_lanes(carry)
+        s_new = mstep_fn(s, stats)                        # :541-701, batched
+        stats_new = estep(s_new, lane_mask=live)          # :713-741, batched
+        ll = stats_new.loglik
+        if _inj_nan_iter is not None:
+            hit = iters + 1 == _inj_nan_iter
+            if _inj_nan_restart is not None:
+                hit = hit & (rids == _inj_nan_restart)
+            ll = jnp.where(hit, jnp.asarray(jnp.nan, ll.dtype), ll)
+        if trajectory_len:
+            # mode='drop': dynamic max_iters can exceed the static buffer.
+            ll_log = jax.vmap(
+                lambda lg, i, v: lg.at[i + 1].set(v, mode="drop"))(
+                    ll_log, iters, ll)
+        h = h + hstep_fn(s_new, stats_new, ll, ll_old)
+        new = (s_new, stats_new, ll, ll - ll_old, iters + 1, ll_log, h)
+
+        def sel(n, o):
+            m = live.reshape((R,) + (1,) * (n.ndim - 1))
+            return jnp.where(m, n, o)
+
+        # Masked freeze-out: finished lanes keep their carry untouched.
+        return jax.tree_util.tree_map(sel, new, carry)
 
     s, _, ll, _, iters, ll_log, h = lax.while_loop(cond, body, carry0)
     if trajectory_len:

@@ -218,7 +218,8 @@ def _reseed_and_refit(model, config, state, chunks, wts, epsilon, k,
 
 
 def _emit_run_summary(rec, config, timer, sweep_log, ideal_k, best_score,
-                      best_ll, em_walls, buckets=None, health_section=None):
+                      best_ll, em_walls, buckets=None, health_section=None,
+                      em_backend=None):
     """Final ``run_summary`` record: scores, 7-category phase profile,
     compile/execute split, metrics-registry snapshot, and (multi-host)
     every rank's snapshot gathered to the one stream process 0 writes.
@@ -240,6 +241,10 @@ def _emit_run_summary(rec, config, timer, sweep_log, ideal_k, best_score,
     fields = dict(
         **({"buckets": buckets} if buckets is not None else {}),
         **({"health": health_section} if health_section is not None else {}),
+        # Which E-step backend actually ran (pallas / pallas-interpret /
+        # jnp / custom; stream rev v1.5) -- mirrors run_start so a
+        # summary-only consumer sees it too.
+        **({"em_backend": em_backend} if em_backend is not None else {}),
         ideal_k=int(ideal_k),
         score=float(best_score),
         criterion=config.criterion,
@@ -511,6 +516,8 @@ def _fit_gmm(data, num_clusters, target_num_clusters, config, model,
             fused_sweep=bool(config.fused_sweep),
             stream_events=bool(config.stream_events),
             n_init=int(config.n_init),
+            em_backend=getattr(model, "estep_backend", "jnp"),
+            em_backend_reason=getattr(model, "estep_backend_reason", None),
             memory_stats=telemetry.memory_stats(),
         )
 
@@ -980,6 +987,7 @@ def _fit_gmm(data, num_clusters, target_num_clusters, config, model,
     _emit_run_summary(
         rec, config, timer, sweep_log, n_active,
         float(min_rissanen), float(best_ll), em_walls,
+        em_backend=getattr(model, "estep_backend", None),
         buckets=dict(
             mode=(config.sweep_k_buckets if bucketing else "off"),
             em_widths=sorted(set(em_widths), reverse=True),
@@ -1627,7 +1635,8 @@ def _run_fused_sweep(fused, config, state, chunks, wts, epsilon,
         _emit_run_summary(rec, config, timer, sweep_log, n_active,
                           float(best_riss), float(best_ll),
                           [s for _, s in sorted(step_secs.items())],
-                          health_section=health_section)
+                          health_section=health_section,
+                          em_backend=getattr(model, "estep_backend", None))
 
     return GMMResult(
         state=compact_state,

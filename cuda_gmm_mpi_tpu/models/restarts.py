@@ -207,7 +207,7 @@ def _json_scores(scores) -> list:
 
 def _recover_batched(model, config, rollback, chunks, wts, epsilon, k_r,
                      live, *, trajectory, rec, log, faulty_counts,
-                     batch_indices):
+                     batch_indices, r_bucket=None):
     """Climb the escalation ladder for a WHOLE-batch fatal EM step.
 
     Mirrors ``health.recover_em`` lane-wise: every live lane's rollback
@@ -251,7 +251,7 @@ def _recover_batched(model, config, rollback, chunks, wts, epsilon, k_r,
         hi_r = np.where(live, config.max_iters, 0).astype(np.int32)
         out = m2.run_em_batched(states2, chunks, wts, epsilon,
                                 min_iters=lo_r, max_iters=hi_r,
-                                trajectory=trajectory)
+                                trajectory=trajectory, r_bucket=r_bucket)
         if trajectory:
             states2, ll_d, iters_d, ll_logs = out
         else:
@@ -385,6 +385,9 @@ def fit_restarts_batched(data, num_clusters, target_num_clusters, config,
                         fused_sweep=False, stream_events=False,
                         n_init=int(R_total),
                         restart_batch_size=int(batch_size),
+                        em_backend=getattr(model, "estep_backend", "jnp"),
+                        em_backend_reason=getattr(
+                            model, "estep_backend_reason", None),
                         memory_stats=telemetry.memory_stats(),
                     )
                 rec.set_context(init=None)
@@ -400,7 +403,7 @@ def fit_restarts_batched(data, num_clusters, target_num_clusters, config,
                 model, config, data, source, num_clusters, stop_number,
                 target_num_clusters, chunks, wts, n_events, n_dims, shift,
                 var_mean, epsilon, idxs, init_means, verbose, rec, log,
-                ckpt)
+                ckpt, r_bucket=batch_size)
             model = out["model"]  # sticky escalation spans batches
             health_totals += out["health_totals"]
             n_recoveries += out["recoveries"]
@@ -420,6 +423,7 @@ def fit_restarts_batched(data, num_clusters, target_num_clusters, config,
                         float(out["min_riss"][j]),
                         float(out["best_ll"][j]),
                         [row[4] for row in out["sweep_logs"][j]],
+                        em_backend=getattr(model, "estep_backend", None),
                         buckets=dict(
                             mode="off",
                             em_widths=[int(out["winner"]["width"])],
@@ -479,8 +483,14 @@ def fit_restarts_batched(data, num_clusters, target_num_clusters, config,
 def _run_batch(model, config, data, source, num_clusters, stop_number,
                target_num_clusters, chunks, wts, n_events, n_dims, shift,
                var_mean, epsilon, batch_indices, init_means, verbose, rec,
-               log, ckpt):
-    """One batch of restarts through the whole vmapped model-order sweep."""
+               log, ckpt, r_bucket=None):
+    """One batch of restarts through the whole vmapped model-order sweep.
+
+    ``r_bucket`` (the fit's restart batch size) pads a ragged tail batch
+    up to the bucket inside ``run_em_batched`` so every batch of the fit
+    reuses ONE compiled batched-EM executable (frozen pad lanes, outputs
+    sliced back -- see GMMModel.run_em_batched).
+    """
     from .order_search import (
         _COV_CODE, _CRITERION_CODE, _emit_em_iters, _resume_mismatch,
         _seed_rows, _shutdown_and_raise,
@@ -649,7 +659,8 @@ def _run_batch(model, config, data, source, num_clusters, stop_number,
                     (lambda done, _k=k_top: sup.poll(
                         where="em", k=_k, em_iter=done))
                     if sup.active else None),
-                freeze=~live, resume=resume_em, donate=True)
+                freeze=~live, resume=resume_em, donate=True,
+                r_bucket=r_bucket)
             resume_em = None
             if em_stopped:
                 payload = host_payload()
@@ -666,11 +677,12 @@ def _run_batch(model, config, data, source, num_clusters, stop_number,
         elif want_traj:
             states, ll_d, iters_d, ll_logs = model.run_em_batched(
                 states, chunks, wts, epsilon, min_iters=lo_r,
-                max_iters=hi_r, trajectory=True, donate=True)
+                max_iters=hi_r, trajectory=True, donate=True,
+                r_bucket=r_bucket)
         else:
             states, ll_d, iters_d = model.run_em_batched(
                 states, chunks, wts, epsilon, min_iters=lo_r,
-                max_iters=hi_r, donate=True)
+                max_iters=hi_r, donate=True, r_bucket=r_bucket)
         counts = np.asarray(jax.device_get(model.last_health), np.int64)
         counts = counts.reshape(R, health.NUM_FLAGS)
 
@@ -707,7 +719,8 @@ def _run_batch(model, config, data, source, num_clusters, stop_number,
                  clean_live) = _recover_batched(
                     model, config, rollback, chunks, wts, epsilon, k_r,
                     live, trajectory=want_traj, rec=rec, log=log,
-                    faulty_counts=counts, batch_indices=batch_indices)
+                    faulty_counts=counts, batch_indices=batch_indices,
+                    r_bucket=r_bucket)
                 n_recoveries += 1
                 still_fatal = live & ~clean_live
                 live = clean_live

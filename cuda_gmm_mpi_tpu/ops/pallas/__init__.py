@@ -1,68 +1,97 @@
-"""Pallas/Mosaic TPU kernels -- EXPERIMENTAL alternates to the XLA path.
+"""Pallas/Mosaic TPU kernels -- the ``estep_backend='pallas'`` hot path.
 
-STATUS (settled round 5, on round-3 hardware data -- see docs/PERF.md
-"routing decision"): the production path is jnp/XLA everywhere; these
-kernels are kept as measured-and-lost research artifacts plus the
-starting point for any future VMEM-resident-features attempt. The round-3
-matched-precision study showed the kernel's earlier wins were an artifact
-of Mosaic lowering precision-unannotated dots at DEFAULT (bf16); at
-honest precision XLA met or beat the kernel at every measured shape. The
-one untested hope -- that in-kernel feature materialization beats XLA's
-xouter HBM traffic at the north star -- is what the hardware session's
-``kernel_north`` step measures; a win there is the only thing that should
-flip ``should_use_pallas``.
+STATUS (round 6): the fused kernel family now covers every in-memory hot
+path -- the single-shard fused E+M statistics kernel (full + diagonal
+covariance), its BATCHED sibling with a leading restart axis (grid over
+restarts x event tiles; the PR-5 batched-restart driver and the
+shard_map(vmap) sharded variant both ride it), the fused M-step parameter
+epilogue (Nk/M1/M2 -> N/means/covariance in VMEM, 'full'/'diag'
+families), and the two-pass cluster-sharded variant (per-shard LSE
+in-kernel, pmax/psum outside; diagonal covariance only). With backend
+'pallas' a full EM iteration is ONE kernel round-trip over the events:
+no HBM [N, D^2] feature materialization and no separate XLA M-step
+dispatch on the statistics.
 
-``should_use_pallas`` decides kernel-vs-jnp per config: 'auto' resolves
-to the jnp/XLA path everywhere. The kernels stay available under
-``use_pallas='always'`` (fp32; all precisions -- 'high' is a manual 3-dot
-bf16_3x decomposition since Mosaic rejects native Precision.HIGH),
-correct and parity-tested: the single-shard fused E+M kernel (full +
-diagonal covariance) and the two-pass cluster-sharded variant (per-shard
-LSE in-kernel, pmax/psum outside -- the cross-device generalization of
-estep1's per-cluster grid axis, ``gaussian_kernel.cu:383``; diagonal
-covariance only). ``make_stats_fn`` binds the config's covariance mode,
-tile size, precision, and mesh axis into the ``stats_fn`` hook consumed
-by ``em_while_loop``.
+Routing: ``resolve_estep_backend`` maps the config to the backend that
+will actually run -- 'pallas' (TPU), 'pallas-interpret' (any other
+platform: Mosaic compiles on TPU only, interpret mode keeps the kernel
+path tier-1-testable), or 'jnp' with a reason string. 'auto' still
+resolves to the jnp/XLA path everywhere: the round-3 matched-precision
+study (docs/PERF.md) showed the UNBATCHED kernel's earlier wins were a
+precision artifact, and that routing decision stands until the batched
+fused iteration is re-measured on hardware (``bench.py --envelope`` is
+the measurement). The resolved backend + reason are emitted as
+``em_backend`` / ``em_backend_reason`` on the telemetry stream, so a
+silent fallback is observable (docs/OBSERVABILITY.md).
+
+All precisions are supported in-kernel ('high' is a manual 3-dot bf16_3x
+decomposition, since Mosaic rejects native Precision.HIGH).
+``make_stats_fn`` / ``make_batched_stats_fn`` / ``make_mstep_fn`` bind
+the config's covariance mode, tile size, precision, and mesh axis into
+the hooks consumed by ``em_while_loop`` / ``em_while_loop_batched``.
 """
 
 from __future__ import annotations
 
 import functools
 
-from .fused_stats import fused_stats_pallas, fused_stats_pallas_sharded
+from .fused_stats import (
+    fused_mstep_pallas,
+    fused_stats_pallas,
+    fused_stats_pallas_batched,
+    fused_stats_pallas_sharded,
+)
+
+AUTO_REASON = ("estep_backend=auto routes to the XLA path (round-3 "
+               "matched-precision routing decision, docs/PERF.md)")
 
 
-def should_use_pallas(config, cluster_sharded: bool = False) -> bool:
-    if config.use_pallas != "always":
-        # 'auto' resolves to the jnp/XLA path everywhere. The round-3
-        # matched-precision study (docs/PERF.md) showed the kernel's earlier
-        # measured wins were an artifact of Mosaic lowering its precision-
-        # unannotated dots at DEFAULT (bf16) while the jnp path ran true
-        # fp32; with precision now plumbed through both paths, XLA meets or
-        # beats the kernel at every measured shape. The kernel stays
-        # available ('always') and tested.
-        return False
+def resolve_estep_backend(config, cluster_sharded: bool = False):
+    """(backend, reason) the E-step/statistics path will actually run.
+
+    backend is 'pallas' | 'pallas-interpret' | 'jnp'. The pair is what
+    the telemetry stream records as ``em_backend``/``em_backend_reason``
+    -- a fallback away from a requested kernel always carries its cause.
+    """
+    mode = getattr(config, "estep_backend", "auto")
+    if mode == "jnp":
+        return "jnp", "estep_backend=jnp (explicit)"
+    if mode == "auto":
+        return "jnp", AUTO_REASON
+    # mode == 'pallas': hard request, honored unless structurally impossible.
     if config.dtype != "float32":
-        return False
+        return "jnp", f"kernel is float32-only (dtype={config.dtype})"
     if cluster_sharded and not config.diag_only:
         # Full covariance is matmul-bound: the 2-pass sharded kernel would
         # evaluate the (B, D^2) @ (D^2, K) contraction twice, while the jnp
         # collective-LSE path does it once at the XLA roofline.
-        return False
-    return True
+        return "jnp", ("cluster-sharded full covariance stays on the jnp "
+                       "collective-LSE path (the 2-pass kernel would double "
+                       "the dominant contraction)")
+    import jax
+
+    if jax.default_backend() == "tpu":
+        return "pallas", "estep_backend=pallas"
+    return "pallas-interpret", ("estep_backend=pallas on a non-TPU "
+                                "platform: Mosaic compiles on TPU only; "
+                                "running the kernel in interpret mode")
+
+
+def should_use_pallas(config, cluster_sharded: bool = False) -> bool:
+    backend, _ = resolve_estep_backend(config, cluster_sharded)
+    return backend != "jnp"
+
+
+def _interpret(backend: str) -> bool:
+    return backend == "pallas-interpret"
 
 
 def make_stats_fn(config, cluster_sharded: bool = False,
                   cluster_axis: str | None = None):
     """stats_fn hook bound to the config, or None for the jnp path."""
-    if not should_use_pallas(config, cluster_sharded):
+    backend, _ = resolve_estep_backend(config, cluster_sharded)
+    if backend == "jnp":
         return None
-    import jax
-
-    # Mosaic compiles on TPU only; on any other backend run the kernel in
-    # interpret mode so use_pallas='always' works (slowly) everywhere --
-    # the same code path the kernel test suite exercises.
-    interpret = jax.default_backend() != "tpu"
     if cluster_sharded:
         from ...parallel.mesh import CLUSTER_AXIS
 
@@ -72,16 +101,71 @@ def make_stats_fn(config, cluster_sharded: bool = False,
             diag_only=config.diag_only,
             block_b=config.pallas_block_b,
             precision=config.matmul_precision,
-            interpret=interpret,
+            interpret=_interpret(backend),
         )
     return functools.partial(
         fused_stats_pallas,
         diag_only=config.diag_only,
         block_b=config.pallas_block_b,
         precision=config.matmul_precision,
-        interpret=interpret,
+        interpret=_interpret(backend),
     )
 
 
-__all__ = ["fused_stats_pallas", "fused_stats_pallas_sharded",
-           "make_stats_fn", "should_use_pallas"]
+def make_batched_stats_fn(config, cluster_sharded: bool = False):
+    """Batched (leading restart axis) stats_fn hook, or None.
+
+    None routes ``run_em_batched`` through the vmapped jnp loop: the
+    cluster-sharded 2-pass kernel has no batched variant (the restart
+    vmap of the jnp path handles that layout), and any jnp-resolved
+    backend batches through vmap by construction.
+    """
+    backend, _ = resolve_estep_backend(config, cluster_sharded)
+    if backend == "jnp" or cluster_sharded:
+        return None
+    return functools.partial(
+        fused_stats_pallas_batched,
+        diag_only=config.diag_only,
+        block_b=config.pallas_block_b,
+        precision=config.matmul_precision,
+        interpret=_interpret(backend),
+    )
+
+
+def make_mstep_fn(config, cluster_sharded: bool = False,
+                  batched: bool = False):
+    """mstep_fn hook (fused M-step epilogue + constants), or None.
+
+    Covers the reference's two covariance families ('full'/'diag');
+    'spherical'/'tied' keep the jnp ``apply_mstep`` (their cross-cluster
+    ties have no per-cluster kernel formulation worth writing), as do
+    cluster-sharded meshes (the pi denominator and tied-pool psums live
+    in the jnp update).
+    """
+    backend, _ = resolve_estep_backend(config, cluster_sharded)
+    if backend == "jnp" or cluster_sharded:
+        return None
+    cov = config.covariance_type
+    if cov not in ("full", "diag"):
+        return None
+    import jax
+
+    from ..constants import compute_constants
+
+    diag_only = config.diag_only
+    interpret = _interpret(backend)
+    constants = functools.partial(compute_constants, diag_only=diag_only)
+    if batched:
+        constants = jax.vmap(constants)
+
+    def mstep(state, stats):
+        return constants(fused_mstep_pallas(
+            state, stats, diag_only=diag_only, interpret=interpret))
+
+    return mstep
+
+
+__all__ = ["fused_stats_pallas", "fused_stats_pallas_batched",
+           "fused_stats_pallas_sharded", "fused_mstep_pallas",
+           "make_stats_fn", "make_batched_stats_fn", "make_mstep_fn",
+           "resolve_estep_backend", "should_use_pallas"]

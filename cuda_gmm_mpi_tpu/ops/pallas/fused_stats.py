@@ -27,6 +27,17 @@ written once on the last tile. ``fused_stats_pallas`` requires an unsharded
 cluster axis; ``fused_stats_pallas_sharded`` (below) is the two-pass
 cluster-sharded variant.
 
+``fused_stats_pallas_batched`` adds a leading RESTART axis: the grid
+becomes (restarts x event tiles), per-restart parameter blocks ride the
+restart axis while the event tiles are shared (R restarts read the data
+once), and per-lane freeze-out masks fold into the event mask. Together
+with ``fused_mstep_pallas`` -- the M-step parameter epilogue
+(Nk/M1/M2 -> N/means/covariance with the empty-cluster guards and
+variance floor, in VMEM, 'full'/'diag' families) -- a full EM iteration
+for a whole restart batch is a single kernel round-trip: no HBM [N, D^2]
+features, no [R, N, K] posteriors, no separate XLA M-step dispatch on
+the statistics (only the K-sized Cholesky/constants stay on XLA).
+
 Precision: 'highest' and 'default' map to Mosaic's native MXU modes.
 'high' (bf16_3x) is NOT accepted by Mosaic's dot lowering -- the kernel
 implements it MANUALLY as the standard 3-dot decomposition (split each fp32
@@ -406,12 +417,13 @@ def fused_stats_pallas_sharded(
     )
 
 
-def _prep_inputs(state, data_chunks, wts_chunks, block_b, diag_only):
-    """Flatten chunks to tile-padded [N, D] and build the per-cluster
-    linear/constant terms (A [F, K], h [D, K], g [1, K]) for
-    logp = -0.5 (x2.A - 2 x.h) + g. A and h are emitted PRE-TRANSPOSED so
-    every kernel dot runs in natural [M, C] . [C, N] layout (the transpose
-    happens once per iteration here, not once per event tile)."""
+def _prep_events(data_chunks, wts_chunks, block_b):
+    """Flatten chunks to tile-padded [N, D] events + [N, 1] weights.
+
+    Padding uses weight 0 via wt (wt rows carry arbitrary nonnegative
+    per-event weights, not just the 0/1 mask), so padded tiles contribute
+    exactly nothing to any statistic.
+    """
     c, b, d = data_chunks.shape
     n = c * b
     x = data_chunks.reshape(n, d).astype(jnp.float32)
@@ -419,14 +431,19 @@ def _prep_inputs(state, data_chunks, wts_chunks, block_b, diag_only):
         wt = jnp.ones((n, 1), jnp.float32)
     else:
         wt = wts_chunks.reshape(n, 1).astype(jnp.float32)
-
-    # Pad events to a whole number of tiles (weight 0 via wt; wt rows carry
-    # arbitrary nonnegative per-event weights, not just the 0/1 mask).
     pad = (-n) % block_b
     if pad:
         x = jnp.concatenate([x, jnp.zeros((pad, d), x.dtype)])
         wt = jnp.concatenate([wt, jnp.zeros((pad, 1), wt.dtype)])
+    return x, wt
 
+
+def _prep_params(state, d, diag_only):
+    """Per-cluster linear/constant terms (A [F, K], h [D, K], g [1, K]) for
+    logp = -0.5 (x2.A - 2 x.h) + g. A and h are emitted PRE-TRANSPOSED so
+    every kernel dot runs in natural [M, C] . [C, N] layout (the transpose
+    happens once per iteration here, not once per event tile). vmap-safe,
+    so the batched entry point maps it over a leading restart axis."""
     K = state.means.shape[0]
     Rinv = state.Rinv.astype(jnp.float32)
     mu = state.means.astype(jnp.float32)
@@ -443,7 +460,323 @@ def _prep_inputs(state, data_chunks, wts_chunks, block_b, diag_only):
         + jnp.log(jnp.maximum(state.pi.astype(jnp.float32), 1e-37))
     )
     g = jnp.where(state.active, g, NEG_LARGE)[None, :]  # [1, K]
-    return x, wt, A.T, h.T, g
+    return A.T, h.T, g
+
+
+def _prep_inputs(state, data_chunks, wts_chunks, block_b, diag_only):
+    """Events + per-cluster terms for the unbatched kernels (see the
+    two halves above)."""
+    d = data_chunks.shape[-1]
+    x, wt = _prep_events(data_chunks, wts_chunks, block_b)
+    A, h, g = _prep_params(state, d, diag_only)
+    return x, wt, A, h, g
+
+
+def _fused_stats_batched_kernel(x_ref, wt_ref, lane_ref, A_ref, h_ref, g_ref,
+                                ll_ref, nk_ref, m1_ref, m2_ref,
+                                ll_acc, nk_acc, m1_acc, m2_acc,
+                                *, diag: bool, precision):
+    """Batched fused E+M statistics: grid (restarts, event tiles).
+
+    Identical tile math to ``_fused_stats_kernel``; the leading grid axis
+    selects one restart's (A, h, g) parameter blocks while the EVENT tiles
+    (x, wt) are shared -- R restarts read the data once. The per-lane
+    freeze-out mask arrives as ``lane_ref`` ([1, 1] per restart) and is
+    folded into the event weight, so a frozen lane's statistics (and
+    loglik) come out exactly zero without touching the event stream.
+    The accumulators live in VMEM scratch shared across the sequential
+    grid: re-initialized on each restart's first tile, flushed to that
+    restart's output block on its last.
+    """
+    j = pl.program_id(1)
+    n_tiles = pl.num_programs(1)
+
+    @pl.when(j == 0)
+    def _init():
+        ll_acc[:] = jnp.zeros_like(ll_acc)
+        nk_acc[:] = jnp.zeros_like(nk_acc)
+        m1_acc[:] = jnp.zeros_like(m1_acc)
+        m2_acc[:] = jnp.zeros_like(m2_acc)
+
+    x = x_ref[:]                          # [B_t, D] (shared across restarts)
+    wt = wt_ref[:] * lane_ref[0, 0]       # [B_t, 1]; frozen lane -> all-zero
+    bt, d = x.shape
+
+    if diag:
+        x2 = x * x
+    else:
+        # Flattened outer products in VMEM (see _fused_stats_kernel).
+        x2 = jnp.concatenate([x * x[:, j2:j2 + 1] for j2 in range(d)], axis=1)
+
+    q = _kdot(x2, A_ref[0], _NT, precision)       # [B_t, K]
+    q = q - 2.0 * _kdot(x, h_ref[0], _NT, precision)
+    logp = -0.5 * q + g_ref[0]            # g broadcasts from [1, K]
+
+    m = jnp.max(logp, axis=1, keepdims=True)
+    m = jnp.maximum(m, NEG_LARGE)
+    e = jnp.exp(logp - m)
+    s = jnp.sum(e, axis=1, keepdims=True)
+    logz = (m + jnp.log(s)) * wt
+    w = (e / s) * wt
+
+    ll_acc[:] = ll_acc[:] + jnp.sum(logz).reshape(1, 1)
+    nk_acc[:] += jnp.sum(w, axis=0, keepdims=True)          # [1, K]
+    m1_acc[:] += _kdot(w, x, _TT, precision)                # [K, D]
+    m2_acc[:] += _kdot(w, x2, _TT, precision)               # [K, D*D] | [K, D]
+
+    @pl.when(j == n_tiles - 1)
+    def _flush():
+        ll_ref[...] = ll_acc[:][None]
+        nk_ref[...] = nk_acc[:][None]
+        m1_ref[...] = m1_acc[:][None]
+        m2_ref[...] = m2_acc[:][None]
+
+
+@functools.partial(jax.jit,
+                   static_argnames=("block_b", "diag", "interpret",
+                                    "precision"))
+def _fused_stats_batched_call(x, wt, lanes, A, h, g, *, block_b: int,
+                              diag: bool, interpret: bool,
+                              precision: str = "highest"):
+    n, d = x.shape
+    r = A.shape[0]
+    f, k = A.shape[1], A.shape[2]  # A arrives transposed per lane: [R, F, K]
+    grid = (r, n // block_b)
+    f32 = jnp.float32
+    out_shapes = (
+        jax.ShapeDtypeStruct((r, 1, 1), f32),
+        jax.ShapeDtypeStruct((r, 1, k), f32),
+        jax.ShapeDtypeStruct((r, k, d), f32),
+        jax.ShapeDtypeStruct((r, k, f), f32),
+    )
+    ev = lambda r_, j_: (j_, 0)       # event tiles: shared across restarts
+    lane = lambda r_, j_: (r_, 0)     # per-restart freeze-out scalar
+    par = lambda r_, j_: (r_, 0, 0)   # per-restart parameter / output block
+    kernel = functools.partial(_fused_stats_batched_kernel, diag=diag,
+                               precision=precision)
+    ll, nk, m1, m2 = pl.pallas_call(
+        kernel,
+        grid=grid,
+        in_specs=[
+            pl.BlockSpec((block_b, d), ev, memory_space=pltpu.VMEM),
+            pl.BlockSpec((block_b, 1), ev, memory_space=pltpu.VMEM),
+            pl.BlockSpec((1, 1), lane, memory_space=pltpu.VMEM),
+            pl.BlockSpec((1, f, k), par, memory_space=pltpu.VMEM),
+            pl.BlockSpec((1, d, k), par, memory_space=pltpu.VMEM),
+            pl.BlockSpec((1, 1, k), par, memory_space=pltpu.VMEM),
+        ],
+        out_specs=(
+            pl.BlockSpec((1, 1, 1), par, memory_space=pltpu.VMEM),
+            pl.BlockSpec((1, 1, k), par, memory_space=pltpu.VMEM),
+            pl.BlockSpec((1, k, d), par, memory_space=pltpu.VMEM),
+            pl.BlockSpec((1, k, f), par, memory_space=pltpu.VMEM),
+        ),
+        out_shape=out_shapes,
+        scratch_shapes=[
+            pltpu.VMEM((1, 1), f32),
+            pltpu.VMEM((1, k), f32),
+            pltpu.VMEM((k, d), f32),
+            pltpu.VMEM((k, f), f32),
+        ],
+        cost_estimate=pl.CostEstimate(
+            flops=4 * r * n * k * f,
+            bytes_accessed=r * n * d * 4 + r * k * f * 8,
+            transcendentals=2 * r * n,
+        ),
+        interpret=interpret,
+    )(x, wt, lanes, A, h, g)
+    return ll, nk, m1, m2
+
+
+def fused_stats_pallas_batched(
+    states,
+    data_chunks: jax.Array,
+    wts_chunks: jax.Array | None,
+    *,
+    lane_mask: jax.Array | None = None,
+    diag_only: bool = False,
+    block_b: int = 512,
+    interpret: bool = False,
+    precision: str = "highest",
+) -> SuffStats:
+    """SuffStats for a BATCH of restarts in one kernel launch.
+
+    ``states`` is a GMMState whose every leaf carries a leading restart
+    axis R (the ``run_em_batched`` layout); ``data_chunks``/``wts_chunks``
+    are SHARED across restarts -- the kernel reads each event tile once
+    per restart from the same HBM buffer (no [R, N, D] replication).
+    Returns SuffStats with batched leaves: loglik [R], Nk [R, K],
+    M1 [R, K, D], M2 [R, K, D, D] (or [R, K, D] diagonal).
+
+    ``lane_mask`` ([R], 0/1) zeroes a frozen restart's statistics in-kernel
+    (folded into the event weight); None means all lanes live. The batched
+    EM loop's select-based freeze-out discards frozen lanes' outputs
+    anyway, so the mask is an arithmetic guarantee, not a speed knob.
+    """
+    c, b, d = data_chunks.shape
+    R, K = states.means.shape[0], states.means.shape[1]
+    x, wt = _prep_events(data_chunks, wts_chunks, block_b)
+    A, h, g = jax.vmap(
+        functools.partial(_prep_params, d=d, diag_only=diag_only))(states)
+    if lane_mask is None:
+        lanes = jnp.ones((R, 1), jnp.float32)
+    else:
+        lanes = lane_mask.astype(jnp.float32).reshape(R, 1)
+    ll, nk, m1, m2 = _fused_stats_batched_call(
+        x, wt, lanes, A, h, g, block_b=block_b, diag=diag_only,
+        interpret=interpret, precision=precision,
+    )
+    dt = data_chunks.dtype
+    return SuffStats(
+        loglik=ll[:, 0, 0].astype(dt),
+        Nk=nk[:, 0].astype(dt),
+        M1=m1.astype(dt),
+        M2=(m2 if diag_only else m2.reshape(R, K, d, d)).astype(dt),
+        # Masked lanes use NEG_LARGE (finite) in-kernel: nothing to
+        # sanitize per lane (same contract as the unbatched kernel).
+        sanitized=jnp.zeros((R,), jnp.int32),
+    )
+
+
+# ---------------------------------------------------------------------------
+# Fused M-step epilogue: Nk/M1/M2 -> N/means/covariance in VMEM.
+# ---------------------------------------------------------------------------
+
+
+def _mstep_math(nk, m1, m2, avgvar, act, diag: bool):
+    """apply_mstep's division/guard/variance-floor sequence on K-major
+    operands (nk/avgvar/act arrive as [K, 1] columns so every op is a
+    lane-broadcast, never a transpose). Shared by the unbatched and
+    batched kernels; expressions mirror ops/mstep.apply_mstep term for
+    term so interpret mode is bit-identical to the jnp update."""
+    k, d = m1.shape
+    nonempty = nk > 0.5                                 # gaussian.cu:614,664
+    mean = jnp.where(nonempty, m1 / jnp.maximum(nk, 1e-30), 0.0)
+    if diag:
+        cov = m2 - nk * mean * mean                     # [K, D] diagonal
+        cov = jnp.where(nk >= 1.0, cov, 0.0)            # kernel.cu:658-668
+        cov = cov + avgvar                              # loading (:673-675)
+        out = jnp.where(nonempty, cov / jnp.maximum(nk, 1e-30), 1.0)
+        fallback = 1.0                                  # identity diagonal
+    else:
+        # Flattened mean outer products, same lane-concat layout as the
+        # statistics kernel's x2 (column j*D+i = mean_i * mean_j).
+        mm = jnp.concatenate([mean * mean[:, j:j + 1] for j in range(d)],
+                             axis=1)                    # [K, D*D]
+        f_idx = jax.lax.broadcasted_iota(jnp.int32, (k, d * d), 1)
+        eye = (f_idx % (d + 1) == 0).astype(m2.dtype)   # flattened identity
+        cov = m2 - nk * mm
+        cov = jnp.where(nk >= 1.0, cov, 0.0)
+        cov = cov + avgvar * eye
+        out = jnp.where(nonempty, cov / jnp.maximum(nk, 1e-30), eye)
+        fallback = eye
+    # Inactive clusters keep inert placeholder params (apply_mstep's
+    # trailing active-mask).
+    live = act > 0.5
+    return (jnp.where(live, nk, 0.0),
+            jnp.where(live, mean, 0.0),
+            jnp.where(live, out, fallback))
+
+
+def _mstep_kernel(nk_ref, m1_ref, m2_ref, av_ref, act_ref,
+                  n_ref, mean_ref, cov_ref, *, diag: bool):
+    n, mean, cov = _mstep_math(nk_ref[:], m1_ref[:], m2_ref[:],
+                               av_ref[:], act_ref[:], diag)
+    n_ref[:] = n
+    mean_ref[:] = mean
+    cov_ref[:] = cov
+
+
+def _mstep_batched_kernel(nk_ref, m1_ref, m2_ref, av_ref, act_ref,
+                          n_ref, mean_ref, cov_ref, *, diag: bool):
+    n, mean, cov = _mstep_math(nk_ref[0], m1_ref[0], m2_ref[0],
+                               av_ref[0], act_ref[0], diag)
+    n_ref[...] = n[None]
+    mean_ref[...] = mean[None]
+    cov_ref[...] = cov[None]
+
+
+@functools.partial(jax.jit, static_argnames=("diag", "interpret"))
+def _mstep_call(nk, m1, m2, av, act, *, diag: bool, interpret: bool):
+    k, d = m1.shape
+    f = m2.shape[1]
+    f32 = jnp.float32
+    full = lambda *_: tuple(0 for _ in range(2))
+    spec2 = lambda shape: pl.BlockSpec(shape, full, memory_space=pltpu.VMEM)
+    return pl.pallas_call(
+        functools.partial(_mstep_kernel, diag=diag),
+        grid=(1,),
+        in_specs=[spec2((k, 1)), spec2((k, d)), spec2((k, f)),
+                  spec2((k, 1)), spec2((k, 1))],
+        out_specs=(spec2((k, 1)), spec2((k, d)), spec2((k, f))),
+        out_shape=(
+            jax.ShapeDtypeStruct((k, 1), f32),
+            jax.ShapeDtypeStruct((k, d), f32),
+            jax.ShapeDtypeStruct((k, f), f32),
+        ),
+        interpret=interpret,
+    )(nk, m1, m2, av, act)
+
+
+@functools.partial(jax.jit, static_argnames=("diag", "interpret"))
+def _mstep_batched_call(nk, m1, m2, av, act, *, diag: bool, interpret: bool):
+    r, k, d = m1.shape
+    f = m2.shape[2]
+    f32 = jnp.float32
+    par = lambda r_: (r_, 0, 0)
+    spec3 = lambda shape: pl.BlockSpec((1,) + shape, par,
+                                       memory_space=pltpu.VMEM)
+    return pl.pallas_call(
+        functools.partial(_mstep_batched_kernel, diag=diag),
+        grid=(r,),
+        in_specs=[spec3((k, 1)), spec3((k, d)), spec3((k, f)),
+                  spec3((k, 1)), spec3((k, 1))],
+        out_specs=(spec3((k, 1)), spec3((k, d)), spec3((k, f))),
+        out_shape=(
+            jax.ShapeDtypeStruct((r, k, 1), f32),
+            jax.ShapeDtypeStruct((r, k, d), f32),
+            jax.ShapeDtypeStruct((r, k, f), f32),
+        ),
+        interpret=interpret,
+    )(nk, m1, m2, av, act)
+
+
+def fused_mstep_pallas(state, stats: SuffStats, *, diag_only: bool = False,
+                       interpret: bool = False):
+    """M-step parameter update via the fused epilogue kernel.
+
+    Drop-in for the division/guard/variance-floor half of
+    ``ops.mstep.apply_mstep`` ('full' and 'diag' covariance families; the
+    caller runs ``compute_constants`` on the result exactly as apply_mstep
+    does). The sufficient statistics never round-trip through an XLA
+    M-step dispatch: the kernel reads Nk/M1/M2 and writes the new
+    N/means/covariance directly. Accepts plain or restart-batched
+    (leading-R) states/stats and dispatches to the matching kernel.
+    """
+    batched = stats.M1.ndim == 3
+    f32 = jnp.float32
+    K, D = state.means.shape[-2], state.means.shape[-1]
+    nk = stats.Nk.astype(f32)[..., None]
+    av = state.avgvar.astype(f32)[..., None]
+    act = state.active.astype(f32)[..., None]
+    m1 = stats.M1.astype(f32)
+    m2 = (stats.M2 if diag_only
+          else stats.M2.reshape(stats.M2.shape[:-2] + (D * D,))).astype(f32)
+    call = _mstep_batched_call if batched else _mstep_call
+    n, mean, cov = call(nk, m1, m2, av, act, diag=diag_only,
+                        interpret=interpret)
+    dtype = state.R.dtype
+    if diag_only:
+        idx = jnp.arange(D)
+        R = (jnp.zeros(cov.shape[:-1] + (D, D), dtype)
+             .at[..., idx, idx].set(cov))
+    else:
+        R = cov.reshape(cov.shape[:-1] + (D, D))
+    return state.replace(
+        N=n[..., 0].astype(dtype),
+        means=mean.astype(dtype),
+        R=R.astype(dtype),
+    )
 
 
 def fused_stats_pallas(

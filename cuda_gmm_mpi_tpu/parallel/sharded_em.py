@@ -231,13 +231,34 @@ class ShardedGMMModel:
         )
         self._kw = kw
 
-        from ..ops.pallas import make_stats_fn
+        from ..ops.pallas import (
+            make_batched_stats_fn, make_mstep_fn, make_stats_fn,
+            resolve_estep_backend,
+        )
 
         if stats_fn is None:
+            self.estep_backend, self.estep_backend_reason = \
+                resolve_estep_backend(
+                    config, cluster_sharded=cluster_axis is not None)
             stats_fn = make_stats_fn(
                 config, cluster_sharded=cluster_axis is not None,
                 cluster_axis=cluster_axis,
             )
+            # Batched kernel + fused M-step epilogue: data-axis-sharded
+            # meshes only (the hooks are None on cluster-sharded meshes,
+            # whose pi/tied psums live in the jnp update).
+            self._batched_stats_fn = make_batched_stats_fn(
+                config, cluster_sharded=cluster_axis is not None)
+            self._mstep_fn = make_mstep_fn(
+                config, cluster_sharded=cluster_axis is not None)
+            self._mstep_fn_batched = make_mstep_fn(
+                config, cluster_sharded=cluster_axis is not None,
+                batched=True)
+        else:
+            self.estep_backend = "custom"
+            self.estep_backend_reason = "caller-supplied stats_fn"
+            self._batched_stats_fn = None
+            self._mstep_fn = self._mstep_fn_batched = None
         self._stats_fn = stats_fn
         self._cluster_axis = cluster_axis
         # Buckets must stay evenly partitionable over the cluster axis
@@ -347,6 +368,7 @@ class ShardedGMMModel:
                 reduce_stats=make_psum_reduce(DATA_AXIS),
                 cluster_axis=self._cluster_axis,
                 stats_fn=self._stats_fn,
+                mstep_fn=self._mstep_fn,
                 covariance_type=self.config.covariance_type,
                 precompute_features=self.config.precompute_features,
                 trajectory_len=trajectory_len,
@@ -412,26 +434,47 @@ class ShardedGMMModel:
         key = ("batched", trajectory_len, donate)
         fn = self._em_exec_cache.get(key)
         if fn is None:
-            em_fn = functools.partial(
-                em_while_loop,
-                reduce_stats=make_psum_reduce(DATA_AXIS),
-                cluster_axis=self._cluster_axis,
-                stats_fn=self._stats_fn,
-                covariance_type=self.config.covariance_type,
-                precompute_features=self.config.precompute_features,
-                trajectory_len=trajectory_len,
-                dynamic_range=self.config.covariance_dynamic_range,
-                regression_scale=self.config.health_regression_scale,
-                **self._kw,
-            )
+            if self._batched_stats_fn is not None:
+                # Data-axis-sharded + Pallas backend: the explicit batched
+                # loop rides the leading-R kernel inside the shard_map --
+                # each device runs ONE batched kernel launch per iteration
+                # over its event shard, and the per-lane stats psum over
+                # 'data' as one fused collective ([R, ...] leaves).
+                from ..models.gmm import em_while_loop_batched
 
-            def batched(states, rids, data_chunks, wts_chunks, epsilon,
-                        lo_r, hi_r):
-                run_one = lambda s, rid, lo, hi: em_fn(
-                    s, data_chunks, wts_chunks, epsilon, lo, hi,
-                    restart_id=rid)
-                return jax.vmap(run_one, in_axes=(0, 0, 0, 0))(
-                    states, rids, lo_r, hi_r)
+                batched = functools.partial(
+                    em_while_loop_batched,
+                    batched_stats_fn=self._batched_stats_fn,
+                    mstep_fn=self._mstep_fn_batched,
+                    reduce_stats=make_psum_reduce(DATA_AXIS),
+                    cluster_axis=self._cluster_axis,
+                    covariance_type=self.config.covariance_type,
+                    trajectory_len=trajectory_len,
+                    dynamic_range=self.config.covariance_dynamic_range,
+                    regression_scale=self.config.health_regression_scale,
+                    **self._kw,
+                )
+            else:
+                em_fn = functools.partial(
+                    em_while_loop,
+                    reduce_stats=make_psum_reduce(DATA_AXIS),
+                    cluster_axis=self._cluster_axis,
+                    stats_fn=self._stats_fn,
+                    covariance_type=self.config.covariance_type,
+                    precompute_features=self.config.precompute_features,
+                    trajectory_len=trajectory_len,
+                    dynamic_range=self.config.covariance_dynamic_range,
+                    regression_scale=self.config.health_regression_scale,
+                    **self._kw,
+                )
+
+                def batched(states, rids, data_chunks, wts_chunks, epsilon,
+                            lo_r, hi_r):
+                    run_one = lambda s, rid, lo, hi: em_fn(
+                        s, data_chunks, wts_chunks, epsilon, lo, hi,
+                        restart_id=rid)
+                    return jax.vmap(run_one, in_axes=(0, 0, 0, 0))(
+                        states, rids, lo_r, hi_r)
 
             bspec = batched_state_pspecs()
             scalar = P()

@@ -42,6 +42,13 @@ def _fmt_run_start(rec: dict) -> str:
         bits.append(f"target_k={rec['target_k']}")
     if rec.get("path"):
         bits.append(f"path={rec['path']}")
+    if rec.get("em_backend"):
+        # rev v1.5: which E-step backend actually ran; a fallback away
+        # from a requested kernel carries its reason.
+        b = f"backend={rec['em_backend']}"
+        if rec.get("em_backend") == "jnp" and rec.get("em_backend_reason"):
+            b += f" ({rec['em_backend_reason']})"
+        bits.append(b)
     if rec.get("mesh"):
         bits.append(f"mesh={rec['mesh']}")
     if rec.get("process_count", 1) and rec.get("process_count", 1) > 1:
@@ -201,12 +208,14 @@ def render_report(records: List[dict], max_trajectory_rows: int = 400) -> str:
                        int(hs.get("io_retries", 0))))
             else:
                 out.append("Health: clean (all flags zero)")
+        backend = (f"  [backend={s['em_backend']}]"
+                   if s.get("em_backend") else "")
         out.append(
             f"Best model: K={s.get('ideal_k')} "
             f"{s.get('criterion', 'score')}={s.get('score'):.6e} "
             f"loglik={s.get('final_loglik'):.6e} "
             f"({s.get('total_iters')} EM iterations, "
-            f"{s.get('wall_s'):.2f}s)")
+            f"{s.get('wall_s'):.2f}s){backend}")
         metrics = s.get("metrics") or {}
         counters = metrics.get("counters")
         if counters:

@@ -26,12 +26,18 @@ COMMON_FIELDS = ("event", "schema", "ts", "run_id", "process")
 # fields are documented for readers; unknown extras are always legal.
 EVENT_FIELDS: Dict[str, Tuple[Tuple[str, ...], Tuple[str, ...]]] = {
     # One per fit (per init when n_init > 1): the run's identity card.
+    # ``em_backend`` (stream rev v1.5) names the E-step/statistics backend
+    # that ACTUALLY ran -- pallas / pallas-interpret / jnp / custom -- and
+    # ``em_backend_reason`` why (resolve_estep_backend): a silent jnp
+    # fallback away from a requested kernel is observable in the stream,
+    # not indistinguishable from the kernel path.
     "run_start": (
         ("platform", "num_events", "num_dimensions", "start_k", "epsilon"),
         ("target_k", "process_count", "device_count", "local_device_count",
          "mesh", "path", "dtype", "chunk_size", "covariance_type",
          "criterion", "fused_sweep", "stream_events", "n_init", "init",
-         "restart_batch_size", "memory_stats"),
+         "restart_batch_size", "memory_stats", "em_backend",
+         "em_backend_reason"),
     ),
     # One per EM iteration of each K (host-driven sweeps; the fused
     # whole-sweep device program emits per-K records only).
@@ -132,10 +138,11 @@ EVENT_FIELDS: Dict[str, Tuple[Tuple[str, ...], Tuple[str, ...]]] = {
     # ``health`` (optional): the numerical-containment summary --
     # {flags, flag_names, fatal, counters, recoveries, io_retries};
     # all-zero flags on a clean run (docs/ROBUSTNESS.md).
+    # ``em_backend`` (optional, rev v1.5) mirrors run_start's.
     "run_summary": (
         ("ideal_k", "score", "criterion", "final_loglik", "total_iters",
          "wall_s", "phase_profile", "compile", "metrics"),
-        ("per_process", "memory_stats", "buckets", "health"),
+        ("per_process", "memory_stats", "buckets", "health", "em_backend"),
     ),
 }
 

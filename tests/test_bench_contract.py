@@ -205,6 +205,72 @@ def test_restart_ab_mode_contract():
         assert ab[side]["winner_init"] is not None
 
 
+def test_envelope_mode_contract():
+    """--envelope (GMM_BENCH_ENVELOPE=1) emits ONE JSON record with the
+    fused-vs-jnp walls AND parity for BOTH covariance families of the
+    K=512/D=32 reference envelope shape (CPU-shrunk here), the resolved
+    backend, and the accelerator_unavailable passthrough -- the same
+    contract style as --sweep/--restarts."""
+    r = _run({
+        "GMM_BENCH_CPU": "1",
+        "GMM_BENCH_ENVELOPE": "1",
+        "GMM_BENCH_ENVELOPE_N": "2048",
+        "GMM_BENCH_ENVELOPE_K": "8",
+        "GMM_BENCH_ENVELOPE_D": "4",
+        "GMM_BENCH_ENVELOPE_ITERS": "2",
+        "GMM_BENCH_ENVELOPE_BLOCK": "128",
+        "GMM_BENCH_CHUNK": "1024",
+    }, timeout=600)
+    assert r.returncode == 0, r.stderr
+    j = _json_line(r.stdout)
+    assert j["unit"] == "s" and j["value"] > 0
+    assert j["accelerator_unavailable"] is False
+    env = j["envelope"]
+    for fam in ("full", "diag"):
+        side = env[fam]
+        assert side["fused"]["wall_s"] > 0 and side["jnp"]["wall_s"] > 0
+        # off-TPU the kernel MUST report the interpret backend -- a CPU
+        # record can never masquerade as a Mosaic measurement
+        assert side["fused"]["backend"] == "pallas-interpret"
+        assert side["jnp"]["backend"] == "jnp"
+        # walls + parity in the SAME record
+        assert side["parity_ok"] is True
+        assert "bit_identical" in side
+    assert j["vs_baseline"] == env["full"]["speedup"]
+
+
+def test_probe_budget_fails_over_after_one_hang():
+    """Default probe budget: ONE attempt -- a hung probe fails over to
+    CPU immediately instead of burning the old 5 x 90s retry ladder
+    (BENCH_r05's ~7.5 wasted minutes). GMM_BENCH_PROBE_RETRIES adds
+    retries back, opt-in."""
+    import time
+
+    bench = _load_bench()
+    env_keys = ("GMM_BENCH_PROBE_ATTEMPTS", "GMM_BENCH_PROBE_RETRIES",
+                "GMM_BENCH_PROBE_WAIT", "GMM_BENCH_PROBE_WAIT_S",
+                "GMM_BENCH_PROBE_TIMEOUT_S")
+    saved = {k: os.environ.pop(k, None) for k in env_keys}
+    try:
+        os.environ["GMM_BENCH_PROBE_TIMEOUT_S"] = "0.01"
+        t0 = time.monotonic()
+        assert bench.probe_default_platform() is False
+        # one 10ms probe, no retry waits: far under the old ~450s floor
+        assert time.monotonic() - t0 < 30.0
+        # retries are opt-in and configurable
+        os.environ["GMM_BENCH_PROBE_RETRIES"] = "2"
+        os.environ["GMM_BENCH_PROBE_WAIT"] = "0.05"
+        t0 = time.monotonic()
+        assert bench.probe_default_platform() is False
+        assert time.monotonic() - t0 < 30.0
+    finally:
+        for k, v in saved.items():
+            if v is None:
+                os.environ.pop(k, None)
+            else:
+                os.environ[k] = v
+
+
 @pytest.mark.slow
 def test_deliberate_cpu_run_measures_with_rc0():
     """GMM_BENCH_CPU=1 is the deliberate-CPU contract: rc 0, a real

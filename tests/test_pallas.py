@@ -13,11 +13,15 @@ import pytest
 
 from cuda_gmm_mpi_tpu.config import GMMConfig
 from cuda_gmm_mpi_tpu.models.gmm import GMMModel, chunk_events
+from cuda_gmm_mpi_tpu.ops.constants import compute_constants
 from cuda_gmm_mpi_tpu.ops.formulas import convergence_epsilon
-from cuda_gmm_mpi_tpu.ops.mstep import accumulate_stats
-from cuda_gmm_mpi_tpu.ops.pallas import should_use_pallas
+from cuda_gmm_mpi_tpu.ops.mstep import accumulate_stats, apply_mstep
+from cuda_gmm_mpi_tpu.ops.pallas import (
+    resolve_estep_backend, should_use_pallas,
+)
 from cuda_gmm_mpi_tpu.ops.pallas.fused_stats import (
-    fused_stats_pallas, fused_stats_pallas_sharded,
+    fused_mstep_pallas, fused_stats_pallas, fused_stats_pallas_batched,
+    fused_stats_pallas_sharded,
 )
 from cuda_gmm_mpi_tpu.ops.seeding import seed_clusters_host
 
@@ -26,6 +30,8 @@ from .test_estep import make_state
 
 pallas_interp = functools.partial(fused_stats_pallas, block_b=64,
                                   interpret=True)
+pallas_batched_interp = functools.partial(fused_stats_pallas_batched,
+                                          block_b=64, interpret=True)
 
 
 def to_f32(state):
@@ -201,6 +207,309 @@ def test_fused_stats_manual_bf16_3x_matches_xla_high(rng):
                                rtol=5e-4, atol=5e-4)
     np.testing.assert_allclose(np.asarray(out.M2), np.asarray(exact.M2),
                                rtol=5e-4, atol=5e-3)
+
+
+# --------------------------------------------- batched (leading-R) kernel
+
+
+def _stack_states(*states):
+    return jax.tree_util.tree_map(
+        lambda *xs: jnp.stack([jnp.asarray(x) for x in xs]), *states)
+
+
+@pytest.mark.parametrize("diag", [False, True])
+@pytest.mark.parametrize("precision", ["highest", "high"])
+def test_batched_kernel_matches_unbatched(rng, diag, precision):
+    """The leading-R batched kernel is BIT-IDENTICAL per lane to the
+    unbatched kernel (same tile math, the grid just gains a restart
+    axis), across full/diag covariance, both supported precisions, and
+    lanes with masked (inactive) clusters."""
+    k, d, n, b = 5, 4, 256, 64
+    s0 = to_f32(make_state(rng, k, d))
+    s1 = to_f32(make_state(rng, k, d, inactive=(2, 4)))  # masked lanes
+    data = rng.normal(scale=2.0, size=(n, d)).astype(np.float32)
+    chunks = jnp.asarray(data.reshape(n // b, b, d))
+    wts_np = np.ones((n // b, b), np.float32)
+    wts_np[-1, 40:] = 0.0  # padded events
+    wts = jnp.asarray(wts_np)
+
+    out_b = pallas_batched_interp(_stack_states(s0, s1), chunks, wts,
+                                  diag_only=diag, precision=precision)
+    for r, s in enumerate((s0, s1)):
+        out_u = pallas_interp(s, chunks, wts, diag_only=diag,
+                              precision=precision)
+        for name in ("loglik", "Nk", "M1", "M2"):
+            np.testing.assert_array_equal(
+                np.asarray(getattr(out_b, name))[r],
+                np.asarray(getattr(out_u, name)), err_msg=name)
+    assert out_b.sanitized.shape == (2,)
+
+
+@pytest.mark.parametrize("diag", [False, True])
+def test_batched_kernel_matches_jnp(rng, diag):
+    """Batched kernel vs the jnp fused pass, per lane: same SuffStats to
+    f32 matmul-association tolerance (the two paths order the quadratic
+    form differently, so exact bit-equality is the batched-vs-unbatched
+    KERNEL contract above, not this one)."""
+    k, d, n, b = 5, 4, 256, 64
+    s0 = to_f32(make_state(rng, k, d))
+    s1 = to_f32(make_state(rng, k, d, inactive=(1,)))
+    data = rng.normal(scale=2.0, size=(n, d)).astype(np.float32)
+    chunks = jnp.asarray(data.reshape(n // b, b, d))
+    wts = jnp.ones((n // b, b), jnp.float32)
+
+    out = pallas_batched_interp(_stack_states(s0, s1), chunks, wts,
+                                diag_only=diag)
+    for r, s in enumerate((s0, s1)):
+        ref = accumulate_stats(s, chunks, wts, diag_only=diag,
+                               matmul_precision="highest")
+        np.testing.assert_allclose(float(out.loglik[r]), float(ref.loglik),
+                                   rtol=1e-5)
+        np.testing.assert_allclose(np.asarray(out.Nk)[r],
+                                   np.asarray(ref.Nk), rtol=1e-5, atol=1e-5)
+        np.testing.assert_allclose(np.asarray(out.M1)[r],
+                                   np.asarray(ref.M1), rtol=1e-4, atol=1e-4)
+        np.testing.assert_allclose(np.asarray(out.M2)[r],
+                                   np.asarray(ref.M2), rtol=1e-4, atol=1e-3)
+        # the health-relevant scalar matches exactly (structurally zero
+        # on both paths for finite inputs)
+        assert int(out.sanitized[r]) == int(ref.sanitized)
+
+
+def test_batched_kernel_lane_mask_freezes_lane(rng):
+    """The per-lane freeze-out mask folds into the event mask: a frozen
+    lane's every statistic (and loglik) is exactly zero while its
+    siblings' are bit-identical to an unmasked run."""
+    k, d, n, b = 4, 3, 128, 64
+    s0 = to_f32(make_state(rng, k, d))
+    s1 = to_f32(make_state(rng, k, d))
+    states = _stack_states(s0, s1)
+    data = rng.normal(size=(n, d)).astype(np.float32)
+    chunks = jnp.asarray(data.reshape(n // b, b, d))
+    wts = jnp.ones((n // b, b), jnp.float32)
+
+    full = pallas_batched_interp(states, chunks, wts)
+    masked = pallas_batched_interp(states, chunks, wts,
+                                   lane_mask=jnp.asarray([0.0, 1.0]))
+    for name in ("loglik", "Nk", "M1", "M2"):
+        a = np.asarray(getattr(masked, name))
+        assert np.all(a[0] == 0.0), name
+        np.testing.assert_array_equal(a[1],
+                                      np.asarray(getattr(full, name))[1],
+                                      err_msg=name)
+
+
+# ----------------------------------------------- fused M-step epilogue
+
+
+@pytest.mark.parametrize("diag", [False, True])
+def test_fused_mstep_matches_apply_mstep(rng, diag):
+    """Kernel epilogue + constants == jitted apply_mstep, BIT-IDENTICAL
+    (same expressions through the same XLA ops in interpret mode),
+    including the empty-cluster guards: one empty lane (Nk=0), one in
+    the (0.5, 1) dead zone, and a nonzero variance floor."""
+    k, d, n, b = 5, 4, 256, 64
+    state = to_f32(make_state(rng, k, d)).replace(
+        avgvar=jnp.asarray(rng.uniform(0.01, 0.1, size=(k,)), jnp.float32))
+    data = rng.normal(scale=2.0, size=(n, d)).astype(np.float32)
+    chunks = jnp.asarray(data.reshape(n // b, b, d))
+    wts = jnp.ones((n // b, b), jnp.float32)
+    stats = accumulate_stats(state, chunks, wts, diag_only=diag,
+                             matmul_precision="highest")
+    # Force the guard branches: lane 3 empty, lane 4 in the dead zone.
+    stats = dataclasses_replace_stats(stats, Nk=stats.Nk.at[3].set(0.0)
+                                      .at[4].set(0.7))
+
+    s_ref = jax.jit(functools.partial(apply_mstep, diag_only=diag))(
+        state, stats)
+    s_ker = jax.jit(functools.partial(
+        lambda s, st: compute_constants(
+            fused_mstep_pallas(s, st, diag_only=diag, interpret=True),
+            diag_only=diag)))(state, stats)
+    for name in ("N", "means", "R", "Rinv", "constant", "pi"):
+        np.testing.assert_array_equal(np.asarray(getattr(s_ker, name)),
+                                      np.asarray(getattr(s_ref, name)),
+                                      err_msg=name)
+
+
+def dataclasses_replace_stats(stats, **kw):
+    import dataclasses
+
+    return dataclasses.replace(stats, **kw)
+
+
+# ------------------------------------- batched EM loop on the kernel path
+
+
+def _pallas_cfg(**kw):
+    base = dict(min_iters=4, max_iters=4, chunk_size=128,
+                pallas_block_b=64, dtype="float32")
+    base.update(kw)
+    return GMMConfig(estep_backend="pallas", **base)
+
+
+@pytest.mark.parametrize("diag", [False, True])
+def test_em_batched_pallas_matches_unbatched_pallas(rng, diag):
+    """run_em_batched on the kernel path (em_while_loop_batched: one
+    batched kernel round-trip per iteration) is BIT-IDENTICAL per lane
+    to run_em on the unbatched kernel path -- the drivers must not be
+    able to tell the two loops apart except by speed."""
+    data, _ = make_blobs(rng, n=512, d=3, k=3, dtype=np.float32)
+    m = GMMModel(_pallas_cfg(diag_only=diag))
+    assert m.batched_stats_fn is not None  # kernel path actually selected
+    chunks, wts = map(jnp.asarray, chunk_events(data, 128))
+    eps = convergence_epsilon(*data.shape)
+    s0 = seed_clusters_host(data, 3)
+    s1 = seed_clusters_host(data[::-1].copy(), 3)
+    batched = _stack_states(s0, s1)
+    out_b, ll_b, it_b = m.run_em_batched(batched, chunks, wts, eps)
+    h_b = np.asarray(jax.device_get(m.last_health))
+    assert h_b.shape[0] == 2
+    for r, s in enumerate((s0, s1)):
+        s_u, ll_u, it_u = m.run_em(s, chunks, wts, eps)
+        h_u = np.asarray(jax.device_get(m.last_health))
+        assert int(it_u) == int(np.asarray(it_b)[r])
+        np.testing.assert_array_equal(np.asarray(ll_b)[r], np.asarray(ll_u))
+        np.testing.assert_array_equal(np.asarray(out_b.means)[r],
+                                      np.asarray(s_u.means))
+        np.testing.assert_array_equal(np.asarray(out_b.R)[r],
+                                      np.asarray(s_u.R))
+        # health flags: per-lane rows equal the solo runs' exactly
+        np.testing.assert_array_equal(h_b[r], h_u)
+
+
+def test_em_batched_pallas_matches_jnp_loop(rng):
+    """Kernel-path batched EM vs the vmapped jnp batched EM: same model
+    to f32 tolerance, same iteration counts, same (clean) health rows."""
+    data, _ = make_blobs(rng, n=512, d=3, k=3, dtype=np.float32)
+    chunks, wts = map(jnp.asarray, chunk_events(data, 128))
+    eps = convergence_epsilon(*data.shape)
+    batched = _stack_states(seed_clusters_host(data, 3),
+                            seed_clusters_host(data[::-1].copy(), 3))
+    m_pal = GMMModel(_pallas_cfg())
+    m_jnp = GMMModel(GMMConfig(estep_backend="jnp", min_iters=4,
+                               max_iters=4, chunk_size=128,
+                               dtype="float32"))
+    out_p, ll_p, it_p = m_pal.run_em_batched(batched, chunks, wts, eps)
+    h_p = np.asarray(jax.device_get(m_pal.last_health))
+    out_j, ll_j, it_j = m_jnp.run_em_batched(batched, chunks, wts, eps)
+    h_j = np.asarray(jax.device_get(m_jnp.last_health))
+    np.testing.assert_array_equal(np.asarray(it_p), np.asarray(it_j))
+    np.testing.assert_allclose(np.asarray(ll_p), np.asarray(ll_j),
+                               rtol=1e-4)
+    np.testing.assert_allclose(np.asarray(out_p.means),
+                               np.asarray(out_j.means),
+                               rtol=1e-3, atol=1e-3)
+    np.testing.assert_array_equal(h_p, h_j)
+
+
+def test_em_batched_pallas_freeze_out(rng):
+    """max_iters=0 lanes pass through bit-identically on the kernel path
+    (the explicit loop's masked freeze-out == the vmapped loop's)."""
+    data, _ = make_blobs(rng, n=256, d=3, k=2, dtype=np.float32)
+    m = GMMModel(_pallas_cfg(min_iters=1, max_iters=8))
+    chunks, wts = map(jnp.asarray, chunk_events(data, 128))
+    eps = convergence_epsilon(*data.shape)
+    s0 = seed_clusters_host(data, 2)
+    batched = _stack_states(s0, s0)
+    out, ll, it = m.run_em_batched(batched, chunks, wts, eps,
+                                   max_iters=np.asarray([0, 8], np.int32))
+    it = np.asarray(it)
+    assert it[0] == 0 and it[1] >= 1
+    np.testing.assert_array_equal(np.asarray(out.means)[0],
+                                  np.asarray(jnp.asarray(s0.means)))
+
+
+def test_r_bucket_pads_and_slices(rng):
+    """run_em_batched(r_bucket=4) on an R=2 batch returns R=2 outputs
+    whose live lanes ran the same iteration counts, and reuses the
+    4-lane executable for both shapes (one trace)."""
+    data, _ = make_blobs(rng, n=256, d=3, k=2, dtype=np.float32)
+    m = GMMModel(_pallas_cfg())
+    chunks, wts = map(jnp.asarray, chunk_events(data, 128))
+    eps = convergence_epsilon(*data.shape)
+    lanes4 = [seed_clusters_host(np.roll(data, i, 0), 2) for i in range(4)]
+    b4 = _stack_states(*lanes4)
+    b2 = _stack_states(*lanes4[:2])
+    out4, ll4, it4 = m.run_em_batched(b4, chunks, wts, eps, r_bucket=4)
+    out2, ll2, it2 = m.run_em_batched(b2, chunks, wts, eps, r_bucket=4)
+    assert np.asarray(ll2).shape == (2,)
+    assert np.asarray(jax.device_get(m.last_health)).shape[0] == 2
+    np.testing.assert_array_equal(np.asarray(it2), np.asarray(it4)[:2])
+    np.testing.assert_array_equal(np.asarray(ll2), np.asarray(ll4)[:2])
+    key = ("batched", 0, False)
+    fn = m._em_exec_cache[key]
+    assert fn._cache_size() == 1  # both calls served by ONE trace
+
+
+# ----------------------------------------------------- backend routing
+
+
+def test_resolve_estep_backend():
+    # 'auto' and legacy 'never' route to jnp with a reason
+    b, why = resolve_estep_backend(GMMConfig())
+    assert b == "jnp" and why
+    b, _ = resolve_estep_backend(GMMConfig(use_pallas="never"))
+    assert b == "jnp"
+    # explicit kernel request off-TPU resolves to interpret mode
+    b, why = resolve_estep_backend(GMMConfig(estep_backend="pallas"))
+    assert b == "pallas-interpret" and "interpret" in why
+    # structural fallbacks carry their cause
+    b, why = resolve_estep_backend(
+        GMMConfig(estep_backend="pallas", dtype="float64"))
+    assert b == "jnp" and "float32" in why
+    b, why = resolve_estep_backend(
+        GMMConfig(estep_backend="pallas"), cluster_sharded=True)
+    assert b == "jnp" and "cluster-sharded" in why
+    b, _ = resolve_estep_backend(
+        GMMConfig(estep_backend="pallas", diag_only=True),
+        cluster_sharded=True)
+    assert b == "pallas-interpret"
+
+
+def test_estep_backend_use_pallas_coherence():
+    # the two spellings are one setting
+    assert GMMConfig(use_pallas="always").estep_backend == "pallas"
+    assert GMMConfig(use_pallas="never").estep_backend == "jnp"
+    assert GMMConfig(estep_backend="pallas").use_pallas == "always"
+    assert GMMConfig(estep_backend="jnp").use_pallas == "never"
+    with pytest.raises(ValueError, match="contradicts"):
+        GMMConfig(estep_backend="pallas", use_pallas="never")
+    with pytest.raises(ValueError, match="contradicts"):
+        GMMConfig(estep_backend="jnp", use_pallas="always")
+    with pytest.raises(ValueError, match="estep_backend"):
+        GMMConfig(estep_backend="sometimes")
+    # kernel + streaming stays rejected through the new spelling too
+    with pytest.raises(ValueError, match="use_pallas"):
+        GMMConfig(estep_backend="pallas", stream_events=True)
+
+
+def test_em_backend_in_telemetry_stream(rng, tmp_path):
+    """run_start/run_summary carry which backend ACTUALLY ran (a silent
+    fallback is observable), and the stream stays schema-valid."""
+    from cuda_gmm_mpi_tpu.models.order_search import fit_gmm
+    from cuda_gmm_mpi_tpu.telemetry import read_stream
+    from cuda_gmm_mpi_tpu.telemetry.schema import validate_stream
+
+    data, _ = make_blobs(rng, n=256, d=3, k=2, dtype=np.float32)
+    kw = dict(min_iters=2, max_iters=2, chunk_size=64, pallas_block_b=64,
+              dtype="float32")
+    mf = str(tmp_path / "pal.jsonl")
+    fit_gmm(data, 2, 2, GMMConfig(estep_backend="pallas",
+                                  metrics_file=mf, **kw))
+    recs = read_stream(mf)
+    assert not validate_stream(recs)
+    starts = [r for r in recs if r["event"] == "run_start"]
+    summaries = [r for r in recs if r["event"] == "run_summary"]
+    assert starts and starts[0]["em_backend"] == "pallas-interpret"
+    assert summaries and summaries[0]["em_backend"] == "pallas-interpret"
+
+    mf2 = str(tmp_path / "jnp.jsonl")
+    fit_gmm(data, 2, 2, GMMConfig(metrics_file=mf2, **kw))
+    recs2 = read_stream(mf2)
+    s2 = [r for r in recs2 if r["event"] == "run_start"][0]
+    assert s2["em_backend"] == "jnp"
+    assert s2["em_backend_reason"]  # the fallback reason rides along
 
 
 sharded_interp = functools.partial(
