@@ -41,6 +41,14 @@ full covariance. CPU fallback runs the kernel in interpret mode
 (correctness, not speed) and is tagged ``accelerator_unavailable``.
 Size knobs: GMM_BENCH_ENVELOPE_{N,D,K,ITERS,BLOCK} (run_envelope_bench).
 
+Serve mode (``--serve`` or GMM_BENCH_SERVE=1): cold-vs-warm A/B of the
+serving subsystem -- fit a small mixture, export it to a temp registry,
+drive the in-process micro-batched server: the cold first request
+(registry load + AOT compile) vs the steady state (>= 100 varying-N
+requests after one warm-up per N-bucket), with the zero-recompile proof
+bit in the record; ``vs_baseline`` is cold / warm-p50. Size knobs:
+GMM_BENCH_SERVE_{N,D,K,REQUESTS} (run_serve_bench).
+
 Env knobs: GMM_BENCH_CPU=1 (deliberate CPU run, rc 0); GMM_BENCH_PRECISION
 (matmul precision override); GMM_BENCH_PRECOMPUTE=1/0 (feature-hoist A/B,
 full-covariance in-memory configs; defaults ON for CPU runs -- the NumPy
@@ -567,6 +575,123 @@ def run_envelope_bench(platform: str, accel_unavailable: bool) -> dict:
     return result
 
 
+def run_serve_bench(platform: str, accel_unavailable: bool) -> dict:
+    """The --serve mode: cold-vs-warm A/B of the serving subsystem.
+
+    Fits a small mixture, exports it to a temporary model registry, and
+    drives the in-process ``GMMServer`` (serving/server.py) with scoring
+    requests of VARYING row counts:
+
+      cold   the first request against an unwarmed server -- pays model
+             load + AOT lower/compile of its (N-bucket, K-bucket)
+             executable;
+      warm   after one warm-up request per N-bucket, >= 100 requests
+             whose row counts vary within the warmed buckets -- the
+             steady state, where the zero-recompile contract says no
+             request may trace or compile.
+
+    ONE JSON record carries the cold first-request wall, the warm p50 /
+    p99 / QPS, and the executor's compile counters before/after the warm
+    phase (``zero_recompile_after_warm`` is the proof bit);
+    ``vs_baseline`` is cold / warm-p50 -- what AOT caching saves every
+    request after the first. Size knobs: GMM_BENCH_SERVE_{N,D,K,REQUESTS}
+    (train rows, dims, clusters, warm request count).
+    """
+    on_accel = platform not in ("cpu",)
+    k = int(os.environ.get("GMM_BENCH_SERVE_K") or (64 if on_accel else 8))
+    n = int(os.environ.get("GMM_BENCH_SERVE_N")
+            or (200_000 if on_accel else 4_000))
+    d = int(os.environ.get("GMM_BENCH_SERVE_D") or (16 if on_accel else 4))
+    n_requests = int(os.environ.get("GMM_BENCH_SERVE_REQUESTS") or 120)
+
+    import tempfile
+
+    from cuda_gmm_mpi_tpu.config import GMMConfig
+    from cuda_gmm_mpi_tpu.estimator import GaussianMixture
+    from cuda_gmm_mpi_tpu.serving import (GMMServer, ModelRegistry,
+                                          ScoringExecutor)
+
+    rng = np.random.default_rng(42)
+    centers = rng.normal(scale=8.0, size=(k, d))
+    data = (centers[rng.integers(0, k, n)]
+            + rng.normal(scale=1.0, size=(n, d))).astype(np.float32)
+    gm = GaussianMixture(
+        k, target_components=k,
+        config=GMMConfig(min_iters=5, max_iters=5,
+                         chunk_size=min(65536, n)))
+    gm.fit(data)
+
+    with tempfile.TemporaryDirectory() as root:
+        registry = ModelRegistry(root)
+        gm.to_registry(registry, "bench")
+        # A dedicated executor (not the process-shared one the fit above
+        # may have warmed) so the cold number really is cold.
+        executor = ScoringExecutor(min_block=256, max_block=4096)
+        server = GMMServer(registry, executor=executor, warm=False)
+
+        def request(i, rows):
+            lo = rng.integers(0, n - rows)
+            return {"id": int(i), "model": "bench", "op": "score_samples",
+                    "x": data[lo:lo + rows].tolist()}
+
+        # Cold: first request ever -- registry load + AOT compile + run.
+        t0 = time.perf_counter()
+        resp = server.handle_requests([request(0, 100)])[0]
+        cold_s = time.perf_counter() - t0
+        assert resp["ok"], resp
+        # Warm-up: one request per N-bucket the warm phase will hit.
+        sizes = [64, 100, 180, 250, 400, 900]
+        for i, rows in enumerate(sizes):
+            server.handle_requests([request(1000 + i, rows)])
+        compiles_before = executor.compile_count
+        lat = []
+        t0 = time.perf_counter()
+        for i in range(n_requests):
+            rows = sizes[i % len(sizes)] + int(rng.integers(-30, 30))
+            t1 = time.perf_counter()
+            resp = server.handle_requests([request(i, max(rows, 2))])[0]
+            lat.append(time.perf_counter() - t1)
+            assert resp["ok"], resp
+        warm_wall = time.perf_counter() - t0
+        new_compiles = executor.compile_count - compiles_before
+        lat = np.asarray(lat)
+        p50 = float(np.percentile(lat, 50))
+        p99 = float(np.percentile(lat, 99))
+
+    result = {
+        "metric": f"gmm serve warm p50 latency (K={k}, D={d}, {platform})",
+        "value": round(p50, 6),
+        "unit": "s",
+        # Cold / warm-p50: what the AOT executable cache saves every
+        # request after the first (NOT the NumPy baseline).
+        "vs_baseline": round(cold_s / max(p50, 1e-9), 3),
+        "accelerator_unavailable": accel_unavailable,
+        "serve": {
+            "train_n": n, "d": d, "k": k, "requests": n_requests,
+            "cold_first_request_s": round(cold_s, 6),
+            "warm": {
+                "p50_s": round(p50, 6),
+                "p99_s": round(p99, 6),
+                "mean_s": round(float(lat.mean()), 6),
+                "qps": round(n_requests / warm_wall, 2),
+            },
+            # The acceptance bit: after one warm-up per (model,
+            # N-bucket), steady-state traffic with varying N performed
+            # ZERO new traces/compiles.
+            "new_compiles_after_warm": int(new_compiles),
+            "zero_recompile_after_warm": bool(new_compiles == 0),
+            "warm_p50_lt_cold": bool(p50 < cold_s),
+            "executor": executor.stats(),
+        },
+        "measured_utc": time.strftime("%Y-%m-%dT%H:%M:%SZ", time.gmtime()),
+    }
+    if accel_unavailable:
+        result["platform_note"] = (
+            "accelerator tunnel unavailable (probe failed); this is a "
+            "CPU-fallback measurement of the serving path")
+    return result
+
+
 CONFIGS = {
     # BASELINE.md benchmark config matrix (1-5); "north" = the north-star;
     # 6 = the reference's first-class envelope (MAX_CLUSTERS=512,
@@ -596,6 +721,8 @@ def main() -> int:
                      or bool(os.environ.get("GMM_BENCH_RESTARTS")))
     want_envelope = ("--envelope" in sys.argv[1:]
                      or os.environ.get("GMM_BENCH_ENVELOPE") == "1")
+    want_serve = ("--serve" in sys.argv[1:]
+                  or os.environ.get("GMM_BENCH_SERVE") == "1")
     spec = CONFIGS.get(cfg_name)
     if spec is None:
         print(
@@ -694,6 +821,14 @@ def main() -> int:
         # Fused-kernel-vs-jnp A/B on the K=512/D=32 reference envelope
         # (ignores --config; sized by GMM_BENCH_ENVELOPE_*).
         result = run_envelope_bench(platform, accel_unavailable)
+        watchdog.cancel()
+        print(json.dumps(result))
+        return 3 if accel_unavailable else 0
+
+    if want_serve:
+        # Serving cold-vs-warm A/B over the AOT executable cache
+        # (ignores --config; sized by GMM_BENCH_SERVE_*).
+        result = run_serve_bench(platform, accel_unavailable)
         watchdog.cancel()
         print(json.dumps(result))
         return 3 if accel_unavailable else 0

@@ -24,9 +24,12 @@ def build_parser() -> argparse.ArgumentParser:
         prog="gmm",
         description="TPU-native GMM-EM clustering with Rissanen model-order "
         "search (capabilities of CUDA-GMM-MPI's gaussianMPI).",
-        epilog="Subcommand: `gmm report FILE.jsonl` renders a "
+        epilog="Subcommands: `gmm report FILE.jsonl` renders a "
         "--metrics-file telemetry stream (phase profile, loglik "
-        "trajectory, sweep summary) offline.",
+        "trajectory, sweep summary) offline; `gmm export` persists a "
+        "fitted model (sweep checkpoint or .summary) into a serving "
+        "registry; `gmm serve` runs the micro-batched scoring loop over "
+        "a registry (JSONL protocol; docs/SERVING.md).",
     )
     from ._version import __version__
 
@@ -258,6 +261,18 @@ def main(argv=None) -> int:
         from .telemetry import report_main
 
         return report_main(argv[1:])
+    if argv and argv[0] == "export":
+        # `gmm export`: persist a model (sweep checkpoint / .summary)
+        # into a serving registry (docs/SERVING.md).
+        from .serving.registry import export_main
+
+        return export_main(argv[1:])
+    if argv and argv[0] == "serve":
+        # `gmm serve`: the micro-batched scoring loop over a registry
+        # (JSONL protocol on stdin/socket; docs/SERVING.md).
+        from .serving.server import serve_main
+
+        return serve_main(argv[1:])
     args = build_parser().parse_args(argv)
 
     # Platform must be pinned before JAX initializes its backends. Set the env

@@ -230,6 +230,61 @@ class GaussianMixture:
         gm._model = GMMModel(gm.config)
         return gm
 
+    # -- serving registry round-trip (docs/SERVING.md) -------------------
+
+    def to_registry(self, registry, name: str, *, version=None,
+                    run_id=None) -> int:
+        """Persist this fitted estimator into a serving model registry.
+
+        ``registry`` is a :class:`~cuda_gmm_mpi_tpu.serving.ModelRegistry`
+        or a root directory path. Unlike the 3-decimal ``.summary``
+        format, the artifact stores the exact state leaves, so a model
+        re-hydrated via :meth:`from_registry` (or served by ``gmm
+        serve``) scores bit-identically to this in-memory estimator.
+        Returns the assigned version.
+        """
+        from .serving.registry import ModelRegistry
+
+        if not isinstance(registry, ModelRegistry):
+            registry = ModelRegistry(registry)
+        return registry.save(name, self._fitted, config=self.config,
+                             run_id=run_id, version=version)
+
+    @classmethod
+    def from_registry(cls, registry, name: str, version=None,
+                      ) -> "GaussianMixture":
+        """Rebuild a fitted estimator from a serving-registry artifact
+        (exact round-trip; the manifest supplies dtype and covariance
+        family)."""
+        from .serving.registry import ModelRegistry
+
+        if not isinstance(registry, ModelRegistry):
+            registry = ModelRegistry(registry)
+        m = registry.load(name, version)
+        gm = cls(m.k, target_components=m.k,
+                 config=GMMConfig(dtype=m.dtype,
+                                  covariance_type=m.covariance_type))
+        import jax
+
+        if m.dtype == "float64" and not jax.config.jax_enable_x64:
+            raise ValueError(
+                "dtype='float64' needs jax_enable_x64; set "
+                "jax.config.update('jax_enable_x64', True) at startup")
+        gm.result_ = GMMResult(
+            state=m.state,
+            ideal_num_clusters=m.k,
+            min_rissanen=(float("nan") if m.manifest.get("score") is None
+                          else float(m.manifest["score"])),
+            final_loglik=(float("nan") if m.manifest.get("loglik") is None
+                          else float(m.manifest["loglik"])),
+            epsilon=float("nan"),
+            num_events=int(m.manifest.get("num_events", 0)),
+            num_dimensions=m.d,
+            data_shift=np.asarray(m.data_shift, np.float64),
+        )
+        gm._model = GMMModel(gm.config)
+        return gm
+
     @property
     def _fitted(self) -> GMMResult:
         if self.result_ is None:
@@ -277,7 +332,18 @@ class GaussianMixture:
     # -- inference --------------------------------------------------------
 
     def _posteriors_and_evidence(self, X: np.ndarray):
-        """(w [N, K], logZ [N]) for arbitrary data under the fitted model."""
+        """(w [N, K], logZ [N]) for arbitrary data under the fitted model.
+
+        Single-device fits route through the serving executor
+        (serving/executor.py): AOT-compiled scoring programs cached per
+        (N-bucket, K-bucket, D), so repeated calls with VARYING row
+        counts reuse one compiled executable per pow2 bucket instead of
+        retracing per distinct N (the pre-serving behavior -- jit keys
+        on exact shapes, so every new N paid a full trace+compile).
+        Sharded and streaming fits keep the model's own chunked
+        ``memberships`` pass (the executor is a one-device program; a
+        mesh fit's posterior pass spans all local devices).
+        """
         from .validation import validate_finite
 
         res = self._fitted
@@ -288,6 +354,15 @@ class GaussianMixture:
             # a clear message instead of silently emitting NaN posteriors.
             validate_finite(X)
         X = X - res.data_shift[None, :].astype(dtype)
+        if (getattr(self._model, "mesh", None) is None
+                and not self.config.stream_events):
+            from .serving.executor import executor_for_config
+
+            w, logz = executor_for_config(self.config).infer(
+                res.state, X, want="proba")
+            # The executor pads K to its pow2 bucket; inactive pad slots
+            # carry exactly-zero responsibility -- slice them off.
+            return w[:, :res.state.num_clusters_padded], logz
         chunks, _ = chunk_events(X, self.config.chunk_size)
         # Host chunks passed through: each model places its own blocks (the
         # sharded model puts them per-shard; an eager jnp.asarray here would

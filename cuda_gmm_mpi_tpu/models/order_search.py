@@ -767,6 +767,11 @@ def _fit_gmm(data, num_clusters, target_num_clusters, config, model,
                         "criterion_code": _CRITERION_CODE[config.criterion],
                         "cov_code": _COV_CODE[config.covariance_type],
                         "sweep_log": np.asarray(sweep_log, np.float64),
+                        # The fit-time centering shift rides every
+                        # checkpoint so `gmm export --checkpoint` can
+                        # rebuild original-coordinate scoring
+                        # (serving/registry.py).
+                        "data_shift": np.asarray(shift, np.float64),
                     }
                     payload.update(stop_extra)
                     _shutdown_and_raise(sup, rec, log, ckpt, step=step,
@@ -971,6 +976,9 @@ def _fit_gmm(data, num_clusters, target_num_clusters, config, model,
                     "criterion_code": _CRITERION_CODE[config.criterion],
                     "cov_code": _COV_CODE[config.covariance_type],
                     "sweep_log": np.asarray(sweep_log, np.float64),
+                    # Centering shift for `gmm export --checkpoint`
+                    # (serving/registry.py).
+                    "data_shift": np.asarray(shift, np.float64),
                 })
         step += 1
 
@@ -1479,6 +1487,9 @@ def _run_fused_sweep(fused, config, state, chunks, wts, epsilon,
                 "num_clusters": int(num_clusters),
                 "criterion_code": _CRITERION_CODE[config.criterion],
                 "cov_code": _COV_CODE[config.covariance_type],
+                # Centering shift for `gmm export --checkpoint`
+                # (serving/registry.py).
+                "data_shift": np.asarray(shift, np.float64),
             })
             sup = supervisor.current()
             if sup.active and sup.stop_requested:

@@ -1,0 +1,20 @@
+"""Serving subsystem: registry, AOT scoring executables, micro-batching.
+
+The inference half of the production story (docs/SERVING.md): the
+training side fits mixtures; this package persists them as versioned
+artifacts (:mod:`.registry`), compiles bucketed ahead-of-time scoring
+executables so a warm request never traces or recompiles
+(:mod:`.executor`), and serves coalesced micro-batched request traffic
+per model (:mod:`.server`, the ``gmm serve`` CLI).
+"""
+
+from .executor import (ScoringExecutor, executor_for_config,
+                       executor_for_model, pow2_bucket)
+from .registry import ModelRegistry, RegistryError, ServedModel
+from .server import GMMServer, serve_main
+
+__all__ = [
+    "GMMServer", "ModelRegistry", "RegistryError", "ScoringExecutor",
+    "ServedModel", "executor_for_config", "executor_for_model",
+    "pow2_bucket", "serve_main",
+]

@@ -1,0 +1,340 @@
+"""AOT-compiled scoring executables with a bucketed LRU cache.
+
+The latency problem this solves: ``jax.jit`` keys its executable cache on
+input SHAPES, so a scoring service whose requests vary in row count N
+retraces-and-recompiles on every new N -- tens of milliseconds to seconds
+of tracing in front of a sub-millisecond posterior pass, on EVERY
+distinct batch size. This module makes both halves of that cost
+front-loadable and bounded:
+
+- **Bucketing** (the PR-2 pow2 policy, ``state.bucket_width``, applied to
+  the EVENT axis): a request of N rows is padded up to the smallest
+  power-of-two block >= N (clamped to [min_block, max_block]; larger
+  requests split into max_block slices), and the model's K axis is padded
+  to its pow2 bucket with algebraically inert inactive slots
+  (``parallel.sharded_em.pad_state_clusters``). The executable universe
+  is therefore (kinds x log2 blocks x log2 K-buckets) -- small, and
+  *independent of traffic*.
+- **AOT compilation**: each bucket's executable is built ONCE via
+  ``jit(...).lower(shapes).compile()`` -- explicit ahead-of-time
+  lowering, so a warmed bucket can never trace or compile again, and a
+  cold server can pre-compile its buckets before taking traffic
+  (:meth:`ScoringExecutor.warmup`).
+- **Donation**: the padded request block is donated to the executable
+  (``donate_argnums``), so the scoring pass reuses the input buffer in
+  place instead of allocating a second [B, D] block per request.
+- **LRU bound**: at most ``max_executables`` live compiled programs;
+  least-recently-used ones are dropped (and re-compiled on next use --
+  counted, so an undersized cache is observable, not silent).
+
+Hit/miss/compile/eviction counters are plain attributes; the serving
+loop folds them into the telemetry stream and the warm-path
+zero-recompile tests assert on ``compile_count`` directly.
+"""
+
+from __future__ import annotations
+
+import collections
+import functools
+from typing import Dict, Optional, Tuple
+
+import numpy as np
+
+from ..state import GMMState
+
+# Executable kinds: 'proba' returns (responsibilities [B, K], logZ [B]);
+# 'assign' returns (argmax labels int32 [B], logZ [B]) -- the hard-
+# assignment path never transfers the [B, K] posterior block.
+KINDS = ("proba", "assign")
+
+
+def pow2_bucket(n: int, lo: int = 1, hi: Optional[int] = None) -> int:
+    """Smallest power of two >= ``n``, clamped to [lo, hi].
+
+    The event-axis spelling of the sweep's ``state.bucket_width`` pow2
+    policy: both bound the distinct compiled shapes to one per octave.
+    ``hi`` callers split/pad beyond the cap themselves.
+    """
+    b = 1 << max(0, int(n) - 1).bit_length()
+    b = max(b, int(lo))
+    if hi is not None:
+        b = min(b, int(hi))
+    return b
+
+
+class ScoringExecutor:
+    """Bucketed AOT executable cache for predict/score under one numeric
+    family (dtype x covariance structure x quad layout x precision).
+
+    One executor serves any number of models sharing the family: the
+    compiled programs are keyed by (kind, block, K-bucket, D), so two
+    16-cluster models of the same D share every executable.
+    """
+
+    def __init__(self, *, dtype: str = "float32", diag_only: bool = False,
+                 quad_mode: str = "expanded",
+                 matmul_precision: str = "highest",
+                 min_block: int = 256, max_block: int = 65536,
+                 max_executables: int = 32):
+        if min_block < 1 or max_block < min_block:
+            raise ValueError(
+                f"need 1 <= min_block <= max_block, got "
+                f"{min_block}/{max_block}")
+        if max_executables < 1:
+            raise ValueError("max_executables must be >= 1")
+        self._dtype = np.dtype(dtype)
+        self._diag_only = bool(diag_only)
+        self._quad_mode = quad_mode
+        self._precision = matmul_precision
+        self._min_block = int(min_block)
+        self._max_block = int(max_block)
+        self._max_execs = int(max_executables)
+        # key -> compiled executable, LRU order (oldest first).
+        self._cache: "collections.OrderedDict[tuple, object]" = \
+            collections.OrderedDict()
+        # (id(state), k_bucket) -> (state ref, padded+cast state). The
+        # strong state ref pins the id against recycling; bounded LRU.
+        self._state_memo: "collections.OrderedDict[tuple, tuple]" = \
+            collections.OrderedDict()
+        self.hits = 0
+        self.misses = 0
+        self.compiles = 0
+        self.evictions = 0
+
+    # -- observability ---------------------------------------------------
+
+    @property
+    def compile_count(self) -> int:
+        """Total AOT compilations so far (the zero-recompile assertion
+        target: warm traffic must not move this)."""
+        return self.compiles
+
+    @property
+    def cache_size(self) -> int:
+        return len(self._cache)
+
+    def stats(self) -> Dict[str, int]:
+        return {"hits": self.hits, "misses": self.misses,
+                "compiles": self.compiles, "evictions": self.evictions,
+                "live_executables": len(self._cache)}
+
+    def cached_keys(self) -> Tuple[tuple, ...]:
+        return tuple(self._cache.keys())
+
+    # -- bucketing -------------------------------------------------------
+
+    def block_for(self, n: int) -> int:
+        """The padded block size an ``n``-row slice dispatches at."""
+        return pow2_bucket(n, lo=self._min_block, hi=self._max_block)
+
+    def blocks_for(self, n: int):
+        """(start, length, block) slices covering an N-row request."""
+        out = []
+        start = 0
+        while start < n:
+            m = min(n - start, self._max_block)
+            out.append((start, m, self.block_for(m)))
+            start += m
+        return out or [(0, 0, self._min_block)]
+
+    def padded_rows(self, n: int) -> int:
+        """Total dispatched rows for an N-row request (telemetry)."""
+        return sum(b for _, _, b in self.blocks_for(n)) if n else 0
+
+    # -- state preparation ----------------------------------------------
+
+    def prepared_state(self, state: GMMState) -> GMMState:
+        """``state`` cast to the executor dtype and K-padded to its pow2
+        bucket with inert inactive slots; memoized per state object."""
+        kb = pow2_bucket(state.num_clusters_padded)
+        key = (id(state), kb)
+        hit = self._state_memo.get(key)
+        if hit is not None and hit[0] is state:
+            self._state_memo.move_to_end(key)
+            return hit[1]
+        import jax.numpy as jnp
+
+        from ..parallel.sharded_em import pad_state_clusters
+
+        dt = jnp.dtype(self._dtype)
+        cast = state.replace(
+            N=jnp.asarray(state.N, dt), pi=jnp.asarray(state.pi, dt),
+            constant=jnp.asarray(state.constant, dt),
+            avgvar=jnp.asarray(state.avgvar, dt),
+            means=jnp.asarray(state.means, dt),
+            R=jnp.asarray(state.R, dt), Rinv=jnp.asarray(state.Rinv, dt),
+            active=jnp.asarray(state.active, bool))
+        padded = pad_state_clusters(cast, kb)
+        self._state_memo[key] = (state, padded)
+        while len(self._state_memo) > 8:
+            self._state_memo.popitem(last=False)
+        return padded
+
+    # -- executables -----------------------------------------------------
+
+    def _executable(self, kind: str, block: int, kb: int, d: int):
+        key = (kind, block, kb, d)
+        fn = self._cache.get(key)
+        if fn is not None:
+            self.hits += 1
+            self._cache.move_to_end(key)
+            return fn
+        self.misses += 1
+        fn = self._build(kind, block, kb, d)
+        self.compiles += 1
+        self._cache[key] = fn
+        while len(self._cache) > self._max_execs:
+            self._cache.popitem(last=False)
+            self.evictions += 1
+        return fn
+
+    def _build(self, kind: str, block: int, kb: int, d: int):
+        """Lower-and-compile one (kind, shapes) scoring program.
+
+        Explicit AOT: ``jit(...).lower(abstract shapes).compile()`` --
+        the compiled object is shape-committed, so serving it can never
+        trace. The request block (argument 1) is donated.
+        """
+        import jax
+        import jax.numpy as jnp
+
+        from ..ops.estep import posteriors
+
+        if kind not in KINDS:
+            raise ValueError(f"unknown executable kind {kind!r}")
+        if self._dtype == np.float64 and not jax.config.jax_enable_x64:
+            # Same guard as the fit path: a float64 model silently served
+            # in float32 would score under truncated densities.
+            raise ValueError(
+                "dtype='float64' needs jax_enable_x64; set "
+                "jax.config.update('jax_enable_x64', True) at startup")
+        post = functools.partial(
+            posteriors, diag_only=self._diag_only,
+            quad_mode=self._quad_mode,
+            matmul_precision=self._precision)
+
+        if kind == "assign":
+            def fn(state, x):
+                w, logz = post(state, x)
+                return jnp.argmax(w, axis=1).astype(jnp.int32), logz
+        else:
+            def fn(state, x):
+                return post(state, x)
+
+        dt = jnp.dtype(self._dtype)
+        sds = jax.ShapeDtypeStruct
+        state_struct = GMMState(
+            N=sds((kb,), dt), pi=sds((kb,), dt), constant=sds((kb,), dt),
+            avgvar=sds((kb,), dt), means=sds((kb, d), dt),
+            R=sds((kb, d, d), dt), Rinv=sds((kb, d, d), dt),
+            active=sds((kb,), jnp.bool_))
+        x_struct = sds((block, d), dt)
+        # Donate the request block where donation exists (the CPU backend
+        # has no aliasing support and would warn on every compile).
+        donate = (1,) if jax.default_backend() != "cpu" else ()
+        return jax.jit(fn, donate_argnums=donate).lower(
+            state_struct, x_struct).compile()
+
+    def warmup(self, state: GMMState, d: Optional[int] = None,
+               kinds=("proba",), blocks=None) -> int:
+        """Pre-compile the executables a model's traffic will hit (cold
+        servers call this before accepting requests). Returns the number
+        of NEW compilations."""
+        ps = self.prepared_state(state)
+        d = int(d or ps.num_dimensions)
+        kb = ps.num_clusters_padded
+        before = self.compiles
+        for kind in kinds:
+            for block in (blocks or (self._min_block,)):
+                self._executable(kind, int(block), kb, d)
+        return self.compiles - before
+
+    # -- inference -------------------------------------------------------
+
+    def infer(self, state: GMMState, X, *, want: str = "proba"):
+        """Score ``X`` [N, D] under ``state``; returns host numpy arrays.
+
+        ``want='proba'`` -> (w [N, K_bucket], logz [N]);
+        ``want='assign'`` -> (labels int32 [N], logz [N]).
+        N is bucketed/split per the block policy; every padded row is
+        garbage discarded before return (rows are independent through
+        the per-event log-sum-exp, so padding never perturbs real rows).
+        """
+        import jax
+
+        X = np.ascontiguousarray(np.asarray(X, self._dtype))
+        if X.ndim != 2:
+            raise ValueError(f"X must be [n_events, n_dims], got {X.shape}")
+        n, d = X.shape
+        ps = self.prepared_state(state)
+        if d != ps.num_dimensions:
+            raise ValueError(
+                f"model has D={ps.num_dimensions} but X has D={d}")
+        kb = ps.num_clusters_padded
+        if n == 0:
+            first = (np.zeros((0, kb), self._dtype) if want == "proba"
+                     else np.zeros((0,), np.int32))
+            return first, np.zeros((0,), self._dtype)
+        outs_a, outs_z = [], []
+        for start, m, block in self.blocks_for(n):
+            xb = np.zeros((block, d), self._dtype)
+            xb[:m] = X[start:start + m]
+            run = self._executable(want, block, kb, d)
+            a, z = run(ps, xb)
+            a, z = jax.device_get((a, z))
+            outs_a.append(np.asarray(a)[:m])
+            outs_z.append(np.asarray(z)[:m])
+        return (np.concatenate(outs_a, axis=0),
+                np.concatenate(outs_z, axis=0))
+
+    def predict_proba(self, state: GMMState, X, k: Optional[int] = None):
+        """Posterior responsibilities [N, k] (k = the model's true
+        cluster count; defaults to the state's padded width)."""
+        w, _ = self.infer(state, X, want="proba")
+        return w[:, :int(k or state.num_clusters_padded)]
+
+    def predict(self, state: GMMState, X):
+        labels, _ = self.infer(state, X, want="assign")
+        return labels
+
+    def score_samples(self, state: GMMState, X):
+        return self.infer(state, X, want="assign")[1]
+
+    def score(self, state: GMMState, X) -> float:
+        return float(np.mean(self.score_samples(state, X)))
+
+
+@functools.lru_cache(maxsize=None)
+def _shared_executor(dtype: str, diag_only: bool, quad_mode: str,
+                     matmul_precision: str,
+                     max_block: int) -> ScoringExecutor:
+    max_block = max(1, int(max_block))
+    return ScoringExecutor(dtype=dtype, diag_only=diag_only,
+                           quad_mode=quad_mode,
+                           matmul_precision=matmul_precision,
+                           # Small-chunk configs (tests fit with
+                           # chunk_size < 256) cap the floor too.
+                           min_block=min(256, max_block),
+                           max_block=max_block)
+
+
+def executor_for_config(config) -> ScoringExecutor:
+    """The process-shared executor for one :class:`GMMConfig` family.
+
+    Keyed by the fields that change compiled code (dtype, covariance
+    structure, quad layout, precision, block cap) so every estimator of
+    a family shares one executable cache -- N estimators cost one
+    compile per bucket, not N.
+    """
+    return _shared_executor(config.dtype, bool(config.diag_only),
+                            config.quad_mode, config.matmul_precision,
+                            int(config.chunk_size))
+
+
+def executor_for_model(model: "ServedModel",
+                       **kw) -> ScoringExecutor:  # noqa: F821
+    """The shared executor for one registry :class:`ServedModel`."""
+    return _shared_executor(model.dtype, model.diag_only,
+                            kw.pop("quad_mode", "expanded"),
+                            kw.pop("matmul_precision", "highest"),
+                            kw.pop("max_block", 65536))

@@ -1,0 +1,471 @@
+"""Model registry: versioned persistence of fitted mixtures for serving.
+
+The reference is fit-and-exit -- its only artifact is the printf-rounded
+``.summary``/``.results`` pair (gaussian.cu:1180-1197), which loses 3
+decimals of every parameter and is never read back by the reference
+itself. The registry closes that gap for the serving path: a fitted
+mixture is persisted as a versioned artifact holding the EXACT
+:class:`~cuda_gmm_mpi_tpu.state.GMMState` leaves (the atomic-npz format
+shared with ``utils/checkpoint.py`` -- ``flatten_tree`` /
+``write_npz_atomic`` / ``load_npz_tree``), so a re-hydrated model scores
+bit-identically to the in-memory estimator it came from.
+
+Layout (``<root>`` is the registry directory)::
+
+    <root>/<name>/<version>/model.npz      # state leaves + data_shift
+    <root>/<name>/<version>/manifest.json  # identity card (below)
+
+Versions are positive integers assigned monotonically per name;
+``load(name)`` resolves the newest READABLE version (the checkpoint
+walk-back semantics: a version torn by a crash warns and falls back to
+the previous one instead of wedging the server; every version unreadable
+raises :class:`RegistryError` with the aggregated failures). An
+explicitly requested version never falls back -- a torn or mismatched
+artifact is a loud :class:`RegistryError`.
+
+The manifest records what the executor and the request router need
+without opening the npz: K (active clusters), D, covariance_type, dtype,
+the training run id, the final loglik, and -- for sweep-checkpoint
+exports -- the model-order criterion and best score, so "which K won and
+under which score" survives into serving (``gmm export``).
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import json
+import os
+import re
+import time
+import warnings
+from typing import Any, Dict, List, Optional, Tuple
+
+import numpy as np
+
+from ..state import GMMState
+from ..utils.checkpoint import flatten_tree, load_npz_tree, write_npz_atomic
+
+MODEL_FILE = "model.npz"
+MANIFEST_FILE = "manifest.json"
+MANIFEST_SCHEMA = 1
+
+_NAME_RE = re.compile(r"^[A-Za-z0-9][A-Za-z0-9._-]*$")
+
+
+class RegistryError(RuntimeError):
+    """A registry artifact is missing, torn, or self-inconsistent.
+
+    Raised loudly at save/load/export time -- a manifest whose K/D/dtype
+    disagrees with the stored arrays must never be served quietly under
+    the wrong densities (the same contract ``GaussianMixture.from_summary``
+    enforces for the text format).
+    """
+
+
+@dataclasses.dataclass
+class ServedModel:
+    """One re-hydrated registry artifact, ready for the executor.
+
+    ``state`` holds the exact fitted parameters (centered coordinates);
+    ``data_shift`` is the fit-time centering shift that request data must
+    be shifted by before scoring (``GMMResult.data_shift`` semantics).
+    """
+
+    name: str
+    version: int
+    state: GMMState
+    data_shift: np.ndarray  # [D] float64
+    manifest: Dict[str, Any]
+
+    @property
+    def k(self) -> int:
+        return int(self.manifest["k"])
+
+    @property
+    def d(self) -> int:
+        return int(self.manifest["d"])
+
+    @property
+    def dtype(self) -> str:
+        return str(self.manifest["dtype"])
+
+    @property
+    def covariance_type(self) -> str:
+        return str(self.manifest["covariance_type"])
+
+    @property
+    def diag_only(self) -> bool:
+        return self.covariance_type in ("diag", "spherical")
+
+
+class ModelRegistry:
+    """Versioned model store rooted at one directory."""
+
+    def __init__(self, root: str):
+        self._root = os.path.abspath(root)
+        os.makedirs(self._root, exist_ok=True)
+
+    @property
+    def root(self) -> str:
+        return self._root
+
+    # -- enumeration -----------------------------------------------------
+
+    def models(self) -> List[str]:
+        """Registered model names (sorted)."""
+        out = []
+        for name in sorted(os.listdir(self._root)):
+            if _NAME_RE.match(name) and self.versions(name):
+                out.append(name)
+        return out
+
+    def versions(self, name: str) -> List[int]:
+        """Existing versions of ``name`` (ascending; [] when unknown)."""
+        d = os.path.join(self._root, self._check_name(name))
+        if not os.path.isdir(d):
+            return []
+        return sorted(int(v) for v in os.listdir(d)
+                      if v.isdigit() and os.path.isfile(
+                          os.path.join(d, v, MODEL_FILE)))
+
+    def _check_name(self, name: str) -> str:
+        if not _NAME_RE.match(name or ""):
+            raise RegistryError(
+                f"invalid model name {name!r} (letters, digits, '.', '_', "
+                "'-' only; must not start with a separator)")
+        return name
+
+    # -- save ------------------------------------------------------------
+
+    def save(self, name: str, result, *, config=None,
+             covariance_type: Optional[str] = None,
+             criterion: Optional[str] = None,
+             run_id: Optional[str] = None,
+             version: Optional[int] = None,
+             source: str = "fit",
+             extra: Optional[Dict[str, Any]] = None) -> int:
+        """Persist a fitted :class:`GMMResult` as ``name``'s next version.
+
+        ``config`` (the fit's :class:`GMMConfig`) supplies the covariance
+        family and criterion when the explicit kwargs are absent; the
+        dtype is read off the state itself. Returns the version number.
+        The write is atomic (npz first, manifest last): a version whose
+        manifest exists is complete, and a crash mid-save leaves only an
+        ignorable orphan.
+        """
+        state = result.state
+        k = int(result.ideal_num_clusters)
+        d = int(result.num_dimensions) or int(state.num_dimensions)
+        if int(state.num_clusters_padded) != k:
+            # Registry artifacts store the COMPACT state (every slot
+            # active) so K in the manifest is the arrays' leading axis.
+            from ..state import compact
+
+            state, k = compact(state)
+        cov = covariance_type or (config.covariance_type if config
+                                  else "full")
+        crit = criterion or (config.criterion if config else None)
+        manifest = {
+            "schema": MANIFEST_SCHEMA,
+            "name": self._check_name(name),
+            "k": k,
+            "d": d,
+            "covariance_type": cov,
+            "dtype": str(np.asarray(state.N).dtype),
+            "loglik": _finite_or_none(result.final_loglik),
+            "score": _finite_or_none(result.min_rissanen),
+            "criterion": crit,
+            "train_run_id": run_id,
+            "num_events": int(getattr(result, "num_events", 0)),
+            "source": source,
+            "created_utc": time.strftime("%Y-%m-%dT%H:%M:%SZ",
+                                         time.gmtime()),
+        }
+        if extra:
+            manifest.update(extra)
+        return self._write_version(name, version, state,
+                                   np.asarray(result.data_shift,
+                                              np.float64), manifest)
+
+    def _write_version(self, name: str, version: Optional[int],
+                       state: GMMState, data_shift: np.ndarray,
+                       manifest: Dict[str, Any]) -> int:
+        name = self._check_name(name)
+        existing = self.versions(name)
+        if version is None:
+            version = (existing[-1] + 1) if existing else 1
+        elif version in existing:
+            raise RegistryError(
+                f"{name!r} version {version} already exists; versions are "
+                "immutable -- save a new one")
+        elif version < 1:
+            raise RegistryError("versions are positive integers")
+        manifest = dict(manifest, version=int(version))
+        vdir = os.path.join(self._root, name, str(version))
+        os.makedirs(vdir, exist_ok=True)
+        import jax
+
+        host_state = jax.device_get(state)
+        flat = flatten_tree({"state": host_state,
+                             "data_shift": data_shift})
+        write_npz_atomic(vdir, os.path.join(vdir, MODEL_FILE), flat)
+        # Manifest last: its presence is the commit record.
+        tmp = os.path.join(vdir, MANIFEST_FILE + ".tmp")
+        with open(tmp, "w", encoding="utf-8") as f:
+            json.dump(manifest, f, indent=1, sort_keys=True)
+            f.flush()
+            os.fsync(f.fileno())
+        os.replace(tmp, os.path.join(vdir, MANIFEST_FILE))
+        return int(version)
+
+    # -- load ------------------------------------------------------------
+
+    def load(self, name: str, version: Optional[int] = None) -> ServedModel:
+        """Re-hydrate ``name`` at ``version`` (default: newest readable).
+
+        Explicit versions fail loudly on ANY problem; the default
+        resolution walks back over torn versions with a warning (the
+        ``utils/checkpoint.py`` restore semantics -- losing one version
+        beats wedging the server) and raises an aggregated
+        :class:`RegistryError` only when every version is unreadable.
+        """
+        versions = self.versions(name)
+        if not versions:
+            raise RegistryError(
+                f"unknown model {name!r} in registry {self._root!r} "
+                f"(registered: {', '.join(self.models()) or 'none'})")
+        if version is not None:
+            if version not in versions:
+                raise RegistryError(
+                    f"{name!r} has no version {version} "
+                    f"(existing: {versions})")
+            return self._load_version(name, int(version))
+        failures: List[Tuple[int, BaseException]] = []
+        for v in reversed(versions):
+            try:
+                return self._load_version(name, v)
+            except Exception as e:
+                failures.append((v, e))
+                warnings.warn(
+                    f"registry model {name!r} version {v} unreadable "
+                    f"({type(e).__name__}: {e}); falling back to the "
+                    "previous version", RuntimeWarning)
+        raise RegistryError(
+            f"every version of {name!r} is unreadable: "
+            + "; ".join(f"v{v}: {type(e).__name__}: {e}"
+                        for v, e in failures)) from failures[0][1]
+
+    def _load_version(self, name: str, version: int) -> ServedModel:
+        vdir = os.path.join(self._root, self._check_name(name),
+                            str(version))
+        man_path = os.path.join(vdir, MANIFEST_FILE)
+        try:
+            with open(man_path, encoding="utf-8") as f:
+                manifest = json.load(f)
+        except (OSError, ValueError) as e:
+            raise RegistryError(
+                f"{name!r} v{version}: unreadable manifest: {e}") from e
+        try:
+            tree = load_npz_tree(os.path.join(vdir, MODEL_FILE),
+                                 state_keys=("state",))
+        except Exception as e:
+            raise RegistryError(
+                f"{name!r} v{version}: unreadable model artifact: "
+                f"{e}") from e
+        state = tree.get("state")
+        if not isinstance(state, GMMState):
+            raise RegistryError(
+                f"{name!r} v{version}: artifact holds no state group")
+        self._validate(name, version, manifest, state)
+        shift = np.asarray(tree.get("data_shift",
+                                    np.zeros((state.num_dimensions,))),
+                           np.float64)
+        return ServedModel(name=name, version=int(version), state=state,
+                           data_shift=shift, manifest=manifest)
+
+    def _validate(self, name, version, manifest, state: GMMState) -> None:
+        """The loud manifest-vs-arrays contract: serving a model whose
+        identity card lies about its shapes/family would score every
+        request under the wrong densities."""
+        where = f"{name!r} v{version}"
+        k = int(manifest.get("k", -1))
+        d = int(manifest.get("d", -1))
+        if state.num_clusters_padded != k or state.num_dimensions != d:
+            raise RegistryError(
+                f"{where}: manifest says K={k} D={d} but the stored state "
+                f"is K={state.num_clusters_padded} "
+                f"D={state.num_dimensions}")
+        dtype = str(manifest.get("dtype"))
+        actual = str(np.asarray(state.N).dtype)
+        if dtype != actual:
+            raise RegistryError(
+                f"{where}: manifest dtype {dtype!r} != stored {actual!r}")
+        cov = manifest.get("covariance_type")
+        if cov not in ("full", "diag", "spherical", "tied"):
+            raise RegistryError(
+                f"{where}: unknown covariance_type {cov!r}")
+        if cov in ("diag", "spherical"):
+            R = np.asarray(state.R)
+            offdiag = R - np.stack([np.diag(np.diag(r)) for r in R])
+            if np.abs(offdiag).max() > 0:
+                raise RegistryError(
+                    f"{where}: manifest says covariance_type={cov!r} but "
+                    "the stored covariances carry nonzero off-diagonals")
+
+    # -- export paths ----------------------------------------------------
+
+    def export_result(self, name: str, result, **kw) -> int:
+        """Alias of :meth:`save` (the library export entry point)."""
+        return self.save(name, result, **kw)
+
+    def export_checkpoint(self, checkpoint_dir: str, name: str, *,
+                          version: Optional[int] = None,
+                          run_id: Optional[str] = None) -> int:
+        """Export the BEST-scoring model from an order-search sweep
+        checkpoint directory.
+
+        A sweep checkpoint's ``state`` is the in-flight K of the step it
+        was taken at -- the LAST fitted K, usually not the winner.
+        Export selects ``best_state`` (the best-criterion configuration
+        so far, the ``saved_clusters`` analog) and records the score
+        criterion, best score, and loglik in the manifest, so the served
+        model is the one the sweep would have returned. Both the
+        host-driven and fused-sweep checkpoint payloads are understood;
+        a checkpoint predating the ``data_shift`` field exports with a
+        zero shift and a loud warning (its fit may have centered data).
+        """
+        from ..models.order_search import (_COV_NAME, _CRITERION_NAME,
+                                           GMMResult)
+        from ..state import compact
+        from ..utils.checkpoint import SweepCheckpointer
+
+        sweep_dir = os.path.join(os.path.abspath(checkpoint_dir), "sweep")
+        if not os.path.isdir(sweep_dir):
+            raise RegistryError(
+                f"{checkpoint_dir!r} holds no sweep checkpoints")
+        restored = SweepCheckpointer(checkpoint_dir).restore()
+        if restored is None:
+            raise RegistryError(
+                f"{checkpoint_dir!r} holds no restorable checkpoint step")
+        best = restored["best_state"]
+        if "fused_log" in restored:  # fused-sweep payload key names
+            score = float(restored["best_riss"])
+            loglik = float(restored["best_ll"])
+        else:
+            score = float(restored["min_rissanen"])
+            loglik = float(restored["best_ll"])
+        criterion = _CRITERION_NAME.get(
+            int(restored.get("criterion_code", 0)), "rissanen")
+        cov = _COV_NAME.get(int(restored.get("cov_code", 0)), "full")
+        state, k_active = compact(best)
+        if "data_shift" in restored:
+            shift = np.asarray(restored["data_shift"], np.float64)
+        else:
+            shift = np.zeros((state.num_dimensions,), np.float64)
+            warnings.warn(
+                "checkpoint predates the data_shift field; exporting with "
+                "a zero shift -- if the original fit centered its data "
+                "(the default), served scores will be wrong. Re-fit or "
+                "export from the .summary instead.", RuntimeWarning)
+        result = GMMResult(
+            state=state,
+            ideal_num_clusters=k_active,
+            min_rissanen=score,
+            final_loglik=loglik,
+            epsilon=float("nan"),
+            num_events=0,
+            num_dimensions=int(state.num_dimensions),
+            data_shift=shift,
+        )
+        return self.save(
+            name, result, covariance_type=cov, criterion=criterion,
+            run_id=run_id, version=version, source="checkpoint",
+            extra={"checkpoint_step": int(restored.get("step", -1)),
+                   "checkpoint_dir": os.path.abspath(checkpoint_dir)})
+
+    def export_summary(self, summary_path: str, name: str, *,
+                       covariance_type: str = "full",
+                       dtype: str = "float32",
+                       version: Optional[int] = None) -> int:
+        """Export a ``.summary`` model file (ours or the reference's own).
+
+        Carries the text format's 3-decimal precision -- exact
+        persistence comes from exporting the in-memory fit
+        (:meth:`save`); this path exists so reference-produced models can
+        be served too. Constants/Rinv are recomputed coherently from R
+        (``from_summary`` semantics).
+        """
+        from ..config import GMMConfig
+        from ..estimator import GaussianMixture
+
+        gm = GaussianMixture.from_summary(
+            summary_path, config=GMMConfig(dtype=dtype,
+                                           covariance_type=covariance_type))
+        return self.save(
+            name, gm.result_, covariance_type=gm.config.covariance_type,
+            version=version, source="summary",
+            extra={"summary_path": os.path.abspath(summary_path)})
+
+
+def _finite_or_none(x) -> Optional[float]:
+    x = float(x)
+    return x if np.isfinite(x) else None
+
+
+def export_main(argv=None) -> int:
+    """``gmm export``: persist a model into a serving registry.
+
+    Sources (exactly one): ``--checkpoint DIR`` (an order-search sweep
+    checkpoint directory -- exports the best-scoring K, not the last
+    step) or ``--summary FILE.summary`` (the text model format, 3-decimal
+    precision).
+    """
+    import argparse
+
+    p = argparse.ArgumentParser(
+        prog="gmm export",
+        description="Export a fitted model into a serving registry "
+        "(docs/SERVING.md).")
+    p.add_argument("--registry", required=True,
+                   help="registry root directory (created if absent)")
+    p.add_argument("--name", required=True, help="model name")
+    src = p.add_mutually_exclusive_group(required=True)
+    src.add_argument("--checkpoint", metavar="DIR",
+                     help="order-search sweep checkpoint directory; "
+                     "exports the best-scoring K with its criterion")
+    src.add_argument("--summary", metavar="FILE.summary",
+                     help="a .summary model file (ours or the "
+                     "reference's)")
+    p.add_argument("--covariance-type", default="full",
+                   choices=["full", "diag", "spherical", "tied"],
+                   help="covariance family of a --summary model "
+                   "(checkpoints record their own)")
+    p.add_argument("--dtype", default="float32",
+                   choices=["float32", "float64"],
+                   help="dtype for a --summary model")
+    p.add_argument("--version", type=int, default=None,
+                   help="explicit version (default: next)")
+    args = p.parse_args(argv)
+
+    reg = ModelRegistry(args.registry)
+    try:
+        if args.checkpoint:
+            v = reg.export_checkpoint(args.checkpoint, args.name,
+                                      version=args.version)
+        else:
+            v = reg.export_summary(args.summary, args.name,
+                                   covariance_type=args.covariance_type,
+                                   dtype=args.dtype,
+                                   version=args.version)
+    except (RegistryError, OSError, ValueError) as e:
+        import sys
+
+        print(f"export failed: {e}", file=sys.stderr)
+        return 1
+    m = reg.load(args.name, v).manifest
+    crit = (f" {m['criterion']}={m['score']:.6e}"
+            if m.get("criterion") and m.get("score") is not None else "")
+    print(f"exported {args.name!r} version {v} "
+          f"(K={m['k']}, D={m['d']}, {m['covariance_type']}, "
+          f"{m['dtype']}{crit})")
+    return 0

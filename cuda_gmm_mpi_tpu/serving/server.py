@@ -1,0 +1,581 @@
+"""Micro-batched scoring server: the ``gmm serve`` request loop.
+
+The third serving layer (docs/SERVING.md): a JSONL request protocol over
+stdin/stdout (default), a request file, or a UNIX socket, feeding a
+micro-batching dispatcher that coalesces concurrent score requests into
+ONE padded executor dispatch per tick and routes per-model.
+
+Protocol -- one JSON object per line, one response line per request::
+
+    {"id": 7, "model": "cells", "op": "score_samples", "x": [[...], ...]}
+    -> {"id": 7, "ok": true, "model": "cells", "version": 2,
+        "op": "score_samples", "n": 2, "result": [...],
+        "latency_ms": 0.8}
+
+``op`` is one of ``predict`` / ``predict_proba`` / ``score_samples`` /
+``score`` (the estimator surface); ``version`` pins a registry version
+(default: newest); ``{"op": "shutdown"}`` stops the server after
+draining. Errors come back on the same id with ``ok: false`` and an
+``error`` message -- a malformed request never kills the loop.
+
+Micro-batching: requests arriving within one tick (``tick_s``) are
+grouped by (model, version) and each group's rows are concatenated into
+a single bucketed executor dispatch; per-request results are sliced back
+out. All four ops ride the SAME 'proba' executable, so a mixed batch
+(score + predict for one model) still coalesces into one dispatch --
+the batched dispatch is bit-identical to per-request dispatches because
+rows are independent through the per-event log-sum-exp (the coalescing
+parity test, tests/test_serving.py).
+
+Telemetry (stream rev v1.6, docs/OBSERVABILITY.md): ``serve_request``
+per request, ``serve_batch`` per coalesced dispatch, and a closing
+``serve_summary`` with QPS + latency percentiles + the MetricsRegistry
+snapshot -- rendered by ``gmm report``.
+"""
+
+from __future__ import annotations
+
+import collections
+import json
+import os
+import queue
+import sys
+import threading
+import time
+from typing import Any, Callable, Dict, List, Optional, Tuple
+
+import numpy as np
+
+from .. import telemetry
+from .executor import ScoringExecutor, executor_for_model
+from .registry import ModelRegistry, RegistryError, ServedModel
+
+OPS = ("predict", "predict_proba", "score_samples", "score")
+
+# Latency samples kept for the summary percentiles (bounded).
+_LATENCY_CAP = 100_000
+
+
+class _Pending:
+    """One in-flight request: the decoded body, where to reply, when it
+    arrived."""
+
+    __slots__ = ("req", "reply", "t0")
+
+    def __init__(self, req: dict, reply: Callable[[dict], None]):
+        self.req = req
+        self.reply = reply
+        self.t0 = time.perf_counter()
+
+
+class GMMServer:
+    """Per-model routed, micro-batched scoring over a model registry."""
+
+    def __init__(self, registry: ModelRegistry, *,
+                 max_batch_rows: int = 8192, tick_s: float = 0.002,
+                 executor: Optional[ScoringExecutor] = None,
+                 warm: bool = True):
+        self._registry = registry
+        self._max_batch_rows = max(1, int(max_batch_rows))
+        self._tick_s = max(0.0, float(tick_s))
+        self._executor_override = executor
+        self._warm = bool(warm)
+        self._models: Dict[Tuple[str, Optional[int]], ServedModel] = {}
+        self._executors: Dict[tuple, ScoringExecutor] = {}
+        self._queue: "queue.Queue[Optional[_Pending]]" = queue.Queue()
+        self._stop = threading.Event()
+        self._latencies: collections.deque = collections.deque(
+            maxlen=_LATENCY_CAP)
+        self._t_start = time.perf_counter()
+        self.requests = 0
+        self.batches = 0
+        self.rows = 0
+        self.errors = 0
+
+    # -- model / executor resolution ------------------------------------
+
+    def resolve(self, name: str, version: Optional[int] = None
+                ) -> ServedModel:
+        """The (cached) served model for one (name, version) route.
+
+        ``version=None`` pins the newest version AT FIRST USE -- a serve
+        process is version-stable; export a new version and restart (or
+        address it explicitly) to roll."""
+        key = (name, version)
+        m = self._models.get(key)
+        if m is None:
+            m = self._registry.load(name, version)
+            self._models[key] = m
+            self._models.setdefault((name, m.version), m)
+            if self._warm:
+                self._executor_for(m).warmup(m.state)
+        return m
+
+    def _executor_for(self, m: ServedModel) -> ScoringExecutor:
+        if self._executor_override is not None:
+            return self._executor_override
+        key = (m.dtype, m.diag_only)
+        ex = self._executors.get(key)
+        if ex is None:
+            ex = self._executors[key] = executor_for_model(m)
+        return ex
+
+    def executor_stats(self) -> Dict[str, int]:
+        """Aggregated executor counters across every family served."""
+        execs = ([self._executor_override] if self._executor_override
+                 else list(self._executors.values()))
+        tot: Dict[str, int] = {}
+        for ex in execs:
+            for k, v in ex.stats().items():
+                tot[k] = tot.get(k, 0) + v
+        return tot
+
+    # -- request handling ------------------------------------------------
+
+    def handle_requests(self, requests: List[dict], *,
+                        coalesce: bool = True) -> List[dict]:
+        """Synchronous convenience: score a request list, return the
+        responses in request order. ``coalesce=False`` dispatches one
+        request at a time (the parity baseline the micro-batch is tested
+        against)."""
+        responses: List[Optional[dict]] = [None] * len(requests)
+        pendings = []
+        for i, req in enumerate(requests):
+            def reply(resp, _i=i):
+                responses[_i] = resp
+            pendings.append(_Pending(req, reply))
+        if coalesce:
+            self._process(pendings)
+        else:
+            for p in pendings:
+                self._process([p])
+        return [r for r in responses if r is not None]
+
+    def _process(self, pendings: List[_Pending]) -> None:
+        """Group one tick's requests per (model, version) and dispatch
+        each group as a single coalesced executor call."""
+        groups: "collections.OrderedDict[tuple, list]" = \
+            collections.OrderedDict()
+        for p in pendings:
+            req = p.req
+            if not isinstance(req, dict):
+                self._reply_error(p, "request is not a JSON object")
+                continue
+            op = req.get("op")
+            if op == "shutdown":
+                self._stop.set()
+                self._reply(p, {"id": req.get("id"), "ok": True,
+                                "op": "shutdown"})
+                continue
+            if op == "ping":
+                self._reply(p, {"id": req.get("id"), "ok": True,
+                                "op": "ping"})
+                continue
+            if op not in OPS:
+                self._reply_error(
+                    p, f"unknown op {op!r} (expected one of "
+                    f"{', '.join(OPS)}, ping, shutdown)")
+                continue
+            name = req.get("model")
+            version = req.get("version")
+            if not isinstance(name, str):
+                self._reply_error(p, "request needs a 'model' name")
+                continue
+            if version is not None and not isinstance(version, int):
+                self._reply_error(p, "'version' must be an integer")
+                continue
+            try:
+                x = np.asarray(req.get("x"), np.float64)
+                if x.ndim == 1 and x.size:
+                    x = x[None, :]
+                if x.ndim != 2 or x.shape[0] == 0:
+                    raise ValueError(
+                        f"'x' must be a non-empty [n, d] row list, got "
+                        f"shape {x.shape}")
+                if not np.isfinite(x).all():
+                    raise ValueError("'x' contains NaN/Inf rows")
+            except (ValueError, TypeError) as e:
+                self._reply_error(p, f"bad 'x': {e}")
+                continue
+            groups.setdefault((name, version), []).append((p, x))
+        for (name, version), items in groups.items():
+            self._dispatch(name, version, items)
+
+    def _dispatch(self, name: str, version: Optional[int],
+                  items: List[Tuple[_Pending, np.ndarray]]) -> None:
+        """One coalesced dispatch: concatenate every request's rows,
+        score once, slice per request, answer per op."""
+        rec = telemetry.current()
+        t0 = time.perf_counter()
+        try:
+            m = self.resolve(name, version)
+        except (RegistryError, OSError) as e:
+            for p, _ in items:
+                self._reply_error(p, str(e), model=name)
+            return
+        d = m.d
+        bad, good = [], []
+        for p, x in items:
+            if x.shape[1] != d:
+                bad.append((p, f"model {name!r} has D={d} but 'x' rows "
+                            f"have D={x.shape[1]}"))
+            else:
+                good.append((p, x))
+        for p, msg in bad:
+            self._reply_error(p, msg, model=name)
+        if not good:
+            return
+        ex = self._executor_for(m)
+        xs = [x for _, x in good]
+        rows = np.concatenate(xs, axis=0).astype(
+            np.dtype(m.dtype), copy=False)
+        rows = rows - m.data_shift[None, :].astype(rows.dtype)
+        compiles_before = ex.compile_count
+        w, logz = ex.infer(m.state, rows, want="proba")
+        wall_ms = (time.perf_counter() - t0) * 1e3
+        compiled = ex.compile_count - compiles_before
+        self.batches += 1
+        self.rows += int(rows.shape[0])
+        if rec.active:
+            rec.emit("serve_batch", model=name, version=m.version,
+                     requests=len(good), rows=int(rows.shape[0]),
+                     padded_rows=int(ex.padded_rows(rows.shape[0])),
+                     wall_ms=round(wall_ms, 3), compiled=int(compiled))
+            rec.metrics.count("serve_batches")
+            rec.metrics.count("serve_rows", int(rows.shape[0]))
+            rec.metrics.count("serve_compiles", int(compiled))
+            rec.metrics.observe("serve.batch_ms", wall_ms)
+            rec.metrics.observe("serve.batch_rows", int(rows.shape[0]))
+        start = 0
+        for p, x in good:
+            n = int(x.shape[0])
+            wi = w[start:start + n, :m.k]
+            zi = logz[start:start + n]
+            start += n
+            op = p.req["op"]
+            if op == "predict":
+                result: Any = np.argmax(wi, axis=1).tolist()
+            elif op == "predict_proba":
+                result = wi.tolist()
+            elif op == "score_samples":
+                result = zi.tolist()
+            else:  # score
+                result = float(np.mean(zi))
+            self._reply(p, {
+                "id": p.req.get("id"), "ok": True, "model": name,
+                "version": m.version, "op": op, "n": n,
+                "result": result,
+            })
+
+    def _reply(self, p: _Pending, resp: dict) -> None:
+        latency_ms = (time.perf_counter() - p.t0) * 1e3
+        resp.setdefault("latency_ms", round(latency_ms, 3))
+        self.requests += 1
+        self._latencies.append(latency_ms)
+        rec = telemetry.current()
+        if rec.active:
+            rec.emit("serve_request",
+                     model=resp.get("model", p.req.get("model")),
+                     op=resp.get("op", p.req.get("op")),
+                     n=int(resp.get("n", 0)),
+                     latency_ms=round(latency_ms, 3),
+                     ok=bool(resp.get("ok")),
+                     **({"version": resp["version"]}
+                        if "version" in resp else {}),
+                     **({"error": resp["error"]}
+                        if "error" in resp else {}))
+            rec.metrics.count("serve_requests")
+            rec.metrics.observe("serve.latency_ms", latency_ms)
+        p.reply(resp)
+
+    def _reply_error(self, p: _Pending, msg: str, model=None) -> None:
+        self.errors += 1
+        rec = telemetry.current()
+        if rec.active:
+            rec.metrics.count("serve_errors")
+        self._reply(p, {"id": (p.req.get("id")
+                               if isinstance(p.req, dict) else None),
+                        "ok": False, "error": msg,
+                        **({"model": model} if model else {})})
+
+    # -- summary ---------------------------------------------------------
+
+    def latency_summary(self) -> Dict[str, float]:
+        lat = np.asarray(self._latencies, np.float64)
+        if lat.size == 0:
+            return {"p50": 0.0, "p99": 0.0, "mean": 0.0, "max": 0.0}
+        return {
+            "p50": round(float(np.percentile(lat, 50)), 3),
+            "p99": round(float(np.percentile(lat, 99)), 3),
+            "mean": round(float(lat.mean()), 3),
+            "max": round(float(lat.max()), 3),
+        }
+
+    def emit_summary(self) -> Optional[dict]:
+        """The closing ``serve_summary`` record (run_summary's serving
+        sibling): volume, QPS, latency percentiles, executor counters,
+        and the metrics-registry snapshot."""
+        rec = telemetry.current()
+        wall = time.perf_counter() - self._t_start
+        if not rec.active:
+            return None
+        return rec.emit(
+            "serve_summary",
+            requests=int(self.requests), batches=int(self.batches),
+            rows=int(self.rows), errors=int(self.errors),
+            wall_s=round(wall, 6),
+            qps=round(self.requests / wall, 3) if wall > 0 else 0.0,
+            latency_ms=self.latency_summary(),
+            models=sorted({f"{n}@{m.version}"
+                           for (n, _), m in self._models.items()}),
+            executor=self.executor_stats(),
+            metrics=rec.metrics.snapshot(),
+        )
+
+    # -- streaming loops -------------------------------------------------
+
+    def submit_line(self, line: str, reply: Callable[[dict], None]) -> None:
+        """Decode one protocol line onto the batching queue (reader
+        threads call this; the tick loop drains it)."""
+        line = line.strip()
+        if not line:
+            return
+        try:
+            req = json.loads(line)
+        except ValueError as e:
+            p = _Pending({}, reply)
+            self._reply_error(p, f"not JSON: {e}")
+            return
+        self._queue.put(_Pending(req, reply))
+
+    def run_loop(self, *, max_requests: Optional[int] = None,
+                 idle_timeout_s: Optional[float] = None,
+                 draining: Optional[Callable[[], bool]] = None) -> None:
+        """The micro-batching tick loop: block for the first pending
+        request, gather everything that arrives within one tick (bounded
+        by ``max_batch_rows``), dispatch the coalesced groups, repeat.
+
+        Ends on ``shutdown``, after ``max_requests`` replies, after
+        ``idle_timeout_s`` with an empty queue, or -- with ``draining``
+        supplied (stdin mode: True once EOF hit) -- when the input is
+        exhausted and the queue is empty.
+        """
+        while not self._stop.is_set():
+            if max_requests is not None and self.requests >= max_requests:
+                break
+            try:
+                first = self._queue.get(timeout=idle_timeout_s or 0.1)
+            except queue.Empty:
+                if idle_timeout_s is not None:
+                    break
+                if draining is not None and draining():
+                    break
+                continue
+            if first is None:
+                break
+            batch = [first]
+            rows = _rows_of(first)
+            deadline = time.perf_counter() + self._tick_s
+            while rows < self._max_batch_rows:
+                remaining = deadline - time.perf_counter()
+                try:
+                    p = (self._queue.get_nowait() if remaining <= 0
+                         else self._queue.get(timeout=remaining))
+                except queue.Empty:
+                    break
+                if p is None:
+                    self._stop.set()
+                    break
+                batch.append(p)
+                rows += _rows_of(p)
+            self._process(batch)
+        # Drain whatever is still queued (EOF/shutdown must not drop
+        # accepted requests on the floor).
+        leftovers = []
+        while True:
+            try:
+                p = self._queue.get_nowait()
+            except queue.Empty:
+                break
+            if p is not None:
+                leftovers.append(p)
+        if leftovers:
+            self._process(leftovers)
+
+
+def _rows_of(p: _Pending) -> int:
+    x = p.req.get("x") if isinstance(p.req, dict) else None
+    try:
+        return max(len(x), 1)
+    except TypeError:
+        return 1
+
+
+def _stdout_replier(out, lock: threading.Lock) -> Callable[[dict], None]:
+    def reply(resp: dict) -> None:
+        line = json.dumps(resp, default=_json_default)
+        with lock:
+            out.write(line + "\n")
+            out.flush()
+    return reply
+
+
+def _json_default(o):
+    item = getattr(o, "item", None)
+    if callable(item):
+        return o.item()
+    tolist = getattr(o, "tolist", None)
+    if callable(tolist):
+        return o.tolist()
+    return str(o)
+
+
+def _serve_socket(server: GMMServer, path: str,
+                  max_requests: Optional[int]) -> None:
+    """UNIX-socket front end: every connection speaks the same JSONL
+    protocol; requests from ALL connections land on one batching queue,
+    so concurrent clients coalesce into shared dispatches (the
+    micro-batching win a per-connection loop could never get)."""
+    import socketserver
+
+    class Handler(socketserver.StreamRequestHandler):
+        def handle(self):
+            lock = threading.Lock()
+
+            def reply(resp: dict) -> None:
+                line = json.dumps(resp, default=_json_default)
+                try:
+                    with lock:
+                        self.wfile.write(line.encode() + b"\n")
+                        self.wfile.flush()
+                except (BrokenPipeError, OSError):
+                    pass  # client went away; the dispatch already ran
+
+            for raw in self.rfile:
+                server.submit_line(raw.decode("utf-8", "replace"), reply)
+                if server._stop.is_set():
+                    break
+
+    class Srv(socketserver.ThreadingMixIn,
+              socketserver.UnixStreamServer):
+        daemon_threads = True
+
+    if os.path.exists(path):
+        os.remove(path)
+    with Srv(path, Handler) as srv:
+        t = threading.Thread(target=srv.serve_forever,
+                             kwargs={"poll_interval": 0.05}, daemon=True)
+        t.start()
+        try:
+            server.run_loop(max_requests=max_requests)
+        finally:
+            srv.shutdown()
+            try:
+                os.remove(path)
+            except OSError:
+                pass
+
+
+def serve_main(argv=None) -> int:
+    """``gmm serve``: run the micro-batched scoring loop over a registry."""
+    import argparse
+
+    p = argparse.ArgumentParser(
+        prog="gmm serve",
+        description="Serve registry models over the JSONL request "
+        "protocol: stdin/stdout by default, a request file with "
+        "--input, or a UNIX socket with --socket (docs/SERVING.md).")
+    p.add_argument("--registry", required=True,
+                   help="model registry root directory (gmm export)")
+    p.add_argument("--models", nargs="*", default=None,
+                   metavar="NAME[@VERSION]",
+                   help="models to load (and AOT-warm) at startup; "
+                   "default: every registered model's newest version. "
+                   "Requests may still address any registry model")
+    p.add_argument("--socket", default=None, metavar="PATH",
+                   help="serve a UNIX stream socket instead of "
+                   "stdin/stdout (concurrent clients share the "
+                   "micro-batch queue)")
+    p.add_argument("--input", default=None, metavar="FILE.jsonl",
+                   help="read requests from a file instead of stdin")
+    p.add_argument("--output", default=None, metavar="FILE.jsonl",
+                   help="write responses to a file instead of stdout")
+    p.add_argument("--max-batch-rows", type=int, default=8192,
+                   help="coalesced rows per dispatch tick (default 8192)")
+    p.add_argument("--tick-ms", type=float, default=2.0,
+                   help="micro-batch gather window in milliseconds "
+                   "(default 2)")
+    p.add_argument("--max-requests", type=int, default=None,
+                   help="exit after this many responses (benchmarks, "
+                   "tests)")
+    p.add_argument("--no-warmup", action="store_true",
+                   help="skip AOT pre-compilation of loaded models "
+                   "(first request pays the compile)")
+    p.add_argument("--device", default=None,
+                   help="JAX platform: tpu | cpu | gpu (default: auto)")
+    p.add_argument("--metrics-file", default=None, metavar="FILE.jsonl",
+                   help="serve telemetry stream: serve_request / "
+                   "serve_batch / serve_summary records (schema rev "
+                   "v1.6; render with `gmm report`)")
+    args = p.parse_args(argv)
+
+    if args.device:
+        os.environ["JAX_PLATFORMS"] = args.device
+        import jax
+
+        jax.config.update("jax_platforms", args.device)
+
+    registry = ModelRegistry(args.registry)
+    server = GMMServer(registry,
+                       max_batch_rows=args.max_batch_rows,
+                       tick_s=args.tick_ms / 1e3,
+                       warm=not args.no_warmup)
+
+    rec = (telemetry.RunRecorder(args.metrics_file)
+           if args.metrics_file else telemetry.RunRecorder())
+    rec.set_context(path="serve")
+
+    with telemetry.use(rec), rec:
+        # Pre-resolve (and AOT-warm) the requested model set so the first
+        # request never pays registry IO or a compile.
+        names = args.models
+        if names is None:
+            names = registry.models()
+        try:
+            for spec in names:
+                name, _, ver = spec.partition("@")
+                server.resolve(name, int(ver) if ver else None)
+        except (RegistryError, ValueError) as e:
+            print(f"cannot load {spec!r}: {e}", file=sys.stderr)
+            return 1
+
+        if args.socket:
+            _serve_socket(server, args.socket, args.max_requests)
+        else:
+            out = (open(args.output, "w", encoding="utf-8")
+                   if args.output else sys.stdout)
+            lock = threading.Lock()
+            reply = _stdout_replier(out, lock)
+            src = (open(args.input, encoding="utf-8")
+                   if args.input else sys.stdin)
+            eof = threading.Event()
+
+            def read_all():
+                try:
+                    for line in src:
+                        server.submit_line(line, reply)
+                finally:
+                    eof.set()
+
+            t = threading.Thread(target=read_all, daemon=True)
+            t.start()
+            try:
+                server.run_loop(max_requests=args.max_requests,
+                                draining=eof.is_set)
+            finally:
+                if args.input:
+                    src.close()
+                if args.output:
+                    out.close()
+        server.emit_summary()
+    return 0

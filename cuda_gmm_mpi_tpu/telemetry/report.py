@@ -66,6 +66,12 @@ def render_report(records: List[dict], max_trajectory_rows: int = 400) -> str:
     chunks = [r for r in records if r.get("event") == "chunk_flush"]
     summaries = [r for r in records if r.get("event") == "run_summary"]
 
+    serve_reqs = [r for r in records if r.get("event") == "serve_request"]
+    serve_batches = [r for r in records
+                     if r.get("event") == "serve_batch"]
+    serve_summaries = [r for r in records
+                       if r.get("event") == "serve_summary"]
+
     selects = [r for r in records if r.get("event") == "restart_select"]
     healths = [r for r in records if r.get("event") == "health"]
     recoveries = [r for r in records if r.get("event") == "recovery"]
@@ -110,6 +116,50 @@ def render_report(records: List[dict], max_trajectory_rows: int = 400) -> str:
         total_bytes = sum(int(r.get("bytes", 0)) for r in chunks)
         out.append(f"Streaming: {len(chunks)} block flushes, "
                    f"{total_bytes / 1e6:.1f} MB host->device")
+        out.append("")
+
+    if serve_reqs or serve_batches or serve_summaries:
+        out.append("Serving (rev v1.6; docs/SERVING.md):")
+        if serve_reqs:
+            by_model: Dict[str, List[dict]] = {}
+            for r in serve_reqs:
+                by_model.setdefault(str(r.get("model")), []).append(r)
+            for model, rs in sorted(by_model.items()):
+                ok = sum(1 for r in rs if r.get("ok"))
+                rows = sum(int(r.get("n", 0)) for r in rs)
+                lat = sorted(float(r.get("latency_ms", 0.0)) for r in rs)
+                p50 = lat[len(lat) // 2] if lat else 0.0
+                out.append(
+                    f"  {model:<20s} {len(rs):6d} requests "
+                    f"({len(rs) - ok} failed)  {rows:8d} rows  "
+                    f"p50 {p50:.3f} ms")
+        if serve_batches:
+            reqs = sum(int(r.get("requests", 0)) for r in serve_batches)
+            rows = sum(int(r.get("rows", 0)) for r in serve_batches)
+            padded = sum(int(r.get("padded_rows", 0))
+                         for r in serve_batches)
+            compiled = sum(int(r.get("compiled", 0))
+                           for r in serve_batches)
+            out.append(
+                f"  {len(serve_batches)} micro-batches: "
+                f"{reqs / max(len(serve_batches), 1):.2f} requests/batch, "
+                f"{rows} rows ({padded} dispatched after bucketing), "
+                f"{compiled} AOT compiles")
+        for s in serve_summaries:
+            lat = s.get("latency_ms") or {}
+            out.append(
+                f"  summary: {s.get('requests')} requests in "
+                f"{s.get('wall_s', 0):.2f}s = {s.get('qps')} QPS; "
+                f"latency p50 {lat.get('p50')} ms, p99 {lat.get('p99')} "
+                f"ms, max {lat.get('max')} ms")
+            ex = s.get("executor") or {}
+            if ex:
+                out.append(
+                    f"  executor: {ex.get('live_executables', 0)} live "
+                    f"executables, {ex.get('compiles', 0)} compiles, "
+                    f"{ex.get('hits', 0)} hits / "
+                    f"{ex.get('misses', 0)} misses, "
+                    f"{ex.get('evictions', 0)} evictions")
         out.append("")
 
     for r in selects:

@@ -130,6 +130,31 @@ EVENT_FIELDS: Dict[str, Tuple[Tuple[str, ...], Tuple[str, ...]]] = {
         ("winner", "scores", "criterion"),
         ("mode", "batch_size", "dropped"),
     ),
+    # Serving loop (stream rev v1.6; serving/server.py, docs/SERVING.md):
+    # one per answered request. ``n`` is the request's row count,
+    # ``latency_ms`` arrival-to-reply; failed requests carry ok=false +
+    # ``error``.
+    "serve_request": (
+        ("model", "op", "n", "latency_ms"),
+        ("version", "ok", "error"),
+    ),
+    # One per coalesced micro-batch dispatch: how many concurrent
+    # requests' rows rode one padded executor call, the pow2-bucketed
+    # row count actually dispatched, and whether the dispatch had to
+    # AOT-compile (``compiled`` > 0 = a cold bucket; a warmed server
+    # emits zeros -- the zero-recompile proof is observable per batch).
+    "serve_batch": (
+        ("model", "requests", "rows", "padded_rows", "wall_ms"),
+        ("version", "compiled"),
+    ),
+    # One per serve session, at shutdown (run_summary's serving
+    # sibling): volume, QPS, latency percentiles, aggregated executor
+    # cache counters, and the metrics-registry snapshot.
+    "serve_summary": (
+        ("requests", "batches", "rows", "wall_s", "qps", "latency_ms",
+         "metrics"),
+        ("models", "executor", "errors"),
+    ),
     # One per fit: final scores, the 7-category phase profile, the
     # compile-vs-execute split, and the metrics-registry snapshot.
     # ``buckets`` (optional; host-driven sweeps) describes cluster-width

@@ -97,6 +97,74 @@ def _from_tree(t: Dict[str, Any]) -> GMMState:
     return GMMState(**{k: jnp.asarray(v) for k, v in t.items()})
 
 
+def flatten_tree(payload: Dict[str, Any]) -> Dict[str, Any]:
+    """One-level flatten of a checkpoint payload into npz-ready keys.
+
+    ``GMMState`` values are expanded to their leaf arrays and every
+    nested dict to ``group.leaf`` keys; scalars/arrays pass through as
+    ``np.asarray``. The shared serializer behind the sweep checkpoints
+    AND the serving model registry (serving/registry.py) -- one artifact
+    format, one flattening rule.
+    """
+    flat: Dict[str, Any] = {}
+    for key, val in payload.items():
+        if isinstance(val, GMMState):
+            val = _to_tree(val)
+        if isinstance(val, dict):
+            for leaf, arr in val.items():
+                flat[f"{key}.{leaf}"] = np.asarray(arr)
+        else:
+            flat[key] = np.asarray(val)
+    return flat
+
+
+def write_npz_atomic(directory: str, target: str,
+                     flat: Dict[str, Any]) -> None:
+    """Durable atomic npz write: tmp + fsync + ``os.replace`` + dir fsync.
+
+    The write path every callback-safe checkpoint and registry artifact
+    shares: the payload must survive a HOST crash, not just a process
+    kill, so the data is fsynced before the atomic rename and the
+    directory entry after it. The tmp name is mkstemp-unique so
+    concurrent savers can never interleave writes into one file.
+    """
+    import tempfile
+
+    fd, tmp = tempfile.mkstemp(dir=directory, suffix=".tmp.npz")
+    with os.fdopen(fd, "wb") as f:
+        np.savez(f, **flat)
+        f.flush()
+        os.fsync(f.fileno())
+    os.replace(tmp, target)
+    dir_fd = os.open(directory, os.O_RDONLY)
+    try:
+        os.fsync(dir_fd)
+    finally:
+        os.close(dir_fd)
+
+
+def load_npz_tree(path: str,
+                  state_keys: Tuple[str, ...] = ("state", "best_state"),
+                  ) -> Dict[str, Any]:
+    """Un-flatten one npz artifact written via :func:`flatten_tree`.
+
+    ``group.leaf`` keys regroup into dicts; groups named in
+    ``state_keys`` (when present) are rebuilt as :class:`GMMState`.
+    """
+    with np.load(path) as z:
+        tree: Dict[str, Any] = {}
+        for key in z.files:
+            if "." in key:
+                group, leaf = key.split(".", 1)
+                tree.setdefault(group, {})[leaf] = z[key]
+            else:
+                tree[key] = z[key]
+    for key in state_keys:
+        if key in tree:
+            tree[key] = _from_tree(tree[key])
+    return tree
+
+
 class SweepCheckpointer:
     """Orbax-backed persistence of the order-search sweep.
 
@@ -314,40 +382,13 @@ class SweepCheckpointer:
 
     def _flatten(self, payload: Dict[str, Any]) -> Dict[str, Any]:
         """One-level flatten of the payload (GMMStates expanded to leaf
-        arrays) into npz-ready ``group.leaf`` keys."""
-        tree = dict(payload)
-        tree["state"] = _to_tree(payload["state"])
-        tree["best_state"] = _to_tree(payload["best_state"])
-        flat = {}
-        for key, val in tree.items():
-            if isinstance(val, dict):
-                for leaf, arr in val.items():
-                    flat[f"{key}.{leaf}"] = np.asarray(arr)
-            else:
-                flat[key] = np.asarray(val)
-        return flat
+        arrays) into npz-ready ``group.leaf`` keys (flatten_tree)."""
+        return flatten_tree(payload)
 
     def _write_npz_atomic(self, target: str, flat: Dict[str, Any]) -> None:
-        import tempfile
-
-        fd, tmp = tempfile.mkstemp(dir=self._dir, suffix=".tmp.npz")
-        with os.fdopen(fd, "wb") as f:
-            np.savez(f, **flat)
-            # The durability contract ("checkpoint s on disk before
-            # step s+1 computes", fused_sweep.py) must survive a HOST
-            # crash, not just a process kill: flush+fsync the data
-            # before the atomic rename, then fsync the directory so
-            # the rename itself is durable. The tmp name is
-            # mkstemp-unique so concurrent savers (racing callback
-            # threads) can never interleave writes into one file.
-            f.flush()
-            os.fsync(f.fileno())
-        os.replace(tmp, target)
-        dir_fd = os.open(self._dir, os.O_RDONLY)
-        try:
-            os.fsync(dir_fd)
-        finally:
-            os.close(dir_fd)
+        # The durability contract ("checkpoint s on disk before step s+1
+        # computes", fused_sweep.py): see write_npz_atomic.
+        write_npz_atomic(self._dir, target, flat)
 
     def _all_steps(self) -> list:
         if not os.path.isdir(self._dir):
@@ -450,16 +491,11 @@ class SweepCheckpointer:
 
 
 def _load_npz_tree(path: str) -> Dict[str, Any]:
-    """Un-flatten one npz checkpoint: ``group.leaf`` keys regroup into
-    dicts, the two GMMState groups are rebuilt as states."""
-    with np.load(path) as z:
-        tree: Dict[str, Any] = {}
-        for key in z.files:
-            if "." in key:
-                group, leaf = key.split(".", 1)
-                tree.setdefault(group, {})[leaf] = z[key]
-            else:
-                tree[key] = z[key]
-    tree["state"] = _from_tree(tree["state"])
-    tree["best_state"] = _from_tree(tree["best_state"])
+    """Un-flatten one npz checkpoint (load_npz_tree; the two GMMState
+    groups are required here -- a sweep checkpoint always has both)."""
+    tree = load_npz_tree(path)
+    for key in ("state", "best_state"):
+        if not isinstance(tree.get(key), GMMState):
+            raise ValueError(f"checkpoint {path!r} is missing the "
+                             f"{key!r} group")
     return tree

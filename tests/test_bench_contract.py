@@ -239,6 +239,41 @@ def test_envelope_mode_contract():
     assert j["vs_baseline"] == env["full"]["speedup"]
 
 
+def test_serve_mode_contract():
+    """--serve (GMM_BENCH_SERVE=1) emits ONE JSON record with the cold
+    first-request wall AND the warm steady-state percentiles, plus the
+    zero-recompile proof bit — the acceptance contract: after one
+    warm-up per (model, N-bucket), varying-N traffic performs no new
+    traces/compiles and warm p50 < the cold first-request wall."""
+    r = _run({
+        "GMM_BENCH_CPU": "1",
+        "GMM_BENCH_SERVE": "1",
+        "GMM_BENCH_SERVE_N": "2000",
+        "GMM_BENCH_SERVE_D": "3",
+        "GMM_BENCH_SERVE_K": "4",
+        "GMM_BENCH_SERVE_REQUESTS": "100",
+    }, timeout=600)
+    assert r.returncode == 0, r.stderr
+    j = _json_line(r.stdout)
+    assert j["unit"] == "s" and j["value"] > 0
+    assert j["accelerator_unavailable"] is False
+    s = j["serve"]
+    assert s["requests"] >= 100
+    assert s["cold_first_request_s"] > 0
+    warm = s["warm"]
+    assert warm["p50_s"] > 0 and warm["p99_s"] >= warm["p50_s"]
+    assert warm["qps"] > 0
+    # cold/warm in the SAME record, with the acceptance bits asserted
+    assert s["warm_p50_lt_cold"] is True
+    assert warm["p50_s"] < s["cold_first_request_s"]
+    assert s["zero_recompile_after_warm"] is True
+    assert s["new_compiles_after_warm"] == 0
+    # vs_baseline is the cold/warm ratio (record fields are rounded
+    # independently, so compare with slack)
+    ratio = s["cold_first_request_s"] / warm["p50_s"]
+    assert abs(j["vs_baseline"] - ratio) <= 0.01 * ratio + 0.01
+
+
 def test_probe_budget_fails_over_after_one_hang():
     """Default probe budget: ONE attempt -- a hung probe fails over to
     CPU immediately instead of burning the old 5 x 90s retry ladder

@@ -1,0 +1,475 @@
+"""Serving subsystem: registry, AOT executor cache, micro-batched server.
+
+Covers the PR's contracts (docs/SERVING.md):
+- registry save/load round-trips score BIT-identically to the in-memory
+  estimator (full + diag), versioning is monotonic, manifest/shape
+  mismatches fail loudly (RegistryError), torn newest versions walk back;
+- the executable cache hits/misses/evicts per the pow2 bucket policy and
+  NEVER recompiles on the warm path (varying N inside warmed buckets);
+- the sklearn-surface estimator routes inference through the executor,
+  so repeated predict/score calls with varying N stay zero-retrace
+  (the compile-count regression the pre-serving code failed);
+- micro-batched dispatch is bit-identical to the per-request loop;
+- the `gmm serve` CLI speaks the JSONL protocol end to end and its
+  telemetry stream validates against schema rev v1.6;
+- `gmm export` from a sweep checkpoint selects the BEST-scoring K and
+  records the criterion.
+"""
+
+import json
+import os
+
+import numpy as np
+import pytest
+
+from cuda_gmm_mpi_tpu import GMMConfig, GaussianMixture, fit_gmm
+from cuda_gmm_mpi_tpu.serving import (GMMServer, ModelRegistry,
+                                      RegistryError, ScoringExecutor,
+                                      pow2_bucket)
+
+from .conftest import make_blobs
+
+
+def fitted(rng, *, diag=False, k=3, d=4, n=600, dtype="float32"):
+    data, _ = make_blobs(rng, n=n, d=d, k=k, dtype=np.float64)
+    gm = GaussianMixture(
+        k, target_components=k,
+        config=GMMConfig(min_iters=4, max_iters=4, chunk_size=256,
+                         dtype=dtype, diag_only=diag))
+    gm.fit(data.astype(np.dtype(dtype)))
+    return gm, data.astype(np.dtype(dtype))
+
+
+# ---------------------------------------------------------------- registry
+
+
+@pytest.mark.parametrize("diag", [False, True])
+def test_registry_roundtrip_bit_identical(rng, tmp_path, diag):
+    """Save -> load -> score must be BIT-identical to the in-memory
+    estimator (both covariance families): the registry stores the exact
+    state leaves, unlike the 3-decimal .summary format."""
+    gm, data = fitted(rng, diag=diag)
+    v = gm.to_registry(str(tmp_path), "m")
+    assert v == 1
+    gm2 = GaussianMixture.from_registry(str(tmp_path), "m")
+    X = data[:173]
+    assert np.array_equal(gm.score_samples(X), gm2.score_samples(X))
+    assert np.array_equal(gm.predict_proba(X), gm2.predict_proba(X))
+    assert np.array_equal(gm.predict(X), gm2.predict(X))
+    assert gm2.n_components_ == gm.n_components_
+    m = ModelRegistry(str(tmp_path)).load("m").manifest
+    assert m["covariance_type"] == gm.config.covariance_type
+    assert m["dtype"] == "float32"
+    assert m["k"] == gm.n_components_ and m["d"] == 4
+
+
+def test_registry_versioning_and_latest(rng, tmp_path):
+    gm, _ = fitted(rng)
+    reg = ModelRegistry(str(tmp_path))
+    assert gm.to_registry(reg, "m") == 1
+    assert gm.to_registry(reg, "m") == 2
+    assert reg.versions("m") == [1, 2]
+    assert reg.load("m").version == 2          # latest by default
+    assert reg.load("m", 1).version == 1       # explicit pin
+    assert reg.models() == ["m"]
+    with pytest.raises(RegistryError, match="immutable"):
+        gm.to_registry(reg, "m", version=1)
+    with pytest.raises(RegistryError, match="no version"):
+        reg.load("m", 7)
+    with pytest.raises(RegistryError, match="unknown model"):
+        reg.load("ghost")
+
+
+def test_registry_manifest_mismatch_is_loud(rng, tmp_path):
+    """A manifest whose K disagrees with the stored arrays must raise
+    RegistryError at load, never serve under the wrong densities."""
+    gm, _ = fitted(rng)
+    reg = ModelRegistry(str(tmp_path))
+    gm.to_registry(reg, "m")
+    man = tmp_path / "m" / "1" / "manifest.json"
+    doc = json.loads(man.read_text())
+    doc["k"] = doc["k"] + 3
+    man.write_text(json.dumps(doc))
+    with pytest.raises(RegistryError, match="manifest says K="):
+        reg.load("m", 1)
+
+
+def test_registry_torn_newest_walks_back(rng, tmp_path):
+    """Default resolution falls back over a torn newest version with a
+    warning (checkpoint walk-back semantics); an explicitly pinned torn
+    version fails loudly; all-torn raises the aggregate."""
+    gm, _ = fitted(rng)
+    reg = ModelRegistry(str(tmp_path))
+    gm.to_registry(reg, "m")
+    gm.to_registry(reg, "m")
+    (tmp_path / "m" / "2" / "model.npz").write_bytes(b"torn")
+    with pytest.warns(RuntimeWarning, match="version 2 unreadable"):
+        assert reg.load("m").version == 1
+    with pytest.raises(RegistryError, match="unreadable model artifact"):
+        reg.load("m", 2)
+    (tmp_path / "m" / "1" / "model.npz").write_bytes(b"torn")
+    with pytest.warns(RuntimeWarning):
+        with pytest.raises(RegistryError, match="every version"):
+            reg.load("m")
+
+
+def test_registry_rejects_bad_names(tmp_path):
+    reg = ModelRegistry(str(tmp_path))
+    for bad in ("", "../x", "a/b", ".hidden"):
+        with pytest.raises(RegistryError, match="invalid model name"):
+            reg._check_name(bad)
+
+
+# ---------------------------------------------------------------- executor
+
+
+def test_pow2_bucket_policy():
+    assert [pow2_bucket(n) for n in (1, 2, 3, 5, 16, 17)] == \
+        [1, 2, 4, 8, 16, 32]
+    assert pow2_bucket(3, lo=256) == 256
+    assert pow2_bucket(100_000, lo=256, hi=4096) == 4096
+
+
+def test_executor_cache_hit_miss_and_lru_eviction(rng):
+    """The LRU bound: with room for 2 executables, a third bucket evicts
+    the least-recently-used one, and revisiting it recompiles (counted --
+    an undersized cache is observable, not silent)."""
+    gm, data = fitted(rng)
+    state = gm.result_.state
+    ex = ScoringExecutor(min_block=32, max_block=256, max_executables=2)
+    ex.infer(state, data[:20])       # block 32: compile 1
+    ex.infer(state, data[:60])       # block 64: compile 2
+    assert (ex.misses, ex.compiles, ex.evictions) == (2, 2, 0)
+    ex.infer(state, data[:20])       # block 32 again: hit
+    assert ex.hits == 1
+    ex.infer(state, data[:120])      # block 128: compile 3, evicts 64
+    assert ex.evictions == 1 and ex.cache_size == 2
+    c = ex.compiles
+    ex.infer(state, data[:60])       # evicted bucket: recompile
+    assert ex.compiles == c + 1
+
+
+def test_executor_warm_path_zero_recompile(rng):
+    """The acceptance contract: after one warm-up per N-bucket, 100
+    requests with VARYING N perform no new traces or compiles."""
+    gm, data = fitted(rng)
+    state = gm.result_.state
+    ex = ScoringExecutor(min_block=32, max_block=256)
+    for n in (32, 64, 128, 256):     # warm one request per bucket
+        ex.infer(state, data[:n])
+    c0 = ex.compile_count
+    lens = rng.integers(1, 257, size=100)
+    for n in lens:
+        ex.infer(state, data[:int(n)])
+    assert ex.compile_count == c0, "warm path traced/compiled"
+    assert ex.hits >= 100
+
+
+def test_executor_split_and_parity_vs_estimator(rng):
+    """Requests beyond max_block split into block slices; results equal
+    the unsplit computation row-for-row (padding rows are inert)."""
+    gm, data = fitted(rng)
+    state = gm.result_.state
+    big = ScoringExecutor(min_block=32, max_block=1024)
+    small = ScoringExecutor(min_block=32, max_block=64)
+    X = data[:300] - gm.result_.data_shift[None, :].astype(data.dtype)
+    wb, zb = big.infer(state, X)
+    ws, zs = small.infer(state, X)
+    assert np.array_equal(zb, zs) and np.array_equal(wb, ws)
+    assert small.padded_rows(300) == 64 * 4 + 64  # 4 full + bucketed tail
+
+
+def test_executor_shares_across_models_same_family(rng, tmp_path):
+    """Two same-(K-bucket, D) models share every executable: the cache is
+    keyed by shapes, not by model identity."""
+    gm1, data = fitted(rng, k=3)
+    gm2, _ = fitted(np.random.default_rng(7), k=4)  # pow2 bucket = 4 both
+    ex = ScoringExecutor(min_block=64, max_block=64)
+    ex.infer(gm1.result_.state, data[:10])
+    c0 = ex.compile_count
+    ex.infer(gm2.result_.state, data[:10])
+    assert ex.compile_count == c0
+
+
+def test_estimator_varying_n_hits_one_executable_per_bucket(rng):
+    """The satellite regression: GaussianMixture.predict/score_samples
+    used to retrace for every distinct input length (jit keys on exact
+    shapes); routed through the N-bucketed executor they must compile at
+    most once per pow2 bucket and reuse it for every later N."""
+    from cuda_gmm_mpi_tpu.serving.executor import executor_for_config
+
+    gm, data = fitted(rng)
+    ex = executor_for_config(gm.config)
+    gm.score_samples(data[:256])     # warm the min_block bucket
+    c0 = ex.compile_count
+    for n in (3, 17, 40, 99, 150, 201, 256):
+        gm.predict(data[:n])
+        gm.score_samples(data[:n])
+        gm.predict_proba(data[:n])
+    assert ex.compile_count == c0, (
+        "estimator inference recompiled on a varying-N warm path")
+
+
+# -------------------------------------------------------------- server
+
+
+def serve_requests(data, k=3):
+    return [
+        {"id": 0, "model": "m", "op": "score", "x": data[:7].tolist()},
+        {"id": 1, "model": "m", "op": "predict", "x": data[7:19].tolist()},
+        {"id": 2, "model": "m", "op": "predict_proba",
+         "x": data[19:22].tolist()},
+        {"id": 3, "model": "m", "op": "score_samples",
+         "x": data[22:41].tolist()},
+        {"id": 4, "model": "m", "op": "score", "x": data[41:44].tolist()},
+    ]
+
+
+def test_microbatch_coalescing_parity(rng, tmp_path):
+    """Batched dispatch == per-request loop, bit for bit: coalescing may
+    change latency, never results."""
+    gm, data = fitted(rng)
+    gm.to_registry(str(tmp_path), "m")
+    server = GMMServer(ModelRegistry(str(tmp_path)))
+    reqs = serve_requests(data)
+    batched = server.handle_requests(reqs, coalesce=True)
+    solo = server.handle_requests(reqs, coalesce=False)
+    assert len(batched) == len(solo) == len(reqs)
+    for a, b in zip(batched, solo):
+        a = {k: v for k, v in a.items() if k != "latency_ms"}
+        b = {k: v for k, v in b.items() if k != "latency_ms"}
+        assert a == b
+    assert all(r["ok"] for r in batched)
+
+
+def test_server_parity_vs_estimator(rng, tmp_path):
+    """A served score_samples response equals the estimator's own
+    scoring of the same rows (the whole serving stack changes latency,
+    not numbers)."""
+    gm, data = fitted(rng)
+    gm.to_registry(str(tmp_path), "m")
+    server = GMMServer(ModelRegistry(str(tmp_path)))
+    X = data[:31]
+    resp = server.handle_requests(
+        [{"id": 0, "model": "m", "op": "score_samples",
+          "x": X.tolist()}])[0]
+    assert resp["ok"]
+    np.testing.assert_array_equal(
+        np.asarray(resp["result"], np.float32), gm.score_samples(X))
+
+
+def test_server_error_paths(rng, tmp_path):
+    """Malformed requests answer ok=false on their id; the loop and the
+    other requests in the same batch are unaffected."""
+    gm, data = fitted(rng)
+    gm.to_registry(str(tmp_path), "m")
+    server = GMMServer(ModelRegistry(str(tmp_path)))
+    reqs = [
+        {"id": 0, "model": "ghost", "op": "score", "x": data[:2].tolist()},
+        {"id": 1, "model": "m", "op": "transmogrify",
+         "x": data[:2].tolist()},
+        {"id": 2, "model": "m", "op": "score", "x": [[1.0, 2.0]]},  # bad D
+        {"id": 3, "model": "m", "op": "score",
+         "x": [[float("nan")] * 4]},
+        {"id": 4, "model": "m", "op": "score", "x": data[:2].tolist()},
+    ]
+    resps = {r["id"]: r for r in server.handle_requests(reqs)}
+    assert not resps[0]["ok"] and "unknown model" in resps[0]["error"]
+    assert not resps[1]["ok"] and "unknown op" in resps[1]["error"]
+    assert not resps[2]["ok"] and "D=4" in resps[2]["error"]
+    assert not resps[3]["ok"] and "NaN" in resps[3]["error"]
+    assert resps[4]["ok"]
+
+
+def test_server_version_routing(rng, tmp_path):
+    """Requests may pin a version; default routes to the newest at first
+    use. Distinct versions really serve distinct parameters."""
+    gm, data = fitted(rng)
+    reg = ModelRegistry(str(tmp_path))
+    gm.to_registry(reg, "m")                      # v1
+    gm2 = GaussianMixture.from_registry(reg, "m")
+    gm2.result_.state = gm2.result_.state.replace(
+        means=gm2.result_.state.means + 1.0)      # visibly different v2
+    reg.save("m", gm2.result_, config=gm2.config)
+    server = GMMServer(reg)
+    X = data[:5].tolist()
+    r_latest = server.handle_requests(
+        [{"model": "m", "op": "score", "x": X}])[0]
+    r_v1 = server.handle_requests(
+        [{"model": "m", "version": 1, "op": "score", "x": X}])[0]
+    assert r_latest["version"] == 2 and r_v1["version"] == 1
+    assert r_latest["result"] != r_v1["result"]
+
+
+# ----------------------------------------------------------- CLI + schema
+
+
+def _write_requests(path, data, n=6):
+    with open(path, "w") as f:
+        for i in range(n):
+            f.write(json.dumps({
+                "id": i, "model": "m",
+                "op": ("score" if i % 2 else "predict"),
+                "x": data[i * 5:(i + 1) * 5 + i].tolist()}) + "\n")
+
+
+def test_serve_cli_smoke_jsonl_protocol(rng, tmp_path):
+    """`gmm serve` end to end over the JSONL protocol: every request gets
+    a response line on its id, and the telemetry stream validates against
+    schema rev v1.6 with serve_request/serve_batch/serve_summary."""
+    from cuda_gmm_mpi_tpu.cli import main
+    from cuda_gmm_mpi_tpu.telemetry import read_stream
+    from cuda_gmm_mpi_tpu.telemetry.schema import validate_stream
+
+    gm, data = fitted(rng)
+    gm.to_registry(str(tmp_path / "reg"), "m")
+    reqs = tmp_path / "req.jsonl"
+    resp_path = tmp_path / "resp.jsonl"
+    metrics = tmp_path / "serve_metrics.jsonl"
+    _write_requests(reqs, data)
+    rc = main(["serve", "--registry", str(tmp_path / "reg"),
+               "--input", str(reqs), "--output", str(resp_path),
+               "--metrics-file", str(metrics)])
+    assert rc == 0
+    resps = [json.loads(ln) for ln in resp_path.read_text().splitlines()]
+    assert sorted(r["id"] for r in resps) == list(range(6))
+    assert all(r["ok"] for r in resps)
+    for r in resps:
+        assert r["model"] == "m" and r["version"] == 1
+        assert (isinstance(r["result"], float)
+                or len(r["result"]) == r["n"])
+
+    records = read_stream(str(metrics))
+    assert validate_stream(records) == []
+    events = [r["event"] for r in records]
+    assert events.count("serve_request") == 6
+    assert "serve_batch" in events
+    summary = [r for r in records if r["event"] == "serve_summary"][-1]
+    assert summary["requests"] == 6 and summary["qps"] > 0
+    assert summary["latency_ms"]["p50"] > 0
+    assert summary["metrics"]["counters"]["serve_requests"] == 6
+    # warmed at startup: no dispatch-time AOT compiles on any batch
+    assert all(r.get("compiled", 0) == 0 for r in records
+               if r["event"] == "serve_batch")
+
+
+def test_serve_report_renders_serving_section(rng, tmp_path, capsys):
+    """`gmm report` renders the v1.6 serving section from the stream."""
+    from cuda_gmm_mpi_tpu.cli import main
+
+    gm, data = fitted(rng)
+    gm.to_registry(str(tmp_path / "reg"), "m")
+    reqs = tmp_path / "req.jsonl"
+    metrics = tmp_path / "m.jsonl"
+    _write_requests(reqs, data, n=3)
+    assert main(["serve", "--registry", str(tmp_path / "reg"),
+                 "--input", str(reqs), "--output", str(tmp_path / "o"),
+                 "--metrics-file", str(metrics)]) == 0
+    assert main(["report", str(metrics), "--validate"]) == 0
+    out = capsys.readouterr().out
+    assert "Serving (rev v1.6" in out
+    assert "micro-batches" in out and "QPS" in out
+
+
+def test_serve_unix_socket(rng, tmp_path):
+    """The UNIX-socket front end speaks the same protocol; concurrent
+    clients share the micro-batch queue."""
+    import socket
+    import threading
+
+    from cuda_gmm_mpi_tpu.serving.server import serve_main
+
+    gm, data = fitted(rng)
+    gm.to_registry(str(tmp_path / "reg"), "m")
+    sock_path = str(tmp_path / "gmm.sock")
+    t = threading.Thread(target=serve_main, args=(
+        ["--registry", str(tmp_path / "reg"), "--socket", sock_path,
+         "--max-requests", "3"],), daemon=True)
+    t.start()
+    deadline = 30.0
+    import time as _t
+    t0 = _t.monotonic()
+    while not os.path.exists(sock_path):
+        assert _t.monotonic() - t0 < deadline, "socket never appeared"
+        _t.sleep(0.02)
+    c = socket.socket(socket.AF_UNIX, socket.SOCK_STREAM)
+    c.connect(sock_path)
+    f = c.makefile("rw")
+    for i in range(3):
+        f.write(json.dumps({"id": i, "model": "m", "op": "score",
+                            "x": data[:4].tolist()}) + "\n")
+    f.flush()
+    got = [json.loads(f.readline()) for _ in range(3)]
+    c.close()
+    t.join(timeout=deadline)
+    assert not t.is_alive()
+    assert sorted(r["id"] for r in got) == [0, 1, 2]
+    assert all(r["ok"] for r in got)
+
+
+# ------------------------------------------------------------------ export
+
+
+def test_export_checkpoint_selects_best_k_not_last_step(rng, tmp_path):
+    """The satellite contract: a sweep checkpoint's in-flight state is
+    the LAST fitted K; export must pick best_state (the best-criterion
+    configuration) and record the criterion + score in the manifest."""
+    data, _ = make_blobs(rng, n=600, d=3, k=3, dtype=np.float32)
+    ck = str(tmp_path / "ck")
+    res = fit_gmm(data, 6, 0,
+                  config=GMMConfig(min_iters=3, max_iters=3,
+                                   chunk_size=256, checkpoint_dir=ck))
+    assert res.ideal_num_clusters < 6  # the sweep really merged
+    reg = ModelRegistry(str(tmp_path / "reg"))
+    v = reg.export_checkpoint(ck, "swept")
+    m = reg.load("swept", v)
+    assert m.manifest["criterion"] == "rissanen"
+    assert m.manifest["source"] == "checkpoint"
+    assert np.isclose(m.manifest["score"], res.min_rissanen)
+    # best-scoring K, not the last step's in-flight K (which is < ideal
+    # by the end of the sweep)
+    assert m.k == res.ideal_num_clusters
+    np.testing.assert_array_equal(m.data_shift,
+                                  np.asarray(res.data_shift, np.float64))
+    gm = GaussianMixture.from_registry(reg, "swept")
+    # identical best parameters => identical scores on fresh rows
+    ref = GaussianMixture(6, config=GMMConfig(min_iters=3, max_iters=3,
+                                              chunk_size=256))
+    ref.result_, ref._model = res, None
+    from cuda_gmm_mpi_tpu.models.gmm import GMMModel
+
+    ref._model = GMMModel(ref.config)
+    assert np.array_equal(gm.score_samples(data[:50]),
+                          ref.score_samples(data[:50]))
+
+
+def test_export_cli_checkpoint_and_summary(rng, tmp_path, capsys):
+    from cuda_gmm_mpi_tpu.cli import main
+    from cuda_gmm_mpi_tpu.io import write_summary
+
+    data, _ = make_blobs(rng, n=400, d=3, k=3, dtype=np.float32)
+    ck = str(tmp_path / "ck")
+    res = fit_gmm(data, 5, 0,
+                  config=GMMConfig(min_iters=2, max_iters=2,
+                                   chunk_size=256, checkpoint_dir=ck))
+    reg_dir = str(tmp_path / "reg")
+    assert main(["export", "--registry", reg_dir, "--name", "a",
+                 "--checkpoint", ck]) == 0
+    out = capsys.readouterr().out
+    assert "exported 'a' version 1" in out and "rissanen=" in out
+
+    summary = str(tmp_path / "model.summary")
+    write_summary(summary, res)
+    assert main(["export", "--registry", reg_dir, "--name", "b",
+                 "--summary", summary]) == 0
+    reg = ModelRegistry(reg_dir)
+    assert reg.load("b").manifest["source"] == "summary"
+    # bad source fails loudly with rc 1, not a traceback
+    assert main(["export", "--registry", reg_dir, "--name", "c",
+                 "--checkpoint", str(tmp_path / "nothing")]) == 1
+
+
+def test_export_empty_checkpoint_is_loud(tmp_path):
+    reg = ModelRegistry(str(tmp_path / "reg"))
+    with pytest.raises(RegistryError, match="no sweep checkpoints"):
+        reg.export_checkpoint(str(tmp_path / "missing"), "x")
