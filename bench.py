@@ -46,7 +46,10 @@ serving subsystem -- fit a small mixture, export it to a temp registry,
 drive the in-process micro-batched server: the cold first request
 (registry load + AOT compile) vs the steady state (>= 100 varying-N
 requests after one warm-up per N-bucket), with the zero-recompile proof
-bit in the record; ``vs_baseline`` is cold / warm-p50. Size knobs:
+bit in the record; ``vs_baseline`` is cold / warm-p50. The record also
+carries the server's resilience counters (shed / deadline_expired /
+breaker trips / reloads -- stream rev v1.7) so soak runs surface
+degradation, all-zero on a clean A/B. Size knobs:
 GMM_BENCH_SERVE_{N,D,K,REQUESTS} (run_serve_bench).
 
 Env knobs: GMM_BENCH_CPU=1 (deliberate CPU run, rc 0); GMM_BENCH_PRECISION
@@ -682,6 +685,12 @@ def run_serve_bench(platform: str, accel_unavailable: bool) -> dict:
             "zero_recompile_after_warm": bool(new_compiles == 0),
             "warm_p50_lt_cold": bool(p50 < cold_s),
             "executor": executor.stats(),
+            # Resilience counters (stream rev v1.7): a soak run whose
+            # server sheds, expires deadlines, trips breakers, or
+            # hot-reloads surfaces that degradation in the artifact
+            # instead of hiding it inside latency percentiles. A clean
+            # A/B run reports all-zero.
+            "resilience": server.resilience_stats(),
         },
         "measured_utc": time.strftime("%Y-%m-%dT%H:%M:%SZ", time.gmtime()),
     }

@@ -8,13 +8,14 @@ executables so a warm request never traces or recompiles
 per model (:mod:`.server`, the ``gmm serve`` CLI).
 """
 
+from .breaker import CircuitBreakers
 from .executor import (ScoringExecutor, executor_for_config,
                        executor_for_model, pow2_bucket)
 from .registry import ModelRegistry, RegistryError, ServedModel
 from .server import GMMServer, serve_main
 
 __all__ = [
-    "GMMServer", "ModelRegistry", "RegistryError", "ScoringExecutor",
-    "ServedModel", "executor_for_config", "executor_for_model",
-    "pow2_bucket", "serve_main",
+    "CircuitBreakers", "GMMServer", "ModelRegistry", "RegistryError",
+    "ScoringExecutor", "ServedModel", "executor_for_config",
+    "executor_for_model", "pow2_bucket", "serve_main",
 ]

@@ -170,6 +170,17 @@ class ScoringExecutor:
             self._state_memo.popitem(last=False)
         return padded
 
+    def release_state(self, state: GMMState) -> int:
+        """Drop ``state``'s prepared-state memo entries (a hot-reload
+        replaced its registry version, serving/server.py). Compiled
+        executables stay -- they are keyed by shapes and shared across
+        models -- and a later pinned-version request simply re-prepares
+        the state. Returns the number of entries released."""
+        dead = [k for k, v in self._state_memo.items() if v[0] is state]
+        for k in dead:
+            del self._state_memo[k]
+        return len(dead)
+
     # -- executables -----------------------------------------------------
 
     def _executable(self, kind: str, block: int, kb: int, d: int):

@@ -135,6 +135,49 @@ class ModelRegistry:
                 "'-' only; must not start with a separator)")
         return name
 
+    # -- hot-reload polling ----------------------------------------------
+
+    def latest_fingerprint(self, name: str) -> Optional[Tuple[int, str]]:
+        """(newest version, its manifest fingerprint) for ``name``;
+        None when the model has no complete version.
+
+        The fingerprint is the manifest's mtime_ns:size -- the manifest
+        is written LAST in the atomic save protocol, so its stat changes
+        exactly when a new version becomes complete. Versions are
+        immutable, so a changed (version, fingerprint) pair is always a
+        NEW version (or a re-rooted registry), never a mutated one.
+        """
+        versions = self.versions(name)
+        if not versions:
+            return None
+        v = versions[-1]
+        man = os.path.join(self._root, name, str(v), MANIFEST_FILE)
+        try:
+            st = os.stat(man)
+            fp = f"{st.st_mtime_ns}:{st.st_size}"
+        except OSError:
+            fp = ""  # torn mid-write; the next poll re-stats
+        return (v, fp)
+
+    def poll(self, snapshot: Dict[str, Tuple[int, str]]
+             ) -> Dict[str, Tuple[int, str]]:
+        """Models whose newest version changed vs ``snapshot``.
+
+        ``snapshot`` maps name -> (version, fingerprint) as previously
+        returned by :meth:`latest_fingerprint`; the result carries only
+        the CHANGED entries with their new pair. The server's hot-reload
+        loop (serving/server.py ``maybe_reload``) is the caller: it
+        swaps the ``version=None`` route of each changed model and
+        updates its snapshot. Pure stat()s -- no artifact is opened, so
+        polling every few seconds is free.
+        """
+        changed: Dict[str, Tuple[int, str]] = {}
+        for name in set(snapshot) | set(self.models()):
+            cur = self.latest_fingerprint(name)
+            if cur is not None and cur != snapshot.get(name):
+                changed[name] = cur
+        return changed
+
     # -- save ------------------------------------------------------------
 
     def save(self, name: str, result, *, config=None,
@@ -256,6 +299,15 @@ class ModelRegistry:
                         for v, e in failures)) from failures[0][1]
 
     def _load_version(self, name: str, version: int) -> ServedModel:
+        from ..testing import faults
+
+        if faults.take("registry_torn", name=name,
+                       version=version) is not None:
+            # Deterministic stand-in for an artifact torn on disk: the
+            # walk-back, breaker, and hot-reload paths rehearse against
+            # it (docs/ROBUSTNESS.md "Serving").
+            raise RegistryError(
+                f"{name!r} v{version}: injected registry_torn fault")
         vdir = os.path.join(self._root, self._check_name(name),
                             str(version))
         man_path = os.path.join(vdir, MANIFEST_FILE)

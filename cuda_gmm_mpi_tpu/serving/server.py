@@ -31,6 +31,37 @@ Telemetry (stream rev v1.6, docs/OBSERVABILITY.md): ``serve_request``
 per request, ``serve_batch`` per coalesced dispatch, and a closing
 ``serve_summary`` with QPS + latency percentiles + the MetricsRegistry
 snapshot -- rendered by ``gmm report``.
+
+Resilience layer (docs/ROBUSTNESS.md "Serving"; stream rev v1.7):
+
+- **graceful drain** -- ``serve_main`` runs under ``supervisor.use()``,
+  so SIGTERM/SIGINT and ``--max-runtime`` flip a drain instead of
+  killing the loop: accepted requests are flushed, post-drain arrivals
+  answer ``{"ok": false, "error": "shutting_down"}``, the
+  ``serve_summary`` is emitted, and the process exits 75 (the PR-4
+  ``EX_TEMPFAIL`` contract -- a batch scheduler restarts it blindly).
+- **admission control** -- ``--max-queue-rows`` bounds the batching
+  queue; arrivals past the bound shed with ``overloaded`` (queued
+  survivors are unaffected). ``--default-deadline-ms`` / a per-request
+  ``deadline_ms`` give each request a budget: a request whose budget
+  expires while queued is rejected with ``deadline_expired`` BEFORE its
+  dispatch, and the coalescing window never outwaits the first
+  request's remaining budget.
+- **registry hot-reload** -- an opt-in ``--reload-interval-s`` loop
+  polls the registry (manifest mtime/size fingerprints) BETWEEN ticks
+  on the loop thread, so an export while serving atomically swaps the
+  ``version=None`` route with in-flight ticks finished on the old
+  version; explicitly pinned versions keep serving bit-identically.
+- **per-model circuit breakers** (serving/breaker.py) -- repeated
+  route failures (non-finite scores via a cheap post-dispatch check,
+  ``RegistryError``, executor errors) open the route: requests
+  fast-fail with ``circuit_open`` while every other model keeps
+  serving; a jittered backoff half-opens it and a healthy probe closes
+  it.
+
+Resilience rejections reply with a machine-readable token in ``error``
+(``overloaded`` / ``shutting_down`` / ``deadline_expired`` /
+``circuit_open``) and the human detail in ``detail``.
 """
 
 from __future__ import annotations
@@ -46,7 +77,10 @@ from typing import Any, Callable, Dict, List, Optional, Tuple
 
 import numpy as np
 
+from .. import supervisor as supervisor_mod
 from .. import telemetry
+from ..testing import faults
+from .breaker import CircuitBreakers
 from .executor import ScoringExecutor, executor_for_model
 from .registry import ModelRegistry, RegistryError, ServedModel
 
@@ -58,14 +92,21 @@ _LATENCY_CAP = 100_000
 
 class _Pending:
     """One in-flight request: the decoded body, where to reply, when it
-    arrived."""
+    arrived, and when its budget runs out (None = no deadline)."""
 
-    __slots__ = ("req", "reply", "t0")
+    __slots__ = ("req", "reply", "t0", "deadline")
 
-    def __init__(self, req: dict, reply: Callable[[dict], None]):
+    def __init__(self, req: dict, reply: Callable[[dict], None],
+                 default_deadline_ms: Optional[float] = None):
         self.req = req
         self.reply = reply
         self.t0 = time.perf_counter()
+        ms = default_deadline_ms
+        if isinstance(req, dict):
+            raw = req.get("deadline_ms")
+            if isinstance(raw, (int, float)) and not isinstance(raw, bool):
+                ms = float(raw)
+        self.deadline = (self.t0 + ms / 1e3) if ms and ms > 0 else None
 
 
 class GMMServer:
@@ -74,7 +115,11 @@ class GMMServer:
     def __init__(self, registry: ModelRegistry, *,
                  max_batch_rows: int = 8192, tick_s: float = 0.002,
                  executor: Optional[ScoringExecutor] = None,
-                 warm: bool = True):
+                 warm: bool = True,
+                 max_queue_rows: Optional[int] = None,
+                 default_deadline_ms: Optional[float] = None,
+                 breaker_threshold: int = 3,
+                 breaker_backoff_s: float = 1.0):
         self._registry = registry
         self._max_batch_rows = max(1, int(max_batch_rows))
         self._tick_s = max(0.0, float(tick_s))
@@ -91,6 +136,24 @@ class GMMServer:
         self.batches = 0
         self.rows = 0
         self.errors = 0
+        # -- resilience layer (docs/ROBUSTNESS.md "Serving") --
+        self._max_queue_rows = (int(max_queue_rows)
+                                if max_queue_rows else None)
+        self._default_deadline_ms = (float(default_deadline_ms)
+                                     if default_deadline_ms else None)
+        self._adm_lock = threading.Lock()
+        self._queued_rows = 0  # rows admitted but not yet popped
+        self._draining = threading.Event()
+        self.drain_reason: Optional[str] = None
+        self.breaker = CircuitBreakers(threshold=breaker_threshold,
+                                       backoff_base_s=breaker_backoff_s)
+        # name -> (version, fingerprint) of the newest registry version
+        # observed; maybe_reload polls against it.
+        self._route_snapshot: Dict[str, Tuple[int, str]] = {}
+        self.shed = 0
+        self.deadline_expired = 0
+        self.reloads = 0
+        self.breaker_fastfails = 0
 
     # -- model / executor resolution ------------------------------------
 
@@ -98,18 +161,70 @@ class GMMServer:
                 ) -> ServedModel:
         """The (cached) served model for one (name, version) route.
 
-        ``version=None`` pins the newest version AT FIRST USE -- a serve
-        process is version-stable; export a new version and restart (or
-        address it explicitly) to roll."""
+        ``version=None`` pins the newest version at first use; with the
+        opt-in hot-reload loop (``--reload-interval-s``,
+        :meth:`maybe_reload`) a later export atomically re-pins that
+        default route to the new version between ticks. Explicit
+        versions stay pinned forever."""
         key = (name, version)
         m = self._models.get(key)
         if m is None:
             m = self._registry.load(name, version)
             self._models[key] = m
             self._models.setdefault((name, m.version), m)
+            if version is None:
+                fp = self._registry.latest_fingerprint(name)
+                if fp is not None:
+                    self._route_snapshot[name] = fp
             if self._warm:
                 self._executor_for(m).warmup(m.state)
         return m
+
+    def maybe_reload(self) -> List[dict]:
+        """Poll the registry and swap every ``version=None`` route whose
+        model grew a new readable version; returns the swap audit list.
+
+        Runs on the TICK-LOOP THREAD between coalesced dispatches
+        (run_loop's ``reload_interval_s``), which is the bit-parity
+        guarantee: an in-flight tick always finishes on the version it
+        resolved. The old version's prepared executor state is released
+        (recomputable -- a pinned request re-prepares it) and its
+        default-route breaker resets so the new version starts closed.
+        """
+        changed = self._registry.poll(self._route_snapshot)
+        swaps: List[dict] = []
+        rec = telemetry.current()
+        for name, fp in sorted(changed.items()):
+            self._route_snapshot[name] = fp
+            cur = self._models.get((name, None))
+            if cur is None:
+                continue  # not an active default route; nothing pinned
+            try:
+                new_m = self._registry.load(name)
+            except (RegistryError, OSError) as e:
+                # The newest version is torn/unreadable: keep serving
+                # the current one; the next poll retries.
+                from ..utils.logging_ import get_logger
+
+                get_logger().warning(
+                    "hot-reload of %r skipped: %s", name, e)
+                continue
+            if new_m.version == cur.version:
+                continue  # walk-back landed on the already-served version
+            if self._warm:
+                self._executor_for(new_m).warmup(new_m.state)
+            self._models[(name, None)] = new_m  # the atomic route swap
+            self._models.setdefault((name, new_m.version), new_m)
+            self.breaker.reset((name, None))
+            self._executor_for(cur).release_state(cur.state)
+            self.reloads += 1
+            swap = {"model": name, "from_version": cur.version,
+                    "to_version": new_m.version}
+            swaps.append(swap)
+            if rec.active:
+                rec.emit("serve_reload", fingerprint=fp[1], **swap)
+                rec.metrics.count("serve_reloads")
+        return swaps
 
     def _executor_for(self, m: ServedModel) -> ScoringExecutor:
         if self._executor_override is not None:
@@ -151,6 +266,29 @@ class GMMServer:
                 self._process([p])
         return [r for r in responses if r is not None]
 
+    def _expire(self, p: _Pending) -> bool:
+        """Reject ``p`` with ``deadline_expired`` when its budget ran
+        out while queued (checked per coalesced tick, BEFORE dispatch --
+        an expired request never costs an executor call)."""
+        if p.deadline is None or time.perf_counter() <= p.deadline:
+            return False
+        waited_ms = (time.perf_counter() - p.t0) * 1e3
+        deadline_ms = (p.deadline - p.t0) * 1e3
+        self.deadline_expired += 1
+        req = p.req if isinstance(p.req, dict) else {}
+        rec = telemetry.current()
+        if rec.active:
+            rec.emit("serve_deadline",
+                     deadline_ms=round(deadline_ms, 3),
+                     waited_ms=round(waited_ms, 3),
+                     model=req.get("model"), op=req.get("op"))
+            rec.metrics.count("serve_deadline_expired")
+        self._reply_error(
+            p, "deadline_expired",
+            detail=f"request budget of {deadline_ms:.1f} ms expired "
+            f"after {waited_ms:.1f} ms in queue")
+        return True
+
     def _process(self, pendings: List[_Pending]) -> None:
         """Group one tick's requests per (model, version) and dispatch
         each group as a single coalesced executor call."""
@@ -160,6 +298,14 @@ class GMMServer:
             req = p.req
             if not isinstance(req, dict):
                 self._reply_error(p, "request is not a JSON object")
+                continue
+            if self._expire(p):
+                continue
+            raw_deadline = req.get("deadline_ms")
+            if raw_deadline is not None and (
+                    isinstance(raw_deadline, bool)
+                    or not isinstance(raw_deadline, (int, float))):
+                self._reply_error(p, "'deadline_ms' must be a number")
                 continue
             op = req.get("op")
             if op == "shutdown":
@@ -204,12 +350,35 @@ class GMMServer:
     def _dispatch(self, name: str, version: Optional[int],
                   items: List[Tuple[_Pending, np.ndarray]]) -> None:
         """One coalesced dispatch: concatenate every request's rows,
-        score once, slice per request, answer per op."""
+        score once, slice per request, answer per op.
+
+        Route failures -- RegistryError at resolve, an executor error,
+        or non-finite scores (the cheap post-dispatch poison check) --
+        feed the (model, version) circuit breaker; while its breaker is
+        open the whole group fast-fails with ``circuit_open`` before any
+        of that cost. Client-content errors (wrong D) never touch the
+        breaker."""
         rec = telemetry.current()
         t0 = time.perf_counter()
+        route = (name, version)
+        denial = self.breaker.admit(route)
+        if denial is not None:
+            self.breaker_fastfails += 1
+            if rec.active:
+                rec.metrics.count("serve_breaker_fastfails",
+                                  len(items))
+            for p, _ in items:
+                self._reply_error(
+                    p, "circuit_open", model=name,
+                    detail=f"model {name!r}"
+                    + (f" v{version}" if version is not None else "")
+                    + " is failing; retry in "
+                    f"{denial['retry_in_s']:.1f}s")
+            return
         try:
             m = self.resolve(name, version)
         except (RegistryError, OSError) as e:
+            self.breaker.record_failure(route, "registry")
             for p, _ in items:
                 self._reply_error(p, str(e), model=name)
             return
@@ -230,8 +399,35 @@ class GMMServer:
         rows = np.concatenate(xs, axis=0).astype(
             np.dtype(m.dtype), copy=False)
         rows = rows - m.data_shift[None, :].astype(rows.dtype)
+        slow = faults.take("serve_slow", model=name)
+        if slow is not None:
+            time.sleep(float(slow.get("ms", 0)) / 1e3)
         compiles_before = ex.compile_count
-        w, logz = ex.infer(m.state, rows, want="proba")
+        try:
+            w, logz = ex.infer(m.state, rows, want="proba")
+        except Exception as e:  # executor/compile failure: a route fault
+            self.breaker.record_failure(route, "executor")
+            for p, _ in good:
+                self._reply_error(p, f"dispatch failed: {e}", model=name)
+            return
+        if faults.take("serve_nan", model=name) is not None:
+            w = np.full_like(w, np.nan)
+            logz = np.full_like(logz, np.nan)
+        if not np.isfinite(logz).all():
+            # The poisoned-artifact containment: logz is [rows], so the
+            # check is O(rows) against the O(rows x K x D^2) dispatch,
+            # and every op's result derives from the same densities.
+            self.breaker.record_failure(route, "non_finite")
+            if rec.active:
+                rec.metrics.count("serve_nonfinite_batches")
+            for p, _ in good:
+                self._reply_error(
+                    p, "non_finite_scores", model=name,
+                    detail=f"model {name!r} v{m.version} scored "
+                    "non-finite densities; its route breaker counts "
+                    "the failure")
+            return
+        self.breaker.record_success(route)
         wall_ms = (time.perf_counter() - t0) * 1e3
         compiled = ex.compile_count - compiles_before
         self.batches += 1
@@ -288,7 +484,8 @@ class GMMServer:
             rec.metrics.observe("serve.latency_ms", latency_ms)
         p.reply(resp)
 
-    def _reply_error(self, p: _Pending, msg: str, model=None) -> None:
+    def _reply_error(self, p: _Pending, msg: str, model=None,
+                     detail: Optional[str] = None) -> None:
         self.errors += 1
         rec = telemetry.current()
         if rec.active:
@@ -296,6 +493,7 @@ class GMMServer:
         self._reply(p, {"id": (p.req.get("id")
                                if isinstance(p.req, dict) else None),
                         "ok": False, "error": msg,
+                        **({"detail": detail} if detail else {}),
                         **({"model": model} if model else {})})
 
     # -- summary ---------------------------------------------------------
@@ -311,10 +509,23 @@ class GMMServer:
             "max": round(float(lat.max()), 3),
         }
 
+    def resilience_stats(self) -> Dict[str, Any]:
+        """The v1.7 resilience counters (serve_summary + bench --serve):
+        shed / deadline-expired request counts, breaker trips and
+        fast-fails, and hot-reload swaps."""
+        return {
+            "shed": int(self.shed),
+            "deadline_expired": int(self.deadline_expired),
+            "reloads": int(self.reloads),
+            "breaker": dict(self.breaker.stats(),
+                            fastfails=int(self.breaker_fastfails)),
+        }
+
     def emit_summary(self) -> Optional[dict]:
         """The closing ``serve_summary`` record (run_summary's serving
         sibling): volume, QPS, latency percentiles, executor counters,
-        and the metrics-registry snapshot."""
+        the resilience counters (rev v1.7), and the metrics-registry
+        snapshot."""
         rec = telemetry.current()
         wall = time.perf_counter() - self._t_start
         if not rec.active:
@@ -330,13 +541,14 @@ class GMMServer:
                            for (n, _), m in self._models.items()}),
             executor=self.executor_stats(),
             metrics=rec.metrics.snapshot(),
+            **self.resilience_stats(),
         )
 
     # -- streaming loops -------------------------------------------------
 
     def submit_line(self, line: str, reply: Callable[[dict], None]) -> None:
-        """Decode one protocol line onto the batching queue (reader
-        threads call this; the tick loop drains it)."""
+        """Decode one protocol line through admission control (reader
+        threads call this; the tick loop drains the queue)."""
         line = line.strip()
         if not line:
             return
@@ -346,41 +558,144 @@ class GMMServer:
             p = _Pending({}, reply)
             self._reply_error(p, f"not JSON: {e}")
             return
-        self._queue.put(_Pending(req, reply))
+        self.submit(_Pending(req, reply, self._default_deadline_ms))
+
+    def submit(self, p: _Pending) -> bool:
+        """Admit ``p`` onto the batching queue, or shed it.
+
+        Two rejection gates, both answered immediately on the reader
+        thread (an overloaded or draining server must not buffer the
+        very traffic it cannot take): ``shutting_down`` once the drain
+        began, and ``overloaded`` when the queued row count would pass
+        ``max_queue_rows`` (a request wider than the whole bound is
+        still admitted when the queue is empty -- it can never fit
+        better later). Returns True when queued.
+        """
+        if self._draining.is_set():
+            self._shed(p, "shutting_down")
+            return False
+        rows = _rows_of(p)
+        if self._max_queue_rows is not None:
+            with self._adm_lock:
+                if (self._queued_rows > 0
+                        and self._queued_rows + rows > self._max_queue_rows):
+                    self._shed(p, "overloaded", rows=rows)
+                    return False
+                self._queued_rows += rows
+        self._queue.put(p)
+        return True
+
+    def _shed(self, p: _Pending, reason: str, rows: int = 0) -> None:
+        self.shed += 1
+        req = p.req if isinstance(p.req, dict) else {}
+        rec = telemetry.current()
+        if rec.active:
+            fields: Dict[str, Any] = {"reason": reason,
+                                      "model": req.get("model")}
+            if reason == "overloaded":
+                fields.update(rows=int(rows),
+                              queued_rows=int(self._queued_rows),
+                              max_queue_rows=int(self._max_queue_rows))
+            rec.emit("serve_shed", **fields)
+            rec.metrics.count("serve_sheds")
+        detail = ("server is draining; no new requests accepted"
+                  if reason == "shutting_down" else
+                  f"admission queue is full ({self._queued_rows} of "
+                  f"{self._max_queue_rows} rows queued)")
+        self._reply_error(p, reason, model=req.get("model"),
+                          detail=detail)
+
+    def _pop(self, timeout: Optional[float]) -> Optional[_Pending]:
+        """One queue pop (None timeout = nonblocking), releasing the
+        popped request's admission rows. Raises ``queue.Empty``."""
+        p = (self._queue.get_nowait() if timeout is None
+             else self._queue.get(timeout=timeout))
+        if p is not None and self._max_queue_rows is not None:
+            with self._adm_lock:
+                self._queued_rows = max(0, self._queued_rows - _rows_of(p))
+        return p
+
+    def begin_drain(self, reason: str) -> None:
+        """Flip the drain: stop admitting, keep flushing what was
+        accepted. Idempotent; the first reason wins."""
+        if not self._draining.is_set():
+            self.drain_reason = reason
+            self._draining.set()
+
+    @property
+    def draining(self) -> bool:
+        return self._draining.is_set()
 
     def run_loop(self, *, max_requests: Optional[int] = None,
                  idle_timeout_s: Optional[float] = None,
-                 draining: Optional[Callable[[], bool]] = None) -> None:
+                 draining: Optional[Callable[[], bool]] = None,
+                 reload_interval_s: Optional[float] = None) -> str:
         """The micro-batching tick loop: block for the first pending
         request, gather everything that arrives within one tick (bounded
-        by ``max_batch_rows``), dispatch the coalesced groups, repeat.
+        by ``max_batch_rows`` and the first request's deadline budget),
+        dispatch the coalesced groups, repeat.
 
-        Ends on ``shutdown``, after ``max_requests`` replies, after
-        ``idle_timeout_s`` with an empty queue, or -- with ``draining``
-        supplied (stdin mode: True once EOF hit) -- when the input is
-        exhausted and the queue is empty.
+        Returns the stop reason: ``"shutdown"`` (protocol op),
+        ``"max_requests"``, ``"idle"`` (``idle_timeout_s`` with an empty
+        queue), ``"eof"`` (``draining`` callback true with an empty
+        queue -- stdin exhausted), or ``"preempted"`` (the ambient
+        supervisor's stop flag: SIGTERM/SIGINT/--max-runtime -- the
+        caller exits 75 after the flush). Every exit first flushes the
+        already-admitted queue; post-drain arrivals are shed with
+        ``shutting_down``. ``reload_interval_s`` opts into the registry
+        hot-reload poll between ticks (:meth:`maybe_reload`).
         """
-        while not self._stop.is_set():
-            if max_requests is not None and self.requests >= max_requests:
+        sup = supervisor_mod.current()
+        reason = "shutdown"
+        next_reload = (time.perf_counter() + reload_interval_s
+                       if reload_interval_s else None)
+        idle_since = time.perf_counter()
+        while True:
+            if self._stop.is_set():
+                reason = "shutdown"
                 break
+            if sup.active and sup.poll(where="serve"):
+                reason = "preempted"
+                self.begin_drain(sup.stop_reason or "preempt")
+                break
+            if max_requests is not None and self.requests >= max_requests:
+                reason = "max_requests"
+                break
+            if (next_reload is not None
+                    and time.perf_counter() >= next_reload):
+                self.maybe_reload()
+                next_reload = time.perf_counter() + reload_interval_s
+            # Bounded wait so signals/deadline/reload stay responsive
+            # even on an idle queue.
+            wait = 0.1 if idle_timeout_s is None else min(
+                0.1, idle_timeout_s)
             try:
-                first = self._queue.get(timeout=idle_timeout_s or 0.1)
+                first = self._pop(timeout=wait)
             except queue.Empty:
-                if idle_timeout_s is not None:
+                now = time.perf_counter()
+                if (idle_timeout_s is not None
+                        and now - idle_since >= idle_timeout_s):
+                    reason = "idle"
                     break
                 if draining is not None and draining():
+                    reason = "eof"
                     break
                 continue
+            idle_since = time.perf_counter()
             if first is None:
+                reason = "shutdown"
                 break
             batch = [first]
             rows = _rows_of(first)
-            deadline = time.perf_counter() + self._tick_s
+            tick_end = time.perf_counter() + self._tick_s
+            if first.deadline is not None:
+                # Never let the gather window outwait the first
+                # request's remaining budget.
+                tick_end = min(tick_end, first.deadline)
             while rows < self._max_batch_rows:
-                remaining = deadline - time.perf_counter()
+                remaining = tick_end - time.perf_counter()
                 try:
-                    p = (self._queue.get_nowait() if remaining <= 0
-                         else self._queue.get(timeout=remaining))
+                    p = self._pop(None if remaining <= 0 else remaining)
                 except queue.Empty:
                     break
                 if p is None:
@@ -389,18 +704,25 @@ class GMMServer:
                 batch.append(p)
                 rows += _rows_of(p)
             self._process(batch)
-        # Drain whatever is still queued (EOF/shutdown must not drop
-        # accepted requests on the floor).
+        # Flush whatever was admitted before the stop (EOF/shutdown/
+        # preemption must not drop accepted requests on the floor). On a
+        # TERMINAL exit the drain flag flips first so concurrent
+        # arrivals shed with shutting_down instead of racing the flush;
+        # idle/max_requests exits stay resumable (benchmarks re-enter
+        # the loop).
+        if reason in ("preempted", "shutdown", "eof"):
+            self.begin_drain(reason)
         leftovers = []
         while True:
             try:
-                p = self._queue.get_nowait()
+                p = self._pop(None)
             except queue.Empty:
                 break
             if p is not None:
                 leftovers.append(p)
         if leftovers:
             self._process(leftovers)
+        return reason
 
 
 def _rows_of(p: _Pending) -> int:
@@ -431,11 +753,13 @@ def _json_default(o):
 
 
 def _serve_socket(server: GMMServer, path: str,
-                  max_requests: Optional[int]) -> None:
+                  max_requests: Optional[int],
+                  reload_interval_s: Optional[float] = None) -> str:
     """UNIX-socket front end: every connection speaks the same JSONL
     protocol; requests from ALL connections land on one batching queue,
     so concurrent clients coalesce into shared dispatches (the
-    micro-batching win a per-connection loop could never get)."""
+    micro-batching win a per-connection loop could never get). Returns
+    the tick loop's stop reason."""
     import socketserver
 
     class Handler(socketserver.StreamRequestHandler):
@@ -467,7 +791,8 @@ def _serve_socket(server: GMMServer, path: str,
                              kwargs={"poll_interval": 0.05}, daemon=True)
         t.start()
         try:
-            server.run_loop(max_requests=max_requests)
+            return server.run_loop(max_requests=max_requests,
+                                   reload_interval_s=reload_interval_s)
         finally:
             srv.shutdown()
             try:
@@ -515,9 +840,52 @@ def serve_main(argv=None) -> int:
                    help="JAX platform: tpu | cpu | gpu (default: auto)")
     p.add_argument("--metrics-file", default=None, metavar="FILE.jsonl",
                    help="serve telemetry stream: serve_request / "
-                   "serve_batch / serve_summary records (schema rev "
-                   "v1.6; render with `gmm report`)")
+                   "serve_batch / serve_summary plus the v1.7 "
+                   "resilience events (serve_shed / serve_deadline / "
+                   "serve_reload / circuit); render with `gmm report`")
+    r = p.add_argument_group(
+        "resilience (docs/ROBUSTNESS.md \"Serving\")")
+    r.add_argument("--max-runtime", type=float, default=None,
+                   metavar="SECONDS",
+                   help="wall-clock budget: reaching it drains like "
+                   "SIGTERM does -- flush the queue, answer "
+                   "shutting_down to late arrivals, exit 75 "
+                   "(EX_TEMPFAIL; the fit CLI's preemption contract)")
+    r.add_argument("--max-queue-rows", type=int, default=None,
+                   metavar="ROWS",
+                   help="admission bound on queued request rows; "
+                   "arrivals past it shed immediately with "
+                   "'overloaded' instead of growing the queue without "
+                   "bound (default: unbounded)")
+    r.add_argument("--default-deadline-ms", type=float, default=None,
+                   metavar="MS",
+                   help="per-request budget for requests that carry no "
+                   "deadline_ms of their own; a request whose budget "
+                   "expires while queued is rejected with "
+                   "'deadline_expired' before dispatch")
+    r.add_argument("--reload-interval-s", type=float, default=None,
+                   metavar="SECONDS",
+                   help="opt-in registry hot-reload: poll the registry "
+                   "at this cadence and atomically swap version-less "
+                   "routes to newly exported versions between ticks "
+                   "(pinned versions are untouched; default: off -- "
+                   "versions pin at first use)")
+    r.add_argument("--breaker-threshold", type=int, default=3,
+                   help="consecutive route failures (non-finite "
+                   "scores, registry/executor errors) that open a "
+                   "(model, version) circuit breaker (default 3)")
+    r.add_argument("--breaker-backoff-s", type=float, default=1.0,
+                   help="base seconds an open breaker fast-fails "
+                   "before half-opening; doubles per consecutive "
+                   "trip with deterministic jitter (default 1)")
     args = p.parse_args(argv)
+
+    if args.socket and (args.input or args.output):
+        # Loud conflict, not a silent ignore: socket mode replies on
+        # each client's own connection, so --input/--output could never
+        # take effect.
+        p.error("--socket conflicts with --input/--output (socket "
+                "clients carry their own request/response streams)")
 
     if args.device:
         os.environ["JAX_PLATFORMS"] = args.device
@@ -529,13 +897,25 @@ def serve_main(argv=None) -> int:
     server = GMMServer(registry,
                        max_batch_rows=args.max_batch_rows,
                        tick_s=args.tick_ms / 1e3,
-                       warm=not args.no_warmup)
+                       warm=not args.no_warmup,
+                       max_queue_rows=args.max_queue_rows,
+                       default_deadline_ms=args.default_deadline_ms,
+                       breaker_threshold=args.breaker_threshold,
+                       breaker_backoff_s=args.breaker_backoff_s)
 
     rec = (telemetry.RunRecorder(args.metrics_file)
            if args.metrics_file else telemetry.RunRecorder())
     rec.set_context(path="serve")
 
-    with telemetry.use(rec), rec:
+    # The run supervisor gives `gmm serve` the fit CLI's preemption
+    # contract (docs/ROBUSTNESS.md "Run lifecycle"): SIGTERM/SIGINT and
+    # the --max-runtime deadline flip a graceful drain observed by the
+    # tick loop, never a mid-dispatch kill. Signal handlers install on
+    # the main thread only (library/thread callers keep deadline
+    # support).
+    sup = supervisor_mod.RunSupervisor(max_runtime_s=args.max_runtime)
+
+    with telemetry.use(rec), rec, supervisor_mod.use(sup):
         # Pre-resolve (and AOT-warm) the requested model set so the first
         # request never pays registry IO or a compile.
         names = args.models
@@ -550,7 +930,8 @@ def serve_main(argv=None) -> int:
             return 1
 
         if args.socket:
-            _serve_socket(server, args.socket, args.max_requests)
+            reason = _serve_socket(server, args.socket, args.max_requests,
+                                   args.reload_interval_s)
         else:
             out = (open(args.output, "w", encoding="utf-8")
                    if args.output else sys.stdout)
@@ -570,12 +951,24 @@ def serve_main(argv=None) -> int:
             t = threading.Thread(target=read_all, daemon=True)
             t.start()
             try:
-                server.run_loop(max_requests=args.max_requests,
-                                draining=eof.is_set)
+                reason = server.run_loop(
+                    max_requests=args.max_requests, draining=eof.is_set,
+                    reload_interval_s=args.reload_interval_s)
             finally:
                 if args.input:
                     src.close()
                 if args.output:
                     out.close()
         server.emit_summary()
+        if reason == "preempted":
+            # The PR-4 exit contract: drained by signal/deadline ->
+            # telemetry shutdown record + exit 75 (EX_TEMPFAIL), so a
+            # batch scheduler restarts the server unconditionally.
+            stop_reason = server.drain_reason or "preempt"
+            if rec.active:
+                rec.emit("shutdown", reason=stop_reason,
+                         checkpointed=False)
+            print(f"Preempted -- serve loop drained ({stop_reason}); "
+                  "queued requests flushed", file=sys.stderr)
+            return supervisor_mod.EX_TEMPFAIL
     return 0

@@ -13,8 +13,10 @@ deadlines, and peer loss into clean, resumable exits:
   exposes a cooperative stop flag. Signal handlers only SET the flag -- all
   real work happens at the next poll point on the main thread, never in
   signal context.
-- The host-driven sweep, the streaming block loop, and the segmented EM
-  driver (``GMMModel.run_em_resumable``) poll the flag between device
+- The host-driven sweep, the streaming block loop, the segmented EM
+  driver (``GMMModel.run_em_resumable``), and the serving tick loop
+  (``serving/server.py`` -- which drains instead of checkpointing: flush
+  the queue, shed late arrivals, exit 75) poll the flag between device
   dispatches. On stop they write an *emergency checkpoint* -- the intra-K
   sub-step of :class:`~cuda_gmm_mpi_tpu.utils.checkpoint.SweepCheckpointer`
   carrying the mid-EM state, iteration count, loglik trajectory, and (for

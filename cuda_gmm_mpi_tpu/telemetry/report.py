@@ -71,6 +71,12 @@ def render_report(records: List[dict], max_trajectory_rows: int = 400) -> str:
                      if r.get("event") == "serve_batch"]
     serve_summaries = [r for r in records
                        if r.get("event") == "serve_summary"]
+    serve_sheds = [r for r in records if r.get("event") == "serve_shed"]
+    serve_deadlines = [r for r in records
+                       if r.get("event") == "serve_deadline"]
+    serve_reloads = [r for r in records
+                     if r.get("event") == "serve_reload"]
+    circuits = [r for r in records if r.get("event") == "circuit"]
 
     selects = [r for r in records if r.get("event") == "restart_select"]
     healths = [r for r in records if r.get("event") == "health"]
@@ -118,7 +124,8 @@ def render_report(records: List[dict], max_trajectory_rows: int = 400) -> str:
                    f"{total_bytes / 1e6:.1f} MB host->device")
         out.append("")
 
-    if serve_reqs or serve_batches or serve_summaries:
+    if (serve_reqs or serve_batches or serve_summaries or serve_sheds
+            or serve_deadlines or serve_reloads or circuits):
         out.append("Serving (rev v1.6; docs/SERVING.md):")
         if serve_reqs:
             by_model: Dict[str, List[dict]] = {}
@@ -145,6 +152,34 @@ def render_report(records: List[dict], max_trajectory_rows: int = 400) -> str:
                 f"{reqs / max(len(serve_batches), 1):.2f} requests/batch, "
                 f"{rows} rows ({padded} dispatched after bucketing), "
                 f"{compiled} AOT compiles")
+        # Resilience (rev v1.7; docs/ROBUSTNESS.md "Serving").
+        if serve_sheds:
+            by_reason: Dict[str, int] = {}
+            for r in serve_sheds:
+                by_reason[str(r.get("reason"))] = \
+                    by_reason.get(str(r.get("reason")), 0) + 1
+            out.append("  shed: " + ", ".join(
+                f"{n} {reason}" for reason, n in sorted(by_reason.items())))
+        if serve_deadlines:
+            waits = [float(r.get("waited_ms", 0.0))
+                     for r in serve_deadlines]
+            out.append(
+                f"  {len(serve_deadlines)} requests expired past their "
+                f"deadline (max waited {max(waits):.1f} ms)")
+        for r in serve_reloads:
+            out.append(
+                f"  hot-reload {r.get('model')}: "
+                f"v{r.get('from_version')} -> v{r.get('to_version')}")
+        for r in circuits:
+            ver = (f"@{r['version']}" if r.get("version") is not None
+                   else "")
+            tail = ""
+            if r.get("state") == "open":
+                tail = (f" (failures={r.get('failures')}, "
+                        f"reason={r.get('reason')}, "
+                        f"backoff {r.get('backoff_s')}s)")
+            out.append(f"  circuit {r.get('model')}{ver}: "
+                       f"{r.get('state')}{tail}")
         for s in serve_summaries:
             lat = s.get("latency_ms") or {}
             out.append(
@@ -160,6 +195,16 @@ def render_report(records: List[dict], max_trajectory_rows: int = 400) -> str:
                     f"{ex.get('hits', 0)} hits / "
                     f"{ex.get('misses', 0)} misses, "
                     f"{ex.get('evictions', 0)} evictions")
+            br = s.get("breaker") or {}
+            if any(s.get(k) for k in ("shed", "deadline_expired",
+                                      "reloads")) or any(br.values()):
+                out.append(
+                    f"  resilience: {s.get('shed', 0)} shed, "
+                    f"{s.get('deadline_expired', 0)} past deadline, "
+                    f"{br.get('trips', 0)} breaker trips "
+                    f"({br.get('fastfails', 0)} fast-fails, "
+                    f"{br.get('open_routes', 0)} open), "
+                    f"{s.get('reloads', 0)} hot-reloads")
         out.append("")
 
     for r in selects:

@@ -101,7 +101,7 @@ EVENT_FIELDS: Dict[str, Tuple[Tuple[str, ...], Tuple[str, ...]]] = {
     # lifecycle"): the cooperative stop flag was first observed at a poll
     # point. ``reason`` is sigterm / sigint / deadline / peer_lost /
     # preempt_injected; ``where`` the poll site (sweep / em /
-    # stream_block / fused_emit).
+    # stream_block / fused_emit / serve).
     "preempt": (
         ("reason",),
         ("where", "k", "em_iter", "peer"),
@@ -147,13 +147,52 @@ EVENT_FIELDS: Dict[str, Tuple[Tuple[str, ...], Tuple[str, ...]]] = {
         ("model", "requests", "rows", "padded_rows", "wall_ms"),
         ("version", "compiled"),
     ),
+    # One per shed request (stream rev v1.7; serving resilience,
+    # docs/ROBUSTNESS.md "Serving"): admission control rejected the
+    # request before it entered the batching queue. ``reason`` is
+    # ``overloaded`` (bounded queue full) or ``shutting_down`` (arrival
+    # after the drain began).
+    "serve_shed": (
+        ("reason",),
+        ("model", "rows", "queued_rows", "max_queue_rows"),
+    ),
+    # One per deadline-expired request (rev v1.7): its budget
+    # (``deadline_ms``, per-request or --default-deadline-ms) ran out
+    # while queued, so it was rejected BEFORE dispatch -- the executor
+    # never ran for it. ``waited_ms`` is how long it actually sat.
+    "serve_deadline": (
+        ("deadline_ms", "waited_ms"),
+        ("model", "op", "n"),
+    ),
+    # One per hot-reloaded default route (rev v1.7): the registry grew a
+    # new version and the server atomically swapped the version=None
+    # route from ``from_version`` to ``to_version`` between ticks
+    # (in-flight ticks finished on the old version; pinned-version
+    # routes are untouched).
+    "serve_reload": (
+        ("model", "from_version", "to_version"),
+        ("fingerprint",),
+    ),
+    # One per circuit-breaker state transition (rev v1.7;
+    # serving/breaker.py): ``state`` is open / half_open / closed;
+    # ``reason`` what tripped it (non_finite / registry / executor);
+    # ``backoff_s`` the open window on an open transition; ``trips``
+    # the route's consecutive-open count.
+    "circuit": (
+        ("model", "state"),
+        ("version", "failures", "trips", "reason", "backoff_s"),
+    ),
     # One per serve session, at shutdown (run_summary's serving
     # sibling): volume, QPS, latency percentiles, aggregated executor
-    # cache counters, and the metrics-registry snapshot.
+    # cache counters, and the metrics-registry snapshot. Rev v1.7 adds
+    # the resilience counters: ``shed``, ``deadline_expired``,
+    # ``reloads``, and the ``breaker`` {trips, closes, open_routes}
+    # rollup.
     "serve_summary": (
         ("requests", "batches", "rows", "wall_s", "qps", "latency_ms",
          "metrics"),
-        ("models", "executor", "errors"),
+        ("models", "executor", "errors", "shed", "deadline_expired",
+         "reloads", "breaker"),
     ),
     # One per fit: final scores, the 7-category phase profile, the
     # compile-vs-execute split, and the metrics-registry snapshot.

@@ -43,6 +43,22 @@ Supported fault kinds (the spec is ``{kind: {params...}}``):
   or stuck host so the PEER's liveness watchdog (``PeerLostError`` +
   emergency checkpoint) can be rehearsed. The wedged process never
   returns; the test harness kills it.
+- ``serve_nan`` ``{"model": name, "times": n}`` -- the serving loop's
+  coalesced dispatch for ``model`` (any model when omitted) returns
+  all-NaN scores, standing in for a poisoned registry artifact so the
+  post-dispatch non-finite check and the per-route circuit breaker
+  (serving/server.py, serving/breaker.py) can be rehearsed; consumed
+  per dispatch, so a breaker's half-open probe after ``times``
+  dispatches observes the model healthy again.
+- ``serve_slow`` ``{"ms": m, "model": name, "times": n}`` -- the serving
+  dispatch sleeps ``m`` milliseconds before the executor call
+  (optionally only for ``model``): deterministic latency injection for
+  the deadline/coalescing paths; consumed per dispatch.
+- ``registry_torn`` ``{"name": n, "version": v, "times": k}`` -- the
+  registry's version load raises :class:`RegistryError` as if the
+  artifact were torn on disk (optionally only for one name/version);
+  consumed per load attempt, so walk-back and breaker-recovery
+  rehearsals observe the next attempt succeed.
 
 Activation: ``faults.use({...})`` (context manager, in-process tests) or
 the ``GMM_FAULTS`` env var holding the JSON spec (subprocess workers; read
@@ -60,7 +76,17 @@ from typing import Any, Dict, Optional
 ENV_VAR = "GMM_FAULTS"
 
 KNOWN_KINDS = ("nan_loglik", "singular_cov", "poison_block",
-               "checkpoint_eio", "preempt", "rank_hang")
+               "checkpoint_eio", "preempt", "rank_hang",
+               "serve_nan", "serve_slow", "registry_torn")
+
+
+def _values_match(spec_val: Any, val: Any) -> bool:
+    """Spec-vs-call match: integer kinds compare as ints (the original
+    contract); non-numeric params (serve_nan's model NAME) as strings."""
+    try:
+        return int(spec_val) == int(val)
+    except (TypeError, ValueError):
+        return str(spec_val) == str(val)
 
 
 class FaultPlan:
@@ -96,7 +122,7 @@ class FaultPlan:
             if cfg is None or cfg["_remaining"] <= 0:
                 return None
             for key, val in match.items():
-                if key in cfg and int(cfg[key]) != int(val):
+                if key in cfg and not _values_match(cfg[key], val):
                     return None
             cfg["_remaining"] -= 1
             self.fired[kind] = self.fired.get(kind, 0) + 1

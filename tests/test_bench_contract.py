@@ -268,6 +268,15 @@ def test_serve_mode_contract():
     assert warm["p50_s"] < s["cold_first_request_s"]
     assert s["zero_recompile_after_warm"] is True
     assert s["new_compiles_after_warm"] == 0
+    # resilience counters (rev v1.7) ride the record so soak runs
+    # surface degradation; a clean A/B reports all-zero
+    res = s["resilience"]
+    assert res["shed"] == 0
+    assert res["deadline_expired"] == 0
+    assert res["reloads"] == 0
+    assert res["breaker"]["trips"] == 0
+    assert res["breaker"]["fastfails"] == 0
+    assert res["breaker"]["open_routes"] == 0
     # vs_baseline is the cold/warm ratio (record fields are rounded
     # independently, so compare with slack)
     ratio = s["cold_first_request_s"] / warm["p50_s"]

@@ -473,3 +473,490 @@ def test_export_empty_checkpoint_is_loud(tmp_path):
     reg = ModelRegistry(str(tmp_path / "reg"))
     with pytest.raises(RegistryError, match="no sweep checkpoints"):
         reg.export_checkpoint(str(tmp_path / "missing"), "x")
+
+
+# ------------------------------------------------- resilience (rev v1.7)
+#
+# The serving resilience layer (docs/ROBUSTNESS.md "Serving"): graceful
+# drain under the run supervisor, bounded-queue load shedding, request
+# deadlines, registry hot-reload, and per-route circuit breakers -- each
+# rehearsed deterministically via the serve-path fault injections.
+
+import threading
+import time
+
+from cuda_gmm_mpi_tpu import supervisor as supervisor_mod
+from cuda_gmm_mpi_tpu import telemetry
+from cuda_gmm_mpi_tpu.telemetry.schema import validate_stream
+from cuda_gmm_mpi_tpu.testing import faults
+
+
+def _collecting_reply(bucket):
+    def reply(resp):
+        bucket.append(resp)
+    return reply
+
+
+def _req(i, data, n=4, model="m", **extra):
+    return {"id": i, "model": model, "op": "score_samples",
+            "x": data[i * n:(i + 1) * n].tolist(), **extra}
+
+
+def test_socket_mode_conflicts_with_input_output_loudly(tmp_path):
+    """The satellite contract: --socket with --input/--output used to be
+    silently ignored; now it is an argparse error (exit 2)."""
+    from cuda_gmm_mpi_tpu.serving.server import serve_main
+
+    for extra in (["--input", "r.jsonl"], ["--output", "o.jsonl"]):
+        with pytest.raises(SystemExit) as exc:
+            serve_main(["--registry", str(tmp_path / "reg"),
+                        "--socket", str(tmp_path / "s.sock")] + extra)
+        assert exc.value.code == 2
+
+
+def test_drain_flushes_queue_and_sheds_late_arrivals(rng, tmp_path):
+    """The graceful-drain contract: a supervisor stop observed by the
+    tick loop flushes every ADMITTED request (real responses), returns
+    reason 'preempted', and post-drain arrivals answer shutting_down
+    without being queued."""
+    gm, data = fitted(rng)
+    gm.to_registry(str(tmp_path), "m")
+    server = GMMServer(ModelRegistry(str(tmp_path)))
+    got = []
+    for i in range(3):
+        server.submit_line(json.dumps(_req(i, data)),
+                           _collecting_reply(got))
+    sup = supervisor_mod.RunSupervisor(install_signals=False)
+    sup.request_stop("sigterm")
+    stream = []
+    rec = telemetry.RunRecorder(stream=_StreamSink(stream))
+    with telemetry.use(rec), supervisor_mod.use(sup):
+        reason = server.run_loop()
+    assert reason == "preempted"
+    assert server.draining and server.drain_reason == "sigterm"
+    # every admitted request was flushed with its real answer
+    assert sorted(r["id"] for r in got) == [0, 1, 2]
+    assert all(r["ok"] for r in got)
+    np.testing.assert_array_equal(
+        np.asarray(got[0]["result"], np.float32),
+        gm.score_samples(data[0:4]))
+    # a post-drain arrival is shed, never queued
+    late = []
+    server.submit_line(json.dumps(_req(9, data)),
+                       _collecting_reply(late))
+    assert late and not late[0]["ok"]
+    assert late[0]["error"] == "shutting_down"
+    # the supervisor's preempt event rode the stream from the poll site
+    events = [r["event"] for r in stream]
+    assert "preempt" in events
+    preempt = next(r for r in stream if r["event"] == "preempt")
+    assert preempt["where"] == "serve" and preempt["reason"] == "sigterm"
+
+
+class _StreamSink:
+    """Minimal text-stream sink decoding records into a list."""
+
+    def __init__(self, records):
+        self._records = records
+
+    def write(self, line):
+        self._records.append(json.loads(line))
+
+    def flush(self):
+        pass
+
+
+def test_overload_sheds_and_survivors_are_unharmed(rng, tmp_path):
+    """Bounded admission: arrivals past --max-queue-rows shed with
+    'overloaded' on the reader thread; already-queued requests still get
+    their exact results; a shed is a serve_shed record."""
+    gm, data = fitted(rng)
+    gm.to_registry(str(tmp_path), "m")
+    from cuda_gmm_mpi_tpu.serving.server import _Pending
+
+    server = GMMServer(ModelRegistry(str(tmp_path)), max_queue_rows=8)
+    got, shed = [], []
+    for i in range(2):   # 2 x 4 rows fill the bound exactly
+        assert server.submit(_Pending(_req(i, data),
+                                      _collecting_reply(got)))
+    stream = []
+    rec = telemetry.RunRecorder(stream=_StreamSink(stream))
+    with telemetry.use(rec):
+        for i in (2, 3):  # queue full: these must shed immediately
+            server.submit_line(json.dumps(_req(i, data)),
+                               _collecting_reply(shed))
+        assert [r["error"] for r in shed] == ["overloaded"] * 2
+        reason = server.run_loop(idle_timeout_s=0.4)
+    assert reason == "idle"
+    assert server.shed == 2
+    assert sorted(r["id"] for r in got) == [0, 1] and all(
+        r["ok"] for r in got)
+    np.testing.assert_array_equal(
+        np.asarray(got[0]["result"], np.float32),
+        gm.score_samples(data[0:4]))
+    sheds = [r for r in stream if r["event"] == "serve_shed"]
+    assert len(sheds) == 2
+    assert sheds[0]["reason"] == "overloaded"
+    assert sheds[0]["max_queue_rows"] == 8
+    assert validate_stream(stream) == []
+
+
+def test_oversized_request_admitted_only_against_empty_queue(rng,
+                                                             tmp_path):
+    """A request wider than the whole bound must not be rejected forever:
+    it is admitted when the queue is empty (it can never fit better)."""
+    gm, data = fitted(rng)
+    gm.to_registry(str(tmp_path), "m")
+    server = GMMServer(ModelRegistry(str(tmp_path)), max_queue_rows=4)
+    got = []
+    from cuda_gmm_mpi_tpu.serving.server import _Pending
+
+    big = {"id": 0, "model": "m", "op": "score",
+           "x": data[:32].tolist()}
+    assert server.submit(_Pending(big, _collecting_reply(got)))
+    # queue now holds 32 rows > bound: the next request sheds
+    assert not server.submit(_Pending(_req(1, data),
+                                      _collecting_reply(got)))
+    assert server.run_loop(idle_timeout_s=0.3) == "idle"
+    ok = [r for r in got if r.get("ok")]
+    assert len(ok) == 1 and ok[0]["id"] == 0
+
+
+def test_deadline_expired_rejected_before_dispatch(rng, tmp_path):
+    """A request whose budget ran out while queued answers
+    deadline_expired BEFORE dispatch (no executor call, batches
+    counter unmoved); an unexpired sibling in the same tick serves."""
+    gm, data = fitted(rng)
+    gm.to_registry(str(tmp_path), "m")
+    server = GMMServer(ModelRegistry(str(tmp_path)))
+    got = []
+    server.submit_line(json.dumps(_req(0, data, deadline_ms=1)),
+                       _collecting_reply(got))
+    server.submit_line(json.dumps(_req(1, data, deadline_ms=60_000)),
+                       _collecting_reply(got))
+    time.sleep(0.05)  # let request 0's budget lapse in the queue
+    stream = []
+    rec = telemetry.RunRecorder(stream=_StreamSink(stream))
+    batches_before = server.batches
+    with telemetry.use(rec):
+        assert server.run_loop(idle_timeout_s=0.3) == "idle"
+    by_id = {r["id"]: r for r in got}
+    assert not by_id[0]["ok"] and by_id[0]["error"] == "deadline_expired"
+    assert by_id[1]["ok"]
+    assert server.deadline_expired == 1
+    assert server.batches == batches_before + 1  # only the survivor ran
+    dl = [r for r in stream if r["event"] == "serve_deadline"]
+    assert len(dl) == 1 and dl[0]["waited_ms"] >= dl[0]["deadline_ms"]
+    assert validate_stream(stream) == []
+    # bad deadline type is a loud per-request error
+    bad = []
+    server.submit_line(json.dumps(_req(2, data, deadline_ms="soon")),
+                       _collecting_reply(bad))
+    server.run_loop(idle_timeout_s=0.2)
+    assert bad and "deadline_ms" in bad[0]["error"]
+
+
+def test_coalesced_tick_parity_under_serve_slow(rng, tmp_path):
+    """Injected dispatch latency (serve_slow) changes walls, never
+    results: the coalesced batch equals the per-request loop bit for
+    bit, and the injection really fired."""
+    gm, data = fitted(rng)
+    gm.to_registry(str(tmp_path), "m")
+    server = GMMServer(ModelRegistry(str(tmp_path)))
+    reqs = serve_requests(data)
+    baseline = server.handle_requests(reqs, coalesce=False)
+    with faults.use({"serve_slow": {"ms": 30, "times": 1}}) as plan:
+        t0 = time.perf_counter()
+        slow = server.handle_requests(reqs, coalesce=True)
+        wall = time.perf_counter() - t0
+    assert plan.fired["serve_slow"] == 1
+    assert wall >= 0.03
+    for a, b in zip(slow, baseline):
+        a = {k: v for k, v in a.items() if k != "latency_ms"}
+        b = {k: v for k, v in b.items() if k != "latency_ms"}
+        assert a == b
+
+
+def test_circuit_breaker_open_halfopen_close_lifecycle(rng, tmp_path):
+    """The breaker acceptance path: a NaN-scoring model fails requests
+    (non_finite_scores), trips open at the threshold, fast-fails with
+    circuit_open while OTHER models keep serving, half-opens after the
+    backoff, and a healthy probe closes it -- with matching v1.7
+    circuit events."""
+    gm, data = fitted(rng)
+    reg = ModelRegistry(str(tmp_path))
+    gm.to_registry(reg, "m")
+    gm.to_registry(reg, "healthy")
+    server = GMMServer(reg, breaker_threshold=2,
+                       breaker_backoff_s=0.05)
+    stream = []
+    rec = telemetry.RunRecorder(stream=_StreamSink(stream))
+    X = data[:5].tolist()
+
+    def ask(model="m"):
+        return server.handle_requests(
+            [{"id": 0, "model": model, "op": "score", "x": X}])[0]
+
+    with telemetry.use(rec), faults.use(
+            {"serve_nan": {"model": "m", "times": 2}}) as plan:
+        r1, r2 = ask(), ask()           # two poisoned dispatches
+        assert not r1["ok"] and r1["error"] == "non_finite_scores"
+        assert not r2["ok"]
+        assert plan.fired["serve_nan"] == 2
+        assert server.breaker.state(("m", None)) == "open"
+        r3 = ask()                      # fast-fail, no dispatch
+        assert not r3["ok"] and r3["error"] == "circuit_open"
+        assert server.breaker_fastfails == 1
+        # containment: the sibling model is untouched by m's breaker
+        r_other = ask("healthy")
+        assert r_other["ok"]
+        time.sleep(0.15)                # > 0.05 * 1.25 jitter ceiling
+        r4 = ask()                      # half-open probe, now healthy
+        assert r4["ok"], r4
+        assert server.breaker.state(("m", None)) == "closed"
+    states = [r["state"] for r in stream if r["event"] == "circuit"]
+    assert states == ["open", "half_open", "closed"]
+    opened = next(r for r in stream if r["event"] == "circuit")
+    assert opened["model"] == "m" and opened["reason"] == "non_finite"
+    assert opened["failures"] == 2 and opened["backoff_s"] > 0
+    assert validate_stream(stream) == []
+    assert server.breaker.stats() == {
+        "trips": 1, "closes": 1, "open_routes": 0}
+
+
+def test_breaker_counts_registry_failures(rng, tmp_path):
+    """RegistryError at resolve is a route failure too: repeated torn
+    loads open the breaker; a later good load closes it via the
+    half-open probe."""
+    gm, data = fitted(rng)
+    reg = ModelRegistry(str(tmp_path))
+    gm.to_registry(reg, "m")
+    server = GMMServer(reg, breaker_threshold=2,
+                       breaker_backoff_s=0.01, warm=False)
+    X = data[:3].tolist()
+
+    def ask():
+        return server.handle_requests(
+            [{"id": 0, "model": "m", "version": 1, "op": "score",
+              "x": X}])[0]
+
+    with faults.use({"registry_torn": {"name": "m", "times": 2}}):
+        assert "registry_torn" in ask()["error"]
+        assert "registry_torn" in ask()["error"]
+        assert server.breaker.state(("m", 1)) == "open"
+    time.sleep(0.05)
+    assert ask()["ok"]  # probe resolves cleanly -> closed
+    assert server.breaker.state(("m", 1)) == "closed"
+
+
+def test_registry_torn_injection_walks_back(rng, tmp_path):
+    """registry_torn composes with the default-resolution walk-back:
+    the newest version 'tears', load(name) warns and serves the
+    previous one -- the hot-reload skip path in miniature."""
+    gm, _ = fitted(rng)
+    reg = ModelRegistry(str(tmp_path))
+    gm.to_registry(reg, "m")
+    gm.to_registry(reg, "m")
+    with faults.use({"registry_torn": {"version": 2}}):
+        with pytest.warns(RuntimeWarning, match="version 2 unreadable"):
+            assert reg.load("m").version == 1
+    assert reg.load("m").version == 2  # budget consumed: healthy again
+
+
+def test_registry_poll_fingerprints_new_versions(rng, tmp_path):
+    """ModelRegistry.poll detects a new export via the manifest
+    fingerprint and reports only changed models."""
+    gm, _ = fitted(rng)
+    reg = ModelRegistry(str(tmp_path))
+    gm.to_registry(reg, "m")
+    snap = {}
+    changed = reg.poll(snap)
+    assert set(changed) == {"m"} and changed["m"][0] == 1
+    snap.update(changed)
+    assert reg.poll(snap) == {}        # stable: no spurious reloads
+    gm.to_registry(reg, "m")           # v2 lands
+    changed = reg.poll(snap)
+    assert set(changed) == {"m"} and changed["m"][0] == 2
+
+
+def test_hot_reload_swaps_default_route_bit_parity(rng, tmp_path):
+    """The acceptance contract: a mid-serve export atomically re-pins
+    the version=None route (new results == direct v2 scoring, bit for
+    bit) while the explicitly pinned old version keeps serving its old
+    bits; serve_reload telemetry + counter recorded; the old version's
+    prepared executor state is released."""
+    gm, data = fitted(rng)
+    reg = ModelRegistry(str(tmp_path))
+    gm.to_registry(reg, "m")          # v1
+    server = GMMServer(reg)
+    X = data[:9].tolist()
+
+    def ask(**extra):
+        return server.handle_requests(
+            [{"id": 0, "model": "m", "op": "score_samples", "x": X,
+              **extra}])[0]
+
+    r_v1 = ask()
+    assert r_v1["version"] == 1
+    assert server.maybe_reload() == []  # nothing new: no-op
+    # a visibly different v2 lands mid-serve (the `gmm export` analog)
+    gm2 = GaussianMixture.from_registry(reg, "m")
+    gm2.result_.state = gm2.result_.state.replace(
+        means=gm2.result_.state.means + 0.5)
+    reg.save("m", gm2.result_, config=gm2.config)
+    old_model = server._models[("m", None)]
+    stream = []
+    rec = telemetry.RunRecorder(stream=_StreamSink(stream))
+    with telemetry.use(rec):
+        swaps = server.maybe_reload()
+    assert swaps == [{"model": "m", "from_version": 1,
+                      "to_version": 2}]
+    assert server.reloads == 1
+    # the replaced version's prepared state left the executor memo (a
+    # later pinned-version request re-prepares it lazily)
+    ex = server._executor_for(old_model)
+    assert not any(v[0] is old_model.state
+                   for v in ex._state_memo.values())
+    r_new = ask()
+    assert r_new["version"] == 2
+    # bit-parity: the swapped route scores exactly as a fresh v2 load
+    gm_v2 = GaussianMixture.from_registry(reg, "m", version=2)
+    np.testing.assert_array_equal(
+        np.asarray(r_new["result"], np.float32),
+        gm_v2.score_samples(np.asarray(X, np.float32)))
+    # ...and the pinned old version still serves its exact old bits
+    r_pin = ask(version=1)
+    assert r_pin["version"] == 1 and r_pin["result"] == r_v1["result"]
+    events = [r for r in stream if r["event"] == "serve_reload"]
+    assert len(events) == 1 and events[0]["to_version"] == 2
+    assert validate_stream(stream) == []
+
+
+def test_run_loop_hot_reloads_between_ticks(rng, tmp_path):
+    """End to end through run_loop's --reload-interval-s path: an export
+    while the loop idles swaps the route before the next dispatch."""
+    gm, data = fitted(rng)
+    reg = ModelRegistry(str(tmp_path))
+    gm.to_registry(reg, "m")
+    server = GMMServer(reg)
+    server.resolve("m")               # pin the default route at v1
+    got = []
+    t = threading.Thread(
+        target=lambda: server.run_loop(idle_timeout_s=2.0,
+                                       reload_interval_s=0.05),
+        daemon=True)
+    t.start()
+    try:
+        gm.to_registry(reg, "m")      # v2 lands mid-serve
+        deadline = time.monotonic() + 5.0
+        while server.reloads == 0:
+            assert time.monotonic() < deadline, "reload never happened"
+            time.sleep(0.02)
+        server.submit_line(json.dumps(_req(0, data)),
+                           _collecting_reply(got))
+        while not got and time.monotonic() < deadline:
+            time.sleep(0.02)
+    finally:
+        server._stop.set()
+        t.join(timeout=10)
+    assert got and got[0]["ok"] and got[0]["version"] == 2
+
+
+def test_serve_summary_carries_resilience_counters(rng, tmp_path):
+    """serve_summary (rev v1.7) rolls up shed/deadline/breaker/reload
+    counters and validates; gmm report renders the resilience line."""
+    from cuda_gmm_mpi_tpu.telemetry.report import render_report
+
+    gm, data = fitted(rng)
+    gm.to_registry(str(tmp_path), "m")
+    server = GMMServer(ModelRegistry(str(tmp_path)), max_queue_rows=4)
+    stream = []
+    rec = telemetry.RunRecorder(stream=_StreamSink(stream))
+    with telemetry.use(rec):
+        server.submit_line(json.dumps(_req(0, data)),
+                           _collecting_reply([]))
+        server.submit_line(json.dumps(_req(1, data)),
+                           _collecting_reply([]))  # sheds (queue full)
+        server.run_loop(idle_timeout_s=0.3)
+        server.emit_summary()
+    summary = next(r for r in stream if r["event"] == "serve_summary")
+    assert summary["shed"] == 1
+    assert summary["deadline_expired"] == 0
+    assert summary["reloads"] == 0
+    assert summary["breaker"]["trips"] == 0
+    assert summary["metrics"]["counters"]["serve_sheds"] == 1
+    assert validate_stream(stream) == []
+    text = render_report(stream)
+    assert "resilience:" in text and "1 shed" in text
+
+
+def test_serve_cli_sigterm_drains_and_exits_75(rng, tmp_path):
+    """The PR-4 exit-code contract for `gmm serve`, with a REAL signal
+    (mirror of test_preemption's SIGTERM CLI test): SIGTERM a serving
+    subprocess under load -> graceful drain, exit 75, and a v1.7-valid
+    stream carrying preempt(where=serve) -> serve_summary -> shutdown."""
+    import signal
+    import socket
+    import subprocess
+    import sys
+
+    from cuda_gmm_mpi_tpu.telemetry import read_stream
+
+    from .conftest import communicate_or_kill, worker_env
+
+    gm, data = fitted(rng)
+    gm.to_registry(str(tmp_path / "reg"), "m")
+    sock_path = str(tmp_path / "gmm.sock")
+    metrics = str(tmp_path / "serve.jsonl")
+    p = subprocess.Popen(
+        [sys.executable, "-m", "cuda_gmm_mpi_tpu.cli", "serve",
+         "--registry", str(tmp_path / "reg"), "--socket", sock_path,
+         "--device", "cpu", "--metrics-file", metrics],
+        stdout=subprocess.PIPE, stderr=subprocess.PIPE,
+        env=worker_env(), text=True)
+    try:
+        deadline = time.monotonic() + 120.0
+        while not os.path.exists(sock_path):
+            assert p.poll() is None, p.communicate()
+            assert time.monotonic() < deadline, "socket never appeared"
+            time.sleep(0.05)
+        c = socket.socket(socket.AF_UNIX, socket.SOCK_STREAM)
+        c.connect(sock_path)
+        f = c.makefile("rw")
+        f.write(json.dumps(_req(0, data)) + "\n")
+        f.flush()
+        first = json.loads(f.readline())
+        assert first["ok"]            # the loop is live and serving
+        p.send_signal(signal.SIGTERM)
+        out_, err_ = communicate_or_kill(p, timeout=120)
+        c.close()
+    finally:
+        if p.poll() is None:
+            p.kill()
+            p.wait(timeout=60)
+    assert p.returncode == 75, f"expected EX_TEMPFAIL:\n{out_}\n{err_}"
+    assert "Preempted" in err_
+    records = read_stream(metrics)
+    assert validate_stream(records) == []
+    events = [r["event"] for r in records]
+    assert "preempt" in events and "shutdown" in events
+    assert "serve_summary" in events
+    preempt = next(r for r in records if r["event"] == "preempt")
+    assert preempt["where"] == "serve"
+    assert preempt["reason"] == "sigterm"
+    shutdown = next(r for r in records if r["event"] == "shutdown")
+    assert shutdown["reason"] == "sigterm"
+    assert shutdown["checkpointed"] is False
+
+
+def test_serve_cli_startup_failure_exits_1(tmp_path):
+    """Exit-code contract: an unloadable model set is a startup failure
+    (rc 1), not a traceback."""
+    from cuda_gmm_mpi_tpu.serving.server import serve_main
+
+    os.makedirs(tmp_path / "reg", exist_ok=True)
+    rc = serve_main(["--registry", str(tmp_path / "reg"),
+                     "--models", "ghost",
+                     "--input", os.devnull,
+                     "--output", str(tmp_path / "o.jsonl")])
+    assert rc == 1

@@ -319,3 +319,39 @@ def test_em_iter_trajectory_matches_final_loglik(tmp_path, rng):
     # monotone non-decreasing loglik across the trajectory (EM guarantee)
     lls = [x["loglik"] for x in iters]
     assert all(b >= a - 1e-9 for a, b in zip(lls, lls[1:]))
+
+
+def test_every_emitted_event_kind_is_declared_in_schema():
+    """Static drift guard (the SESSION_BAND<->PERF.md test's spirit,
+    applied to telemetry): scan the package for `<recorder>.emit("<kind>",
+    ...)` call sites and assert every emitted kind has a field table in
+    telemetry/schema.py -- a new event wired into production code without
+    a schema entry (the v1.7 omission shape) fails HERE, not in whichever
+    integration test happens to validate a stream containing it."""
+    import pathlib
+    import re
+
+    import cuda_gmm_mpi_tpu
+    from cuda_gmm_mpi_tpu.telemetry.schema import EVENT_FIELDS
+
+    pkg = pathlib.Path(cuda_gmm_mpi_tpu.__file__).parent
+    # \s* spans newlines: multi-line emit( calls still match.
+    pat = re.compile(r'\.emit\(\s*["\']([a-z_]+)["\']')
+    found = {}
+    for py in sorted(pkg.rglob("*.py")):
+        for m in pat.finditer(py.read_text(encoding="utf-8")):
+            found.setdefault(m.group(1), set()).add(
+                str(py.relative_to(pkg)))
+    assert found, "no emit() call sites found -- the scan pattern rotted"
+    # the known call-site spread: if these move wholesale the pattern
+    # is probably matching the wrong thing
+    assert "run_start" in found and "serve_request" in found
+    undeclared = {k: sorted(v) for k, v in found.items()
+                  if k not in EVENT_FIELDS}
+    assert undeclared == {}, (
+        f"emit() call sites with no telemetry/schema.py entry: "
+        f"{undeclared}")
+    # and the inverse: a declared event nobody can emit is dead schema
+    unemitted = sorted(set(EVENT_FIELDS) - set(found))
+    assert unemitted == [], (
+        f"schema declares events no code emits: {unemitted}")
