@@ -52,6 +52,14 @@ breaker trips / reloads -- stream rev v1.7) so soak runs surface
 degradation, all-zero on a clean A/B. Size knobs:
 GMM_BENCH_SERVE_{N,D,K,REQUESTS} (run_serve_bench).
 
+Tenancy mode (``--tenancy`` or GMM_BENCH_TENANCY=1): batched-fleet-vs-
+sequential multi-tenant A/B -- T independent per-tenant datasets fitted
+once through ``fit_fleet`` (packed groups, one fleet EM dispatch per
+sweep step; tenancy/) and once as T sequential solo fits, with BOTH
+walls and per-tenant winner/loglik parity bits in ONE record;
+``vs_baseline`` is sequential / fleet. Size knobs: GMM_BENCH_TENANTS +
+GMM_BENCH_TENANCY_{N,D,K,ITERS} (run_tenancy_bench).
+
 Env knobs: GMM_BENCH_CPU=1 (deliberate CPU run, rc 0); GMM_BENCH_PRECISION
 (matmul precision override); GMM_BENCH_PRECOMPUTE=1/0 (feature-hoist A/B,
 full-covariance in-memory configs; defaults ON for CPU runs -- the NumPy
@@ -578,6 +586,131 @@ def run_envelope_bench(platform: str, accel_unavailable: bool) -> dict:
     return result
 
 
+def run_tenancy_bench(platform: str, accel_unavailable: bool) -> dict:
+    """The --tenancy mode: batched-fleet-vs-sequential multi-tenant A/B.
+
+    Builds T independent per-tenant datasets (varying N within one pow2
+    bucket, shared D) and fits them twice with identical seeds/config:
+    once through ``fit_fleet`` (tenancy/fleet.py -- packed groups, one
+    fleet EM dispatch per sweep step) and once as T sequential
+    ``fit_gmm`` calls sharing one model (the solo baseline every
+    tenant's parity is defined against). ONE JSON record carries BOTH
+    walls plus per-tenant parity bits -- winner K equality and a
+    loglik-bit / relative-difference check per tenant -- because the
+    speedup is only meaningful if the fleet computed the same models.
+    ``vs_baseline`` is sequential/fleet (the packing win).
+
+    Size knobs: GMM_BENCH_TENANTS (T, default 6), GMM_BENCH_TENANCY_N
+    (base rows/tenant, default 50k accel / 4k CPU), GMM_BENCH_TENANCY_D
+    (8 / 4), GMM_BENCH_TENANCY_K (8 / 4 -- pow2 keeps the bit-parity
+    contract), GMM_BENCH_TENANCY_ITERS (5 / 3), GMM_BENCH_TENANCY_MODE
+    ('scan' default -- bit-exact; 'vmap' measures the batched-matmul
+    throughput shape at tolerance parity).
+    """
+    on_accel = platform not in ("cpu",)
+    t_count = int(os.environ.get("GMM_BENCH_TENANTS") or 6)
+    n = int(os.environ.get("GMM_BENCH_TENANCY_N")
+            or (50_000 if on_accel else 4_000))
+    d = int(os.environ.get("GMM_BENCH_TENANCY_D")
+            or (8 if on_accel else 4))
+    k = int(os.environ.get("GMM_BENCH_TENANCY_K")
+            or (8 if on_accel else 4))
+    iters = int(os.environ.get("GMM_BENCH_TENANCY_ITERS")
+                or (5 if on_accel else 3))
+    chunk = int(os.environ.get("GMM_BENCH_CHUNK")
+                or (131072 if on_accel else 4096))
+    chunk = min(chunk, n)
+
+    from cuda_gmm_mpi_tpu.config import GMMConfig
+    from cuda_gmm_mpi_tpu.models.gmm import GMMModel
+    from cuda_gmm_mpi_tpu.models.order_search import fit_gmm
+    from cuda_gmm_mpi_tpu.tenancy import TenantSpec, fit_fleet
+
+    rng = np.random.default_rng(42)
+    tenants = []
+    for t in range(t_count):
+        # Ragged sizes inside one pow2 bucket: the packing is exercised
+        # without multiplying compiled group shapes.
+        n_t = n - int(rng.integers(0, max(n // 4, 1)))
+        centers = rng.normal(scale=8.0, size=(k, d))
+        data = (centers[rng.integers(0, k, n_t)]
+                + rng.normal(scale=1.0, size=(n_t, d))
+                ).astype(np.float32)
+        tenants.append(TenantSpec(f"tenant{t:03d}", data, k))
+
+    fleet_mode = os.environ.get("GMM_BENCH_TENANCY_MODE") or "scan"
+    cfg = GMMConfig(min_iters=iters, max_iters=iters, chunk_size=chunk,
+                    seed=0, fleet_mode=fleet_mode)
+
+    # Fleet side: one shared model so the warm pass compiles the exact
+    # group executables the timed pass reuses (the solo baseline below
+    # gets the same treatment).
+    fleet_model = GMMModel(cfg)
+    fit_fleet(tenants, cfg, model=fleet_model)
+    t0 = time.perf_counter()
+    fleet = fit_fleet(tenants, cfg, model=fleet_model)
+    fleet_wall = time.perf_counter() - t0
+
+    # Sequential baseline: T solo fits sharing ONE model/executables.
+    model = GMMModel(cfg)
+    for t in tenants:  # warm pass mirrors the fleet's
+        fit_gmm(t.data, t.num_clusters, 0, cfg, model=model)
+    t0 = time.perf_counter()
+    solos = [fit_gmm(t.data, t.num_clusters, 0, cfg, model=model)
+             for t in tenants]
+    seq_wall = time.perf_counter() - t0
+
+    per_tenant = []
+    for spec, solo in zip(tenants, solos):
+        tr = fleet[spec.name]
+        r = tr.result
+        rel_ll = (abs(r.final_loglik - solo.final_loglik)
+                  / max(abs(solo.final_loglik), 1e-30))
+        per_tenant.append({
+            "name": spec.name,
+            "n": int(np.asarray(spec.data).shape[0]),
+            "ideal_k_equal": bool(
+                r.ideal_num_clusters == solo.ideal_num_clusters),
+            "loglik_bit_identical": bool(
+                r.final_loglik == solo.final_loglik),
+            "rel_loglik_diff": rel_ll,
+            "parity_ok": bool(
+                r.ideal_num_clusters == solo.ideal_num_clusters
+                and rel_ll < 1e-6),
+        })
+    speedup = seq_wall / max(fleet_wall, 1e-9)
+    result = {
+        "metric": f"fleet fit wall, {t_count} tenants (~{n}x{d}, "
+                  f"K={k}->1, {platform})",
+        "value": round(fleet_wall, 3),
+        "unit": "s",
+        # A/B ratio (sequential / fleet), NOT the NumPy baseline.
+        "vs_baseline": round(speedup, 3),
+        "accelerator_unavailable": accel_unavailable,
+        "tenancy": {
+            "tenants": t_count, "base_n": n, "d": d, "k": k,
+            "em_iters_per_k": iters, "chunk_size": chunk,
+            "mode": fleet.mode,
+            "groups": len(fleet.groups),
+            "fleet_wall_s": round(fleet_wall, 3),
+            "sequential_wall_s": round(seq_wall, 3),
+            "speedup": round(speedup, 3),
+            "dropped": len(fleet.dropped),
+            "per_tenant": per_tenant,
+            "all_parity_ok": bool(all(t["parity_ok"]
+                                      for t in per_tenant)),
+            "all_bit_identical": bool(all(t["loglik_bit_identical"]
+                                          for t in per_tenant)),
+        },
+        "measured_utc": time.strftime("%Y-%m-%dT%H:%M:%SZ", time.gmtime()),
+    }
+    if accel_unavailable:
+        result["platform_note"] = (
+            "accelerator tunnel unavailable (probe failed after retries); "
+            "this is a CPU-fallback measurement, not an accelerator result")
+    return result
+
+
 def run_serve_bench(platform: str, accel_unavailable: bool) -> dict:
     """The --serve mode: cold-vs-warm A/B of the serving subsystem.
 
@@ -732,6 +865,8 @@ def main() -> int:
                      or os.environ.get("GMM_BENCH_ENVELOPE") == "1")
     want_serve = ("--serve" in sys.argv[1:]
                   or os.environ.get("GMM_BENCH_SERVE") == "1")
+    want_tenancy = ("--tenancy" in sys.argv[1:]
+                    or os.environ.get("GMM_BENCH_TENANCY") == "1")
     spec = CONFIGS.get(cfg_name)
     if spec is None:
         print(
@@ -838,6 +973,14 @@ def main() -> int:
         # Serving cold-vs-warm A/B over the AOT executable cache
         # (ignores --config; sized by GMM_BENCH_SERVE_*).
         result = run_serve_bench(platform, accel_unavailable)
+        watchdog.cancel()
+        print(json.dumps(result))
+        return 3 if accel_unavailable else 0
+
+    if want_tenancy:
+        # Batched-fleet-vs-sequential multi-tenant A/B (ignores
+        # --config; sized by GMM_BENCH_TENANTS / GMM_BENCH_TENANCY_*).
+        result = run_tenancy_bench(platform, accel_unavailable)
         watchdog.cancel()
         print(json.dumps(result))
         return 3 if accel_unavailable else 0

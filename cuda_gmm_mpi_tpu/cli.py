@@ -29,7 +29,9 @@ def build_parser() -> argparse.ArgumentParser:
         "trajectory, sweep summary) offline; `gmm export` persists a "
         "fitted model (sweep checkpoint or .summary) into a serving "
         "registry; `gmm serve` runs the micro-batched scoring loop over "
-        "a registry (JSONL protocol; docs/SERVING.md).",
+        "a registry (JSONL protocol; docs/SERVING.md); `gmm fleet` fits "
+        "a manifest of per-tenant datasets as packed multi-tenant "
+        "dispatches (docs/TENANCY.md).",
     )
     from ._version import __version__
 
@@ -273,6 +275,12 @@ def main(argv=None) -> int:
         from .serving.server import serve_main
 
         return serve_main(argv[1:])
+    if argv and argv[0] == "fleet":
+        # `gmm fleet`: fit a manifest of per-tenant input files as
+        # packed multi-tenant dispatches (docs/TENANCY.md).
+        from .tenancy.cli import fleet_main
+
+        return fleet_main(argv[1:])
     args = build_parser().parse_args(argv)
 
     # Platform must be pinned before JAX initializes its backends. Set the env

@@ -248,6 +248,24 @@ class GMMConfig:
     # the identical winner at the same seeds). Streaming and fused-sweep
     # restarts always run sequentially.
     restart_batch_size: Optional[int] = None
+    # --- multi-tenancy fleet fits (tenancy/; docs/TENANCY.md) ---
+    # Per-group EM dispatch mode for `fit_fleet` / `gmm fleet`:
+    #   'scan' (default): the tenant lanes of one packed group run as a
+    #     lax.map over the UNBATCHED EM loop inside one compiled program
+    #     -- one dispatch per group, and every lane's arithmetic is the
+    #     exact HLO of its solo fit, so per-tenant results are
+    #     BIT-IDENTICAL to solo fits of the same tenants (the fleet
+    #     parity contract, tests/test_tenancy.py).
+    #   'vmap': the lanes vmap over a leading tenant axis -- [T, B, K]
+    #     batched matmuls (the restart-batching shape, maximal MXU feed)
+    #     at reduction-order tolerance instead of bit-parity (batched
+    #     dot_general associates differently than T solo matmuls).
+    fleet_mode: str = "scan"
+    # Tenants per packed-group EM dispatch. None = every tenant of a
+    # (N-bucket, K-bucket) group rides one dispatch; smaller values split
+    # groups (memory bound: one group holds T x the padded chunk grid on
+    # device).
+    fleet_group_size: Optional[int] = None
     # Numerical-sanitizer analog (SURVEY SS5.2: the reference has no race
     # detection / sanitizers; JAX's functional model removes data races, and
     # this enables the remaining useful check -- trap NaN/Inf at the op that
@@ -375,6 +393,13 @@ class GMMConfig:
         if self.restart_batch_size is not None and self.restart_batch_size < 1:
             raise ValueError("restart_batch_size must be >= 1 (or None for "
                              "the host-memory auto cap)")
+        if self.fleet_mode not in ("scan", "vmap"):
+            raise ValueError(
+                f"unknown fleet_mode: {self.fleet_mode!r} "
+                "(expected 'scan' or 'vmap')")
+        if self.fleet_group_size is not None and self.fleet_group_size < 1:
+            raise ValueError("fleet_group_size must be >= 1 (or None for "
+                             "whole-group dispatches)")
 
 
 DEFAULT_CONFIG = GMMConfig()

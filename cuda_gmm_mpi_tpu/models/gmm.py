@@ -635,6 +635,106 @@ class GMMModel:
             }
         return states, ll_out, iters_out, bufs, stopped, extra
 
+    # Multi-tenant fleet fits (tenancy/; docs/TENANCY.md): the EM loop
+    # generalized over a leading DATASET axis -- per-tenant data, weights,
+    # epsilon, and iteration bounds instead of the restart axis's shared
+    # data. Streaming overrides this off (no single EM program to map).
+    supports_fleet = True
+
+    def _em_fleet_executable(self, trajectory_len: int, donate: bool,
+                             mode: str):
+        """Memoized jitted FLEET EM loop: ``em_while_loop`` mapped over a
+        leading tenant axis with PER-TENANT data/weights/epsilon/bounds
+        (the dataset-axis generalization of ``_em_batched_executable``,
+        whose restart lanes share one dataset).
+
+        ``mode='scan'`` maps lanes with ``lax.map``: one compiled dispatch
+        per group whose per-lane arithmetic is the exact HLO of a solo
+        ``run_em`` -- tenant results stay BIT-IDENTICAL to solo fits (the
+        packed padding is algebraically inert: zero-weight event rows and
+        inactive cluster slots contribute exact zeros). ``mode='vmap'``
+        batches the lanes instead ([T, B, K] matmuls -- the restart-
+        batching throughput shape) at reduction-order tolerance: a batched
+        dot_general associates differently than T solo matmuls, so vmap
+        trades bit-parity for MXU feed (config.fleet_mode documents the
+        trade). Both modes freeze finished lanes -- scan lanes run their
+        own while_loop trip counts natively; vmap lanes freeze via
+        ``lax.while_loop``'s batching-rule select masks.
+
+        The fleet loop always runs the jnp statistics path (stats_fn=None
+        -- the Pallas kernels batch the restart axis over SHARED event
+        tiles, which a per-tenant data axis defeats; fit_fleet rejects
+        pallas-pinned configs loudly).
+        """
+        key = ("fleet", mode, trajectory_len, donate)
+        fn = self._em_exec_cache.get(key)
+        if fn is None:
+            em_fn = functools.partial(
+                em_while_loop, reduce_stats=self.reduce_stats,
+                stats_fn=None,
+                covariance_type=self.config.covariance_type,
+                precompute_features=False,
+                trajectory_len=trajectory_len,
+                dynamic_range=self.config.covariance_dynamic_range,
+                regression_scale=self.config.health_regression_scale,
+                **self._kw)
+
+            def fleet(states, tids, data_chunks, wts_chunks, eps_t,
+                      lo_t, hi_t):
+                if mode == "vmap":
+                    return jax.vmap(
+                        lambda s, tid, c, w, e, lo, hi: em_fn(
+                            s, c, w, e, lo, hi, restart_id=tid))(
+                        states, tids, data_chunks, wts_chunks, eps_t,
+                        lo_t, hi_t)
+                return lax.map(
+                    lambda args: em_fn(args[0], args[2], args[3], args[4],
+                                       args[5], args[6],
+                                       restart_id=args[1]),
+                    (states, tids, data_chunks, wts_chunks, eps_t,
+                     lo_t, hi_t))
+
+            fn = self._em_exec_cache[key] = jax.jit(
+                fleet, donate_argnums=(0,) if donate else ())
+        return fn
+
+    def run_em_fleet(self, states, data_chunks, wts_chunks, epsilons,
+                     min_iters=None, max_iters=None, *,
+                     trajectory: bool = False, donate: bool = False,
+                     mode: str = "scan"):
+        """Full EM for a FLEET of independent datasets in one dispatch.
+
+        ``states`` carries a leading tenant axis T on every leaf;
+        ``data_chunks`` [T, C, B, D] / ``wts_chunks`` [T, C, B] hold each
+        tenant's own packed chunk grid (zero-weight pad rows beyond its
+        true event count); ``epsilons`` [T] each tenant's convergence
+        threshold. ``min_iters``/``max_iters`` accept scalars or [T]
+        vectors -- a lane with ``max_iters=0`` is frozen (zero iterations,
+        state passed through bit-identically), the drivers' handle for
+        tenants whose sweep already finished.
+
+        Returns ``(states, loglik [T], iters [T])`` (+ ``ll_log`` with
+        ``trajectory=True``); per-tenant health counter ROWS land on
+        ``last_health`` as int32 [T, NUM_FLAGS] -- a poisoned tenant flags
+        its own row only, so the fleet driver drops it and keeps the
+        survivors (the PR-5 drop_restart containment shape).
+        """
+        T = int(states.N.shape[0])
+        lo_t, hi_t = resolve_iters_batched(self.config, T, min_iters,
+                                           max_iters)
+        run = self._em_fleet_executable(
+            int(self.config.max_iters) if trajectory else 0, donate, mode)
+        out = run(states, jnp.arange(T, dtype=jnp.int32), data_chunks,
+                  wts_chunks, jnp.asarray(epsilons, data_chunks.dtype),
+                  lo_t, hi_t)
+        self.last_health = out[-1]
+        return out[:-1]
+
+    def prepare_fleet(self, data_chunks, wts_chunks):
+        """Place one group's packed [T, C, B, D] chunk grid on device
+        (the fleet sibling of the plain jnp.asarray data placement)."""
+        return jnp.asarray(data_chunks), jnp.asarray(wts_chunks)
+
     def rebucket_state(self, state, num_clusters: int):
         """Compact ``state`` to a narrower padded width on device (the
         sweep's bucket recompaction; see state.compact_to). Width is

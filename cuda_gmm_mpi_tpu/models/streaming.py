@@ -88,6 +88,8 @@ class StreamingGMMModel(GMMModel):
     # per-block dispatch sequence, not one program a restart axis can
     # vmap over (restarts fall back to the sequential driver).
     supports_batched_restarts = False
+    # No fleet fits either, for the same reason (tenancy/fleet.py).
+    supports_fleet = False
     data_size = 1  # overridden per-instance when a mesh is configured
     cluster_size = 1  # events-only sharding (prepare_inference contract)
 

@@ -495,6 +495,90 @@ class ShardedGMMModel:
             )
         return fn
 
+    # Multi-tenant fleet fits on the mesh (tenancy/; docs/TENANCY.md):
+    # the tenant axis is replicated, each tenant's OWN chunk grid shards
+    # over the data axis, and the lanes map inside the shard_map -- scan
+    # mode keeps every lane's per-shard arithmetic (and psum order) the
+    # exact HLO of a solo sharded fit, so sharded fleet results stay
+    # bit-identical to sharded solo fits.
+    supports_fleet = True
+    run_em_fleet = GMMModel.run_em_fleet
+
+    def _em_fleet_executable(self, trajectory_len: int, donate: bool,
+                             mode: str):
+        """shard_map(lax.map|vmap(em_while_loop)) over per-tenant data
+        (the mesh sibling of GMMModel._em_fleet_executable; see the
+        class-level fleet comment for the axis layout)."""
+        key = ("fleet", mode, trajectory_len, donate)
+        fn = self._em_exec_cache.get(key)
+        if fn is None:
+            em_fn = functools.partial(
+                em_while_loop,
+                reduce_stats=make_psum_reduce(DATA_AXIS),
+                cluster_axis=self._cluster_axis,
+                stats_fn=None,
+                covariance_type=self.config.covariance_type,
+                precompute_features=False,
+                trajectory_len=trajectory_len,
+                dynamic_range=self.config.covariance_dynamic_range,
+                regression_scale=self.config.health_regression_scale,
+                **self._kw,
+            )
+
+            def fleet(states, tids, data_chunks, wts_chunks, eps_t,
+                      lo_t, hi_t):
+                if mode == "vmap":
+                    return jax.vmap(
+                        lambda s, tid, c, w, e, lo, hi: em_fn(
+                            s, c, w, e, lo, hi, restart_id=tid))(
+                        states, tids, data_chunks, wts_chunks, eps_t,
+                        lo_t, hi_t)
+                return lax.map(
+                    lambda args: em_fn(args[0], args[2], args[3], args[4],
+                                       args[5], args[6],
+                                       restart_id=args[1]),
+                    (states, tids, data_chunks, wts_chunks, eps_t,
+                     lo_t, hi_t))
+
+            bspec = batched_state_pspecs()
+            scalar = P()
+            out_specs = (bspec, scalar, scalar)
+            if trajectory_len:
+                out_specs = out_specs + (scalar,)
+            out_specs = out_specs + (scalar,)  # [T, NUM_FLAGS] health
+            fn = self._em_exec_cache[key] = jax.jit(
+                shard_map(
+                    fleet,
+                    mesh=self.mesh,
+                    in_specs=(bspec, scalar,
+                              P(None, DATA_AXIS, None, None),
+                              P(None, DATA_AXIS, None), scalar, scalar,
+                              scalar),
+                    out_specs=out_specs,
+                    check_vma=False,
+                ),
+                donate_argnums=(0,) if donate else (),
+            )
+        return fn
+
+    def prepare_fleet(self, data_chunks, wts_chunks):
+        """Place one group's packed [T, C, B, D] chunk grid on the mesh:
+        tenant axis replicated, each lane's chunk axis sharded over
+        ``data`` (the fleet sibling of :meth:`prepare`'s data placement).
+        Single-controller only -- a multi-controller fleet would need
+        per-host tenant slicing the way host_chunk_bounds slices events."""
+        if jax.process_count() > 1:
+            raise NotImplementedError(
+                "fleet fits are single-controller; multi-controller runs "
+                "fit one tenant at a time (tenancy/fleet.py)")
+        chunks = jax.device_put(
+            np.asarray(data_chunks),
+            NamedSharding(self.mesh, P(None, DATA_AXIS, None, None)))
+        wts = jax.device_put(
+            np.asarray(wts_chunks),
+            NamedSharding(self.mesh, P(None, DATA_AXIS, None)))
+        return chunks, wts
+
     def prepare_states_batched(self, host_states):
         """Stack R host seed states into one restart-batched state and
         place it on the mesh (restart axis replicated, K axis

@@ -143,10 +143,18 @@ class ScoringExecutor:
 
     # -- state preparation ----------------------------------------------
 
-    def prepared_state(self, state: GMMState) -> GMMState:
+    def prepared_state(self, state: GMMState,
+                       k_bucket: Optional[int] = None) -> GMMState:
         """``state`` cast to the executor dtype and K-padded to its pow2
-        bucket with inert inactive slots; memoized per state object."""
+        bucket with inert inactive slots; memoized per state object.
+
+        ``k_bucket`` overrides the bucket upward (stacked cross-model
+        dispatches pad every participant to the family's shared width;
+        inactive slots are algebraically inert, so a wider pad never
+        changes a model's scores)."""
         kb = pow2_bucket(state.num_clusters_padded)
+        if k_bucket is not None:
+            kb = max(kb, int(k_bucket))
         key = (id(state), kb)
         hit = self._state_memo.get(key)
         if hit is not None and hit[0] is state:
@@ -245,6 +253,123 @@ class ScoringExecutor:
         donate = (1,) if jax.default_backend() != "cpu" else ()
         return jax.jit(fn, donate_argnums=donate).lower(
             state_struct, x_struct).compile()
+
+    def _executable_stacked(self, models: int, block: int, kb: int,
+                            d: int):
+        """Lower-and-compile one STACKED scoring program: ``models``
+        lanes of (state, request block) scored by a ``lax.map`` over the
+        model axis -- ONE dispatch for several different models of one
+        numeric family (the cross-model coalescing the tick loop's
+        per-(model, version) grouping alone cannot get). ``lax.map``
+        (not vmap) keeps each lane's arithmetic the exact HLO of the
+        solo 'proba' executable, so stacked responses are BIT-IDENTICAL
+        to per-model dispatches (the parity contract,
+        tests/test_serving.py). Shares the LRU cache/counters with the
+        per-model executables under key ('stacked', M, block, kb, d).
+        """
+        key = ("stacked", models, block, kb, d)
+        fn = self._cache.get(key)
+        if fn is not None:
+            self.hits += 1
+            self._cache.move_to_end(key)
+            return fn
+        self.misses += 1
+
+        import jax
+        import jax.numpy as jnp
+
+        from ..ops.estep import posteriors
+
+        if self._dtype == np.float64 and not jax.config.jax_enable_x64:
+            raise ValueError(
+                "dtype='float64' needs jax_enable_x64; set "
+                "jax.config.update('jax_enable_x64', True) at startup")
+        post = functools.partial(
+            posteriors, diag_only=self._diag_only,
+            quad_mode=self._quad_mode,
+            matmul_precision=self._precision)
+
+        def stacked(states, x):
+            return jax.lax.map(lambda args: post(args[0], args[1]),
+                               (states, x))
+
+        dt = jnp.dtype(self._dtype)
+        sds = jax.ShapeDtypeStruct
+        state_struct = GMMState(
+            N=sds((models, kb), dt), pi=sds((models, kb), dt),
+            constant=sds((models, kb), dt),
+            avgvar=sds((models, kb), dt),
+            means=sds((models, kb, d), dt),
+            R=sds((models, kb, d, d), dt),
+            Rinv=sds((models, kb, d, d), dt),
+            active=sds((models, kb), jnp.bool_))
+        x_struct = sds((models, block, d), dt)
+        donate = (1,) if jax.default_backend() != "cpu" else ()
+        fn = jax.jit(stacked, donate_argnums=donate).lower(
+            state_struct, x_struct).compile()
+        self.compiles += 1
+        self._cache[key] = fn
+        while len(self._cache) > self._max_execs:
+            self._cache.popitem(last=False)
+            self.evictions += 1
+        return fn
+
+    def stackable_rows(self, n: int) -> bool:
+        """Whether an ``n``-row request fits one stacked lane (requests
+        past ``max_block`` split into slices, which the stacked layout
+        does not model -- they dispatch per-model instead)."""
+        return 0 < int(n) <= self._max_block
+
+    def infer_stacked(self, states, Xs):
+        """Score several DIFFERENT models' requests in one dispatch.
+
+        ``states[i]`` scores ``Xs[i]`` ([n_i, D], all same D and all
+        within ``max_block``). Every lane pads to the family-shared
+        (row-block, K-bucket) -- pad rows/slots are discarded before
+        return, and the model axis pads to its pow2 bucket with
+        duplicate lanes, so the executable universe stays bounded at
+        (log2 models x log2 blocks x log2 K-buckets). Returns
+        ``([(w [n_i, K_bucket_i], logz [n_i]), ...], padded_block)``
+        with per-lane host numpy arrays sliced back to each model's own
+        rows and K bucket.
+        """
+        import jax
+        import jax.numpy as jnp
+
+        if len(states) != len(Xs) or not states:
+            raise ValueError("infer_stacked needs one X per state")
+        M = len(states)
+        xs = [np.ascontiguousarray(np.asarray(x, self._dtype))
+              for x in Xs]
+        d = xs[0].shape[1]
+        for x in xs:
+            if x.ndim != 2 or x.shape[1] != d:
+                raise ValueError(
+                    f"stacked requests must share D={d}, got {x.shape}")
+            if not self.stackable_rows(x.shape[0]):
+                raise ValueError(
+                    f"stacked lane of {x.shape[0]} rows exceeds "
+                    f"max_block={self._max_block}")
+        block = max(self.block_for(x.shape[0]) for x in xs)
+        own_kb = [pow2_bucket(s.num_clusters_padded) for s in states]
+        kb = max(own_kb)
+        prepared = [self.prepared_state(s, k_bucket=kb) for s in states]
+        mb = pow2_bucket(M)
+        lanes = prepared + [prepared[0]] * (mb - M)
+        stacked_state = jax.tree_util.tree_map(
+            lambda *ls: jnp.stack(ls), *lanes)
+        xb = np.zeros((mb, block, d), self._dtype)
+        for i, x in enumerate(xs):
+            xb[i, :x.shape[0]] = x
+        run = self._executable_stacked(mb, block, kb, d)
+        w, logz = run(stacked_state, jnp.asarray(xb))
+        w, logz = jax.device_get((w, logz))
+        out = []
+        for i, x in enumerate(xs):
+            n = x.shape[0]
+            out.append((np.asarray(w)[i, :n, :own_kb[i]],
+                        np.asarray(logz)[i, :n]))
+        return out, block
 
     def warmup(self, state: GMMState, d: Optional[int] = None,
                kinds=("proba",), blocks=None) -> int:

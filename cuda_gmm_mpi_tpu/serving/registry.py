@@ -435,6 +435,59 @@ class ModelRegistry:
             extra={"checkpoint_step": int(restored.get("step", -1)),
                    "checkpoint_dir": os.path.abspath(checkpoint_dir)})
 
+    def export_fleet(self, fleet_dir: str, *,
+                     version: Optional[int] = None) -> List[dict]:
+        """Bulk export: one atomic version PER TENANT MODEL from a fleet
+        fit's output directory (``gmm fleet --out-dir``).
+
+        Reads ``<fleet_dir>/fleet.json`` and exports every fitted
+        tenant's ``.summary`` under its tenant name. Partial failure is
+        per tenant, never run-fatal: each row of the returned audit list
+        carries either the assigned ``version`` or the ``error`` that
+        skipped it (plus ``skipped: dropped`` rows for tenants the fleet
+        itself dropped). Exact-state exports come from ``gmm fleet
+        --registry`` in the fitting invocation; this path serves the
+        decoupled fit-here-export-later workflow at the text format's
+        precision.
+        """
+        manifest_path = os.path.join(os.path.abspath(fleet_dir),
+                                     "fleet.json")
+        try:
+            with open(manifest_path, encoding="utf-8") as f:
+                fleet = json.load(f)
+        except (OSError, ValueError) as e:
+            raise RegistryError(
+                f"cannot read fleet manifest {manifest_path!r}: {e}"
+            ) from e
+        rows = fleet.get("tenants")
+        if not isinstance(rows, list) or not rows:
+            raise RegistryError(
+                f"{manifest_path!r} lists no tenants")
+        audit: List[dict] = []
+        for row in rows:
+            name = str(row.get("name"))
+            if row.get("dropped"):
+                audit.append({"name": name, "skipped": "dropped",
+                              "error": row.get("error")})
+                continue
+            summary = row.get("summary")
+            try:
+                if not summary:
+                    raise RegistryError(
+                        "fleet.json row carries no summary path (was "
+                        "the fleet run without --out-dir?)")
+                v = self.export_summary(
+                    summary, name,
+                    covariance_type=row.get("covariance_type", "full"),
+                    dtype=row.get("dtype", "float32"),
+                    version=version)
+                audit.append({"name": name, "version": int(v)})
+            except (RegistryError, OSError, ValueError) as e:
+                # Per-tenant containment: one torn summary must not
+                # void its siblings' exports.
+                audit.append({"name": name, "error": str(e)})
+        return audit
+
     def export_summary(self, summary_path: str, name: str, *,
                        covariance_type: str = "full",
                        dtype: str = "float32",
@@ -477,10 +530,12 @@ def export_main(argv=None) -> int:
     p = argparse.ArgumentParser(
         prog="gmm export",
         description="Export a fitted model into a serving registry "
-        "(docs/SERVING.md).")
+        "(docs/SERVING.md); --fleet bulk-exports one version per tenant "
+        "from a fleet fit (docs/TENANCY.md).")
     p.add_argument("--registry", required=True,
                    help="registry root directory (created if absent)")
-    p.add_argument("--name", required=True, help="model name")
+    p.add_argument("--name", default=None, help="model name (single-"
+                   "model sources; --fleet uses tenant names)")
     src = p.add_mutually_exclusive_group(required=True)
     src.add_argument("--checkpoint", metavar="DIR",
                      help="order-search sweep checkpoint directory; "
@@ -488,6 +543,11 @@ def export_main(argv=None) -> int:
     src.add_argument("--summary", metavar="FILE.summary",
                      help="a .summary model file (ours or the "
                      "reference's)")
+    src.add_argument("--fleet", metavar="DIR",
+                     help="a `gmm fleet --out-dir` directory: bulk-"
+                     "export ONE version per fitted tenant (per-model "
+                     "atomic npz; per-tenant failures reported, not "
+                     "run-fatal)")
     p.add_argument("--covariance-type", default="full",
                    choices=["full", "diag", "spherical", "tied"],
                    help="covariance family of a --summary model "
@@ -498,6 +558,34 @@ def export_main(argv=None) -> int:
     p.add_argument("--version", type=int, default=None,
                    help="explicit version (default: next)")
     args = p.parse_args(argv)
+
+    import sys
+
+    if args.fleet:
+        if args.name is not None:
+            p.error("--fleet exports under tenant names; drop --name")
+        reg = ModelRegistry(args.registry)
+        try:
+            audit = reg.export_fleet(args.fleet, version=args.version)
+        except (RegistryError, OSError) as e:
+            print(f"fleet export failed: {e}", file=sys.stderr)
+            return 1
+        ok = 0
+        for row in audit:
+            if "version" in row:
+                ok += 1
+                print(f"exported {row['name']!r} version "
+                      f"{row['version']}")
+            elif row.get("skipped") == "dropped":
+                print(f"skipped {row['name']!r}: dropped by the fleet "
+                      f"fit ({row.get('error')})", file=sys.stderr)
+            else:
+                print(f"export of {row['name']!r} failed: "
+                      f"{row.get('error')}", file=sys.stderr)
+        print(f"fleet export: {ok}/{len(audit)} tenants exported")
+        return 0 if ok else 1
+    if args.name is None:
+        p.error("--name is required for single-model sources")
 
     reg = ModelRegistry(args.registry)
     try:

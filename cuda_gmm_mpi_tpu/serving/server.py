@@ -119,7 +119,8 @@ class GMMServer:
                  max_queue_rows: Optional[int] = None,
                  default_deadline_ms: Optional[float] = None,
                  breaker_threshold: int = 3,
-                 breaker_backoff_s: float = 1.0):
+                 breaker_backoff_s: float = 1.0,
+                 stack_models: bool = False):
         self._registry = registry
         self._max_batch_rows = max(1, int(max_batch_rows))
         self._tick_s = max(0.0, float(tick_s))
@@ -154,6 +155,13 @@ class GMMServer:
         self.deadline_expired = 0
         self.reloads = 0
         self.breaker_fastfails = 0
+        # Cross-model stacked dispatch (docs/TENANCY.md "Serving the
+        # fleet"): one tick's groups for DIFFERENT models of one numeric
+        # family coalesce into a single lax.map-stacked executable call
+        # (ScoringExecutor.infer_stacked) -- bit-identical to per-model
+        # dispatches, parity-tested. Opt-in (--stack-models).
+        self._stack_models = bool(stack_models)
+        self.stacked_batches = 0
 
     # -- model / executor resolution ------------------------------------
 
@@ -344,20 +352,19 @@ class GMMServer:
                 self._reply_error(p, f"bad 'x': {e}")
                 continue
             groups.setdefault((name, version), []).append((p, x))
-        for (name, version), items in groups.items():
-            self._dispatch(name, version, items)
+        if self._stack_models and len(groups) > 1:
+            self._dispatch_stacked(list(groups.items()))
+        else:
+            for (name, version), items in groups.items():
+                self._dispatch(name, version, items)
 
-    def _dispatch(self, name: str, version: Optional[int],
-                  items: List[Tuple[_Pending, np.ndarray]]) -> None:
-        """One coalesced dispatch: concatenate every request's rows,
-        score once, slice per request, answer per op.
-
-        Route failures -- RegistryError at resolve, an executor error,
-        or non-finite scores (the cheap post-dispatch poison check) --
-        feed the (model, version) circuit breaker; while its breaker is
-        open the whole group fast-fails with ``circuit_open`` before any
-        of that cost. Client-content errors (wrong D) never touch the
-        breaker."""
+    def _prepare_route(self, name: str, version: Optional[int],
+                       items: List[Tuple[_Pending, np.ndarray]]):
+        """The dispatch front half shared by the per-model and stacked
+        paths: breaker admission, registry resolve, per-request D
+        validation, and the shifted row block. Returns ``(m, good,
+        rows, t0)`` or None when every request was already answered
+        (fast-fail / resolve error / all-bad rows)."""
         rec = telemetry.current()
         t0 = time.perf_counter()
         route = (name, version)
@@ -374,14 +381,14 @@ class GMMServer:
                     + (f" v{version}" if version is not None else "")
                     + " is failing; retry in "
                     f"{denial['retry_in_s']:.1f}s")
-            return
+            return None
         try:
             m = self.resolve(name, version)
         except (RegistryError, OSError) as e:
             self.breaker.record_failure(route, "registry")
             for p, _ in items:
                 self._reply_error(p, str(e), model=name)
-            return
+            return None
         d = m.d
         bad, good = [], []
         for p, x in items:
@@ -393,8 +400,7 @@ class GMMServer:
         for p, msg in bad:
             self._reply_error(p, msg, model=name)
         if not good:
-            return
-        ex = self._executor_for(m)
+            return None
         xs = [x for _, x in good]
         rows = np.concatenate(xs, axis=0).astype(
             np.dtype(m.dtype), copy=False)
@@ -402,22 +408,123 @@ class GMMServer:
         slow = faults.take("serve_slow", model=name)
         if slow is not None:
             time.sleep(float(slow.get("ms", 0)) / 1e3)
+        return m, good, rows, t0
+
+    def _dispatch(self, name: str, version: Optional[int],
+                  items: List[Tuple[_Pending, np.ndarray]]) -> None:
+        """One coalesced dispatch: concatenate every request's rows,
+        score once, slice per request, answer per op.
+
+        Route failures -- RegistryError at resolve, an executor error,
+        or non-finite scores (the cheap post-dispatch poison check) --
+        feed the (model, version) circuit breaker; while its breaker is
+        open the whole group fast-fails with ``circuit_open`` before any
+        of that cost. Client-content errors (wrong D) never touch the
+        breaker."""
+        prep = self._prepare_route(name, version, items)
+        if prep is None:
+            return
+        m, good, rows, t0 = prep
+        ex = self._executor_for(m)
         compiles_before = ex.compile_count
         try:
             w, logz = ex.infer(m.state, rows, want="proba")
         except Exception as e:  # executor/compile failure: a route fault
-            self.breaker.record_failure(route, "executor")
+            self.breaker.record_failure((name, version), "executor")
             for p, _ in good:
                 self._reply_error(p, f"dispatch failed: {e}", model=name)
             return
+        compiled = ex.compile_count - compiles_before
+        self._answer_route(name, version, m, good, rows, w, logz, t0,
+                           compiled, int(ex.padded_rows(rows.shape[0])))
+
+    def _dispatch_stacked(self, routes) -> None:
+        """Cross-model coalescing (docs/TENANCY.md "Serving the fleet"):
+        one tick's per-(model, version) groups partition by numeric
+        family -- shared executor (dtype x covariance structure) and D
+        -- and each family of >= 2 routes scores through ONE stacked
+        executable call (``ScoringExecutor.infer_stacked``; lax.map over
+        the model axis, so responses stay bit-identical to per-model
+        dispatches). Per-route error isolation is unchanged: breaker
+        admission, registry errors, and the non-finite poison check all
+        stay per (model, version)."""
+        preps = []
+        for (name, version), items in routes:
+            prep = self._prepare_route(name, version, items)
+            if prep is not None:
+                preps.append((name, version) + prep)
+        families: "collections.OrderedDict[tuple, list]" = \
+            collections.OrderedDict()
+        singles = []
+        for entry in preps:
+            name, version, m, good, rows, t0 = entry
+            ex = self._executor_for(m)
+            if not ex.stackable_rows(rows.shape[0]):
+                singles.append(entry)
+            else:
+                families.setdefault((id(ex), m.d), []).append(entry)
+        for fam in families.values():
+            if len(fam) < 2:
+                singles.extend(fam)
+                continue
+            ex = self._executor_for(fam[0][2])
+            compiles_before = ex.compile_count
+            try:
+                outs, padded = ex.infer_stacked(
+                    [m.state for _, _, m, _, _, _ in fam],
+                    [rows for _, _, _, _, rows, _ in fam])
+            except Exception as e:
+                for name, version, m, good, rows, t0 in fam:
+                    self.breaker.record_failure((name, version),
+                                                "executor")
+                    for p, _ in good:
+                        self._reply_error(p, f"dispatch failed: {e}",
+                                          model=name)
+                continue
+            compiled = ex.compile_count - compiles_before
+            self.stacked_batches += 1
+            rec = telemetry.current()
+            if rec.active:
+                rec.metrics.count("serve_stacked_batches")
+            for (name, version, m, good, rows, t0), (w, logz) in zip(
+                    fam, outs):
+                self._answer_route(name, version, m, good, rows, w,
+                                   logz, t0, compiled, int(padded),
+                                   stacked=len(fam))
+        for name, version, m, good, rows, t0 in singles:
+            ex = self._executor_for(m)
+            compiles_before = ex.compile_count
+            try:
+                w, logz = ex.infer(m.state, rows, want="proba")
+            except Exception as e:
+                self.breaker.record_failure((name, version), "executor")
+                for p, _ in good:
+                    self._reply_error(p, f"dispatch failed: {e}",
+                                      model=name)
+                continue
+            compiled = ex.compile_count - compiles_before
+            self._answer_route(name, version, m, good, rows, w, logz,
+                               t0, compiled,
+                               int(ex.padded_rows(rows.shape[0])))
+
+    def _answer_route(self, name: str, version: Optional[int], m,
+                      good, rows, w, logz, t0, compiled: int,
+                      padded_rows: int,
+                      stacked: Optional[int] = None) -> None:
+        """The dispatch back half: poison check -> breaker verdict ->
+        telemetry -> per-request slicing and replies (identical for
+        per-model and stacked dispatches)."""
+        rec = telemetry.current()
         if faults.take("serve_nan", model=name) is not None:
             w = np.full_like(w, np.nan)
             logz = np.full_like(logz, np.nan)
         if not np.isfinite(logz).all():
             # The poisoned-artifact containment: logz is [rows], so the
             # check is O(rows) against the O(rows x K x D^2) dispatch,
-            # and every op's result derives from the same densities.
-            self.breaker.record_failure(route, "non_finite")
+            # and every op's result derives from the same densities. In
+            # a stacked call the check is PER LANE: one poisoned model
+            # trips only its own route's breaker.
+            self.breaker.record_failure((name, version), "non_finite")
             if rec.active:
                 rec.metrics.count("serve_nonfinite_batches")
             for p, _ in good:
@@ -427,16 +534,17 @@ class GMMServer:
                     "non-finite densities; its route breaker counts "
                     "the failure")
             return
-        self.breaker.record_success(route)
+        self.breaker.record_success((name, version))
         wall_ms = (time.perf_counter() - t0) * 1e3
-        compiled = ex.compile_count - compiles_before
         self.batches += 1
         self.rows += int(rows.shape[0])
         if rec.active:
             rec.emit("serve_batch", model=name, version=m.version,
                      requests=len(good), rows=int(rows.shape[0]),
-                     padded_rows=int(ex.padded_rows(rows.shape[0])),
-                     wall_ms=round(wall_ms, 3), compiled=int(compiled))
+                     padded_rows=int(padded_rows),
+                     wall_ms=round(wall_ms, 3), compiled=int(compiled),
+                     **({"stacked": int(stacked)}
+                        if stacked is not None else {}))
             rec.metrics.count("serve_batches")
             rec.metrics.count("serve_rows", int(rows.shape[0]))
             rec.metrics.count("serve_compiles", int(compiled))
@@ -540,6 +648,7 @@ class GMMServer:
             models=sorted({f"{n}@{m.version}"
                            for (n, _), m in self._models.items()}),
             executor=self.executor_stats(),
+            stacked_batches=int(self.stacked_batches),
             metrics=rec.metrics.snapshot(),
             **self.resilience_stats(),
         )
@@ -878,6 +987,12 @@ def serve_main(argv=None) -> int:
                    help="base seconds an open breaker fast-fails "
                    "before half-opening; doubles per consecutive "
                    "trip with deterministic jitter (default 1)")
+    p.add_argument("--stack-models", action="store_true",
+                   help="cross-model coalescing: one tick's requests "
+                   "for DIFFERENT models of one numeric family score "
+                   "through a single stacked executable call "
+                   "(bit-identical to per-model dispatch; "
+                   "docs/TENANCY.md \"Serving the fleet\")")
     args = p.parse_args(argv)
 
     if args.socket and (args.input or args.output):
@@ -901,7 +1016,8 @@ def serve_main(argv=None) -> int:
                        max_queue_rows=args.max_queue_rows,
                        default_deadline_ms=args.default_deadline_ms,
                        breaker_threshold=args.breaker_threshold,
-                       breaker_backoff_s=args.breaker_backoff_s)
+                       breaker_backoff_s=args.breaker_backoff_s,
+                       stack_models=args.stack_models)
 
     rec = (telemetry.RunRecorder(args.metrics_file)
            if args.metrics_file else telemetry.RunRecorder())

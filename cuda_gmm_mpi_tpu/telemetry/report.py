@@ -78,6 +78,11 @@ def render_report(records: List[dict], max_trajectory_rows: int = 400) -> str:
                      if r.get("event") == "serve_reload"]
     circuits = [r for r in records if r.get("event") == "circuit"]
 
+    fleet_starts = [r for r in records if r.get("event") == "fleet_start"]
+    tenant_dones = [r for r in records if r.get("event") == "tenant_done"]
+    fleet_summaries = [r for r in records
+                       if r.get("event") == "fleet_summary"]
+
     selects = [r for r in records if r.get("event") == "restart_select"]
     healths = [r for r in records if r.get("event") == "health"]
     recoveries = [r for r in records if r.get("event") == "recovery"]
@@ -205,6 +210,33 @@ def render_report(records: List[dict], max_trajectory_rows: int = 400) -> str:
                     f"({br.get('fastfails', 0)} fast-fails, "
                     f"{br.get('open_routes', 0)} open), "
                     f"{s.get('reloads', 0)} hot-reloads")
+        out.append("")
+
+    if fleet_starts or tenant_dones or fleet_summaries:
+        out.append("Fleet (rev v1.8; docs/TENANCY.md):")
+        for r in fleet_starts:
+            out.append(
+                f"  {r.get('tenants')} tenants in {r.get('groups')} "
+                f"packed group(s), mode={r.get('mode')} "
+                f"D={r.get('num_dimensions', '?')} "
+                f"{r.get('covariance_type', '')}")
+        for r in tenant_dones:
+            if r.get("dropped"):
+                out.append(f"  {str(r.get('tenant')):<20s} DROPPED "
+                           f"({r.get('error', '?')})")
+            else:
+                score = r.get("score")
+                sval = (f"{score:.6e}" if isinstance(score, (int, float))
+                        else "-")
+                out.append(
+                    f"  {str(r.get('tenant')):<20s} K={r.get('k'):>3} "
+                    f"{r.get('criterion', 'score')}={sval}  "
+                    f"{r.get('iters', 0):>5} EM iters")
+        for r in fleet_summaries:
+            out.append(
+                f"  summary: {r.get('tenants')} tenants "
+                f"({r.get('dropped')} dropped) in {r.get('groups')} "
+                f"group(s), {r.get('wall_s', 0):.2f}s")
         out.append("")
 
     for r in selects:

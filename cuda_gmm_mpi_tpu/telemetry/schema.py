@@ -143,9 +143,13 @@ EVENT_FIELDS: Dict[str, Tuple[Tuple[str, ...], Tuple[str, ...]]] = {
     # row count actually dispatched, and whether the dispatch had to
     # AOT-compile (``compiled`` > 0 = a cold bucket; a warmed server
     # emits zeros -- the zero-recompile proof is observable per batch).
+    # ``stacked`` (optional, rev v1.8) marks a cross-model stacked
+    # dispatch: how many DIFFERENT models' groups rode one executable
+    # call (serving/server.py --stack-models; bit-identical to
+    # per-model dispatch).
     "serve_batch": (
         ("model", "requests", "rows", "padded_rows", "wall_ms"),
-        ("version", "compiled"),
+        ("version", "compiled", "stacked"),
     ),
     # One per shed request (stream rev v1.7; serving resilience,
     # docs/ROBUSTNESS.md "Serving"): admission control rejected the
@@ -192,7 +196,31 @@ EVENT_FIELDS: Dict[str, Tuple[Tuple[str, ...], Tuple[str, ...]]] = {
         ("requests", "batches", "rows", "wall_s", "qps", "latency_ms",
          "metrics"),
         ("models", "executor", "errors", "shed", "deadline_expired",
-         "reloads", "breaker"),
+         "reloads", "breaker", "stacked_batches"),
+    ),
+    # Fleet fits (stream rev v1.8; tenancy/, docs/TENANCY.md): one per
+    # `fit_fleet` invocation -- the fleet's identity card: tenant count,
+    # packed-group count, and the dispatch mode ('scan' = bit-exact
+    # lane mapping, 'vmap' = batched-matmul throughput).
+    # ``group_shapes`` lists each group's {tenants, n_bucket, k_bucket}.
+    "fleet_start": (
+        ("tenants", "groups", "mode"),
+        ("platform", "num_dimensions", "dtype", "covariance_type",
+         "criterion", "chunk_size", "group_shapes"),
+    ),
+    # One per tenant as its group completes (rev v1.8): the tenant's
+    # solo-fit summary scalars, or ``dropped: true`` + ``error`` when
+    # the drop-one containment removed it from its group.
+    "tenant_done": (
+        ("tenant", "dropped"),
+        ("k", "score", "loglik", "iters", "group", "num_events",
+         "criterion", "error"),
+    ),
+    # One per fleet fit, at the end (rev v1.8): totals + the metrics-
+    # registry snapshot (run_summary's fleet sibling).
+    "fleet_summary": (
+        ("tenants", "dropped", "groups", "wall_s"),
+        ("mode", "metrics"),
     ),
     # One per fit: final scores, the 7-category phase profile, the
     # compile-vs-execute split, and the metrics-registry snapshot.

@@ -1,0 +1,23 @@
+"""Multi-tenancy subsystem: fit and serve thousands of independent GMMs
+in a handful of dispatches (docs/TENANCY.md).
+
+- :mod:`~cuda_gmm_mpi_tpu.tenancy.packing` -- ragged tenants into pow2
+  (event-bucket, cluster-bucket) groups; pure layout, never arithmetic.
+- :mod:`~cuda_gmm_mpi_tpu.tenancy.fleet` -- the fleet-fit driver: one
+  packed group = one fleet EM dispatch per sweep step, per-tenant
+  freeze-out / health rows / checkpoints, bit-identical to solo fits.
+- :mod:`~cuda_gmm_mpi_tpu.tenancy.cli` -- the ``gmm fleet`` driver:
+  manifest of per-tenant input files -> per-tenant fitted models, with
+  bulk registry export.
+"""
+
+from .fleet import FleetResult, TenantResult, fit_fleet
+from .packing import (
+    FleetGroup, PackedGroup, TenantSpec, pack_group, plan_fleet,
+    unpack_rows,
+)
+
+__all__ = [
+    "FleetGroup", "FleetResult", "PackedGroup", "TenantResult",
+    "TenantSpec", "fit_fleet", "pack_group", "plan_fleet", "unpack_rows",
+]

@@ -1,0 +1,582 @@
+"""Fleet fits: thousands of independent GMMs as a handful of dispatches.
+
+The multi-tenancy driver (docs/TENANCY.md): T independent datasets --
+per-tenant N_t / K_t / seed, shared D and covariance family -- pack into
+pow2 (event-bucket, cluster-bucket) groups (``tenancy/packing.py``) and
+each group runs its whole model-order sweep through ONE fleet EM
+executable per step (``GMMModel.run_em_fleet``: the PR-5/6 restart axis
+generalized into a dataset axis -- per-tenant data, weights, epsilon, and
+iteration bounds ride a leading tenant axis).
+
+Contracts (tests/test_tenancy.py):
+
+- **solo parity** -- every tenant's fitted model is BIT-IDENTICAL to a
+  solo ``fit_gmm`` of that tenant at the same seed/config (plain and
+  sharded meshes, full and diag covariance): the per-tenant host recipe
+  (moments, shift, seeding, epsilon) is the solo code path itself, the
+  packing pad is algebraically inert, and the default ``fleet_mode=
+  'scan'`` maps lanes with ``lax.map``, so each lane's arithmetic is the
+  exact HLO of its solo run. ``fleet_mode='vmap'`` trades bit-parity for
+  [T, B, K] batched matmuls (reduction-order tolerance).
+- **per-tenant freeze-out** -- a tenant that converges (or finishes its
+  sweep) freezes (``max_iters=0`` lanes pass through bit-identically)
+  while its groupmates keep iterating.
+- **drop-one containment** -- per-tenant health ROWS ([T, NUM_FLAGS]):
+  a tenant whose EM goes fatal is DROPPED from the group (``recovery``
+  action ``drop_tenant``) and its survivors' results are untouched;
+  ``recovery='off'`` raises instead (the PR-5 drop_restart shape).
+- **preempt/resume** -- with a checkpoint dir, every completed sweep
+  step is durable per group (``checkpoint_dir/group<i>/``); SIGTERM /
+  deadline between steps exits 75 and ``--resume auto`` continues
+  bit-identically.
+
+Telemetry (stream rev v1.8, docs/OBSERVABILITY.md): ``fleet_start`` /
+per-tenant ``tenant_done`` / closing ``fleet_summary``, rendered by
+``gmm report`` ("Fleet" section). The per-init run_start/run_summary
+contract stays the restart driver's; fleet streams are fleet-shaped.
+"""
+
+from __future__ import annotations
+
+import contextlib
+import dataclasses
+import math
+import time
+from typing import Dict, List, Optional
+
+import jax
+import numpy as np
+
+from .. import health, supervisor, telemetry
+from ..config import GMMConfig
+from ..models.restarts import (
+    _host_batched, _pad_sweep_logs, _place_batched, _place_batched_state,
+    _where_lanes,
+)
+from ..ops.formulas import model_score
+from ..state import clone_state, compact
+from ..telemetry import RunRecorder
+from ..utils.logging_ import get_logger
+from .packing import TenantSpec, pack_group, plan_fleet
+
+
+@dataclasses.dataclass
+class TenantResult:
+    """One tenant's outcome: a fitted model, or why it was dropped."""
+
+    name: str
+    index: int        # position in the fleet's tenant list
+    group: int        # packed-group index
+    result: Optional[object] = None   # GMMResult; None when dropped
+    error: Optional[str] = None       # the drop diagnosis
+
+    @property
+    def dropped(self) -> bool:
+        return self.result is None
+
+
+@dataclasses.dataclass
+class FleetResult:
+    """All tenants' outcomes plus the fleet-level accounting."""
+
+    tenants: List[TenantResult]
+    groups: List[dict]    # per-group {tenants, n_bucket, k_bucket, ...}
+    mode: str
+    wall_s: float
+
+    def __getitem__(self, name: str) -> TenantResult:
+        for t in self.tenants:
+            if t.name == name:
+                return t
+        raise KeyError(name)
+
+    @property
+    def dropped(self) -> List[TenantResult]:
+        return [t for t in self.tenants if t.dropped]
+
+    @property
+    def fitted(self) -> List[TenantResult]:
+        return [t for t in self.tenants if not t.dropped]
+
+
+def _reject_unsupported(config: GMMConfig) -> None:
+    """Loud rejection of config combinations the fleet driver cannot
+    honor -- silently ignoring a requested mode would fit tenants under
+    different semantics than the flag promised."""
+    why = None
+    if config.stream_events:
+        why = "stream_events has no single EM program to map tenants over"
+    elif config.fused_sweep:
+        why = "fused_sweep runs one whole-sweep program per dataset"
+    elif config.n_init > 1:
+        why = "n_init restarts nest a second batch axis (fit tenants solo)"
+    elif config.precompute_features:
+        why = "precompute_features would hold [T, C, B, F] features"
+    elif config.use_pallas == "always" or config.estep_backend == "pallas":
+        why = ("the Pallas kernels batch the restart axis over SHARED "
+               "event tiles; the fleet loop runs the jnp path")
+    elif config.recovery_reseed_empty:
+        why = "recovery_reseed_empty is a solo target-K refinement pass"
+    if why is not None:
+        raise ValueError(f"fit_fleet cannot honor this config: {why}")
+    if jax.process_count() > 1:
+        raise ValueError(
+            "fleet fits are single-controller; multi-controller runs fit "
+            "one tenant at a time")
+
+
+def fit_fleet(tenants: List[TenantSpec], config: GMMConfig = GMMConfig(),
+              model=None, verbose: Optional[bool] = None) -> FleetResult:
+    """Fit every tenant's mixture -- the fleet library entry point.
+
+    Mirrors ``fit_gmm``'s ambient-subsystem contract: ``metrics_file``
+    activates a run-scoped telemetry recorder (already-active ambient
+    recorders are reused) and ``max_runtime_s`` a signal-free deadline
+    supervisor, and a preemption surfaces as
+    :class:`~cuda_gmm_mpi_tpu.supervisor.PreemptedError` for the CLI's
+    exit-75 contract.
+    """
+    _reject_unsupported(config)
+    with contextlib.ExitStack() as stack:
+        if config.metrics_file and not telemetry.current().active:
+            rec = RunRecorder(config.metrics_file)
+            stack.enter_context(telemetry.use(rec))
+            stack.enter_context(rec)
+        if config.max_runtime_s is not None \
+                and not supervisor.current().active:
+            stack.enter_context(supervisor.use(supervisor.RunSupervisor(
+                max_runtime_s=config.max_runtime_s,
+                install_signals=False)))
+        return _fit_fleet(tenants, config, model, verbose)
+
+
+def _fit_fleet(tenants, config, model, verbose) -> FleetResult:
+    log = get_logger(config)
+    rec = telemetry.current()
+    verbose = config.enable_print if verbose is None else verbose
+    t_start = time.perf_counter()
+
+    if config.device:
+        jax.config.update("jax_platforms", config.device)
+    if config.dtype == "float64" and not jax.config.jax_enable_x64:
+        raise ValueError(
+            "dtype='float64' needs jax_enable_x64; set "
+            "jax.config.update('jax_enable_x64', True) at startup (the "
+            "CLI does this for --dtype=float64)")
+
+    if model is None:
+        if config.mesh_shape is not None:
+            from ..parallel import ShardedGMMModel
+
+            model = ShardedGMMModel(config)
+        else:
+            from ..models.gmm import GMMModel
+
+            model = GMMModel(config)
+    if not getattr(model, "supports_fleet", False):
+        raise ValueError(
+            f"{type(model).__name__} has no fleet EM loop")
+
+    groups = plan_fleet(
+        tenants, config,
+        data_axis=int(getattr(model, "data_size", 1)),
+        cluster_axis=int(getattr(model, "cluster_size", 1)))
+    mode = config.fleet_mode
+    d = int(np.asarray(tenants[0].data).shape[1])
+    log.info("fleet fit: %d tenants in %d packed group(s), mode=%s",
+             len(tenants), len(groups), mode)
+    if rec.active:
+        rec.set_context(path="fleet")
+        rec.emit(
+            "fleet_start",
+            tenants=len(tenants), groups=len(groups), mode=mode,
+            platform=jax.devices()[0].platform,
+            num_dimensions=d, dtype=config.dtype,
+            covariance_type=config.covariance_type,
+            criterion=config.criterion,
+            chunk_size=int(config.chunk_size),
+            group_shapes=[{"tenants": len(g.indices),
+                           "n_bucket": int(g.n_bucket),
+                           "k_bucket": int(g.k_bucket)}
+                          for g in groups],
+        )
+
+    out: List[Optional[TenantResult]] = [None] * len(tenants)
+    group_meta: List[dict] = []
+    for gi, group in enumerate(groups):
+        packed = pack_group(group, tenants, config,
+                            data_axis=int(getattr(model, "data_size", 1)))
+        ckpt = None
+        if config.checkpoint_dir:
+            import os
+
+            from ..utils.checkpoint import SweepCheckpointer
+
+            ckpt = SweepCheckpointer(
+                os.path.join(config.checkpoint_dir, f"group{gi}"),
+                keep=config.checkpoint_keep,
+                retries=config.checkpoint_retries)
+        t0 = time.perf_counter()
+        results = _run_group(model, config, packed, ckpt, rec, log,
+                             verbose, mode, gi)
+        group_meta.append({
+            "tenants": len(group.indices),
+            "n_bucket": int(group.n_bucket),
+            "k_bucket": int(group.k_bucket),
+            "num_chunks": int(group.num_chunks),
+            "seconds": round(time.perf_counter() - t0, 6),
+        })
+        for lane, i in enumerate(group.indices):
+            tr = results[lane]
+            out[i] = tr
+            if rec.active:
+                fields: Dict[str, object] = dict(
+                    tenant=tr.name, dropped=tr.dropped, group=gi,
+                    num_events=int(packed.n_events[lane]))
+                if tr.dropped:
+                    fields["error"] = tr.error
+                else:
+                    r = tr.result
+                    fields.update(
+                        k=int(r.ideal_num_clusters),
+                        score=_json_float(r.min_rissanen),
+                        loglik=_json_float(r.final_loglik),
+                        iters=int(sum(row[3] for row in r.sweep_log)),
+                        criterion=config.criterion)
+                rec.emit("tenant_done", **fields)
+                rec.metrics.count("tenants_dropped" if tr.dropped
+                                  else "tenants_fitted")
+            if verbose:
+                if tr.dropped:
+                    print(f"tenant {tr.name}: DROPPED ({tr.error})")
+                else:
+                    print(f"tenant {tr.name}: "
+                          f"{config.criterion}="
+                          f"{tr.result.min_rissanen:.6e} "
+                          f"K={tr.result.ideal_num_clusters}")
+
+    wall = time.perf_counter() - t_start
+    fleet = FleetResult(tenants=[t for t in out if t is not None],
+                        groups=group_meta, mode=mode,
+                        wall_s=round(wall, 6))
+    if rec.active:
+        rec.emit("fleet_summary",
+                 tenants=len(fleet.tenants),
+                 dropped=len(fleet.dropped),
+                 groups=len(groups), mode=mode,
+                 wall_s=round(wall, 6),
+                 metrics=rec.metrics.snapshot())
+        rec.set_context(path=None)
+    return fleet
+
+
+def _json_float(x) -> Optional[float]:
+    x = float(x)
+    return x if math.isfinite(x) else None
+
+
+def _fleet_elim(model, config, mode: str):
+    """Order-reduction for a tenant-batched state: scan mode lax.maps the
+    per-lane ``eliminate_and_reduce`` (bit-identical to the solo
+    dispatch); vmap mode reuses the restart driver's vmapped executable."""
+    import functools
+
+    from jax import lax
+
+    from ..models.restarts import _elim_reduce_batched_jit
+    from ..ops.merge import eliminate_and_reduce
+
+    if mode == "vmap":
+        return _elim_reduce_batched_jit(config.diag_only)
+    fn = functools.partial(eliminate_and_reduce,
+                           diag_only=config.diag_only)
+    cache = model.__dict__.setdefault("_fleet_elim_cache", {})
+    jitted = cache.get(config.diag_only)
+    if jitted is None:
+        jitted = cache[config.diag_only] = jax.jit(
+            lambda s: lax.map(fn, s))
+    return jitted
+
+
+def _run_group(model, config, packed, ckpt, rec, log, verbose, mode,
+               group_index) -> List[TenantResult]:
+    """One packed group through the whole per-tenant model-order sweep.
+
+    The fleet mirror of the batched-restart sweep (``restarts._run_batch``)
+    with per-LANE datasets: every lane carries its own k trajectory,
+    epsilon, event count, and stop target; one fleet EM dispatch + one
+    mapped order-reduction dispatch per step serve every live lane.
+    """
+    from ..models.order_search import (
+        _COV_CODE, _CRITERION_CODE, _resume_mismatch, _shutdown_and_raise,
+        GMMResult,
+    )
+
+    sup = supervisor.current()
+    T = len(packed.names)
+    d = packed.chunks.shape[-1]
+
+    states = _place_batched(model, packed.states)
+    chunks_d, wts_d = model.prepare_fleet(packed.chunks, packed.wts)
+    if rec.active:
+        rec.metrics.count("h2d_bytes", int(packed.chunks.nbytes)
+                          + int(packed.wts.nbytes))
+
+    K0 = packed.k0.copy()
+    k_r = packed.k0.copy()
+    stop_r = np.where(packed.targets > 0, packed.targets, 1)
+    alive = np.ones((T,), bool)
+    dropped = np.zeros((T,), bool)
+    drop_error: List[Optional[str]] = [None] * T
+    min_riss_r = np.full((T,), np.inf)
+    ideal_k_r = k_r.copy()
+    best_ll_r = np.full((T,), -np.inf)
+    sweep_logs: List[list] = [[] for _ in range(T)]
+    health_lane = np.zeros((T, health.NUM_FLAGS), np.int64)
+    # The first EM call donates the seed buffers; best must not alias.
+    best_states = clone_state(states)
+    elim = _fleet_elim(model, config, mode)
+
+    step = 0
+    if ckpt is not None and config.resume != "never":
+        restored = ckpt.restore()
+        if restored is not None and (
+                "fleet" not in restored
+                or int(np.asarray(restored["state"].N).shape[0]) != T
+                or not np.array_equal(np.asarray(restored["k0"],
+                                                 np.int64), K0)
+                or not np.array_equal(
+                    np.asarray(restored["n_events"], np.int64),
+                    packed.n_events)
+                or _resume_mismatch(restored, config, log)):
+            restored = None
+        if restored is not None:
+            states = _place_batched_state(model, restored["state"])
+            best_states = _place_batched_state(model,
+                                               restored["best_state"])
+            k_r = np.asarray(restored["k"], np.int64).copy()
+            alive = np.asarray(restored["alive"], bool).copy()
+            dropped = np.asarray(restored["dropped"], bool).copy()
+            min_riss_r = np.asarray(restored["min_rissanen"],
+                                    np.float64).copy()
+            ideal_k_r = np.asarray(restored["ideal_k"], np.int64).copy()
+            best_ll_r = np.asarray(restored["best_ll"], np.float64).copy()
+            lens = np.asarray(restored["sweep_len"], np.int64)
+            rows_log = np.asarray(restored["sweep_log"], np.float64)
+            sweep_logs = [
+                [tuple(row) for row in rows_log[t][:int(lens[t])]]
+                for t in range(T)
+            ]
+            health_lane = np.asarray(restored["health_lane"],
+                                     np.int64).copy()
+            step = int(np.asarray(restored["step"])) + 1
+            log.info("resumed fleet group %d from checkpoint: step %d",
+                     group_index, step)
+            rec.metrics.count("resumes") if rec.active else None
+
+    def host_payload():
+        return {
+            "state": _host_batched(model, states),
+            "best_state": _host_batched(model, best_states),
+            "min_rissanen": np.asarray(min_riss_r, np.float64),
+            "ideal_k": np.asarray(ideal_k_r, np.int64),
+            "best_ll": np.asarray(best_ll_r, np.float64),
+            "k": np.asarray(k_r, np.int64),
+            "alive": alive.astype(np.int64),
+            "dropped": dropped.astype(np.int64),
+            "k0": K0,
+            "targets": packed.targets,
+            "n_events": packed.n_events,
+            "fleet": 1,
+            "num_clusters": int(packed.group.k_bucket),
+            "criterion_code": _CRITERION_CODE[config.criterion],
+            "cov_code": _COV_CODE[config.covariance_type],
+            "health_lane": health_lane,
+            "sweep_log": _pad_sweep_logs(sweep_logs),
+            "sweep_len": np.asarray([len(l) for l in sweep_logs],
+                                    np.int64),
+        }
+
+    while alive.any():
+        k_top = int(k_r[alive].max())
+        if sup.active and sup.poll(where="fleet", k=k_top, em_iter=step):
+            _shutdown_and_raise(sup, rec, log, ckpt,
+                                step=step - 1 if step else None, k=k_top,
+                                checkpointed=ckpt is not None and step > 0)
+        t0 = time.perf_counter()
+        live = alive.copy()
+        lo_t = np.where(live, min(config.min_iters, config.max_iters),
+                        0).astype(np.int32)
+        hi_t = np.where(live, config.max_iters, 0).astype(np.int32)
+        states, ll_d, iters_d = model.run_em_fleet(
+            states, chunks_d, wts_d, packed.epsilons,
+            min_iters=lo_t, max_iters=hi_t, donate=True, mode=mode)
+        counts = np.asarray(jax.device_get(model.last_health), np.int64)
+        counts = counts.reshape(T, health.NUM_FLAGS)
+        next_states, k_active_d, min_d_d, pair_d = elim(states)
+        ll_np, iters_np, k_active_np, min_d_np, pair_np = map(
+            np.asarray,
+            jax.device_get((ll_d, iters_d, k_active_d, min_d_d, pair_d)))
+        dt = time.perf_counter() - t0
+
+        # --- per-tenant fault containment (drop-one, PR-5 shape) -------
+        fatal_t = np.asarray([
+            health.word_is_fatal(health.pack_word(counts[t]))
+            for t in range(T)
+        ]) & live
+        if fatal_t.any():
+            if config.recovery == "off":
+                bad = [packed.names[t] for t in np.flatnonzero(fatal_t)]
+                total = counts[fatal_t].sum(axis=0)
+                raise health.NumericalFaultError(
+                    f"numerical fault in tenant(s) {', '.join(bad)} at "
+                    f"K={k_top} and recovery is 'off'",
+                    health.fault_bundle(total, k=k_top, where="fleet",
+                                        config=config))
+            for t in np.flatnonzero(fatal_t):
+                health_lane[t] += counts[t]
+                word = health.pack_word(counts[t])
+                names = health.flag_names(word)
+                drop_error[t] = (
+                    f"fatal numerical fault at K={int(k_r[t])} "
+                    f"(flags={names})")
+                log.warning(
+                    "tenant %s hit a fatal numerical fault at K=%d; "
+                    "dropped from the fleet (survivors continue)",
+                    packed.names[t], int(k_r[t]))
+                if rec.active:
+                    rec.set_context(tenant=packed.names[t])
+                    rec.emit("health", k=int(k_r[t]), where="fleet",
+                             flags=int(word), flag_names=names,
+                             counters=health.counts_dict(counts[t]))
+                    rec.emit("recovery", k=int(k_r[t]), attempt=1,
+                             action="drop_tenant", outcome="dropped",
+                             flags=int(word), flag_names=names)
+                    rec.metrics.count("tenant_drops")
+                    rec.set_context(tenant=None)
+            alive &= ~fatal_t
+            dropped |= fatal_t
+            live &= ~fatal_t
+
+        # --- scoring + best-model save per live lane --------------------
+        improved = np.zeros((T,), bool)
+        for t in np.flatnonzero(live):
+            health_lane[t] += counts[t]
+            word = health.pack_word(counts[t])
+            ll_f = float(ll_np[t])
+            riss = model_score(ll_f, int(k_r[t]),
+                               int(packed.n_events[t]), d,
+                               criterion=config.criterion,
+                               covariance_type=config.covariance_type)
+            score_ok = math.isfinite(riss)
+            if not score_ok:
+                health_lane[t, health.NONFINITE_SCORE] += 1
+                log.warning("non-finite %s score at K=%d (tenant %s); "
+                            "excluded from best-model selection",
+                            config.criterion, int(k_r[t]),
+                            packed.names[t])
+            sweep_logs[t].append((int(k_r[t]), ll_f, riss,
+                                  int(iters_np[t]), dt))
+            if rec.active and word:
+                rec.set_context(tenant=packed.names[t])
+                rec.emit("health", k=int(k_r[t]), where="fleet",
+                         flags=int(word),
+                         flag_names=health.flag_names(word),
+                         counters=health.counts_dict(counts[t]))
+                rec.metrics.count("health_events")
+                rec.set_context(tenant=None)
+            if rec.active:
+                rec.metrics.count("em_iters", int(iters_np[t]))
+            if verbose:
+                print(f"tenant {packed.names[t]} K={int(k_r[t])}: "
+                      f"loglik={ll_f:.6e} {config.criterion}={riss:.6e} "
+                      f"iters={int(iters_np[t])} ({dt:.2f}s)")
+            if score_ok and (
+                k_r[t] == K0[t]
+                or (riss < min_riss_r[t] and packed.targets[t] == 0)
+                or k_r[t] == packed.targets[t]
+            ):  # gaussian.cu:839, per lane, NaN-score-guarded
+                improved[t] = True
+                min_riss_r[t] = riss
+                ideal_k_r[t] = k_r[t]
+                best_ll_r[t] = ll_f
+        if improved.any():
+            best_states = _where_lanes(improved, states, best_states)
+        if rec.active:
+            rec.heartbeat("fleet", k=k_top)
+
+        # --- sweep advance per lane -------------------------------------
+        finished = live & (k_r <= stop_r)
+        alive &= ~finished
+        live &= ~finished
+        if not alive.any():
+            break
+        merge_mask = np.zeros((T,), bool)
+        for t in np.flatnonzero(live):
+            k_new = int(k_active_np[t])
+            if k_new < 2:
+                alive[t] = False
+                continue
+            if not np.isfinite(float(min_d_np[t])):
+                log.warning("no valid merge pair at K=%d (tenant %s); "
+                            "stopping that tenant's sweep", k_new,
+                            packed.names[t])
+                alive[t] = False
+                continue
+            if rec.active:
+                rec.set_context(tenant=packed.names[t])
+                rec.emit("merge", k_active=k_new, next_k=k_new - 1,
+                         min_distance=float(min_d_np[t]),
+                         pair=[int(pair_np[t][0]), int(pair_np[t][1])])
+                rec.metrics.count("merges")
+                rec.set_context(tenant=None)
+            merge_mask[t] = True
+            k_r[t] = k_new - 1
+            if k_r[t] < stop_r[t]:
+                alive[t] = False
+        if merge_mask.any():
+            states = _where_lanes(merge_mask, next_states, states)
+
+        if ckpt is not None and alive.any():
+            rec.metrics.count("checkpoint_saves") if rec.active else None
+            ckpt.save(step, host_payload())
+        step += 1
+
+    # --- per-tenant results -----------------------------------------------
+    host_best = _host_batched(model, best_states)
+    results: List[TenantResult] = []
+    for t in range(T):
+        if dropped[t]:
+            results.append(TenantResult(
+                name=packed.names[t], index=packed.group.indices[t],
+                group=group_index, result=None,
+                error=drop_error[t] or "dropped"))
+            continue
+        import jax.numpy as jnp
+
+        lane = jax.tree_util.tree_map(
+            lambda a, _t=t: jnp.asarray(np.asarray(a)[_t]), host_best)
+        compact_state, n_active = compact(lane)
+        results.append(TenantResult(
+            name=packed.names[t], index=packed.group.indices[t],
+            group=group_index,
+            result=GMMResult(
+                state=compact_state,
+                ideal_num_clusters=int(n_active),
+                min_rissanen=float(min_riss_r[t]),
+                final_loglik=float(best_ll_r[t]),
+                epsilon=float(packed.epsilons[t]),
+                num_events=int(packed.n_events[t]),
+                num_dimensions=d,
+                data_shift=np.asarray(packed.shifts[t]),
+                sweep_log=sweep_logs[t],
+                profile=None, profile_report=None,
+                host_range=(0, int(packed.n_events[t])),
+                health=health.health_summary(
+                    health_lane[t],
+                    io_retries=(ckpt.io_retries if ckpt is not None
+                                else 0)),
+                model=model,
+            )))
+    return results
+

@@ -326,3 +326,36 @@ def test_deliberate_cpu_run_measures_with_rc0():
     assert j["value"] > 0 and j["vs_baseline"] > 0
     assert j["accelerator_unavailable"] is False
     assert "cpu" in j["metric"]
+
+
+def test_tenancy_ab_mode_contract():
+    """--tenancy (GMM_BENCH_TENANCY=1) emits ONE JSON record carrying
+    the fleet AND sequential walls plus per-tenant parity bits -- the
+    same contract style as --restarts. Tiny shapes, pow2 K so the
+    bit-parity contract applies (docs/TENANCY.md)."""
+    r = _run({
+        "GMM_BENCH_CPU": "1",
+        "GMM_BENCH_TENANCY": "1",
+        "GMM_BENCH_TENANTS": "3",
+        "GMM_BENCH_TENANCY_N": "1500",
+        "GMM_BENCH_TENANCY_D": "3",
+        "GMM_BENCH_TENANCY_K": "4",
+        "GMM_BENCH_TENANCY_ITERS": "2",
+    }, timeout=600)
+    assert r.returncode == 0, r.stderr
+    j = _json_line(r.stdout)
+    assert j["unit"] == "s" and j["value"] > 0
+    assert j["accelerator_unavailable"] is False
+    ab = j["tenancy"]
+    assert ab["tenants"] == 3
+    assert ab["fleet_wall_s"] > 0 and ab["sequential_wall_s"] > 0
+    # walls + parity in the SAME record
+    assert ab["all_parity_ok"] is True
+    assert ab["all_bit_identical"] is True
+    assert len(ab["per_tenant"]) == 3
+    for t in ab["per_tenant"]:
+        assert t["ideal_k_equal"] is True
+        assert t["loglik_bit_identical"] is True
+    assert ab["dropped"] == 0
+    assert j["vs_baseline"] == ab["speedup"]
+    assert ab["mode"] in ("scan", "vmap")

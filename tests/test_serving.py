@@ -960,3 +960,111 @@ def test_serve_cli_startup_failure_exits_1(tmp_path):
                      "--input", os.devnull,
                      "--output", str(tmp_path / "o.jsonl")])
     assert rc == 1
+
+
+# ------------------------------------------------- stacked cross-model
+
+
+def _two_family_models(rng, tmp_path):
+    """Two different models of ONE numeric family (same D/dtype/full)."""
+    reg = ModelRegistry(str(tmp_path))
+    gm1, data1 = fitted(rng, k=3, d=4)
+    gm2, data2 = fitted(rng, k=5, d=4, n=700)
+    gm1.to_registry(reg, "m1")
+    gm2.to_registry(reg, "m2")
+    return reg, data1, data2
+
+
+def _mixed_requests(data1, data2):
+    return [
+        {"id": 0, "model": "m1", "op": "score_samples",
+         "x": data1[:40].tolist()},
+        {"id": 1, "model": "m2", "op": "predict_proba",
+         "x": data2[:17].tolist()},
+        {"id": 2, "model": "m1", "op": "predict",
+         "x": data1[50:75].tolist()},
+        {"id": 3, "model": "m2", "op": "score",
+         "x": data2[20:60].tolist()},
+    ]
+
+
+def test_stacked_cross_model_dispatch_parity(rng, tmp_path):
+    """The satellite fix for the per-(model, version)-only tick loop:
+    with --stack-models, one tick's groups for DIFFERENT models of one
+    family ride ONE stacked executable call -- and every response is
+    BIT-identical to the per-request dispatch baseline (the PR-7
+    coalescing-parity contract, extended across models). The stacked
+    executable maps lanes with lax.map, so each model's arithmetic is
+    the solo executable's exact HLO."""
+    reg, data1, data2 = _two_family_models(rng, tmp_path)
+    stacked_srv = GMMServer(reg, warm=False, stack_models=True)
+    plain_srv = GMMServer(reg, warm=False)
+    reqs = _mixed_requests(data1, data2)
+    stream = []
+    rec = telemetry.RunRecorder(stream=_StreamSink(stream))
+    with telemetry.use(rec):
+        got = stacked_srv.handle_requests(reqs, coalesce=True)
+    want = plain_srv.handle_requests(reqs, coalesce=False)
+    assert stacked_srv.stacked_batches == 1
+    for a, b in zip(got, want):
+        a = {k: v for k, v in a.items() if k != "latency_ms"}
+        b = {k: v for k, v in b.items() if k != "latency_ms"}
+        assert a == b
+    batches = [r for r in stream if r["event"] == "serve_batch"]
+    assert len(batches) == 2  # one record per route, same stacked call
+    assert all(r.get("stacked") == 2 for r in batches)
+    assert validate_stream(stream) == []
+
+
+def test_stacked_dispatch_poison_isolated_per_route(rng, tmp_path):
+    """A poisoned model inside a stacked family fails ONLY its own
+    route: the non-finite check runs per lane, its breaker counts one
+    failure, and the sibling's responses stay bit-identical."""
+    reg, data1, data2 = _two_family_models(rng, tmp_path)
+    server = GMMServer(reg, warm=False, stack_models=True,
+                       breaker_threshold=3)
+    baseline = GMMServer(reg, warm=False).handle_requests(
+        _mixed_requests(data1, data2), coalesce=False)
+    with faults.use({"serve_nan": {"model": "m2", "times": 1}}) as plan:
+        got = server.handle_requests(_mixed_requests(data1, data2),
+                                     coalesce=True)
+    assert plan.fired["serve_nan"] == 1
+    by_id = {r["id"]: r for r in got}
+    want = {r["id"]: r for r in baseline}
+    for i in (0, 2):  # m1 requests: untouched, bit-identical
+        a = {k: v for k, v in by_id[i].items() if k != "latency_ms"}
+        b = {k: v for k, v in want[i].items() if k != "latency_ms"}
+        assert a == b
+    for i in (1, 3):  # m2 requests: contained failure
+        assert not by_id[i]["ok"]
+        assert by_id[i]["error"] == "non_finite_scores"
+    # Only m2's route breaker observed the failure.
+    assert server.breaker.stats()["trips"] == 0
+    out = server.handle_requests(_mixed_requests(data1, data2),
+                                 coalesce=True)
+    assert all(r["ok"] for r in out)
+
+
+def test_stacked_falls_back_per_model_when_family_is_single(rng,
+                                                            tmp_path):
+    """One tick, two models of DIFFERENT D: no shared family, so the
+    stacked path dispatches each per-model -- responses still match the
+    per-request baseline and no stacked batch is counted."""
+    reg = ModelRegistry(str(tmp_path))
+    gm1, data1 = fitted(rng, k=3, d=4)
+    gm2, data2 = fitted(rng, k=3, d=3)
+    gm1.to_registry(reg, "m1")
+    gm2.to_registry(reg, "m2")
+    server = GMMServer(reg, warm=False, stack_models=True)
+    reqs = [
+        {"id": 0, "model": "m1", "op": "score", "x": data1[:9].tolist()},
+        {"id": 1, "model": "m2", "op": "score", "x": data2[:9].tolist()},
+    ]
+    got = server.handle_requests(reqs, coalesce=True)
+    want = GMMServer(reg, warm=False).handle_requests(reqs,
+                                                      coalesce=False)
+    assert server.stacked_batches == 0
+    for a, b in zip(got, want):
+        a = {k: v for k, v in a.items() if k != "latency_ms"}
+        b = {k: v for k, v in b.items() if k != "latency_ms"}
+        assert a == b

@@ -1,0 +1,488 @@
+"""Multi-tenancy fleet fits (cuda_gmm_mpi_tpu/tenancy/; docs/TENANCY.md).
+
+The contracts under test:
+
+- **solo bit-parity** -- a fleet fit of T tenants produces, for every
+  tenant, a model BIT-IDENTICAL to that tenant's solo ``fit_gmm`` at the
+  same seed/config (pow2 starting K; plain + sharded meshes, full +
+  diag covariance). Non-pow2 K tenants (whose solo seed width has no
+  shared-program equivalent) agree at reduction-order tolerance, as does
+  the ``fleet_mode='vmap'`` throughput mode.
+- **ragged pack/unpack round-trip** -- packing is pure layout: the
+  packed grid slices back to exactly the rows that went in.
+- **drop-one containment** -- a lane-targeted ``nan_loglik`` injection
+  poisons ONE tenant; it is dropped with a ``drop_tenant`` recovery
+  event while every survivor's model stays bit-identical to a clean
+  fleet's.
+- **preempt -> resume** -- an injected preemption between sweep steps
+  exits through PreemptedError with a durable group checkpoint, and
+  ``resume='auto'`` continues to results bit-identical to an
+  uninterrupted fleet.
+- **bulk export** -- one registry version per tenant; partial failure
+  stays per-tenant.
+- **telemetry rev v1.8** -- fleet_start / tenant_done / fleet_summary
+  validate against the schema and render in ``gmm report``.
+"""
+
+import dataclasses
+import json
+import os
+import subprocess
+import sys
+
+import numpy as np
+import pytest
+
+from cuda_gmm_mpi_tpu import GMMConfig, fit_gmm, supervisor
+from cuda_gmm_mpi_tpu.supervisor import PreemptedError, RunSupervisor
+from cuda_gmm_mpi_tpu.tenancy import (
+    TenantSpec, fit_fleet, pack_group, plan_fleet, unpack_rows,
+)
+from cuda_gmm_mpi_tpu.testing import faults
+
+from .conftest import worker_env
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+
+def cfg(**kw):
+    base = dict(min_iters=4, max_iters=4, chunk_size=256, dtype="float64")
+    base.update(kw)
+    return GMMConfig(**base)
+
+
+def blob(n, k, seed, d=3):
+    r = np.random.default_rng(seed)
+    centers = r.normal(scale=8.0, size=(k, d))
+    return (centers[r.integers(0, k, n)]
+            + r.normal(size=(n, d))).astype(np.float64)
+
+
+def tenant_set():
+    """Mixed-N tenants, pow2 starting Ks, one with a target K."""
+    return [
+        TenantSpec("alpha", blob(700, 4, 1), 4),
+        TenantSpec("beta", blob(500, 4, 2), 4, seed=3),
+        TenantSpec("gamma", blob(900, 4, 3), 4, target_num_clusters=2),
+    ]
+
+
+def assert_tenant_bit_identical(tr, solo, trajectory="exact"):
+    """The fleet-vs-solo parity ladder (docs/TENANCY.md):
+
+    - the fitted MODEL (state bits, scores, selected K, shift) must be
+      bit-identical in both comparisons;
+    - the full per-K trajectory is bit-exact vs the FIXED-WIDTH solo
+      sweep (``sweep_k_buckets='off'`` -- the fleet is fixed-width by
+      construction, the batched-restart trade); vs the default pow2-
+      bucketing solo it is compared at near-epsilon tolerance instead,
+      because the solo sweep's width can shrink below the fleet's fixed
+      width mid-sweep and non-best Ks then differ in the last bits.
+    """
+    r = tr.result
+    assert r is not None, tr.error
+    assert r.ideal_num_clusters == solo.ideal_num_clusters
+    assert r.min_rissanen == solo.min_rissanen
+    assert r.final_loglik == solo.final_loglik
+    np.testing.assert_array_equal(np.asarray(r.state.means),
+                                  np.asarray(solo.state.means))
+    np.testing.assert_array_equal(np.asarray(r.state.R),
+                                  np.asarray(solo.state.R))
+    np.testing.assert_array_equal(np.asarray(r.state.N),
+                                  np.asarray(solo.state.N))
+    np.testing.assert_array_equal(r.data_shift, solo.data_shift)
+    assert len(r.sweep_log) == len(solo.sweep_log)
+    for frow, srow in zip(r.sweep_log, solo.sweep_log):
+        assert frow[0] == srow[0] and frow[3] == srow[3]
+        if trajectory == "exact":
+            assert frow[1:3] == srow[1:3]
+        else:
+            np.testing.assert_allclose(frow[1:3], srow[1:3], rtol=1e-12)
+
+
+# ---------------------------------------------------------- solo parity
+
+
+def test_fleet_vs_solo_bit_parity_plain(rng):
+    """Every tenant of a plain-model fleet is bit-identical -- model AND
+    full per-K trajectory -- to its solo fit at the same seed/config
+    (full covariance). The shared config pins ``sweep_k_buckets='off'``:
+    the fleet sweep is fixed-width by construction (the PR-5 batched-
+    restart trade), and 'off' is the solo sweep's fixed-width program
+    shape, so both sides literally run the same HLO per tenant."""
+    tenants = tenant_set()
+    c = cfg(sweep_k_buckets="off")
+    fleet = fit_fleet(tenants, c)
+    assert not fleet.dropped
+    for spec in tenants:
+        solo = fit_gmm(spec.data, spec.num_clusters,
+                       spec.target_num_clusters,
+                       dataclasses.replace(c, seed=(c.seed if spec.seed
+                                                    is None else spec.seed)))
+        assert_tenant_bit_identical(fleet[spec.name], solo,
+                                    trajectory="exact")
+
+
+def test_fleet_vs_default_bucketing_solo_tolerance(rng):
+    """Against the DEFAULT config (pow2 sweep bucketing), the solo
+    sweep's padded width shrinks mid-sweep below the fleet's fixed
+    width, so parity is near-epsilon rather than guaranteed-bitwise:
+    identical selected K, scores within 1e-12 (docs/TENANCY.md
+    'Parity guarantees')."""
+    tenants = tenant_set()
+    c = cfg()
+    fleet = fit_fleet(tenants, c)
+    for spec in tenants:
+        solo = fit_gmm(spec.data, spec.num_clusters,
+                       spec.target_num_clusters,
+                       dataclasses.replace(c, seed=(c.seed if spec.seed
+                                                    is None else spec.seed)))
+        r = fleet[spec.name].result
+        assert r.ideal_num_clusters == solo.ideal_num_clusters
+        np.testing.assert_allclose(r.min_rissanen, solo.min_rissanen,
+                                   rtol=1e-12)
+        np.testing.assert_allclose(r.final_loglik, solo.final_loglik,
+                                   rtol=1e-12)
+        np.testing.assert_allclose(np.asarray(r.state.means),
+                                   np.asarray(solo.state.means),
+                                   rtol=1e-9, atol=1e-9)
+
+
+def test_fleet_vs_solo_bit_parity_diag(rng):
+    tenants = tenant_set()[:2]
+    c = cfg(covariance_type="diag", sweep_k_buckets="off")
+    fleet = fit_fleet(tenants, c)
+    for spec in tenants:
+        solo = fit_gmm(spec.data, spec.num_clusters,
+                       spec.target_num_clusters,
+                       dataclasses.replace(c, seed=(c.seed if spec.seed
+                                                    is None else spec.seed)))
+        assert_tenant_bit_identical(fleet[spec.name], solo,
+                                    trajectory="exact")
+
+
+@pytest.mark.parametrize("mesh", [(2, 1), (2, 2)])
+def test_fleet_vs_solo_bit_parity_sharded(rng, mesh):
+    """Sharded fleet lanes replicate the tenant axis and shard each
+    lane's own chunk grid over the data axis; pad chunks interleave per
+    shard so the stats psum groups exactly like the solo fit's."""
+    tenants = tenant_set()[:2]
+    c = cfg(chunk_size=128, mesh_shape=mesh, sweep_k_buckets="off")
+    fleet = fit_fleet(tenants, c)
+    for spec in tenants:
+        solo = fit_gmm(spec.data, spec.num_clusters,
+                       spec.target_num_clusters,
+                       dataclasses.replace(c, seed=(c.seed if spec.seed
+                                                    is None else spec.seed)))
+        assert_tenant_bit_identical(fleet[spec.name], solo,
+                                    trajectory="exact")
+
+
+def test_fleet_nonpow2_k_tolerance(rng):
+    """A non-pow2 starting K has no shared-program width equal to its
+    solo seed width (K itself), so the contract degrades to
+    reduction-order tolerance -- same selected K, near-identical
+    scores (docs/TENANCY.md 'Parity guarantees')."""
+    spec = TenantSpec("odd", blob(600, 3, 9), 3)
+    c = cfg()
+    fleet = fit_fleet([spec], c)
+    solo = fit_gmm(spec.data, 3, 0, c)
+    r = fleet["odd"].result
+    assert r.ideal_num_clusters == solo.ideal_num_clusters
+    np.testing.assert_allclose(r.min_rissanen, solo.min_rissanen,
+                               rtol=1e-9)
+    np.testing.assert_allclose(np.asarray(r.state.means),
+                               np.asarray(solo.state.means),
+                               rtol=1e-7, atol=1e-7)
+
+
+def test_fleet_vmap_mode_tolerance(rng):
+    """fleet_mode='vmap' batches the tenant matmuls (the throughput
+    shape); results agree with solo fits at tolerance, same winner K."""
+    tenants = tenant_set()[:2]
+    c = cfg(fleet_mode="vmap")
+    fleet = fit_fleet(tenants, c)
+    for spec in tenants:
+        solo = fit_gmm(spec.data, spec.num_clusters, 0,
+                       dataclasses.replace(c, seed=(c.seed if spec.seed
+                                                    is None else spec.seed)))
+        r = fleet[spec.name].result
+        assert r.ideal_num_clusters == solo.ideal_num_clusters
+        np.testing.assert_allclose(r.min_rissanen, solo.min_rissanen,
+                                   rtol=1e-8)
+        np.testing.assert_allclose(np.asarray(r.state.means),
+                                   np.asarray(solo.state.means),
+                                   rtol=1e-7, atol=1e-7)
+
+
+# ------------------------------------------------------ packing layout
+
+
+def test_ragged_pack_unpack_roundtrip(rng):
+    """Packing is pure layout: the grid slices back to exactly the
+    centered rows that went in, for every tenant of every group."""
+    tenants = [
+        TenantSpec("a", blob(700, 4, 1), 4),
+        TenantSpec("b", blob(500, 4, 2), 4),
+        TenantSpec("c", blob(130, 2, 3), 2),
+    ]
+    c = cfg()
+    groups = plan_fleet(tenants, c)
+    seen = set()
+    for g in groups:
+        packed = pack_group(g, tenants, c)
+        for lane, i in enumerate(g.indices):
+            spec = tenants[i]
+            dtype = np.dtype(c.dtype)
+            want = (spec.data.astype(dtype)
+                    - packed.shifts[lane].astype(dtype)[None, :])
+            got = unpack_rows(packed, lane)
+            np.testing.assert_array_equal(got, want)
+            # Exactly N_t unit weights; every pad row weighs zero
+            # (what makes the pad algebraically inert).
+            w = packed.wts[lane].reshape(-1)
+            n = int(packed.n_events[lane])
+            assert int((w != 0).sum()) == n
+            assert set(np.unique(w).tolist()) <= {0.0, 1.0}
+            seen.add(spec.name)
+    assert seen == {"a", "b", "c"}
+
+
+def test_plan_fleet_grouping_and_caps():
+    """Tenants group by (chunk-count, K-bucket) signature; the group cap
+    splits oversized groups; mixed D and duplicate names are loud."""
+    c = cfg(chunk_size=256)
+    tenants = [
+        TenantSpec("t1", blob(500, 4, 1), 4),    # bucket 512, kb 4
+        TenantSpec("t2", blob(400, 3, 2), 3),    # bucket 512, kb 4
+        TenantSpec("t3", blob(900, 4, 3), 4),    # bucket 1024, kb 4
+        TenantSpec("t4", blob(480, 8, 4), 8),    # bucket 512, kb 8
+    ]
+    groups = plan_fleet(tenants, c)
+    keys = sorted((g.num_chunks, g.k_bucket, len(g.indices))
+                  for g in groups)
+    assert keys == [(2, 4, 2), (2, 8, 1), (4, 4, 1)]
+    capped = plan_fleet(tenants,
+                        dataclasses.replace(c, fleet_group_size=1))
+    assert all(len(g.indices) == 1 for g in capped)
+    with pytest.raises(ValueError, match="dimensionality"):
+        plan_fleet([TenantSpec("x", blob(100, 2, 1, d=3), 2),
+                    TenantSpec("y", blob(100, 2, 1, d=4), 2)], c)
+    with pytest.raises(ValueError, match="duplicate"):
+        plan_fleet([TenantSpec("x", blob(100, 2, 1), 2),
+                    TenantSpec("x", blob(100, 2, 2), 2)], c)
+
+
+def test_fleet_rejects_unsupported_configs():
+    spec = [TenantSpec("t", blob(200, 2, 1), 2)]
+    for bad, match in [
+        (cfg(stream_events=True), "stream_events"),
+        (cfg(fused_sweep=True), "fused_sweep"),
+        (cfg(n_init=3), "n_init"),
+        (cfg(estep_backend="pallas"), "Pallas"),
+    ]:
+        with pytest.raises(ValueError, match=match):
+            fit_fleet(spec, bad)
+    with pytest.raises(ValueError, match="fleet_mode"):
+        cfg(fleet_mode="bogus")
+    with pytest.raises(ValueError, match="fleet_group_size"):
+        cfg(fleet_group_size=0)
+
+
+# ------------------------------------------------- fault containment
+
+
+def test_drop_one_poisoned_tenant_keeps_survivors(rng):
+    """A lane-targeted nan_loglik injection (the GMM_FAULTS 'restart'
+    key addresses fleet lanes too) poisons ONE tenant: it drops with a
+    drop_tenant recovery action; every survivor is bit-identical to the
+    clean fleet's result."""
+    tenants = [
+        TenantSpec("a", blob(512, 4, 1), 4),
+        TenantSpec("b", blob(512, 4, 2), 4),
+        TenantSpec("c", blob(512, 4, 3), 4),
+    ]
+    c = cfg()
+    clean = fit_fleet(tenants, c)
+    assert not clean.dropped
+    with faults.use({"nan_loglik": {"iter": 2, "restart": 1}}):
+        fleet = fit_fleet(tenants, c)
+    assert [t.name for t in fleet.dropped] == ["b"]
+    assert "fatal numerical fault" in fleet["b"].error
+    for name in ("a", "c"):
+        r = fleet[name].result
+        want = clean[name].result
+        assert r.final_loglik == want.final_loglik
+        np.testing.assert_array_equal(np.asarray(r.state.means),
+                                      np.asarray(want.state.means))
+
+
+def test_poisoned_tenant_with_recovery_off_raises(rng):
+    from cuda_gmm_mpi_tpu.health import NumericalFaultError
+
+    tenants = [TenantSpec("a", blob(512, 4, 1), 4),
+               TenantSpec("b", blob(512, 4, 2), 4)]
+    with faults.use({"nan_loglik": {"iter": 2, "restart": 0}}):
+        with pytest.raises(NumericalFaultError, match=r"tenant\(s\) a "):
+            fit_fleet(tenants, cfg(recovery="off"))
+
+
+# ------------------------------------------------- preempt + resume
+
+
+def test_fleet_preempt_then_bit_identical_resume(rng, tmp_path):
+    """An injected preemption between sweep steps raises PreemptedError
+    with the completed steps durable; the resumed fleet finishes to
+    results bit-identical to an uninterrupted run."""
+    tenants = tenant_set()[:2]
+    ckdir = str(tmp_path / "ck")
+    c = cfg(checkpoint_dir=ckdir)
+    want = fit_fleet(tenants, cfg())  # uninterrupted reference
+
+    with faults.use({"preempt": {"iter": 2}}):
+        with supervisor.use(RunSupervisor(install_signals=False)):
+            with pytest.raises(PreemptedError):
+                fit_fleet(tenants, c)
+    # At least one group checkpoint survived the stop.
+    assert any(p.name.startswith("group")
+               for p in (tmp_path / "ck").iterdir())
+
+    resumed = fit_fleet(tenants, c)
+    for spec in tenants:
+        r = resumed[spec.name].result
+        w = want[spec.name].result
+        assert r.final_loglik == w.final_loglik
+        assert r.min_rissanen == w.min_rissanen
+        np.testing.assert_array_equal(np.asarray(r.state.means),
+                                      np.asarray(w.state.means))
+        np.testing.assert_array_equal(np.asarray(r.state.R),
+                                      np.asarray(w.state.R))
+
+
+# ------------------------------------------------------- bulk export
+
+
+def test_fleet_registry_export_and_serving_roundtrip(rng, tmp_path):
+    """Direct fleet export: one exact registry version per tenant; a
+    re-hydrated model scores bit-identically to the fleet's result."""
+    from cuda_gmm_mpi_tpu.serving import ModelRegistry
+
+    tenants = tenant_set()[:2]
+    c = cfg()
+    fleet = fit_fleet(tenants, c)
+    reg = ModelRegistry(str(tmp_path / "reg"))
+    for tr in fleet.fitted:
+        v = reg.save(tr.name, tr.result, config=c, source="fleet")
+        assert v == 1
+        m = reg.load(tr.name)
+        np.testing.assert_array_equal(np.asarray(m.state.means),
+                                      np.asarray(tr.result.state.means))
+        assert m.manifest["source"] == "fleet"
+        assert m.k == tr.result.ideal_num_clusters
+
+
+def test_bulk_export_partial_failure_reported_not_fatal(tmp_path):
+    """registry.export_fleet: a tenant with a torn/missing summary is
+    reported in the audit and skipped; its siblings still export."""
+    from cuda_gmm_mpi_tpu.serving import ModelRegistry
+
+    out = tmp_path / "out"
+    out.mkdir()
+    spec = TenantSpec("good", blob(300, 2, 1), 2)
+    fleet = fit_fleet([spec], cfg())
+    from cuda_gmm_mpi_tpu.io import write_summary
+
+    write_summary(str(out / "good.summary"), fleet["good"].result)
+    manifest = {
+        "schema": 1,
+        "tenants": [
+            {"name": "good", "dropped": False,
+             "summary": str(out / "good.summary"),
+             "covariance_type": "full", "dtype": "float64"},
+            {"name": "torn", "dropped": False,
+             "summary": str(out / "missing.summary"),
+             "covariance_type": "full", "dtype": "float64"},
+            {"name": "was-dropped", "dropped": True,
+             "error": "fatal numerical fault"},
+        ],
+    }
+    (out / "fleet.json").write_text(json.dumps(manifest))
+    reg = ModelRegistry(str(tmp_path / "reg"))
+    audit = reg.export_fleet(str(out))
+    by_name = {row["name"]: row for row in audit}
+    assert by_name["good"]["version"] == 1
+    assert "error" in by_name["torn"]
+    assert by_name["was-dropped"]["skipped"] == "dropped"
+    assert reg.models() == ["good"]
+
+
+# ------------------------------------------------- telemetry / report
+
+
+def test_fleet_telemetry_stream_validates_and_renders(rng, tmp_path):
+    from cuda_gmm_mpi_tpu.telemetry import read_stream
+    from cuda_gmm_mpi_tpu.telemetry.report import render_report
+    from cuda_gmm_mpi_tpu.telemetry.schema import validate_stream
+
+    tenants = tenant_set()[:2]
+    path = str(tmp_path / "fleet.jsonl")
+    fit_fleet(tenants, cfg(metrics_file=path))
+    recs = read_stream(path)
+    assert validate_stream(recs) == []
+    kinds = [r["event"] for r in recs]
+    assert kinds.count("fleet_start") == 1
+    assert kinds.count("tenant_done") == 2
+    assert kinds.count("fleet_summary") == 1
+    done = {r["tenant"]: r for r in recs if r["event"] == "tenant_done"}
+    assert set(done) == {"alpha", "beta"}
+    assert all(not r["dropped"] and r["k"] >= 1 for r in done.values())
+    summary = [r for r in recs if r["event"] == "fleet_summary"][0]
+    assert summary["tenants"] == 2 and summary["dropped"] == 0
+    text = render_report(recs)
+    assert "Fleet (rev v1.8" in text
+    assert "alpha" in text and "beta" in text
+
+
+# ------------------------------------------------------- CLI (subprocess)
+
+
+def _write_csv(path, x):
+    with open(path, "w") as f:
+        f.write(",".join(f"c{i}" for i in range(x.shape[1])) + "\n")
+        for row in x:
+            f.write(",".join(f"{v:.8f}" for v in row) + "\n")
+
+
+def test_fleet_cli_end_to_end(tmp_path):
+    """gmm fleet manifest -> per-tenant summaries + fleet.json + direct
+    registry export; gmm export --fleet bulk-exports from fleet.json."""
+    d = tmp_path
+    for i, (n, k) in enumerate([(300, 2), (260, 2)]):
+        _write_csv(d / f"t{i}.csv", blob(n, k, i + 1))
+    manifest = [
+        {"name": "m0", "infile": str(d / "t0.csv"), "num_clusters": 2},
+        {"name": "m1", "infile": str(d / "t1.csv"), "num_clusters": 2,
+         "seed": 5},
+    ]
+    (d / "manifest.json").write_text(json.dumps(manifest))
+    env = worker_env()
+    r = subprocess.run(
+        [sys.executable, "-m", "cuda_gmm_mpi_tpu.cli", "fleet",
+         str(d / "manifest.json"), "--out-dir", str(d / "out"),
+         "--registry", str(d / "reg"), "--min-iters", "2",
+         "--max-iters", "2", "--chunk-size", "128", "--device", "cpu"],
+        capture_output=True, text=True, env=env, timeout=600)
+    assert r.returncode == 0, r.stderr
+    assert (d / "out" / "m0.summary").exists()
+    assert (d / "out" / "m1.summary").exists()
+    fleet_json = json.loads((d / "out" / "fleet.json").read_text())
+    assert {t["name"] for t in fleet_json["tenants"]} == {"m0", "m1"}
+    assert all(t.get("registry_version") == 1
+               for t in fleet_json["tenants"])
+    # Bulk export from the fleet manifest into a second registry.
+    r2 = subprocess.run(
+        [sys.executable, "-m", "cuda_gmm_mpi_tpu.cli", "export",
+         "--registry", str(d / "reg2"), "--fleet", str(d / "out")],
+        capture_output=True, text=True, env=env, timeout=600)
+    assert r2.returncode == 0, r2.stderr
+    assert "2/2 tenants exported" in r2.stdout
