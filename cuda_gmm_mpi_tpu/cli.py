@@ -180,6 +180,33 @@ def build_parser() -> argparse.ArgumentParser:
                    "by host memory, not HBM; slower -- use only when the "
                    "data exceeds device memory). Composes with --mesh=S to "
                    "stream blocks sharded over S local devices")
+    t.add_argument("--ingest", default="resident",
+                   choices=["resident", "pipelined"],
+                   help="how --stream-events chunks reach the host: "
+                   "'resident' loads the whole slice up front; 'pipelined' "
+                   "prefetches per-block byte ranges from the input file on "
+                   "a background thread while the device computes, so peak "
+                   "host memory is O(queue depth x block), never O(N) -- "
+                   "results bit-identical (docs/PERF.md)")
+    t.add_argument("--ingest-queue-depth", type=int, default=4,
+                   help="prefetched blocks held in host RAM by "
+                   "--ingest=pipelined (the memory/overlap trade)")
+    t.add_argument("--em-mode", default="full", choices=["full", "minibatch"],
+                   help="'full' runs exact batch EM; 'minibatch' runs "
+                   "stepwise EM (Cappe-Moulines decayed sufficient "
+                   "statistics) over --minibatch-size event slices -- "
+                   "approximate, but each step touches only a fraction of "
+                   "the data (pairs with --ingest=pipelined for fits that "
+                   "never hold the dataset in host memory)")
+    t.add_argument("--minibatch-size", type=int, default=0,
+                   help="events per stepwise-EM minibatch (rounded up to "
+                   "whole stream blocks); 0 = one block per step")
+    t.add_argument("--minibatch-t0", type=float, default=2.0,
+                   help="stepwise-EM decay offset t0 in the step size "
+                   "(t + t0)^-alpha (larger = more damping early)")
+    t.add_argument("--minibatch-alpha", type=float, default=0.7,
+                   help="stepwise-EM decay exponent alpha in (0.5, 1]: "
+                   "smaller forgets faster, 1.0 averages all history")
     t.add_argument("--checkpoint-dir", default=None,
                    help="orbax checkpoint directory for the K-sweep (resume "
                    "with the same path)")
@@ -367,6 +394,12 @@ def main(argv=None) -> int:
             debug_nans=args.debug_nans,
             validate_input=not args.no_validate_input,
             stream_events=args.stream_events,
+            ingest=args.ingest,
+            ingest_queue_depth=args.ingest_queue_depth,
+            em_mode=args.em_mode,
+            minibatch_size=args.minibatch_size,
+            minibatch_t0=args.minibatch_t0,
+            minibatch_alpha=args.minibatch_alpha,
             precompute_features=args.precompute_features,
             max_runtime_s=args.max_runtime,
             resume=args.resume,
@@ -400,6 +433,8 @@ def main(argv=None) -> int:
             ("--mesh", args.mesh),
             ("--seed-method", args.seed_method != "even"),
             ("--stream-events", args.stream_events),
+            ("--ingest", args.ingest != "resident"),
+            ("--em-mode", args.em_mode != "full"),
         ]
         for flag, present in fit_only:
             if present:
@@ -458,12 +493,22 @@ def main(argv=None) -> int:
         # bounds; multi-host runs must reject instead (validate_input).
         print("--allow-nonfinite is a single-process mode", file=sys.stderr)
         return 1
+    if args.allow_nonfinite and args.ingest == "pipelined":
+        # Quarantine materializes the data to drop rows -- the opposite of
+        # out-of-core ingestion, and dropped rows would shift every block's
+        # byte range. The streaming validator rejects bad rows instead.
+        print("--allow-nonfinite requires --ingest=resident (quarantine "
+              "rewrites the event array; pipelined ingestion reads fixed "
+              "byte ranges)", file=sys.stderr)
+        return 1
 
     t_io0 = time.perf_counter()
-    if nproc > 1:
-        # Per-host sharded loading: fit_gmm pulls only this host's slice
-        # through the range readers (the anti-MPI_Bcast; the reference
-        # broadcast the ENTIRE dataset, gaussian.cu:191-201).
+    if nproc > 1 or args.ingest == "pipelined":
+        # Range-reader loading: fit_gmm pulls data through the file source
+        # instead of a materialized array -- each host only its slice
+        # (the anti-MPI_Bcast; the reference broadcast the ENTIRE dataset,
+        # gaussian.cu:191-201), and --ingest=pipelined only the blocks in
+        # flight.
         def _open_source(path):
             src = FileSource(path)
             src.shape  # force the header/shape parse inside the error guard
@@ -555,7 +600,10 @@ def main(argv=None) -> int:
 def _fit_and_write(args, config, fit_input, pid, nproc, init_means,
                    t_io) -> int:
     """The supervised span of ``main``: fit, then write outputs."""
-    data = fit_input  # single-process: the in-memory array itself
+    # Single-process: the in-memory array itself, or (--ingest=pipelined)
+    # the FileSource -- iter_memberships slices both, so the memberships
+    # pass stays out-of-core when the fit was.
+    data = fit_input
     from . import supervisor as supervisor_mod
     from .health import NumericalFaultError
     from .io import write_summary

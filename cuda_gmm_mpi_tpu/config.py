@@ -130,6 +130,39 @@ class GMMConfig:
     # only worth it when the data genuinely exceeds device memory
     # (models/streaming.py). Single-process, single-device.
     stream_events: bool = False
+    # Out-of-core ingestion (io/pipeline.py; docs/PERF.md "Pipelined
+    # ingestion"): 'resident' materializes this rank's event slice in host
+    # RAM before streaming (the classic path); 'pipelined' never does -- a
+    # bounded-queue background reader pulls per-block byte ranges from the
+    # source file and decodes/screens them on a worker thread while the
+    # device computes the previous block, so peak host memory is
+    # O(ingest_queue_depth x block), never O(N). Requires stream_events
+    # and a file-backed source; results are bit-identical to 'resident'
+    # (same chunk grid, same block-sequential addition order).
+    ingest: str = "resident"  # 'resident' | 'pipelined'
+    # Blocks the background reader may run ahead of the device: the
+    # bounded prefetch queue's capacity, and therefore the peak resident
+    # block count of 'pipelined' mode.
+    ingest_queue_depth: int = 4
+
+    # --- EM update schedule (models/streaming.py) ---
+    # 'full' = the reference's batch EM: one M-step per full-data pass.
+    # 'minibatch' = stepwise EM (Cappe & Moulines 2009): each step reads
+    # the NEXT minibatch of streamed blocks, rescales its sufficient
+    # statistics to full-data size, folds them into a decayed running
+    # estimate with gamma_t = (t + minibatch_t0) ** -minibatch_alpha, and
+    # applies the M-step -- convergence no longer costs a full data pass
+    # per iteration. min/max_iters count minibatch STEPS in this mode; the
+    # reported final loglik is still one full-data evaluation pass.
+    # Requires stream_events (it is the streaming block loop's schedule).
+    em_mode: str = "full"  # 'full' | 'minibatch'
+    # Events per stepwise-EM minibatch, rounded UP to whole streamed
+    # blocks (chunk_size x local data shards). 0 = one block per step.
+    minibatch_size: int = 0
+    # Stepwise decay knobs: gamma_t = (t + t0) ** -alpha. alpha must lie
+    # in (0.5, 1] (the Robbins-Monro square-summability condition).
+    minibatch_t0: float = 2.0
+    minibatch_alpha: float = 0.7
 
     # --- platform / parallelism ---
     device: Optional[str] = None  # None = JAX default platform
@@ -358,6 +391,33 @@ class GMMConfig:
                     "precompute_features holds all features in device "
                     "memory; stream_events exists because the data does "
                     "not fit there -- drop one flag")
+        if self.ingest not in ("resident", "pipelined"):
+            raise ValueError(
+                f"unknown ingest: {self.ingest!r} "
+                "(expected 'resident' or 'pipelined')")
+        if self.ingest == "pipelined" and not self.stream_events:
+            raise ValueError(
+                "ingest='pipelined' feeds the streaming block loop; it "
+                "requires stream_events=True")
+        if self.ingest_queue_depth < 1:
+            raise ValueError("ingest_queue_depth must be >= 1")
+        if self.em_mode not in ("full", "minibatch"):
+            raise ValueError(
+                f"unknown em_mode: {self.em_mode!r} "
+                "(expected 'full' or 'minibatch')")
+        if self.em_mode == "minibatch" and not self.stream_events:
+            raise ValueError(
+                "em_mode='minibatch' is the streaming stepwise driver; it "
+                "requires stream_events=True")
+        if not 0.5 < self.minibatch_alpha <= 1.0:
+            raise ValueError(
+                f"minibatch_alpha must lie in (0.5, 1], got "
+                f"{self.minibatch_alpha}")
+        if self.minibatch_t0 < 0:
+            raise ValueError("minibatch_t0 must be >= 0")
+        if self.minibatch_size < 0:
+            raise ValueError(
+                "minibatch_size must be >= 0 (0 = one block per step)")
         if self.seed_method not in ("even", "kmeans++"):
             raise ValueError(f"unknown seed_method: {self.seed_method!r}")
         if self.checkpoint_keep < 1:

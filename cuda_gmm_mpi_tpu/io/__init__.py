@@ -1,5 +1,6 @@
 """Data I/O layer (SURVEY L0): readers, writers, native fast paths."""
 
+from .pipeline import PipelinedBlockSource, streamed_moments
 from .readers import (
     FileSource, data_shape, read_bin, read_csv, read_data, read_rows,
     write_bin,
@@ -7,6 +8,7 @@ from .readers import (
 from .writers import write_results, write_summary
 
 __all__ = [
-    "FileSource", "data_shape", "read_bin", "read_csv", "read_data",
-    "read_rows", "write_bin", "write_results", "write_summary",
+    "FileSource", "PipelinedBlockSource", "data_shape", "read_bin",
+    "read_csv", "read_data", "read_rows", "streamed_moments", "write_bin",
+    "write_results", "write_summary",
 ]

@@ -1,0 +1,317 @@
+"""Out-of-core pipelined ingestion: bounded-queue background block reads.
+
+The streaming model (models/streaming.py) already overlaps the host->device
+copy of block j+1 with the device compute of block j -- but only from a
+HOST-RESIDENT chunk array, so peak host memory is still O(N) per host, the
+same shape as the reference's broadcast-a-full-replica ingest
+(``gaussian.cu:191-201``). This module extends the overlap pipeline one
+stage back to disk: a :class:`PipelinedBlockSource` wraps a
+:class:`~cuda_gmm_mpi_tpu.io.readers.FileSource` and serves the streaming
+loop per-block ``[S, B, D]`` slices that a background worker thread reads
+(byte-range ``read_range`` -- the io/readers.py metadata cache makes each
+one O(slice)), decodes, casts, and centers WHILE the device computes the
+previous block. A bounded queue (``GMMConfig.ingest_queue_depth``) caps the
+prefetch distance, so peak host memory is O(queue_depth x block), never
+O(N).
+
+Bit-identity contract: block j holds local shard d's chunk ``d * blocks +
+j`` -- the exact block-major layout ``StreamingGMMModel.prepare`` gives the
+resident path -- and each chunk's rows are cast and centered with the same
+elementwise recipe ``_prepare_fit`` applies to the resident slice, so the
+streamed statistics (and therefore the fit) match the host-resident path
+bit for bit, single-device and data-mesh alike. Per-rank sharding composes
+the same way: each host's source covers only its own ``host_chunk_bounds``
+row range, so no host ever holds (or reads) more than its slice.
+
+:func:`streamed_moments` is the matching out-of-core replacement for the
+load -> ``validate_finite`` -> ``global_moments`` prologue: one pass of
+per-chunk range reads builds the identical per-chunk partials matrix
+(``parallel.distributed.moment_part``) and accumulates the non-finite-row
+scan, then makes ONE collectively agreed validation decision -- the same
+collective shape as the resident path, so multi-controller ranks can never
+diverge on a raise.
+
+Determinism: one worker thread reads blocks strictly in ascending order per
+pass and the consumer requests them in the same order, so delivery order is
+deterministic by construction (asserted under ``-p no:randomly`` in
+tests/test_ingest.py); ``faults`` ``read_slow`` injection only moves the
+prefetch wait, never the data.
+"""
+
+from __future__ import annotations
+
+import queue
+import threading
+import time
+from typing import Optional
+
+import numpy as np
+
+from ..testing import faults
+
+
+class PipelinedBlockSource:
+    """Lazy block source: the streaming loop's chunk-array stand-in.
+
+    Implements the minimal surface ``StreamingGMMModel`` consumes
+    (``shape``, ``dtype``, ``get_block``) plus ingestion telemetry
+    counters. ``get_block(j)`` returns ``([B, D], [B])`` when
+    ``local_data_size == 1`` and ``([S, B, D], [S, B])`` block-major
+    otherwise -- already cast to the compute dtype and centered, i.e.
+    exactly what ``_put_block`` would have sliced out of a prepared
+    resident array.
+
+    ``num_chunks`` must be this host's chunk-slot count from
+    ``host_chunk_bounds`` (always a multiple of the local data-axis
+    extent), ``start``/``stop`` its row range. Chunk slots past
+    ``stop - start`` rows are zero-filled with zero weights, the same
+    padding contract as ``chunk_events``.
+    """
+
+    def __init__(self, source, *, start: int, stop: int, chunk_size: int,
+                 num_chunks: int, local_data_size: int = 1,
+                 shift: Optional[np.ndarray] = None, dtype=np.float64,
+                 queue_depth: int = 4):
+        if num_chunks % max(local_data_size, 1):
+            raise ValueError(
+                f"num_chunks {num_chunks} not divisible by the local "
+                f"data-axis extent {local_data_size}; derive slices with "
+                "parallel.distributed.host_chunk_bounds")
+        self.source = source
+        self.start, self.stop = int(start), int(stop)
+        self.chunk_size = int(chunk_size)
+        self.num_chunks = int(num_chunks)
+        self.local_data_size = max(int(local_data_size), 1)
+        self.num_blocks = self.num_chunks // self.local_data_size
+        self._shift = None if shift is None else np.asarray(shift)
+        self._dtype = np.dtype(dtype)
+        self.queue_depth = max(int(queue_depth), 1)
+        self._n_dims = int(source.shape[1])
+        # -- ingestion telemetry (read by ingest_summary / tests) --
+        self.last_wait_s = 0.0     # consumer wait for the latest block
+        self.prefetch_wait_s = 0.0  # cumulative consumer wait
+        self.blocks_read = 0
+        self.bytes_read = 0
+        self.peak_resident = 0     # max blocks ever resident in the queue
+        self.delivered_order: list = []  # capped; seeded-order assertion
+        self._summary_emitted = False
+        # -- worker state --
+        self._gen = 0
+        self._next = 0             # block index the live worker serves next
+        self._queue: Optional[queue.Queue] = None
+        self._thread: Optional[threading.Thread] = None
+        self._closed = False
+        self._lock = threading.Lock()
+
+    # -- array-facade surface consumed by StreamingGMMModel ---------------
+
+    @property
+    def shape(self):
+        return (self.num_chunks, self.chunk_size, self._n_dims)
+
+    @property
+    def dtype(self):
+        return self._dtype
+
+    @property
+    def total_weight(self) -> float:
+        """This host's real (un-padded) event count == its weight sum."""
+        return float(self.stop - self.start)
+
+    # -- block production --------------------------------------------------
+
+    def _read_chunk(self, c: int, out_x: np.ndarray, out_w: np.ndarray):
+        """Fill one chunk slot: range-read, cast, center, pad (the same
+        elementwise recipe the resident path applies to its whole slice)."""
+        m = self.stop - self.start
+        a = min(c * self.chunk_size, m)
+        b = min((c + 1) * self.chunk_size, m)
+        if b > a:
+            raw = self.source.read_range(self.start + a, self.start + b)
+            self.bytes_read += int(raw.nbytes)
+            rows = raw.astype(self._dtype, copy=False)
+            if self._shift is not None:
+                rows = rows - self._shift[None, :]
+            out_x[:b - a] = rows
+            out_w[:b - a] = 1.0
+
+    def _read_block(self, j: int):
+        """One block's ([S, B, D], [S, B]) (squeezed to 2-D/1-D when
+        S == 1), read on the worker thread."""
+        cfg = faults.take("read_slow", block=j)
+        if cfg is not None:
+            time.sleep(float(cfg.get("ms", 0)) / 1e3)
+        S, B = self.local_data_size, self.chunk_size
+        x = np.zeros((S, B, self._n_dims), self._dtype)
+        w = np.zeros((S, B), self._dtype)
+        for d in range(S):
+            self._read_chunk(d * self.num_blocks + j, x[d], w[d])
+        if S == 1:
+            return x[0], w[0]
+        return x, w
+
+    def _run(self, gen: int, q: queue.Queue, start_block: int):
+        """Worker loop: read blocks ``start_block..num_blocks-1`` in order
+        into the bounded queue; exits when the pass ends, the generation
+        is superseded (a seek restarted the stream), or the source closes.
+        Read errors are delivered in-band so the consumer re-raises them
+        on its thread."""
+        for j in range(start_block, self.num_blocks):
+            try:
+                payload = (j, self._read_block(j), None)
+            except BaseException as e:  # delivered, not swallowed
+                payload = (j, None, e)
+            while True:
+                if self._gen != gen or self._closed or self._queue is not q:
+                    return
+                try:
+                    q.put(payload, timeout=0.1)
+                    break
+                except queue.Full:
+                    continue
+            with self._lock:
+                self.peak_resident = max(self.peak_resident, q.qsize())
+            if payload[2] is not None:
+                return
+
+    def _restart(self, start_block: int):
+        with self._lock:
+            self._gen += 1
+            gen = self._gen
+            q = queue.Queue(maxsize=self.queue_depth)
+            self._queue = q
+            self._next = start_block
+            self._thread = threading.Thread(
+                target=self._run, args=(gen, q, start_block),
+                name=f"gmm-ingest-{id(self) & 0xffff:x}", daemon=True)
+        self._thread.start()
+
+    def get_block(self, j: int):
+        """Block j's (chunks, weights), blocking only when the prefetcher
+        has not gotten to it yet (``last_wait_s`` records that wait)."""
+        if self._closed:
+            raise RuntimeError("PipelinedBlockSource is closed")
+        if not 0 <= j < self.num_blocks:
+            raise IndexError(
+                f"block {j} out of range [0, {self.num_blocks})")
+        if self._queue is None or self._next != j:
+            # Cold start, new pass (wrap to 0), or an out-of-order seek
+            # (mid-pass resume): restart the prefetcher at j.
+            self._restart(j)
+        q, gen = self._queue, self._gen
+        t0 = time.perf_counter()
+        while True:
+            try:
+                jj, data, err = q.get(timeout=0.1)
+            except queue.Empty:
+                if self._closed:
+                    raise RuntimeError("PipelinedBlockSource closed "
+                                       "while waiting for a block")
+                if self._gen != gen:   # superseded mid-wait; re-request
+                    return self.get_block(j)
+                continue
+            break
+        if jj != j:
+            # One worker reads in ascending order and one consumer pops in
+            # the same order, so this is unreachable by construction.
+            raise RuntimeError(f"prefetch order violated: got block {jj}, "
+                               f"expected {j}")
+        self.last_wait_s = time.perf_counter() - t0
+        self.prefetch_wait_s += self.last_wait_s
+        if err is not None:
+            raise err
+        self._next = j + 1
+        self.blocks_read += 1
+        if len(self.delivered_order) < 65536:
+            self.delivered_order.append(j)
+        return data
+
+    def close(self):
+        """Stop the worker and emit ``ingest_summary`` once (idempotent)."""
+        if self._closed:
+            return
+        self._closed = True
+        with self._lock:
+            self._gen += 1
+        t = self._thread
+        if t is not None and t.is_alive():
+            t.join(timeout=2.0)
+        self._emit_summary()
+
+    def __del__(self):
+        # Safety net for fits aborted by an exception (preemption,
+        # validation raise): without it a worker blocked on a full queue
+        # would spin at its put-retry cadence until process exit.
+        try:
+            self.close()
+        except Exception:
+            pass
+
+    def emit_start(self, rec, *, em_mode: str = "full") -> None:
+        """One ``ingest_start`` record on ``rec`` (no-op when inactive)."""
+        if not getattr(rec, "active", False):
+            return
+        rec.emit(
+            "ingest_start",
+            source=str(getattr(self.source, "path", "<source>")),
+            rows=int(self.stop - self.start),
+            queue_depth=int(self.queue_depth),
+            row_start=int(self.start), row_stop=int(self.stop),
+            blocks=int(self.num_blocks),
+            chunk_size=int(self.chunk_size),
+            mode=str(em_mode),
+        )
+
+    def _emit_summary(self) -> None:
+        if self._summary_emitted:
+            return
+        from ..telemetry import current as current_recorder
+
+        rec = current_recorder()
+        if not rec.active:
+            return
+        self._summary_emitted = True
+        rec.emit(
+            "ingest_summary",
+            blocks_read=int(self.blocks_read),
+            peak_resident_blocks=int(self.peak_resident),
+            prefetch_wait_s=round(float(self.prefetch_wait_s), 6),
+            bytes=int(self.bytes_read),
+            queue_depth=int(self.queue_depth),
+        )
+
+
+def streamed_moments(source, start: int, stop: int, chunk_size: int,
+                     num_chunks: int, *, validate: bool = True,
+                     collective: bool = False, dtype=None):
+    """(mean[D], var[D]) float64 + input validation in ONE out-of-core pass.
+
+    Builds the exact per-chunk partials matrix ``global_moments`` builds
+    from a resident slice (``moment_part`` per chunk, same chunk grid, same
+    reduction), accumulating the non-finite-row scan alongside, then makes
+    the single (optionally collective) raise/continue decision
+    ``validate_finite`` would have made -- so the pipelined prologue is
+    bit-identical to the resident one without ever materializing the slice.
+    """
+    from ..parallel.distributed import moment_part, reduce_moment_parts
+    from ..validation import finite_row_stats, raise_if_nonfinite
+
+    d = int(source.shape[1])
+    parts = np.zeros((num_chunks, 1 + 2 * d), np.float64)
+    n_bad, first_bad = 0, -1
+    m = stop - start
+    for j in range(num_chunks):
+        a, b = min(j * chunk_size, m), min((j + 1) * chunk_size, m)
+        if b <= a:
+            continue
+        block = np.ascontiguousarray(source.read_range(start + a, start + b))
+        if validate:
+            nb, fb = finite_row_stats(block, start + a, dtype=dtype)
+            if nb:
+                n_bad += nb
+                if first_bad < 0:
+                    first_bad = fb
+        parts[j] = moment_part(block)
+    if validate:
+        raise_if_nonfinite(n_bad, first_bad, collective=collective)
+    return reduce_moment_parts(parts)

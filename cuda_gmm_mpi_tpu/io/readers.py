@@ -24,9 +24,44 @@ CSV single-pass-scans with a bounded buffer).
 
 from __future__ import annotations
 
+import os
+import threading
 from typing import Optional, Tuple
 
 import numpy as np
+
+# --- per-path file metadata cache -------------------------------------------
+# Per-block range reads used to re-scan the file prefix on EVERY block of
+# every pass: read_bin re-read the 8-byte header, data_shape re-counted the
+# whole CSV, and read_csv line-scanned from byte 0 up to ``start`` each call
+# -- O(passes x blocks x N) line parsing for the pipelined ingestion loop.
+# The cache below memoizes what those scans learn, keyed by
+# (abspath, mtime_ns, size) so a rewritten file can never serve stale
+# metadata. CSV entries additionally accumulate ``marks``: data-row ->
+# byte-offset checkpoints recorded as reads complete, so a sequential
+# per-block read seeks straight to its range instead of re-parsing the
+# prefix. Entries are tiny (a shape tuple + one int per block boundary).
+
+_META_LOCK = threading.Lock()
+_META_CACHE: dict = {}
+
+
+def _file_meta(path: str) -> dict:
+    """The mutable metadata dict for ``path`` at its current (mtime, size).
+
+    Stale entries for the same path (the file was rewritten) are dropped;
+    the returned dict is shared across callers and threads -- all mutations
+    are single dict-item writes (GIL-atomic)."""
+    st = os.stat(path)
+    key = (os.path.abspath(path), st.st_mtime_ns, st.st_size)
+    with _META_LOCK:
+        meta = _META_CACHE.get(key)
+        if meta is None:
+            for k in [k for k in _META_CACHE if k[0] == key[0]]:
+                del _META_CACHE[k]
+            meta = {}
+            _META_CACHE[key] = meta
+        return meta
 
 
 class TruncatedInputError(ValueError):
@@ -140,13 +175,21 @@ def data_shape(path: str, use_native: str = "auto") -> Tuple[int, int]:
     """(num_events, num_dimensions) without loading the payload.
 
     BIN reads the 8-byte header; CSV makes one streaming pass counting
-    non-blank lines (minus the header) -- O(1) memory either way.
+    non-blank lines (minus the header) -- O(1) memory either way. The
+    result is cached per (path, mtime, size), so per-block range readers
+    probing the shape every block pay the scan once per file, not once
+    per block.
     """
+    meta = _file_meta(path)
+    cached = meta.get("shape")
+    if cached is not None:
+        return cached
     if use_native != "never":
         from . import native
 
         if native.available():
-            return native.data_shape(path)
+            meta["shape"] = native.data_shape(path)
+            return meta["shape"]
         if use_native == "always":
             raise RuntimeError("native gmm_io library unavailable "
                                "(use_native='always')")
@@ -157,7 +200,9 @@ def data_shape(path: str, use_native: str = "auto") -> Tuple[int, int]:
             raise TruncatedInputError(f"{path}: truncated BIN header")
         if header[0] <= 0 or header[1] <= 0:  # same contract as bin_shape()
             raise ValueError(f"{path}: malformed BIN header {header.tolist()}")
-        return int(header[0]), int(header[1])
+        meta["bin_header"] = (int(header[0]), int(header[1]))
+        meta["shape"] = meta["bin_header"]
+        return meta["shape"]
     num_dims = None
     count = 0
     for _, line in _iter_csv_lines(path):
@@ -166,19 +211,26 @@ def data_shape(path: str, use_native: str = "auto") -> Tuple[int, int]:
         count += 1
     if num_dims is None or count < 2:
         raise ValueError(f"{path}: no data rows after header")
-    return count - 1, num_dims
+    meta["shape"] = (count - 1, num_dims)
+    return meta["shape"]
 
 
 def read_bin(path: str, start: int = 0,
              stop: Optional[int] = None) -> np.ndarray:
     """BIN rows [start, stop): header + one fseek + one bounded fromfile
-    (readData.cpp:35-47 layout; trivially seekable, SURVEY.md SS2.4)."""
+    (readData.cpp:35-47 layout; trivially seekable, SURVEY.md SS2.4). The
+    header dims are cached per (path, mtime, size), so per-block range
+    reads skip the header re-read after the first block."""
     _check_range(path, start, stop)
+    meta = _file_meta(path)
     with open(path, "rb") as f:
-        header = np.fromfile(f, dtype=np.int32, count=2)
-        if header.size != 2:
-            raise TruncatedInputError(f"{path}: truncated BIN header")
-        num_events, num_dims = int(header[0]), int(header[1])
+        hdr = meta.get("bin_header")
+        if hdr is None:
+            header = np.fromfile(f, dtype=np.int32, count=2)
+            if header.size != 2:
+                raise TruncatedInputError(f"{path}: truncated BIN header")
+            hdr = meta["bin_header"] = (int(header[0]), int(header[1]))
+        num_events, num_dims = hdr
         if stop is None:
             stop = num_events
         if not (0 <= start <= stop <= num_events):
@@ -238,43 +290,73 @@ def read_csv(path: str, start: int = 0,
     The first non-blank line is dropped as a header (readData.cpp:84) and sets
     the dimension count; ragged rows among those read raise (readData.cpp:
     104-107). With a bounded ``stop`` the scan exits early at the range end.
+
+    Range reads leave row -> byte-offset checkpoints in the per-path
+    metadata cache (one per visited range boundary), and later reads seek
+    to the closest checkpoint at or before ``start`` instead of re-parsing
+    the prefix -- the sequential per-block reads of the pipelined ingestion
+    loop each scan exactly their own byte range after the first pass.
     """
     _check_range(path, start, stop)
-    num_dims = None
+    meta = _file_meta(path)
+    marks = meta.setdefault("csv_marks", {})
+    num_dims = meta.get("csv_dims")
+    resume_row, resume_off = -1, 0
+    if num_dims is not None:
+        for r, off in list(marks.items()):
+            if resume_row < r <= start:
+                resume_row, resume_off = r, off
     data = None
     seen = 0
-    grow = 0
-    total_rows = 0
-    for idx, line in _iter_csv_lines(path):
-        if idx == 0:
-            num_dims = line.count(",") + 1
-            continue
-        row = idx - 1
-        total_rows = row + 1
-        if row < start:
-            continue
-        if stop is not None and row >= stop:
-            break
-        fields = line.split(",")
-        if len(fields) != num_dims:
-            raise ValueError(
-                f"{path}: row {idx + 1} has {len(fields)} fields, "
-                f"expected {num_dims}"
-            )
-        if data is None:
-            # Bounded initial allocation: rows arrive from the scan, so an
-            # absurd stop errors at EOF instead of OOMing up front.
-            grow = min(stop - start, 65536) if stop is not None else 4096
-            data = np.empty((max(grow, 1), num_dims), np.float32)
-        elif seen == data.shape[0]:  # amortized doubling
-            add = data.shape[0]
-            if stop is not None:
-                add = min(add, (stop - start) - data.shape[0])
-            data = np.concatenate(
-                [data, np.empty((max(add, 1), num_dims), np.float32)]
-            )
-        _parse_fields(fields, data[seen])
-        seen += 1
+    total_rows = max(resume_row, 0)
+    with open(path, "rb") as f:
+        header_done = resume_row >= 0
+        row = max(resume_row, 0)
+        if header_done:
+            f.seek(resume_off)
+        while True:
+            pos = f.tell()
+            raw = f.readline()
+            if not raw:
+                break
+            line = raw.decode("utf-8").strip("\r\n")
+            if line == "":
+                continue  # blank lines skipped (readData.cpp:61)
+            if not header_done:
+                num_dims = meta["csv_dims"] = line.count(",") + 1
+                header_done = True
+                marks.setdefault(0, f.tell())
+                continue
+            total_rows = row + 1
+            if row < start:
+                row += 1
+                continue
+            if stop is not None and row >= stop:
+                marks.setdefault(row, pos)
+                break
+            if row == start:
+                marks.setdefault(row, pos)
+            fields = line.split(",")
+            if len(fields) != num_dims:
+                raise ValueError(
+                    f"{path}: row {row + 2} has {len(fields)} fields, "
+                    f"expected {num_dims}"
+                )
+            if data is None:
+                # Bounded initial allocation: rows arrive from the scan, so
+                # an absurd stop errors at EOF instead of OOMing up front.
+                grow = min(stop - start, 65536) if stop is not None else 4096
+                data = np.empty((max(grow, 1), num_dims), np.float32)
+            elif seen == data.shape[0]:  # amortized doubling
+                add = data.shape[0]
+                if stop is not None:
+                    add = min(add, (stop - start) - data.shape[0])
+                data = np.concatenate(
+                    [data, np.empty((max(add, 1), num_dims), np.float32)]
+                )
+            _parse_fields(fields, data[seen])
+            seen += 1
+            row += 1
     if num_dims is None:
         raise ValueError(f"{path}: empty input file")
     want = None if stop is None else stop - start
@@ -387,6 +469,15 @@ class FileSource:
 
     def read_all(self) -> np.ndarray:
         return read_data(self.path, use_native=self.use_native)
+
+    def __getitem__(self, key) -> np.ndarray:
+        # Contiguous row slices only: lets array-shaped consumers
+        # (iter_memberships' block loop) walk a file source without
+        # materializing it -- each slice is one bounded range read.
+        if isinstance(key, slice) and key.step in (None, 1):
+            start, stop, _ = key.indices(self.shape[0])
+            return self.read_range(start, stop)
+        raise TypeError("FileSource supports contiguous row slices only")
 
 
 def write_bin(path: str, data: np.ndarray) -> None:

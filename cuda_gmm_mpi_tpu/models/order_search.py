@@ -681,11 +681,13 @@ def _fit_gmm(data, num_clusters, target_num_clusters, config, model,
             resume_em = {"em_iter": int(sub["em_iter"]),
                          "em_lls": np.asarray(sub.get("em_lls", ()),
                                               np.float64)}
-            for key in ("stream_pass", "stream_block"):
+            for key in ("stream_pass", "stream_block", "mb_step",
+                        "mb_cursor"):
                 if key in sub:
                     resume_em[key] = int(sub[key])
-            if "stream_acc" in sub:
-                resume_em["stream_acc"] = sub["stream_acc"]
+            for key in ("stream_acc", "mb_acc"):
+                if key in sub:
+                    resume_em[key] = sub[key]
             log.info("resuming INSIDE the interrupted fit: K=%d at EM "
                      "iteration %d (intra-K sub-step %d.iter%d)",
                      k, resume_em["em_iter"], step, resume_em["em_iter"])
@@ -1003,6 +1005,12 @@ def _fit_gmm(data, num_clusters, target_num_clusters, config, model,
             rebuckets=n_rebuckets,
         ),
         health_section=health_section)
+    if hasattr(chunks, "close") and getattr(model, "_restart_cache",
+                                            None) is None:
+        # Pipelined ingestion owner: stop the prefetch worker and emit
+        # ingest_summary. Under restarts the cache (and close) belongs to
+        # _fit_with_restarts, which reuses the source across inits.
+        chunks.close()
     return GMMResult(
         state=compact_state,
         ideal_num_clusters=n_active,
@@ -1136,6 +1144,13 @@ def _prepare_fit(data, num_clusters, config, model, phase, log,
             "sample_weight requires in-memory event data (FileSource/"
             "streamed inputs carry no weight column)")
 
+    pipelined = config.stream_events and config.ingest == "pipelined"
+    if pipelined and source is None:
+        raise ValueError(
+            "ingest='pipelined' reads per-block byte ranges from a file "
+            "source; an in-memory array is already resident -- pass a "
+            "path/FileSource or keep ingest='resident'")
+
     # n_init > 1 restarts fit the SAME data repeatedly: _fit_with_restarts
     # hangs a one-fit-scoped cache off the shared model so the load,
     # validation, moments, chunk build, and -- the expensive part -- the
@@ -1151,9 +1166,45 @@ def _prepare_fit(data, num_clusters, config, model, phase, log,
         # silently fit the wrong dataset. Drop the stale entry.
         prepared = None
         cache.pop("prepared", None)
+    lazy_source = None
     if prepared is not None:
         (chunks, wts, chunks_np, wts_np, n_events, n_dims, shift,
          start, stop, var_mean) = prepared
+    elif pipelined:
+        # Out-of-core prologue (io/pipeline.py): never materialize the
+        # host slice. One pass of per-chunk range reads builds the SAME
+        # per-chunk moments partials and the SAME single collective
+        # validation decision as the resident path below, then the lazy
+        # block source replaces the chunk arrays -- peak host memory is
+        # O(queue_depth x block) for the whole fit.
+        with phase("cpu"):
+            n_events, n_dims = source.shape
+            data_axis = getattr(model, "data_size", 1)
+            start, stop, num_chunks = host_chunk_bounds(
+                n_events, config.chunk_size, data_axis, pid, nproc
+            )
+        from ..io.pipeline import PipelinedBlockSource, streamed_moments
+
+        with phase("mpi"):
+            mean64, var64 = streamed_moments(
+                source, start, stop, config.chunk_size, num_chunks,
+                validate=config.validate_input,
+                collective=nproc > 1, dtype=dtype)
+        with phase("cpu"):
+            if config.center_data:
+                shift = mean64.astype(dtype)
+            else:
+                shift = np.zeros((n_dims,), dtype)
+            var_mean = float(var64.mean())
+            s_local = (getattr(model, "_local_data_size", 1)
+                       if getattr(model, "mesh", None) is not None else 1)
+            chunks_np = wts_np = None
+            lazy_source = PipelinedBlockSource(
+                source, start=start, stop=stop,
+                chunk_size=config.chunk_size, num_chunks=num_chunks,
+                local_data_size=s_local,
+                shift=(shift if config.center_data else None),
+                dtype=dtype, queue_depth=config.ingest_queue_depth)
     else:
         with phase("cpu"):
             if source is not None:
@@ -1246,6 +1297,8 @@ def _prepare_fit(data, num_clusters, config, model, phase, log,
             state = faults.maybe_poison_state(state)
 
     rec = telemetry.current()
+    if lazy_source is not None:
+        lazy_source.emit_start(rec, em_mode=config.em_mode)
     with phase("memcpy"):
         if prepared is not None:
             # Restart: the chunk arrays are already device-resident (or
@@ -1265,7 +1318,9 @@ def _prepare_fit(data, num_clusters, config, model, phase, log,
 
                 place = zeros_state(num_clusters, n_dims, dtype)
             placed, chunks, wts = model.prepare(
-                place, chunks_np, wts_np, host_local=(nproc > 1)
+                place,
+                (lazy_source if lazy_source is not None else chunks_np),
+                wts_np, host_local=(nproc > 1)
             )
             state = placed if state is not None else None
         else:
@@ -1366,6 +1421,12 @@ def _fit_with_restarts(data, num_clusters, target_num_clusters, config,
                     or r.min_rissanen < best.min_rissanen):
                 best, best_i = r, i
     finally:
+        cached = (model._restart_cache or {}).get("prepared")
+        if cached is not None and hasattr(cached[0], "close"):
+            # Pipelined ingestion: the lazy block source outlived the
+            # per-init fits by design (all inits stream the same file);
+            # close it with the cache.
+            cached[0].close()
         model._restart_cache = None
     best.init_index = best_i
     if rec.active:

@@ -59,7 +59,7 @@ from jax.sharding import NamedSharding, PartitionSpec as P
 
 from .. import health
 from ..config import GMMConfig
-from ..ops.mstep import apply_mstep, chunk_stats
+from ..ops.mstep import SuffStats, apply_mstep, chunk_stats
 from ..telemetry import current as current_recorder
 from ..testing import faults
 from .gmm import GMMModel, resolve_iters
@@ -137,9 +137,38 @@ class StreamingGMMModel(GMMModel):
             return apply_mstep(state, stats, diag_only=config.diag_only,
                                covariance_type=config.covariance_type)
 
+        # Stepwise (minibatch) EM's decayed running estimate (Cappe &
+        # Moulines 2009): S <- (1-gamma) S + gamma * scale * s_batch, with
+        # ``scale`` rescaling the batch statistics to full-data size so the
+        # absolute Nk thresholds (empty-cluster semantics, gaussian.cu)
+        # keep their reference meaning. gamma/scale are cast INSIDE the jit
+        # to the accumulator dtype so Python-float weak types can never
+        # promote the statistics. ``sanitized`` is an integer event count,
+        # not a statistic -- it rides through unblended (counted host-side
+        # per batch).
+        @functools.partial(jax.jit, donate_argnums=(0,))
+        def _decay_stats(a, b, gamma, scale):
+            g = jnp.asarray(gamma, a.Nk.dtype)
+            sc = jnp.asarray(scale, a.Nk.dtype)
+
+            def blend(x, y):
+                return (1.0 - g) * x + g * (sc * y)
+
+            return SuffStats(blend(a.loglik, b.loglik), blend(a.Nk, b.Nk),
+                             blend(a.M1, b.M1), blend(a.M2, b.M2),
+                             b.sanitized)
+
+        @jax.jit
+        def _scale_stats(b, scale):
+            sc = jnp.asarray(scale, b.Nk.dtype)
+            return SuffStats(sc * b.loglik, sc * b.Nk, sc * b.M1,
+                             sc * b.M2, b.sanitized)
+
         self._chunk_stats_jit = _stats
         self._add = _add
         self._mstep = _mstep
+        self._decay_stats = _decay_stats
+        self._scale_stats = _scale_stats
 
         if self.mesh is not None:
             from ..ops.estep import posteriors
@@ -224,7 +253,23 @@ class StreamingGMMModel(GMMModel):
         Multi-controller: ``chunks_np`` must be THIS host's slice
         (``host_local=True``, same contract as ShardedGMMModel.prepare);
         each host streams its slice over its local shards and the
-        end-of-pass psum spans the global mesh."""
+        end-of-pass psum spans the global mesh.
+
+        Lazy mode (out-of-core ingestion, io/pipeline.py): ``chunks_np``
+        may be a block source exposing ``get_block(j)`` instead of an
+        ndarray. Nothing is materialized here -- the source already owns
+        the block-major layout and the zero-weight padding contract, and
+        it is host-local by construction (each rank's source covers only
+        its own ``host_chunk_bounds`` row range)."""
+        if hasattr(chunks_np, "get_block"):
+            if self.mesh is not None and (
+                    chunks_np.local_data_size != self._local_data_size):
+                raise ValueError(
+                    f"block source was built for local data extent "
+                    f"{chunks_np.local_data_size}, mesh has "
+                    f"{self._local_data_size}")
+            self._block_major = True
+            return self.prepare_state(state), chunks_np, wts_np
         if jax.process_count() > 1:
             from ..parallel.distributed import require_host_local_chunks
 
@@ -290,12 +335,21 @@ class StreamingGMMModel(GMMModel):
         in-memory sharded model assigns it -- placed sharded over the data
         axis. ``prepare`` lays the chunks out block-major, so the block is
         a contiguous zero-copy view; un-prepared arrays fall back to the
-        strided gather."""
+        strided gather. A lazy block source (io/pipeline.py) produces the
+        block on demand instead -- its prefetch worker has usually already
+        read it, so this is a queue pop, not a disk read."""
+        lazy = hasattr(chunks, "get_block")
         if self.mesh is None:
-            chunk, wrow = faults.maybe_poison_block(chunks[j], wts[j], j)
+            if lazy:
+                chunk, wrow = chunks.get_block(j)
+            else:
+                chunk, wrow = chunks[j], wts[j]
+            chunk, wrow = faults.maybe_poison_block(chunk, wrow, j)
             return (jnp.asarray(chunk), jnp.asarray(wrow))
         S = self._local_data_size
-        if self._block_major:
+        if lazy:
+            sel_c, sel_w = chunks.get_block(j)
+        elif self._block_major:
             sel_c, sel_w = chunks[j * S:(j + 1) * S], wts[j * S:(j + 1) * S]
         else:
             sel_c = np.ascontiguousarray(chunks[j::blocks])
@@ -361,24 +415,39 @@ class StreamingGMMModel(GMMModel):
         emit = rec.active
         pass_idx, self._pass_index = self._pass_index, self._pass_index + 1
         chunks_per_block = 1 if self.mesh is None else self._local_data_size
+        lazy = hasattr(chunks, "get_block")
         acc = acc0
         nxt = self._put_block(chunks, wts, start_block, blocks)
+        # Per-block walls (schema rev v1.9): the put of block j records how
+        # long the host BLOCKED on ingestion (0.0 resident -- the array is
+        # already there); the wait is carried alongside the double-buffered
+        # block so block j's record reports block j's wait even though
+        # block j+1's put runs first.
+        wait_nxt = chunks.last_wait_s if lazy else 0.0
         for j in range(start_block, blocks):
-            cur = nxt
+            cur, wait_cur = nxt, wait_nxt
             if j + 1 < blocks:
                 # Double-buffer: enqueue block j+1's copy BEFORE dispatching
                 # block j's compute, so the transfer overlaps the compute
                 # instead of serializing behind it.
                 nxt = self._put_block(chunks, wts, j + 1, blocks)
+                wait_nxt = chunks.last_wait_s if lazy else 0.0
+            t0 = time.perf_counter()
             s = stats_fn(state, *cur)
             acc = s if acc is None else self._add(acc, s)
+            compute_s = time.perf_counter() - t0
             if emit:
                 # One record per streamed block flush ("iter" is the pass
                 # index: 0 = the initial E-step, i+1 = EM iteration i).
+                # prefetch_wait_s/compute_s split the block's host wall:
+                # time blocked on ingestion vs. time in the statistics
+                # dispatch (including any device-queue backpressure).
                 nbytes = int(cur[0].nbytes) + int(cur[1].nbytes)
                 rec.metrics.count("h2d_bytes", nbytes)
                 rec.emit("chunk_flush", iter=pass_idx, block=j,
-                         chunks=chunks_per_block, bytes=nbytes)
+                         chunks=chunks_per_block, bytes=nbytes,
+                         prefetch_wait_s=round(wait_cur, 6),
+                         compute_s=round(compute_s, 6))
                 rec.heartbeat("stream")
             if (stop_check is not None and j + 1 < blocks
                     and stop_check(pass_idx, j)):
@@ -390,6 +459,188 @@ class StreamingGMMModel(GMMModel):
                 self._reduce_fn = self._make_reduce(acc)
             acc = self._reduce_fn(acc)
         return acc
+
+    def _minibatch_setup(self, chunks, wts):
+        """(blocks_total, mb_blocks, W_total) for the stepwise-EM driver.
+
+        ``W_total`` is the GLOBAL event weight (cross-host allgather on a
+        multi-controller run, deterministic so a resumed run recomputes the
+        identical value); ``mb_blocks`` how many streamed blocks one step
+        consumes to cover ``minibatch_size`` events.
+        """
+        lazy = hasattr(chunks, "get_block")
+        n = chunks.shape[0]
+        S = 1 if self.mesh is None else self._local_data_size
+        blocks = n // S
+        events_per_block = self.config.chunk_size * (
+            self.data_size if self.mesh is not None else 1)
+        mb = int(self.config.minibatch_size)
+        mb_blocks = max(1, -(-mb // events_per_block)) if mb > 0 else 1
+        mb_blocks = min(mb_blocks, blocks)
+        if lazy:
+            w_local = float(chunks.total_weight)
+        else:
+            w_local = float(np.asarray(wts, np.float64).sum())
+        if jax.process_count() > 1:
+            from ..parallel.distributed import allgather_host
+
+            w_local = float(allgather_host(
+                np.asarray([w_local], np.float64)).sum())
+        return blocks, mb_blocks, w_local
+
+    def _minibatch_stats(self, state, chunks, wts, cursor, mb_blocks,
+                         blocks, emit_iter):
+        """One minibatch's reduced SuffStats: ``mb_blocks`` streamed blocks
+        from ``cursor`` (wrapping), merged with the same per-block ``_add``
+        the full pass uses, psum-reduced on a mesh. Returns
+        ``(s_batch, next_cursor)``."""
+        stats_fn = (self._chunk_stats_jit if self.mesh is None
+                    else self._stats_block)
+        rec = current_recorder()
+        emit = rec.active
+        chunks_per_block = 1 if self.mesh is None else self._local_data_size
+        lazy = hasattr(chunks, "get_block")
+        acc = None
+        j = cursor
+        for _ in range(mb_blocks):
+            cur = self._put_block(chunks, wts, j, blocks)
+            wait = chunks.last_wait_s if lazy else 0.0
+            t0 = time.perf_counter()
+            s = stats_fn(state, *cur)
+            acc = s if acc is None else self._add(acc, s)
+            compute_s = time.perf_counter() - t0
+            if emit:
+                nbytes = int(cur[0].nbytes) + int(cur[1].nbytes)
+                rec.metrics.count("h2d_bytes", nbytes)
+                rec.emit("chunk_flush", iter=emit_iter, block=j,
+                         chunks=chunks_per_block, bytes=nbytes,
+                         prefetch_wait_s=round(wait, 6),
+                         compute_s=round(compute_s, 6))
+                rec.heartbeat("stream")
+            j = (j + 1) % blocks
+        if self.mesh is not None:
+            if self._reduce_fn is None:
+                self._reduce_fn = self._make_reduce(acc)
+            acc = self._reduce_fn(acc)
+        return acc, j
+
+    def _minibatch_core(self, state, chunks, wts, epsilon, lo, hi, *,
+                        should_stop=None, resume=None):
+        """The stepwise-EM loop (``em_mode='minibatch'``).
+
+        Each step streams one minibatch, folds its statistics into the
+        decayed running estimate ``S <- (1-gamma_t) S + gamma_t scale s``
+        with ``gamma_t = (t + t0)^-alpha`` (Cappe & Moulines; ``scale``
+        rescales the batch to full-data size), and M-steps off the running
+        estimate -- so convergence no longer costs a full data pass per
+        iteration. min/max_iters count STEPS; the per-step loglik is the
+        full-data-equivalent PROXY ``scale * batch_loglik`` (noisy by
+        construction); one final full pass produces the true loglik and
+        the exit health check. ``should_stop(t)``/``resume`` carry the
+        supervisor contract: a stop's payload is ``{mb_step, mb_cursor,
+        mb_acc}`` (the decay state), so a resumed run replays the exact
+        step sequence bit-identically.
+
+        Returns ``(state, lls, iters, counts, stopped, extra)``.
+        """
+        import dataclasses as _dc
+
+        counts = np.zeros((health.NUM_FLAGS,), np.int64)
+        reg_tol = float(self.config.health_regression_scale) * float(epsilon)
+        eps_f = abs(float(epsilon))
+        blocks, mb_blocks, w_total = self._minibatch_setup(chunks, wts)
+        t0_decay = float(self.config.minibatch_t0)
+        alpha = float(self.config.minibatch_alpha)
+
+        def observe(ll, ll_prev=None):
+            if not np.isfinite(ll):
+                counts[health.NONFINITE_LOGLIK] += 1
+                return True
+            if ll_prev is not None and np.isfinite(ll_prev) \
+                    and ll < ll_prev - reg_tol:
+                counts[health.LOGLIK_REGRESSION] += 1
+            return False
+
+        resume = resume or {}
+        running = None
+        cursor, t = 0, 0
+        lls: list = []
+        if "mb_step" in resume:
+            cursor = int(resume["mb_cursor"])
+            t = int(resume["mb_step"])
+            lls = [float(x) for x in
+                   np.asarray(resume.get("em_lls", ())).reshape(-1)]
+            if "mb_acc" in resume:  # absent only for a step-0 stop
+                running = SuffStats(**{k: jnp.asarray(v) for k, v in
+                                       resume["mb_acc"].items()})
+        ll_old = lls[-1] if lls else None
+        change = (lls[-1] - lls[-2]) if len(lls) >= 2 \
+            else abs(2.0 * eps_f) + 1.0
+        fatal = False
+        inj = faults.peek("nan_loglik")  # runtime-consumed (host loop)
+        while not fatal and (
+                t < lo or (not abs(change) <= eps_f and t < hi)):
+            if should_stop is not None and should_stop(t):
+                extra = {"mb_step": int(t), "mb_cursor": int(cursor)}
+                if running is not None:
+                    extra["mb_acc"] = {
+                        f.name: np.asarray(jax.device_get(
+                            getattr(running, f.name)))
+                        for f in _dc.fields(running)
+                    }
+                return state, lls, t, counts, True, extra
+            t_wall = time.perf_counter()
+            s_batch, cursor = self._minibatch_stats(
+                state, chunks, wts, cursor, mb_blocks, blocks, t)
+            counts[health.SANITIZED_LANES] += int(s_batch.sanitized)
+            w_batch = float(jnp.sum(s_batch.Nk))
+            if w_batch <= 0.0:
+                # An all-padding minibatch (zero-weight tail blocks):
+                # nothing to learn from; advance past it without an update.
+                self.last_iter_seconds.append(
+                    time.perf_counter() - t_wall)
+                t += 1
+                continue
+            scale = w_total / w_batch
+            ll = float(s_batch.loglik) * scale
+            if inj is not None and t + 1 == int(inj["iter"]) \
+                    and faults.take("nan_loglik") is not None:
+                ll = float("nan")
+            if running is None:
+                running = self._scale_stats(s_batch, scale)
+            else:
+                gamma = (float(t) + t0_decay) ** (-alpha)
+                running = self._decay_stats(running, s_batch, gamma, scale)
+            state = self._mstep(state, running)
+            fatal = observe(ll, ll_old)
+            self.last_iter_seconds.append(time.perf_counter() - t_wall)
+            lls.append(ll)
+            change = ll - ll_old if ll_old is not None \
+                else abs(2.0 * eps_f) + 1.0
+            ll_old = ll
+            t += 1
+        if fatal:
+            nk = running.Nk if running is not None else None
+            if nk is not None:
+                counts[:] += np.asarray(jax.device_get(self._state_health(
+                    state, nk)), np.int64)
+            return state, lls, t, counts, False, {}
+        # True final loglik + exit health check: ONE full pass (the only
+        # full-data sweep of the whole fit). Its chunk_flush records carry
+        # iter=t, right after step t-1's.
+        self._pass_index = t
+        stats = self._estep_all(state, chunks, wts)
+        ll_final = float(stats.loglik)
+        counts[health.SANITIZED_LANES] += int(stats.sanitized)
+        if not np.isfinite(ll_final):
+            counts[health.NONFINITE_LOGLIK] += 1
+        # No regression check proxy-vs-true: the per-step logliks are
+        # stochastic estimates; comparing the exact final value against
+        # them would flag noise, not faults.
+        lls.append(ll_final)
+        counts[:] += np.asarray(jax.device_get(self._state_health(
+            state, stats.Nk)), np.int64)
+        return state, lls, t, counts, False, {}
 
     @property
     def inference_block(self) -> int:
@@ -447,6 +698,16 @@ class StreamingGMMModel(GMMModel):
         lo, hi = int(lo), int(hi)
         self._pass_index = 0
         self.last_iter_seconds = []
+        if self.config.em_mode == "minibatch":
+            state, lls, iters, counts, _, _ = self._minibatch_core(
+                state, chunks, wts, epsilon, lo, hi)
+            self.last_health = jnp.asarray(counts, jnp.int32)
+            ll_out = lls[-1] if lls else float("nan")
+            out = (state, jnp.asarray(ll_out, chunks.dtype),
+                   jnp.asarray(iters))
+            if trajectory:
+                return out + (np.asarray(lls, np.float64),)
+            return out
         counts = np.zeros((health.NUM_FLAGS,), np.int64)
         reg_tol = float(self.config.health_regression_scale) * float(epsilon)
 
@@ -520,11 +781,27 @@ class StreamingGMMModel(GMMModel):
         """
         import dataclasses as _dc
 
-        from ..ops.mstep import SuffStats
-
         lo, hi = resolve_iters(self.config, min_iters, max_iters)
         lo, hi = int(lo), int(hi)
         self.last_iter_seconds = []
+        if self.config.em_mode == "minibatch":
+            # Stepwise EM under supervision: the per-step poll replaces the
+            # per-pass/per-block polls (steps are short -- one minibatch),
+            # and the stop payload carries the decay state (mb_step /
+            # mb_cursor / mb_acc) instead of the pass/block/acc carry.
+            self._pass_index = 0
+            state, lls, iters, counts, stopped, extra = \
+                self._minibatch_core(state, chunks, wts, epsilon, lo, hi,
+                                     should_stop=should_stop, resume=resume)
+            if stopped:
+                extra = dict(extra, em_lls=np.asarray(lls, np.float64))
+            self.last_health = jnp.asarray(counts, jnp.int32)
+            buf = np.full((int(self.config.max_iters) + 1,), np.nan,
+                          np.float64)
+            n = min(len(lls), buf.shape[0])
+            buf[:n] = lls[:n]
+            ll_out = lls[-1] if lls else float("nan")
+            return state, ll_out, iters, buf, stopped, extra
         counts = np.zeros((health.NUM_FLAGS,), np.int64)
         reg_tol = float(self.config.health_regression_scale) * float(epsilon)
         eps_f = abs(float(epsilon))

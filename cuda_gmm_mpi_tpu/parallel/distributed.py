@@ -81,9 +81,29 @@ def global_moments(local_data: np.ndarray, chunk_size: int, num_chunks: int):
         block = local_data[j * chunk_size:(j + 1) * chunk_size]
         if block.shape[0] == 0:
             continue
-        parts[j, 0] = block.shape[0]
-        parts[j, 1:1 + d] = block.sum(axis=0, dtype=np.float64)
-        parts[j, 1 + d:] = (block.astype(np.float64) ** 2).sum(axis=0)
+        parts[j] = moment_part(block)
+    return reduce_moment_parts(parts)
+
+
+def moment_part(block: np.ndarray) -> np.ndarray:
+    """One chunk's [1+2D] float64 (count, sum, sum-of-squares) partial --
+    the per-chunk half of :func:`global_moments`, shared with the pipelined
+    ingestion pass (io/pipeline.py) so a per-block-read moments pass builds
+    the EXACT same partials matrix a resident slice would."""
+    d = block.shape[1]
+    part = np.empty((1 + 2 * d,), np.float64)
+    part[0] = block.shape[0]
+    part[1:1 + d] = block.sum(axis=0, dtype=np.float64)
+    part[1 + d:] = (block.astype(np.float64) ** 2).sum(axis=0)
+    return part
+
+
+def reduce_moment_parts(parts: np.ndarray):
+    """(mean[D], var[D]) float64 from a [num_chunks, 1+2D] partials matrix;
+    the reduction half of :func:`global_moments` (same allgather, same
+    summation order, so every builder of the same partials matrix gets the
+    same bits)."""
+    d = (parts.shape[1] - 1) // 2
     if jax.process_count() > 1:
         from jax.experimental import multihost_utils
 

@@ -123,10 +123,38 @@ def render_report(records: List[dict], max_trajectory_rows: int = 400) -> str:
             out.append(f"  ... {len(iters) - len(shown)} more rows elided")
         out.append("")
 
-    if chunks:
-        total_bytes = sum(int(r.get("bytes", 0)) for r in chunks)
-        out.append(f"Streaming: {len(chunks)} block flushes, "
-                   f"{total_bytes / 1e6:.1f} MB host->device")
+    ingest_starts = [r for r in records if r.get("event") == "ingest_start"]
+    ingest_summaries = [r for r in records
+                        if r.get("event") == "ingest_summary"]
+    if chunks or ingest_starts or ingest_summaries:
+        if chunks:
+            total_bytes = sum(int(r.get("bytes", 0)) for r in chunks)
+            line = (f"Streaming: {len(chunks)} block flushes, "
+                    f"{total_bytes / 1e6:.1f} MB host->device")
+            waits = [float(r["prefetch_wait_s"]) for r in chunks
+                     if r.get("prefetch_wait_s") is not None]
+            computes = [float(r["compute_s"]) for r in chunks
+                        if r.get("compute_s") is not None]
+            if waits or computes:
+                # rev v1.9 split: total host wall blocked on ingestion vs.
+                # in the statistics dispatch, across all blocks.
+                line += (f"; prefetch wait {sum(waits):.3f}s / "
+                         f"compute {sum(computes):.3f}s")
+            out.append(line)
+        for r in ingest_starts:
+            out.append(
+                f"  ingest: {r.get('source', '?')} rows "
+                f"[{r.get('row_start', '?')}, {r.get('row_stop', '?')}) "
+                f"in {r.get('blocks', '?')} blocks, "
+                f"queue depth {r.get('queue_depth', '?')}"
+                + (f", mode={r['mode']}" if r.get("mode") else ""))
+        for r in ingest_summaries:
+            out.append(
+                f"  ingest summary: {r.get('blocks_read', 0)} blocks "
+                f"served, peak {r.get('peak_resident_blocks', 0)} resident "
+                f"(queue depth {r.get('queue_depth', '?')}), "
+                f"{float(r.get('bytes', 0)) / 1e6:.1f} MB read, "
+                f"prefetch wait {float(r.get('prefetch_wait_s', 0)):.3f}s")
         out.append("")
 
     if (serve_reqs or serve_batches or serve_summaries or serve_sheds

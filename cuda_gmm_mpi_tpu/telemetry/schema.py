@@ -66,9 +66,27 @@ EVENT_FIELDS: Dict[str, Tuple[Tuple[str, ...], Tuple[str, ...]]] = {
         (),
     ),
     # Streaming (out-of-core) path: one per host->device block flush.
+    # ``prefetch_wait_s``/``compute_s`` (rev v1.9) split the block's host
+    # wall: time blocked on ingestion (0.0 when the chunks are host-
+    # resident) vs. time in the statistics dispatch -- the pipelined-
+    # ingestion overlap win is directly observable per block.
     "chunk_flush": (
         ("iter", "block", "chunks", "bytes"),
-        ("k",),
+        ("k", "prefetch_wait_s", "compute_s"),
+    ),
+    # Pipelined ingestion lifecycle (rev v1.9; io/pipeline.py): one
+    # ingest_start per fit with a lazy block source -- the rank's file
+    # source, row range, and bounded-queue depth.
+    "ingest_start": (
+        ("source", "rows", "queue_depth"),
+        ("row_start", "row_stop", "blocks", "chunk_size", "mode"),
+    ),
+    # ...and one ingest_summary when the source closes: blocks served,
+    # peak resident block count (the O(queue_depth x block) memory claim,
+    # measured), cumulative prefetch wait, and bytes range-read.
+    "ingest_summary": (
+        ("blocks_read", "peak_resident_blocks"),
+        ("prefetch_wait_s", "bytes", "queue_depth"),
     ),
     # Rate-limited liveness marker for long phases.
     "heartbeat": (

@@ -28,6 +28,12 @@ Supported fault kinds (the spec is ``{kind: {params...}}``):
 - ``poison_block`` ``{"block": j, "times": n}`` -- the streaming path's
   host->device block ``j`` arrives as all-NaN (a torn read / bad DMA);
   consumed per delivery, so the recovery retry streams clean data.
+- ``read_slow`` ``{"ms": m, "block": j, "times": n}`` -- the pipelined
+  ingestion worker (io/pipeline.py) sleeps ``m`` milliseconds before
+  reading block ``j`` (any block when omitted): deterministic slow-disk
+  injection for the bounded-queue backpressure path; consumed per read,
+  host side, so results stay bit-identical -- only the prefetch wait
+  moves.
 - ``checkpoint_eio`` ``{"step": s, "times": n}`` -- the checkpoint write
   for sweep step ``s`` (any step when omitted) raises ``OSError(EIO)``;
   consumed per raise, so the bounded retry's n+1-th attempt succeeds.
@@ -75,7 +81,7 @@ from typing import Any, Dict, Optional
 
 ENV_VAR = "GMM_FAULTS"
 
-KNOWN_KINDS = ("nan_loglik", "singular_cov", "poison_block",
+KNOWN_KINDS = ("nan_loglik", "singular_cov", "poison_block", "read_slow",
                "checkpoint_eio", "preempt", "rank_hang",
                "serve_nan", "serve_slow", "registry_torn")
 

@@ -19,17 +19,11 @@ class InvalidInputError(ValueError):
     ValueErrors crash loudly with their tracebacks."""
 
 
-def validate_finite(local: np.ndarray, start: int = 0,
-                    collective: bool = False, dtype=None) -> None:
-    """Reject rows that are (or will become) non-finite; collective-safe.
-
-    With ``collective``, every rank must reach the same raise/continue
-    decision: a lone rank raising before a later collective would leave the
-    clean ranks blocked in it forever (``parallel.distributed.allgather_host``
-    is the shared primitive). ``dtype`` names the COMPUTE dtype: a value like
-    1e39 is finite in the reader's float64 but overflows to Inf when cast to
-    float32, which is exactly the poisoning this guards against -- checked
-    by magnitude so the raw data needn't be cast first.
+def finite_row_stats(local: np.ndarray, start: int = 0, dtype=None):
+    """(n_bad, first_bad_global_row) for one slice -- no decision, no
+    collective. The scan half of :func:`validate_finite`, split out so the
+    pipelined ingestion path (io/pipeline.py) can accumulate it chunk by
+    chunk and still make ONE collectively agreed raise/continue decision.
     """
     finite = np.isfinite(local)
     if dtype is not None and np.dtype(dtype).itemsize < local.dtype.itemsize:
@@ -38,6 +32,13 @@ def validate_finite(local: np.ndarray, start: int = 0,
     bad = np.flatnonzero(~finite)
     n_bad = int(bad.size)
     first_bad = start + int(bad[0]) if n_bad else -1
+    return n_bad, first_bad
+
+
+def raise_if_nonfinite(n_bad: int, first_bad: int,
+                       collective: bool = False) -> None:
+    """The decision half of :func:`validate_finite`: one (optionally
+    collective) raise/continue verdict from accumulated scan counts."""
     if collective:
         from .parallel.distributed import allgather_host
 
@@ -53,3 +54,19 @@ def validate_finite(local: np.ndarray, start: int = 0,
             "data or pass validate_input=False/--no-validate-input to "
             "proceed anyway"
         )
+
+
+def validate_finite(local: np.ndarray, start: int = 0,
+                    collective: bool = False, dtype=None) -> None:
+    """Reject rows that are (or will become) non-finite; collective-safe.
+
+    With ``collective``, every rank must reach the same raise/continue
+    decision: a lone rank raising before a later collective would leave the
+    clean ranks blocked in it forever (``parallel.distributed.allgather_host``
+    is the shared primitive). ``dtype`` names the COMPUTE dtype: a value like
+    1e39 is finite in the reader's float64 but overflows to Inf when cast to
+    float32, which is exactly the poisoning this guards against -- checked
+    by magnitude so the raw data needn't be cast first.
+    """
+    n_bad, first_bad = finite_row_stats(local, start, dtype=dtype)
+    raise_if_nonfinite(n_bad, first_bad, collective=collective)

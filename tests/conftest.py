@@ -84,3 +84,19 @@ def make_blobs(rng, n=2000, d=3, k=4, spread=8.0, dtype=np.float64):
 @pytest.fixture
 def blobs(rng):
     return make_blobs(rng)
+
+
+@pytest.fixture
+def sized_tmp_path(tmp_path):
+    """tmp_path with a disk-usage guard: disk-heavy tests (out-of-core
+    ingestion fixtures writing dataset files) opt in, and a fixture that
+    grows past the cap fails the TEST instead of silently filling the CI
+    disk. GMM_TEST_TMPDIR_CAP_MB overrides the default 256 MB cap."""
+    cap_mb = float(os.environ.get("GMM_TEST_TMPDIR_CAP_MB") or 256)
+    yield tmp_path
+    total = sum(f.stat().st_size for f in tmp_path.rglob("*")
+                if f.is_file())
+    assert total <= cap_mb * 1024 * 1024, (
+        f"test left {total / 1e6:.1f} MB in {tmp_path} "
+        f"(cap {cap_mb:.0f} MB; raise GMM_TEST_TMPDIR_CAP_MB only with "
+        f"a reason)")

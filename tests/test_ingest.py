@@ -1,0 +1,477 @@
+"""Out-of-core pipelined ingestion + stepwise minibatch EM (round 13).
+
+Contracts under test (io/pipeline.py, models/streaming.py minibatch
+driver, the CLI --ingest/--em-mode surface):
+
+  * pipelined ingestion is a TRANSPORT change, not a math change: fits
+    are bit-identical to the host-resident path, single-device and data
+    mesh, full and diag covariance;
+  * the bounded queue really bounds residency (read_slow backpressure
+    moves the prefetch wait, never the data), and delivery order is
+    deterministic by construction;
+  * stepwise minibatch EM converges within the health-check tolerance of
+    full EM while touching one minibatch per step;
+  * preemption mid-pass (pipelined) and mid-step (minibatch) checkpoints
+    the carry state, exits 75 at the CLI, and --resume auto reproduces
+    the uninterrupted run byte-for-byte;
+  * peak host RSS stays O(queue_depth x block) for a fit whose dataset
+    never fits the budgeted host slice (slow test).
+"""
+
+import json
+import os
+import subprocess
+import sys
+
+import numpy as np
+import pytest
+
+from cuda_gmm_mpi_tpu import GMMConfig, fit_gmm, supervisor
+from cuda_gmm_mpi_tpu.io import FileSource, PipelinedBlockSource, write_bin
+from cuda_gmm_mpi_tpu.ops.formulas import convergence_epsilon
+from cuda_gmm_mpi_tpu.supervisor import PreemptedError, RunSupervisor
+from cuda_gmm_mpi_tpu.testing import faults
+
+from .conftest import communicate_or_kill, worker_env
+
+CLI = [sys.executable, "-m", "cuda_gmm_mpi_tpu.cli"]
+
+
+def _blob_file(tmp_path, rng, n=2048, d=3, k=3, name="events.bin"):
+    centers = rng.normal(scale=9.0, size=(k, d))
+    data = (centers[rng.integers(0, k, n)]
+            + rng.normal(size=(n, d))).astype(np.float32)
+    path = str(tmp_path / name)
+    write_bin(path, data)
+    return path
+
+
+def _substeps(ck):
+    d = os.path.join(ck, "sweep")
+    if not os.path.isdir(d):
+        return []
+    return sorted(f for f in os.listdir(d)
+                  if ".iter" in f and f.endswith(".npz"))
+
+
+def _sup():
+    return RunSupervisor(install_signals=False)
+
+
+# ---------------------------------------------------------------------------
+# bit-identity: pipelined == resident
+# ---------------------------------------------------------------------------
+
+
+@pytest.mark.parametrize("mesh,diag", [
+    (None, False), ((8, 1), False), (None, True), ((8, 1), True),
+])
+def test_pipelined_bit_identical_to_resident(tmp_path, rng, mesh, diag):
+    """The tentpole contract: ingest='pipelined' changes WHERE blocks come
+    from (per-block byte ranges off disk vs a resident host slice), not a
+    single bit of the fit -- across the K sweep, on a data mesh, and for
+    both covariance families."""
+    path = _blob_file(tmp_path, rng)
+    kw = dict(min_iters=4, max_iters=4, chunk_size=256, dtype="float64",
+              stream_events=True, diag_only=diag,
+              mesh_shape=mesh, seed=7)
+    r_res = fit_gmm(FileSource(path), 4, 2, config=GMMConfig(**kw))
+    r_pipe = fit_gmm(FileSource(path), 4, 2,
+                     config=GMMConfig(ingest="pipelined", **kw))
+    assert r_pipe.ideal_num_clusters == r_res.ideal_num_clusters
+    assert r_pipe.final_loglik == r_res.final_loglik
+    assert r_pipe.min_rissanen == r_res.min_rissanen
+    np.testing.assert_array_equal(np.asarray(r_pipe.means),
+                                  np.asarray(r_res.means))
+    np.testing.assert_array_equal(np.asarray(r_pipe.covariances),
+                                  np.asarray(r_res.covariances))
+    for (k1, ll1, *_), (k2, ll2, *_) in zip(r_pipe.sweep_log,
+                                            r_res.sweep_log):
+        assert k1 == k2 and ll1 == ll2
+
+
+def test_pipelined_csv_bit_identical(tmp_path, rng):
+    """CSV sources pipeline too: the byte-range reader serves the same
+    decoded rows either way, so the fits agree exactly."""
+    centers = rng.normal(scale=9.0, size=(3, 4))
+    x = (centers[rng.integers(0, 3, 1500)]
+         + rng.normal(size=(1500, 4))).astype(np.float32)
+    csv = tmp_path / "ev.csv"
+    csv.write_text("a,b,c,d\n" + "\n".join(
+        ",".join(f"{v:.6f}" for v in r) for r in x))
+    kw = dict(min_iters=4, max_iters=4, chunk_size=128, dtype="float64",
+              stream_events=True, seed=5)
+    r_res = fit_gmm(FileSource(str(csv)), 3, 3, config=GMMConfig(**kw))
+    r_pipe = fit_gmm(FileSource(str(csv)), 3, 3,
+                     config=GMMConfig(ingest="pipelined", **kw))
+    assert r_pipe.final_loglik == r_res.final_loglik
+    np.testing.assert_array_equal(np.asarray(r_pipe.means),
+                                  np.asarray(r_res.means))
+
+
+# ---------------------------------------------------------------------------
+# guards
+# ---------------------------------------------------------------------------
+
+
+def test_ingest_config_guards(blobs):
+    data, _ = blobs
+    with pytest.raises(ValueError, match="unknown ingest"):
+        GMMConfig(ingest="mmap")
+    with pytest.raises(ValueError, match="streaming block loop"):
+        GMMConfig(ingest="pipelined")  # needs stream_events
+    with pytest.raises(ValueError, match="ingest_queue_depth"):
+        GMMConfig(stream_events=True, ingest="pipelined",
+                  ingest_queue_depth=0)
+    with pytest.raises(ValueError, match="unknown em_mode"):
+        GMMConfig(em_mode="sgd")
+    with pytest.raises(ValueError, match="stepwise driver"):
+        GMMConfig(em_mode="minibatch")  # needs stream_events
+    with pytest.raises(ValueError, match="minibatch_alpha"):
+        GMMConfig(stream_events=True, em_mode="minibatch",
+                  minibatch_alpha=0.5)
+    with pytest.raises(ValueError, match="minibatch_t0"):
+        GMMConfig(stream_events=True, em_mode="minibatch",
+                  minibatch_t0=-1.0)
+    with pytest.raises(ValueError, match="minibatch_size"):
+        GMMConfig(stream_events=True, em_mode="minibatch",
+                  minibatch_size=-5)
+    # pipelined ingestion needs a file source: an in-memory array is
+    # already resident, so the config is a contradiction.
+    cfg = GMMConfig(stream_events=True, ingest="pipelined",
+                    min_iters=2, max_iters=2, chunk_size=128)
+    with pytest.raises(ValueError, match="FileSource"):
+        fit_gmm(data, 3, 3, config=cfg)
+
+
+# ---------------------------------------------------------------------------
+# bounded queue: backpressure, determinism, telemetry
+# ---------------------------------------------------------------------------
+
+
+def test_backpressure_read_slow(tmp_path, rng):
+    """A slow disk (read_slow injection on one block) shifts the prefetch
+    wait, bounds residency at queue_depth, and changes NOTHING about the
+    delivered data or its order."""
+    n, d, chunk = 2048, 3, 256
+    path = _blob_file(tmp_path, rng, n=n, d=d)
+    src = FileSource(path)
+    expect = [src.read_range(j * chunk, (j + 1) * chunk).astype(np.float64)
+              for j in range(n // chunk)]
+
+    with faults.use({"read_slow": {"ms": 40, "block": 1, "times": 2}}) \
+            as plan:
+        pbs = PipelinedBlockSource(src, start=0, stop=n, chunk_size=chunk,
+                                   num_chunks=n // chunk, queue_depth=2)
+        try:
+            for _pass in range(2):
+                for j in range(pbs.num_blocks):
+                    x, w = pbs.get_block(j)
+                    np.testing.assert_array_equal(x, expect[j])
+                    np.testing.assert_array_equal(w, np.ones(chunk))
+        finally:
+            pbs.close()
+    assert plan.fired["read_slow"] == 2
+    assert pbs.prefetch_wait_s > 0.0  # the consumer DID wait on block 1
+    assert 1 <= pbs.peak_resident <= 2  # the queue bound held
+    assert pbs.delivered_order == list(range(pbs.num_blocks)) * 2
+    assert pbs.blocks_read == 2 * pbs.num_blocks
+
+
+def test_prefetch_order_deterministic_and_seek(tmp_path, rng):
+    """One worker reads ascending, one consumer pops ascending: delivery
+    order is the block sequence itself, and an out-of-order request (a
+    mid-pass resume seek) restarts the prefetcher at the requested
+    block."""
+    n, chunk = 1024, 128
+    path = _blob_file(tmp_path, rng, n=n)
+    src = FileSource(path)
+    pbs = PipelinedBlockSource(src, start=0, stop=n, chunk_size=chunk,
+                               num_chunks=n // chunk, queue_depth=3)
+    try:
+        for j in range(pbs.num_blocks):
+            pbs.get_block(j)
+        # mid-pass seek: resume replays from block 5
+        expect = src.read_range(5 * chunk, 6 * chunk).astype(np.float64)
+        x, _ = pbs.get_block(5)
+        np.testing.assert_array_equal(x, expect)
+        for j in range(6, pbs.num_blocks):
+            pbs.get_block(j)
+        with pytest.raises(IndexError):
+            pbs.get_block(pbs.num_blocks)
+    finally:
+        pbs.close()
+    assert pbs.delivered_order == (list(range(pbs.num_blocks))
+                                   + list(range(5, pbs.num_blocks)))
+    with pytest.raises(RuntimeError, match="closed"):
+        pbs.get_block(0)
+
+
+def test_pipelined_telemetry_stream(tmp_path, rng):
+    """A pipelined fit's metrics stream validates against the schema and
+    carries the round-13 ingestion story: one ingest_start, one
+    ingest_summary whose peak residency respects the queue bound, and
+    chunk_flush records split into prefetch_wait_s / compute_s."""
+    from cuda_gmm_mpi_tpu.telemetry import read_stream, validate_stream
+    from cuda_gmm_mpi_tpu.telemetry.report import render_report
+
+    path = _blob_file(tmp_path, rng)
+    mf = tmp_path / "m.jsonl"
+    cfg = GMMConfig(min_iters=3, max_iters=3, chunk_size=256,
+                    dtype="float64", stream_events=True, ingest="pipelined",
+                    ingest_queue_depth=2, metrics_file=str(mf), seed=7)
+    fit_gmm(FileSource(path), 3, 3, config=cfg)
+
+    records = read_stream(str(mf))
+    assert validate_stream(records) == []
+    starts = [r for r in records if r["event"] == "ingest_start"]
+    summaries = [r for r in records if r["event"] == "ingest_summary"]
+    assert len(starts) == 1 and len(summaries) == 1
+    assert starts[0]["mode"] == "full"
+    assert starts[0]["rows"] == 2048
+    assert starts[0]["queue_depth"] == 2
+    s = summaries[0]
+    assert 1 <= s["peak_resident_blocks"] <= 2
+    assert s["blocks_read"] >= starts[0]["blocks"]  # >= one full pass
+    assert s["bytes"] > 0 and s["prefetch_wait_s"] >= 0.0
+    flushes = [r for r in records if r["event"] == "chunk_flush"]
+    assert flushes
+    for r in flushes:
+        assert r["prefetch_wait_s"] >= 0.0 and r["compute_s"] >= 0.0
+    rep = render_report(records)
+    assert "ingest:" in rep and "ingest summary:" in rep
+    assert "prefetch wait" in rep
+
+
+# ---------------------------------------------------------------------------
+# stepwise minibatch EM
+# ---------------------------------------------------------------------------
+
+
+def test_minibatch_within_health_tolerance_of_full(tmp_path, rng):
+    """The acceptance bound: a gamma-sum-matched stepwise run lands within
+    health_regression_scale x convergence_epsilon of full EM's loglik --
+    the same tolerance the health layer treats as 'no regression' -- while
+    each step touches one minibatch instead of the full pass."""
+    n, d, k = 4096, 3, 4
+    path = _blob_file(tmp_path, rng, n=n, d=d, k=k)
+    kw = dict(chunk_size=256, dtype="float64", stream_events=True, seed=3)
+    full = fit_gmm(FileSource(path), k, k,
+                   config=GMMConfig(min_iters=12, max_iters=12, **kw))
+    mb = fit_gmm(FileSource(path), k, k,
+                 config=GMMConfig(min_iters=340, max_iters=340,
+                                  em_mode="minibatch", minibatch_size=1024,
+                                  ingest="pipelined", **kw))
+    tol = 10.0 * convergence_epsilon(n, d)  # health_regression_scale x eps
+    assert abs(mb.final_loglik - full.final_loglik) <= tol
+
+
+def test_minibatch_resident_matches_pipelined(tmp_path, rng):
+    """em_mode='minibatch' composes with BOTH ingestion modes and the step
+    sequence is deterministic, so resident and pipelined stepwise fits are
+    bit-identical to each other."""
+    path = _blob_file(tmp_path, rng)
+    kw = dict(min_iters=20, max_iters=20, chunk_size=256, dtype="float64",
+              stream_events=True, em_mode="minibatch", minibatch_size=512,
+              seed=9)
+    r_res = fit_gmm(FileSource(path), 3, 3, config=GMMConfig(**kw))
+    r_pipe = fit_gmm(FileSource(path), 3, 3,
+                     config=GMMConfig(ingest="pipelined", **kw))
+    assert r_pipe.final_loglik == r_res.final_loglik
+    np.testing.assert_array_equal(np.asarray(r_pipe.means),
+                                  np.asarray(r_res.means))
+
+
+# ---------------------------------------------------------------------------
+# preemption + resume (in-process, deterministic injection)
+# ---------------------------------------------------------------------------
+
+
+def test_injected_preempt_pipelined_mid_pass_resume(tmp_path, rng):
+    """Mid-pass preemption under pipelined ingestion: the sub-step saves
+    the partial stream accumulator, and the resumed run -- which seeks the
+    prefetcher to the first unprocessed block -- is bit-identical to the
+    uninterrupted fit."""
+    path = _blob_file(tmp_path, rng, n=3072)
+    ck_ref, ck = str(tmp_path / "ref"), str(tmp_path / "ck")
+    kw = dict(min_iters=5, max_iters=5, chunk_size=256, dtype="float64",
+              stream_events=True, ingest="pipelined",
+              preempt_poll_iters=2, seed=7)
+
+    with supervisor.use(_sup()):
+        ref = fit_gmm(FileSource(path), 4, 4,
+                      config=GMMConfig(checkpoint_dir=ck_ref, **kw))
+
+    with pytest.raises(PreemptedError) as ei:
+        with faults.use({"preempt": {"iter": 2, "block": 3}}):
+            with supervisor.use(_sup()):
+                fit_gmm(FileSource(path), 4, 4,
+                        config=GMMConfig(checkpoint_dir=ck, **kw))
+    assert ei.value.checkpointed
+    subs = _substeps(ck)
+    assert len(subs) == 1
+    with np.load(os.path.join(ck, "sweep", subs[0])) as z:
+        assert {"stream_pass", "stream_block", "stream_acc.Nk"} <= \
+            set(z.files)
+        assert int(z["stream_pass"]) == 2 and int(z["stream_block"]) == 4
+
+    with supervisor.use(_sup()):
+        res = fit_gmm(FileSource(path), 4, 4,
+                      config=GMMConfig(checkpoint_dir=ck, **kw))
+    assert res.final_loglik == ref.final_loglik
+    assert res.min_rissanen == ref.min_rissanen
+    np.testing.assert_array_equal(np.asarray(res.means),
+                                  np.asarray(ref.means))
+
+
+def test_injected_preempt_minibatch_resume(tmp_path, rng):
+    """Mid-run preemption under stepwise EM: the sub-step saves the decay
+    state (mb_step / mb_cursor / mb_acc), and the resumed run replays the
+    exact remaining step sequence -- bit-identical final model."""
+    path = _blob_file(tmp_path, rng, n=3072)
+    ck_ref, ck = str(tmp_path / "ref"), str(tmp_path / "ck")
+    kw = dict(min_iters=10, max_iters=10, chunk_size=256, dtype="float64",
+              stream_events=True, ingest="pipelined", em_mode="minibatch",
+              minibatch_size=512, preempt_poll_iters=2, seed=7)
+
+    with supervisor.use(_sup()):
+        ref = fit_gmm(FileSource(path), 4, 4,
+                      config=GMMConfig(checkpoint_dir=ck_ref, **kw))
+
+    with pytest.raises(PreemptedError) as ei:
+        with faults.use({"preempt": {"iter": 3}}) as plan:
+            with supervisor.use(_sup()):
+                fit_gmm(FileSource(path), 4, 4,
+                        config=GMMConfig(checkpoint_dir=ck, **kw))
+    assert plan.fired["preempt"] == 1
+    assert ei.value.checkpointed
+    subs = _substeps(ck)
+    assert len(subs) == 1
+    with np.load(os.path.join(ck, "sweep", subs[0])) as z:
+        keys = set(z.files)
+        assert {"mb_step", "mb_cursor", "mb_acc.Nk", "mb_acc.M1",
+                "mb_acc.M2"} <= keys
+        assert int(z["mb_step"]) == 3
+
+    with supervisor.use(_sup()):
+        res = fit_gmm(FileSource(path), 4, 4,
+                      config=GMMConfig(checkpoint_dir=ck, **kw))
+    assert res.final_loglik == ref.final_loglik
+    np.testing.assert_array_equal(np.asarray(res.means),
+                                  np.asarray(ref.means))
+    assert _substeps(ck) == []  # consumed + pruned
+
+
+# ---------------------------------------------------------------------------
+# CLI: exit 75 + byte-identical resume
+# ---------------------------------------------------------------------------
+
+
+@pytest.mark.parametrize("mode,spec,extra", [
+    ("pipelined", {"preempt": {"iter": 2, "block": 2}}, []),
+    ("minibatch", {"preempt": {"iter": 3}},
+     ["--em-mode=minibatch", "--minibatch-size=1024"]),
+])
+def test_cli_preempt_exit75_then_byte_identical_resume(
+        tmp_path, rng, mode, spec, extra):
+    """The CLI acceptance path, deterministic via GMM_FAULTS: an injected
+    preemption (the SIGTERM stand-in) mid-fit exits 75 with a durable
+    sub-step; rerunning the same command resumes and produces output
+    files byte-identical to an uninterrupted run's."""
+    infile = _blob_file(tmp_path, rng, n=3000, d=3, k=4)
+    ck = str(tmp_path / "ck")
+
+    def args(out, ckdir):
+        return ["4", infile, str(out), "4", "--device=cpu",
+                "--dtype=float64", "--min-iters=6", "--max-iters=6",
+                "--sweep-k-buckets=off", "--preempt-poll-iters=2",
+                "--chunk-size=256", "--stream-events", "--ingest=pipelined",
+                f"--checkpoint-dir={ckdir}", *extra]
+
+    def run(out, ckdir, fault_spec=None):
+        env = worker_env()
+        if fault_spec is not None:
+            env["GMM_FAULTS"] = json.dumps(fault_spec)
+        p = subprocess.Popen(CLI + args(out, ckdir),
+                             stdout=subprocess.PIPE,
+                             stderr=subprocess.PIPE, env=env, text=True)
+        out_, err_ = communicate_or_kill(p, timeout=600)
+        return p.returncode, out_, err_
+
+    rc, o, e = run(tmp_path / "int", ck, fault_spec=spec)
+    assert rc == 75, f"expected EX_TEMPFAIL:\n{o}\n{e[-3000:]}"
+    assert "Preempted" in e
+    assert len(_substeps(ck)) == 1
+
+    rc2, o2, e2 = run(tmp_path / "resumed", ck)
+    assert rc2 == 0, f"resume failed:\n{o2}\n{e2[-3000:]}"
+    assert _substeps(ck) == []
+
+    rc3, o3, e3 = run(tmp_path / "ref", str(tmp_path / "ck_ref"))
+    assert rc3 == 0, f"reference failed:\n{o3}\n{e3[-3000:]}"
+
+    assert (tmp_path / "resumed.summary").read_bytes() == \
+        (tmp_path / "ref.summary").read_bytes()
+    assert (tmp_path / "resumed.results").read_bytes() == \
+        (tmp_path / "ref.results").read_bytes()
+
+
+# ---------------------------------------------------------------------------
+# peak host RSS is bounded by the queue, not the file
+# ---------------------------------------------------------------------------
+
+
+@pytest.mark.slow
+def test_pipelined_rss_bounded_by_queue(sized_tmp_path):
+    """Fit a 128 MB on-disk dataset both ways, each in its own process
+    (ru_maxrss is a process-lifetime high-water mark): the resident fit's
+    RSS growth carries a materialized copy of the data, the pipelined one
+    must not -- its residency is O(queue_depth x block), independent of
+    the file size. An absolute bound would measure the XLA CPU runtime's
+    ~160 MB of fit-time allocations, which both modes pay identically, so
+    the contract is the A/B difference."""
+    code = r"""
+import jax
+jax.config.update("jax_platforms", "cpu")
+import numpy as np, resource, sys
+from cuda_gmm_mpi_tpu.config import GMMConfig
+from cuda_gmm_mpi_tpu.io import FileSource
+from cuda_gmm_mpi_tpu.models import fit_gmm
+
+path, mode = sys.argv[1], sys.argv[2]
+jax.devices()
+base = resource.getrusage(resource.RUSAGE_SELF).ru_maxrss
+cfg = GMMConfig(min_iters=2, max_iters=2, chunk_size=4096,
+                stream_events=True, ingest=mode)
+r = fit_gmm(FileSource(path), 2, 2, config=cfg)
+peak = resource.getrusage(resource.RUSAGE_SELF).ru_maxrss
+print("GROWTH_KB", int(peak - base), "LL", float(r.final_loglik))
+"""
+    path = str(sized_tmp_path / "big.bin")
+    n, d, step = 4_000_000, 8, 1 << 16
+    rng = np.random.default_rng(0)
+    # Written in bounded slices so the WRITER (this pytest process) never
+    # holds the dataset either.
+    with open(path, "wb") as f:
+        np.asarray([n, d], np.int32).tofile(f)
+        for lo in range(0, n, step):
+            m = min(step, n - lo)
+            f.write(rng.normal(size=(m, d)).astype(np.float32).tobytes())
+    data_mb = n * d * 4 / 1024 / 1024  # 128 MB on disk
+
+    growth, ll = {}, {}
+    for mode in ("resident", "pipelined"):
+        r = subprocess.run([sys.executable, "-c", code, path, mode],
+                           capture_output=True, text=True, env=worker_env(),
+                           timeout=600)
+        assert r.returncode == 0, f"{mode}:\n{r.stdout}\n{r.stderr[-3000:]}"
+        parts = r.stdout.split()
+        growth[mode] = int(parts[parts.index("GROWTH_KB") + 1]) / 1024.0
+        ll[mode] = float(parts[parts.index("LL") + 1])
+    assert ll["pipelined"] == ll["resident"]  # same fit, bit for bit
+    # The resident fit held at least one full copy of the data ...
+    assert growth["resident"] >= data_mb, growth
+    # ... the pipelined fit held none of it (only the shared runtime
+    # allocations plus O(queue x block) buffers).
+    assert growth["pipelined"] <= 0.6 * growth["resident"], growth
+    assert growth["pipelined"] <= growth["resident"] - 0.7 * data_mb, growth
