@@ -1035,6 +1035,155 @@ print(json.dumps({
     return result
 
 
+def run_elastic_bench(platform: str, accel_unavailable: bool) -> dict:
+    """The --elastic mode: warm elastic recovery vs cold restart A/B.
+
+    Simulated single-process chaos (the same harness the elastic tests
+    use): a generation-0 membership pre-seeded with ranks (0, 1) makes
+    this process rank 0 of a 2-host world on paper, and an injected
+    ``rank_lost`` mid-sweep declares rank 1 dead at a deterministic EM
+    iteration. Three fits over the same blobs:
+
+      reference  no fault -- ground-truth wall and selected model;
+      cold       rank_lost with --elastic OFF -> PeerLostError (the
+                 exit-75 operator path), then a from-scratch relaunch in
+                 a fresh checkpoint dir: wall = partial run + full rerun;
+      elastic    rank_lost with --elastic ON -> ONE call that shrinks to
+                 generation 1, restores the emergency checkpoint, and
+                 finishes the sweep: wall includes the whole recovery.
+
+    ``vs_baseline`` is cold_total / elastic wall -- the time a fleet
+    operator saves per peer loss by shrinking instead of relaunching.
+    The record also carries the determinism checks the acceptance
+    criteria name: same winner K as the reference and a final loglik
+    within ``health_regression_scale x convergence_epsilon``. Size
+    knobs: GMM_BENCH_ELASTIC_{N,D,K,ITERS}.
+    """
+    import tempfile
+
+    import jax
+
+    jax.config.update("jax_enable_x64", True)  # the fits run float64
+
+    from cuda_gmm_mpi_tpu import supervisor
+    from cuda_gmm_mpi_tpu.config import GMMConfig
+    from cuda_gmm_mpi_tpu.models.order_search import fit_gmm
+    from cuda_gmm_mpi_tpu.parallel import elastic
+    from cuda_gmm_mpi_tpu.testing import faults
+
+    on_accel = platform not in ("cpu",)
+    n = int(os.environ.get("GMM_BENCH_ELASTIC_N")
+            or (200_000 if on_accel else 40_000))
+    d = int(os.environ.get("GMM_BENCH_ELASTIC_D") or 8)
+    kmax = int(os.environ.get("GMM_BENCH_ELASTIC_K") or 6)
+    iters = int(os.environ.get("GMM_BENCH_ELASTIC_ITERS") or 12)
+    # Fire past the midpoint so the partial run is a meaningful fraction
+    # of the reference wall (a loss at iteration 1 makes any restart
+    # strategy look cheap).
+    fault_iter = max(2, (2 * iters) // 3)
+
+    rng = np.random.default_rng(7)
+    centers = rng.normal(scale=8.0, size=(4, d))
+    data = (centers[rng.integers(0, 4, n)]
+            + rng.normal(size=(n, d))).astype(np.float64)
+
+    def cfg(ck, **kw):
+        base = dict(min_iters=iters, max_iters=iters, chunk_size=4096,
+                    dtype="float64", checkpoint_dir=ck, seed=11,
+                    preempt_poll_iters=1, elastic_backoff_s=0.1)
+        base.update(kw)
+        return GMMConfig(**base)
+
+    def sup():
+        return supervisor.RunSupervisor(install_signals=False)
+
+    fault = {"rank_lost": {"iter": fault_iter, "rank": 1}}
+    with tempfile.TemporaryDirectory() as root:
+        # Reference: the uninterrupted wall and ground-truth model.
+        elastic.reset()
+        t0 = time.perf_counter()
+        with supervisor.use(sup()):
+            ref = fit_gmm(data, kmax, 2,
+                          config=cfg(os.path.join(root, "ck_ref")))
+        ref_wall = time.perf_counter() - t0
+
+        # Cold side: loss -> exit-75 path -> from-scratch relaunch.
+        elastic.reset()
+        t0 = time.perf_counter()
+        try:
+            with faults.use(fault):
+                with supervisor.use(sup()):
+                    fit_gmm(data, kmax, 2,
+                            config=cfg(os.path.join(root, "ck_cold")))
+            raise RuntimeError("rank_lost injection never fired")
+        except supervisor.PeerLostError:
+            pass
+        partial_wall = time.perf_counter() - t0
+        t0 = time.perf_counter()
+        with supervisor.use(sup()):
+            cold = fit_gmm(data, kmax, 2,
+                           config=cfg(os.path.join(root, "ck_cold2")))
+        restart_wall = time.perf_counter() - t0
+        cold_total = partial_wall + restart_wall
+
+        # Elastic side: the same loss, survived in one call.
+        elastic.reset()
+        ck_el = os.path.join(root, "ck_el")
+        elastic.write_membership(
+            elastic.membership_dir(ck_el),
+            elastic.Membership(generation=0, ranks=(0, 1), world_size0=2))
+        t0 = time.perf_counter()
+        with faults.use(fault):
+            with supervisor.use(sup()):
+                el = fit_gmm(data, kmax, 2,
+                             config=cfg(ck_el, elastic=True, min_hosts=1))
+        elastic_wall = time.perf_counter() - t0
+        gen = elastic.generation()
+        elastic.reset()
+
+    speedup = cold_total / max(elastic_wall, 1e-9)
+    # The acceptance tolerance: health_regression_scale (10, the GMMConfig
+    # default) x convergence_epsilon(n, d) (ops/formulas.py), absolute
+    # loglik units -- same bound the health monitor applies to a resume.
+    fppc = 1.0 + d + 0.5 * d * (d + 1)
+    tol = 10.0 * fppc * np.log(float(n) * d) * 0.01
+    err = abs(float(el.final_loglik) - float(ref.final_loglik))
+    result = {
+        "metric": f"elastic recovery speedup vs cold restart "
+                  f"({n}x{d}, K<= {kmax}, {platform})",
+        "value": round(speedup, 3),
+        "unit": "x",
+        # cold-restart wall / elastic wall for the SAME injected loss.
+        "vs_baseline": round(speedup, 3),
+        "accelerator_unavailable": accel_unavailable,
+        "elastic": {
+            "n": n, "d": d, "k_max": kmax, "em_iters": iters,
+            "fault_iter": fault_iter,
+            "ref_wall_s": round(ref_wall, 3),
+            "cold_partial_wall_s": round(partial_wall, 3),
+            "cold_restart_wall_s": round(restart_wall, 3),
+            "cold_total_wall_s": round(cold_total, 3),
+            "elastic_wall_s": round(elastic_wall, 3),
+            "recovery_overhead_s": round(elastic_wall - ref_wall, 3),
+            "generation": int(gen),
+            "winner_k_ref": int(ref.ideal_num_clusters),
+            "winner_k_elastic": int(el.ideal_num_clusters),
+            "winner_k_cold": int(cold.ideal_num_clusters),
+            "winner_k_match": bool(int(el.ideal_num_clusters)
+                                   == int(ref.ideal_num_clusters)),
+            "loglik_abs_err": round(err, 9),
+            "loglik_tolerance": round(float(tol), 6),
+            "within_tolerance": bool(err <= tol),
+        },
+        "measured_utc": time.strftime("%Y-%m-%dT%H:%M:%SZ", time.gmtime()),
+    }
+    if accel_unavailable:
+        result["platform_note"] = (
+            "accelerator tunnel unavailable (probe failed); this is a "
+            "CPU-fallback measurement of the recovery path")
+    return result
+
+
 CONFIGS = {
     # BASELINE.md benchmark config matrix (1-5); "north" = the north-star;
     # 6 = the reference's first-class envelope (MAX_CLUSTERS=512,
@@ -1070,6 +1219,8 @@ def main() -> int:
                     or os.environ.get("GMM_BENCH_TENANCY") == "1")
     want_ingest = ("--ingest" in sys.argv[1:]
                    or os.environ.get("GMM_BENCH_INGEST") == "1")
+    want_elastic = ("--elastic" in sys.argv[1:]
+                    or os.environ.get("GMM_BENCH_ELASTIC") == "1")
     spec = CONFIGS.get(cfg_name)
     if spec is None:
         print(
@@ -1192,6 +1343,14 @@ def main() -> int:
         # Host-resident vs pipelined out-of-core ingestion A/B (ignores
         # --config; sized by GMM_BENCH_INGEST_*).
         result = run_ingest_bench(platform, accel_unavailable)
+        watchdog.cancel()
+        print(json.dumps(result))
+        return 3 if accel_unavailable else 0
+
+    if want_elastic:
+        # Warm elastic recovery vs cold restart A/B after an injected
+        # peer loss (ignores --config; sized by GMM_BENCH_ELASTIC_*).
+        result = run_elastic_bench(platform, accel_unavailable)
         watchdog.cancel()
         print(json.dumps(result))
         return 3 if accel_unavailable else 0

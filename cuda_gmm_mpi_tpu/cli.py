@@ -239,6 +239,18 @@ def build_parser() -> argparse.ArgumentParser:
                    "beyond this fails loudly with PeerLostError + an "
                    "emergency checkpoint instead of hanging in the next "
                    "collective; 0 disables")
+    t.add_argument("--elastic", action="store_true",
+                   help="elastic multi-host recovery: on peer loss the "
+                   "surviving hosts rendezvous on the checkpoint "
+                   "filesystem, seal a shrunken generation-stamped "
+                   "membership, re-shard, restore the newest checkpoint, "
+                   "and continue -- instead of exiting 75 and waiting for "
+                   "a full-world restart. Requires --checkpoint-dir "
+                   "(docs/DISTRIBUTED.md 'Elastic recovery')")
+    t.add_argument("--min-hosts", type=int, default=1, metavar="N",
+                   help="smallest world --elastic may shrink to; a loss "
+                   "that would go below this exits 75 as without "
+                   "--elastic")
     t.add_argument("--allow-nonfinite", action="store_true",
                    help="count-and-quarantine NaN/Inf input rows at load "
                    "(they are DROPPED with a warning) instead of "
@@ -405,6 +417,8 @@ def main(argv=None) -> int:
             resume=args.resume,
             preempt_poll_iters=args.preempt_poll_iters,
             peer_timeout_s=args.peer_timeout,
+            elastic=args.elastic,
+            min_hosts=args.min_hosts,
         )
     except ValueError as e:
         print(str(e), file=sys.stderr)

@@ -214,6 +214,25 @@ class GMMConfig:
     # with a local emergency checkpoint instead of hanging forever in the
     # next collective. 0 disables the watchdog.
     peer_timeout_s: float = 60.0
+    # Elastic multi-host recovery (parallel/elastic.py;
+    # docs/DISTRIBUTED.md "Elastic recovery"): on PeerLostError the
+    # surviving hosts rendezvous on the checkpoint filesystem, seal a
+    # generation-stamped shrunken membership, recompute host_chunk_bounds
+    # over the survivors, restore the newest checkpoint, and refit --
+    # instead of exiting 75 and waiting for an external full-world
+    # restart. Requires checkpoint_dir (the rendezvous medium). Off by
+    # default: the exit-75 contract is unchanged unless opted into.
+    elastic: bool = False
+    # Smallest world elastic recovery may shrink to; a loss that would go
+    # below this gives up and exits 75 as today. >= 1.
+    min_hosts: int = 1
+    # Shrink attempts before elastic recovery gives up (each loss event
+    # consumes one; repeated losses of different peers each retry). >= 1.
+    elastic_max_retries: int = 2
+    # First-attempt pause before the rendezvous (doubles per attempt):
+    # lets a transient filesystem blip or a slow-but-alive peer settle
+    # before the world is resealed without it. >= 0.
+    elastic_backoff_s: float = 0.5
 
     # --- numerical fault containment (health.py; docs/ROBUSTNESS.md) ---
     # Health detection (the in-loop bitmask) is ALWAYS on -- it is a
@@ -432,6 +451,17 @@ class GMMConfig:
                 "(expected 'auto' or 'never')")
         if self.peer_timeout_s < 0:
             raise ValueError("peer_timeout_s must be >= 0 (0 disables)")
+        if self.elastic and not self.checkpoint_dir:
+            raise ValueError(
+                "elastic recovery requires checkpoint_dir: the checkpoint "
+                "filesystem is the survivors' rendezvous medium and the "
+                "resume source")
+        if self.min_hosts < 1:
+            raise ValueError("min_hosts must be >= 1")
+        if self.elastic_max_retries < 1:
+            raise ValueError("elastic_max_retries must be >= 1")
+        if self.elastic_backoff_s < 0:
+            raise ValueError("elastic_backoff_s must be >= 0")
         if self.recovery not in ("retry", "off"):
             raise ValueError(
                 f"unknown recovery: {self.recovery!r} "

@@ -226,6 +226,34 @@ class PipelinedBlockSource:
             self.delivered_order.append(j)
         return data
 
+    def reseek(self, *, start: int, stop: int, num_chunks: int,
+               local_data_size: Optional[int] = None) -> None:
+        """Rebind this source to a NEW ``host_chunk_bounds`` row range --
+        the elastic-recovery re-shard: after the world shrinks, each
+        survivor's slice of the event range changes, and re-seeking the
+        live source beats reopening the file (the readers' metadata cache
+        and the source handle survive). Supersedes any in-flight prefetch
+        generation; the next ``get_block(0)`` starts a fresh pass over the
+        new range. Telemetry counters continue to accumulate -- one
+        ``ingest_summary`` still describes the whole source lifetime."""
+        if self._closed:
+            raise RuntimeError("PipelinedBlockSource is closed")
+        S = int(local_data_size if local_data_size is not None
+                else self.local_data_size)
+        if int(num_chunks) % max(S, 1):
+            raise ValueError(
+                f"num_chunks {num_chunks} not divisible by the local "
+                f"data-axis extent {S}; derive slices with "
+                "parallel.distributed.host_chunk_bounds")
+        with self._lock:
+            self._gen += 1          # supersede any in-flight worker
+            self._queue = None      # next get_block cold-starts at j
+            self._next = 0
+            self.start, self.stop = int(start), int(stop)
+            self.num_chunks = int(num_chunks)
+            self.local_data_size = max(S, 1)
+            self.num_blocks = self.num_chunks // self.local_data_size
+
     def close(self):
         """Stop the worker and emit ``ingest_summary`` once (idempotent)."""
         if self._closed:

@@ -27,6 +27,7 @@ import numpy as np
 
 from .. import health, supervisor
 from ..config import GMMConfig
+from ..parallel import elastic
 from ..ops.formulas import convergence_epsilon, model_score
 from ..validation import InvalidInputError, validate_finite
 from ..ops.merge import eliminate_and_reduce
@@ -238,9 +239,14 @@ def _emit_run_summary(rec, config, timer, sweep_log, ideal_k, best_score,
         return
     first = em_walls[0] if em_walls else None
     warm = min(em_walls[1:]) if len(em_walls) > 1 else None
+    elastic_section = elastic.run_summary_section()
     fields = dict(
         **({"buckets": buckets} if buckets is not None else {}),
         **({"health": health_section} if health_section is not None else {}),
+        # Elastic recovery rollup (rev v2.0): present only when the run
+        # survived at least one shrink.
+        **({"elastic": elastic_section} if elastic_section is not None
+           else {}),
         # Which E-step backend actually ran (pallas / pallas-interpret /
         # jnp / custom; stream rev v1.5) -- mirrors run_start so a
         # summary-only consumer sees it too.
@@ -417,8 +423,27 @@ def fit_gmm(
             stack.enter_context(supervisor.use(supervisor.RunSupervisor(
                 max_runtime_s=config.max_runtime_s,
                 install_signals=False)))
-        return _fit_gmm(data, num_clusters, target_num_clusters, config,
-                        model, verbose, init_means, sample_weight)
+        # Elastic retry loop (docs/DISTRIBUTED.md "Elastic recovery"): a
+        # peer loss under --elastic shrinks the world via the checkpoint-FS
+        # rendezvous and REFITS (resume="auto" restores the newest step)
+        # instead of propagating to exit 75. Without --elastic, recovery
+        # is None and the first PeerLostError propagates unchanged.
+        recovery = None
+        while True:
+            try:
+                return _fit_gmm(data, num_clusters, target_num_clusters,
+                                config, model, verbose, init_means,
+                                sample_weight)
+            except supervisor.PeerLostError as e:
+                if recovery is None:
+                    recovery = supervisor.ElasticRecovery.maybe(config)
+                if recovery is None:
+                    raise
+                # The model survives the retry: its restart cache is
+                # world-keyed (_data_fingerprint), so arrays prepared
+                # under the old bounds can never serve the refit, and a
+                # live pipelined source re-seeks to the new bounds.
+                config = recovery.recover(e, config)
 
 
 def _fit_gmm(data, num_clusters, target_num_clusters, config, model,
@@ -531,7 +556,8 @@ def _fit_gmm(data, num_clusters, target_num_clusters, config, model,
         # that is GCS/NFS by construction; docs/DISTRIBUTED.md).
         ckpt = SweepCheckpointer(config.checkpoint_dir,
                                  keep=config.checkpoint_keep,
-                                 retries=config.checkpoint_retries)
+                                 retries=config.checkpoint_retries,
+                                 allow_world_change=config.elastic)
 
     sup = supervisor.current()
     if (sup.active and ckpt is not None and nproc > 1
@@ -540,12 +566,15 @@ def _fit_gmm(data, num_clusters, target_num_clusters, config, model,
         # checkpoint filesystem (multi-host runs already require one); a
         # peer stale beyond peer_timeout_s raises PeerLostError with a
         # local emergency checkpoint instead of hanging this rank forever
-        # in the next collective (supervisor.LivenessWatchdog).
+        # in the next collective (supervisor.LivenessWatchdog). An elastic
+        # refit watches only the sealed membership's survivors (original
+        # rank ids), never the rank it just shrank away.
         sup.start_watchdog(
             os.path.join(os.path.abspath(config.checkpoint_dir),
                          "heartbeats"),
-            rank=jax.process_index(), nproc=nproc,
-            timeout_s=config.peer_timeout_s)
+            rank=elastic.original_rank(), nproc=nproc,
+            timeout_s=config.peer_timeout_s,
+            peers=elastic.peer_ranks())
 
     # Health counters observed by a fused sweep that aborted on a fatal
     # word (the host-driven rerun below folds them into its summary).
@@ -1105,7 +1134,10 @@ def _data_fingerprint(data, source, sample_weight):
     dtype = str(getattr(obj, "dtype", ""))
     w = (None if sample_weight is None
          else (id(sample_weight), tuple(np.asarray(sample_weight).shape)))
-    return (id(obj), shape, dtype, w)
+    # The effective world is part of the data identity: an elastic shrink
+    # changes every survivor's host_chunk_bounds slice, so device arrays
+    # uploaded under the old world must never serve the refit.
+    return (id(obj), shape, dtype, w, elastic.world())
 
 
 def _prepare_fit(data, num_clusters, config, model, phase, log,
@@ -1130,7 +1162,12 @@ def _prepare_fit(data, num_clusters, config, model, phase, log,
     from ..ops.seeding import seed_state_from_parts
     from ..parallel.distributed import global_moments, host_chunk_bounds
 
-    pid, nproc = jax.process_index(), jax.process_count()
+    # The EFFECTIVE world: the elastic overlay when a shrink was sealed
+    # (survivor index / survivor count -- host_chunk_bounds then re-shards
+    # the full event range over the survivors), the launch runtime
+    # otherwise. Collectives must agree with it (elastic.py).
+    elastic.assert_world_coherent()
+    pid, nproc = elastic.world()
     source = data if hasattr(data, "read_range") else None
     dtype = np.dtype(config.dtype)
     if nproc > 1 and not hasattr(model, "prepare"):
@@ -1199,12 +1236,27 @@ def _prepare_fit(data, num_clusters, config, model, phase, log,
             s_local = (getattr(model, "_local_data_size", 1)
                        if getattr(model, "mesh", None) is not None else 1)
             chunks_np = wts_np = None
-            lazy_source = PipelinedBlockSource(
-                source, start=start, stop=stop,
-                chunk_size=config.chunk_size, num_chunks=num_chunks,
-                local_data_size=s_local,
-                shift=(shift if config.center_data else None),
-                dtype=dtype, queue_depth=config.ingest_queue_depth)
+            prior = cache.get("lazy_source") if cache is not None else None
+            if (prior is not None and prior.source is source
+                    and not prior._closed
+                    and prior.chunk_size == config.chunk_size):
+                # An elastic refit over the same file: re-seek the live
+                # source to the survivor's new host_chunk_bounds range
+                # (readers' metadata cache and file handle survive)
+                # instead of reopening it.
+                prior.reseek(start=start, stop=stop,
+                             num_chunks=num_chunks,
+                             local_data_size=s_local)
+                lazy_source = prior
+            else:
+                lazy_source = PipelinedBlockSource(
+                    source, start=start, stop=stop,
+                    chunk_size=config.chunk_size, num_chunks=num_chunks,
+                    local_data_size=s_local,
+                    shift=(shift if config.center_data else None),
+                    dtype=dtype, queue_depth=config.ingest_queue_depth)
+            if cache is not None:
+                cache["lazy_source"] = lazy_source
     else:
         with phase("cpu"):
             if source is not None:

@@ -261,6 +261,13 @@ class StreamingGMMModel(GMMModel):
         the block-major layout and the zero-weight padding contract, and
         it is host-local by construction (each rank's source covers only
         its own ``host_chunk_bounds`` row range)."""
+        from ..parallel import elastic
+
+        # Elastic worlds: fail loudly here rather than hang in the first
+        # end-of-pass psum if a sealed shrink diverged from the live
+        # multi-controller runtime (the runtime cannot drop ranks in
+        # process; docs/DISTRIBUTED.md "Elastic recovery").
+        elastic.assert_world_coherent()
         if hasattr(chunks_np, "get_block"):
             if self.mesh is not None and (
                     chunks_np.local_data_size != self._local_data_size):

@@ -181,6 +181,12 @@ def barrier(name: str = "gmm_barrier",
     daemon thread, which is fine because the caller's next act is an
     emergency checkpoint and a loud exit.
     """
+    # Deterministic collective_timeout chaos hook (testing.faults): fires
+    # BEFORE the single-process early return so the collective-loss leg of
+    # elastic recovery is rehearsable without a real multi-host mesh.
+    from . import elastic
+
+    elastic.take_collective_timeout(name, timeout_s)
     if jax.process_count() <= 1:
         return
     from jax.experimental import multihost_utils

@@ -304,6 +304,12 @@ class ShardedGMMModel:
         ``distributed.host_chunk_bounds``); the global sharded arrays are then
         assembled with zero cross-host traffic.
         """
+        from . import elastic
+
+        # Elastic worlds: a sealed shrink that diverged from the live
+        # multi-controller runtime must fail loudly here, not hang in the
+        # first psum on the dead ranks (docs/DISTRIBUTED.md).
+        elastic.assert_world_coherent()
         if jax.process_count() > 1:
             from .distributed import (
                 require_host_local_chunks, sharded_chunks_from_host_data,

@@ -210,6 +210,8 @@ class RunSupervisor:
 
         if faults.peek("rank_hang") is not None:
             self._maybe_hang(where=where, em_iter=em_iter)
+        if not self._stop.is_set():
+            self._maybe_rank_lost(where=where, em_iter=em_iter, block=-1)
         if not self._stop.is_set() and em_iter is not None:
             # block=-1: a spec targeting a specific streaming block must
             # only fire from poll_block, never at a segment boundary.
@@ -231,6 +233,9 @@ class RunSupervisor:
 
         if faults.peek("rank_hang") is not None:
             self._maybe_hang(where="stream_block", em_iter=em_iter)
+        if not self._stop.is_set():
+            self._maybe_rank_lost(where="stream_block", em_iter=em_iter,
+                                  block=block)
         if not self._stop.is_set():
             if faults.take("preempt", iter=em_iter, block=block) is not None:
                 self._reason = "preempt_injected"
@@ -272,6 +277,73 @@ class RunSupervisor:
         while True:  # pragma: no cover - killed externally
             time.sleep(3600.0)
 
+    def _maybe_rank_lost(self, *, where: str, em_iter: Optional[int],
+                         block: int) -> None:
+        """Honor an armed ``rank_lost`` injection: behave exactly as if
+        the liveness watchdog had just declared the spec's peer dead --
+        WITHOUT any process dying -- so the elastic shrink path (and the
+        exit-75 fallback when ``--elastic`` is off) is rehearsable
+        deterministically on a single process. Gating mirrors ``preempt``:
+        an ``iter``/``block``-targeted spec never fires at a between-K
+        poll, and ``where`` (optional) pins one poll site."""
+        from .testing import faults
+
+        cfg = faults.peek("rank_lost")
+        if cfg is None:
+            return
+        if em_iter is None:
+            # Between-K (sweep/fleet) poll: only an untargeted spec --
+            # or one pinned to this site via ``where`` -- may fire here.
+            if "iter" in cfg or "block" in cfg:
+                return
+            cfg = faults.take("rank_lost", where=where)
+        else:
+            cfg = faults.take("rank_lost", where=where, iter=em_iter,
+                              block=block)
+        if cfg is None:
+            return
+        self._synthesize_peer_loss(
+            rank=int(cfg.get("rank", 1)),
+            timeout_s=float(cfg.get("timeout_s",
+                                    self.collective_timeout_s or 0.0)))
+
+    def _synthesize_peer_loss(self, *, rank: int,
+                              timeout_s: float = 0.0,
+                              age_s: Optional[float] = None) -> None:
+        """The watchdog's declare-dead sequence, minus the forced-exit
+        escalation thread: the poll that invokes this returns True
+        immediately, so the main thread is by construction not wedged."""
+        self._lost_peer = {"rank": int(rank),
+                           "age_s": round(float(age_s if age_s is not None
+                                                else timeout_s), 3),
+                           "timeout_s": float(timeout_s)}
+        from . import telemetry
+        from .utils.logging_ import get_logger
+
+        get_logger().error(
+            "peer rank %d declared lost (injected rank_lost fault)", rank)
+        rec = telemetry.current()
+        if rec.active:
+            rec.emit("peer_lost", rank=int(rank),
+                     timeout_s=float(timeout_s),
+                     age_s=self._lost_peer["age_s"])
+            rec.metrics.count("peer_losses")
+        if self._watchdog is not None:
+            self.stop_watchdog()
+        self.request_stop("peer_lost")
+
+    def reset_for_retry(self) -> None:
+        """Re-arm the supervisor for an elastic refit: drop the consumed
+        stop (and the peer it blamed) so the surviving world's next fit
+        polls clean. Signal handlers and the wall-clock deadline persist
+        -- the runtime budget spans the whole run, shrinks included."""
+        self.stop_watchdog()
+        self._stop = threading.Event()
+        self._stop_consumed = threading.Event()
+        self._reason = None
+        self._lost_peer = None
+        self._preempt_emitted = False
+
     def _emit_preempt(self, *, where: str, k=None, em_iter=None) -> None:
         with self._lock:
             if self._preempt_emitted:
@@ -295,11 +367,15 @@ class RunSupervisor:
 
     def start_watchdog(self, directory: str, *, rank: int, nproc: int,
                        timeout_s: float,
-                       interval_s: Optional[float] = None) -> None:
+                       interval_s: Optional[float] = None,
+                       peers: Optional[List[int]] = None) -> None:
         """Start (idempotently) the cross-host liveness watchdog. Runs
         until :meth:`uninstall`; a stale peer trips the stop flag with
         reason ``peer_lost`` and the next poll raises
-        :class:`PeerLostError` after the emergency checkpoint."""
+        :class:`PeerLostError` after the emergency checkpoint. ``peers``
+        (original rank ids) overrides the default everyone-but-me set --
+        an elastic refit watches only the sealed membership's survivors,
+        never the rank it just shrank away."""
         if self._watchdog is not None:
             return
 
@@ -353,7 +429,7 @@ class RunSupervisor:
 
         self._watchdog = LivenessWatchdog(
             directory, rank=rank, nproc=nproc, timeout_s=timeout_s,
-            interval_s=interval_s, on_peer_lost=on_lost)
+            interval_s=interval_s, on_peer_lost=on_lost, peers=peers)
         self._watchdog.start()
 
     def stop_watchdog(self) -> None:
@@ -428,25 +504,38 @@ class LivenessWatchdog(threading.Thread):
     already require (GCS/NFS on pods) -- deliberately NOT a device
     collective: a collective heartbeat from a background thread would
     interleave with the main thread's compute collectives, and a hung
-    peer is precisely the case where collectives stop returning. Ages
-    compare this host's clock to the file's mtime; NFS/GCS keep those
-    within seconds, and ``timeout_s`` should dwarf worst-case skew.
+    peer is precisely the case where collectives stop returning.
+
+    Staleness is READER-LOCAL: a peer's age is this watchdog's monotonic
+    time since it last OBSERVED that peer's heartbeat mtime change --
+    never a cross-host wall-clock difference. A peer whose clock is
+    skewed hours into the past (or future) keeps producing mtime
+    *changes* at the heartbeat cadence and is therefore never falsely
+    declared dead; only a genuinely frozen file ages out.
     """
 
     def __init__(self, directory: str, *, rank: int, nproc: int,
                  timeout_s: float, interval_s: Optional[float] = None,
-                 on_peer_lost: Optional[Callable[[int, float], None]] = None):
+                 on_peer_lost: Optional[Callable[[int, float], None]] = None,
+                 peers: Optional[List[int]] = None):
         super().__init__(name="gmm-liveness-watchdog", daemon=True)
         self.directory = directory
         self.rank = int(rank)
         self.nproc = int(nproc)
+        self.peers = (tuple(int(p) for p in peers if int(p) != int(rank))
+                      if peers is not None
+                      else tuple(p for p in range(self.nproc)
+                                 if p != self.rank))
         self.timeout_s = float(timeout_s)
         self.interval_s = float(interval_s if interval_s is not None
                                 else min(max(self.timeout_s / 4.0, 0.2), 5.0))
         self._on_peer_lost = on_peer_lost
         self._stopped = threading.Event()
         self._writing = True
-        self._started_at = time.time()
+        self._started_mono = time.monotonic()
+        # peer -> (last observed mtime, monotonic instant of that
+        # observation): the reader-local staleness clock.
+        self._seen: Dict[int, tuple] = {}
 
     def stop(self) -> None:
         self._stopped.set()
@@ -477,19 +566,135 @@ class LivenessWatchdog(threading.Thread):
     def check_peers(self):
         """(rank, age_s) of the stalest over-timeout peer, else None. A
         peer that never wrote yet ages from this watchdog's start (ranks
-        come up seconds apart; the timeout doubles as the grace window)."""
+        come up seconds apart; the timeout doubles as the grace window).
+
+        Ages are reader-local monotonic deltas since the last observed
+        mtime CHANGE -- mtime values are only compared for equality,
+        never against this host's clock, so cross-host clock skew (or an
+        NTP step on the peer) cannot fake a stale heartbeat."""
         from .parallel import distributed
 
-        now = time.time()
+        now = time.monotonic()
         worst = None
-        for peer in range(self.nproc):
-            if peer == self.rank:
-                continue
+        for peer in self.peers:
             mtime = distributed.read_rank_heartbeat(self.directory, peer)
-            age = now - (mtime if mtime is not None else self._started_at)
+            seen = self._seen.get(peer)
+            if seen is None or seen[0] != mtime:
+                # First sight, or the file changed since last check:
+                # restart this peer's staleness clock at now. A missing
+                # file keeps the watchdog-start epoch as its baseline.
+                base = (self._started_mono if mtime is None else now)
+                self._seen[peer] = (mtime, base)
+                seen = self._seen[peer]
+            age = now - seen[1]
             if age > self.timeout_s and (worst is None or age > worst[1]):
                 worst = (peer, age)
         return worst
+
+
+class ElasticRecovery:
+    """Bounded shrink-and-continue driver for :class:`PeerLostError`.
+
+    The drivers (``fit_gmm``, the fleet loop) wrap their fit in::
+
+        while True:
+            try:
+                return _fit(...)
+            except PeerLostError as e:
+                recovery = recovery or ElasticRecovery.maybe(config)
+                if recovery is None:
+                    raise                       # exit 75, as today
+                config = recovery.recover(e, config)
+
+    Each recovery attempt backs off (``elastic_backoff_s`` doubling),
+    rendezvouses the survivors on the checkpoint filesystem
+    (``parallel.elastic``), adopts the sealed membership as the world
+    overlay, re-arms the supervisor, and returns a config with
+    ``resume="auto"`` so the refit restores the newest checkpoint.
+    After ``elastic_max_retries`` exhausted attempts -- or a shrink
+    below ``min_hosts`` -- the original error propagates and the run
+    exits 75 exactly as a non-elastic peer loss would.
+    """
+
+    def __init__(self):
+        self.attempt = 0
+
+    @staticmethod
+    def maybe(config) -> Optional["ElasticRecovery"]:
+        """An ElasticRecovery when the config opted in (``--elastic``
+        plus a checkpoint dir -- the rendezvous medium), else None."""
+        if getattr(config, "elastic", False) \
+                and getattr(config, "checkpoint_dir", None):
+            return ElasticRecovery()
+        return None
+
+    def recover(self, exc: PeerLostError, config):
+        """One shrink: rendezvous the survivors, adopt the new world,
+        return the refit config. Re-raises ``exc`` when recovery is out
+        of budget, the lost rank is unidentifiable, or the world would
+        shrink below ``min_hosts``."""
+        import dataclasses
+
+        from . import telemetry
+        from .parallel import elastic
+        from .utils.logging_ import get_logger
+
+        log = get_logger()
+        self.attempt += 1
+        max_retries = int(getattr(config, "elastic_max_retries", 2))
+        if self.attempt > max_retries:
+            log.error("elastic recovery budget exhausted (%d attempts); "
+                      "giving up", max_retries)
+            raise exc
+        if exc.rank is None:
+            log.error("peer loss without an identifiable rank; cannot "
+                      "shrink -- giving up")
+            raise exc
+        backoff = (float(getattr(config, "elastic_backoff_s", 0.5))
+                   * (2.0 ** (self.attempt - 1)))
+        if backoff > 0:
+            time.sleep(backoff)
+
+        mdir = elastic.membership_dir(config.checkpoint_dir)
+        prev = elastic.read_membership(mdir)
+        my_rank = elastic.original_rank()
+        if prev is None:
+            _, nproc0 = elastic.world()
+            prev = elastic.Membership(generation=0,
+                                      ranks=tuple(range(nproc0)),
+                                      world_size0=nproc0)
+        window = min(max(float(getattr(config, "peer_timeout_s", 60.0)),
+                         1.0), 30.0)
+        sealed = elastic.rendezvous(mdir, my_rank=my_rank, prev=prev,
+                                    lost=(int(exc.rank),),
+                                    window_s=window)
+        min_hosts = int(getattr(config, "min_hosts", 1))
+        if sealed.world_size < min_hosts:
+            log.error("elastic shrink to %d host(s) is below --min-hosts "
+                      "%d; giving up", sealed.world_size, min_hosts)
+            raise exc
+        elastic.set_world_overlay(sealed, my_rank)
+        elastic.note_shrink()
+        current().reset_for_retry()
+        log.warning(
+            "elastic recovery: generation %d sealed with %d/%d host(s) "
+            "%s (lost rank %d, attempt %d/%d); resuming from checkpoint",
+            sealed.generation, sealed.world_size, prev.world_size,
+            list(sealed.ranks), int(exc.rank), self.attempt, max_retries)
+        rec = telemetry.current()
+        if rec.active:
+            rec.emit("elastic_shrink", generation=int(sealed.generation),
+                     survivors=[int(r) for r in sealed.ranks],
+                     world_size=int(sealed.world_size),
+                     lost_ranks=[int(exc.rank)], attempt=int(self.attempt),
+                     min_hosts=min_hosts)
+            rec.metrics.count("elastic_shrinks")
+        elastic.note_resume()
+        if rec.active:
+            rec.emit("elastic_resume", generation=int(sealed.generation),
+                     attempt=int(self.attempt),
+                     world_size=int(sealed.world_size))
+        return dataclasses.replace(config, resume="auto")
 
 
 _NULL = _NullSupervisor()

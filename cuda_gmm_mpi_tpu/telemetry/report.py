@@ -90,6 +90,8 @@ def render_report(records: List[dict], max_trajectory_rows: int = 400) -> str:
     preempts = [r for r in records if r.get("event") == "preempt"]
     shutdowns = [r for r in records if r.get("event") == "shutdown"]
     peer_losts = [r for r in records if r.get("event") == "peer_lost"]
+    shrinks = [r for r in records if r.get("event") == "elastic_shrink"]
+    resumes = [r for r in records if r.get("event") == "elastic_resume"]
 
     for s in starts:
         out.append(_fmt_run_start(s))
@@ -306,12 +308,28 @@ def render_report(records: List[dict], max_trajectory_rows: int = 400) -> str:
                        f"{r.get('error')}{tail}")
         out.append("")
 
-    if preempts or shutdowns or peer_losts:
+    if preempts or shutdowns or peer_losts or shrinks or resumes:
         out.append("Run lifecycle (preemption; docs/ROBUSTNESS.md):")
         for r in peer_losts:
             out.append(f"  peer_lost rank={r.get('rank')} heartbeat "
                        f"stale {r.get('age_s', '?')}s > timeout "
                        f"{r.get('timeout_s', '?')}s")
+        for r in shrinks:
+            survivors = r.get("survivors") or []
+            lost = ",".join(str(x) for x in (r.get("lost_ranks") or []))
+            out.append(f"  elastic_shrink gen={r.get('generation')} -> "
+                       f"{r.get('world_size')} host(s) {survivors}"
+                       + (f" (lost rank {lost})" if lost else "")
+                       + (f" attempt={r['attempt']}"
+                          if r.get("attempt") is not None else ""))
+        for r in resumes:
+            pos = ""
+            if r.get("step") is not None:
+                pos = f" from step {r['step']}"
+                if r.get("k") is not None:
+                    pos += f" (K={r['k']})"
+            out.append(f"  elastic_resume gen={r.get('generation')} "
+                       f"continued the sweep{pos}")
         for r in preempts:
             pos = ""
             if r.get("k") is not None:
@@ -363,6 +381,13 @@ def render_report(records: List[dict], max_trajectory_rows: int = 400) -> str:
                        int(hs.get("io_retries", 0))))
             else:
                 out.append("Health: clean (all flags zero)")
+        el = s.get("elastic")
+        if el:
+            out.append(
+                f"Elastic: generation {el.get('generation')} "
+                f"({el.get('world_size')} host(s) at finish, "
+                f"{el.get('shrinks', 0)} shrink(s), "
+                f"{el.get('resumes', 0)} resume(s))")
         backend = (f"  [backend={s['em_backend']}]"
                    if s.get("em_backend") else "")
         out.append(

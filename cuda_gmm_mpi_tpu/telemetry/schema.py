@@ -138,6 +138,22 @@ EVENT_FIELDS: Dict[str, Tuple[Tuple[str, ...], Tuple[str, ...]]] = {
         ("rank", "timeout_s"),
         ("age_s",),
     ),
+    # Elastic recovery (stream rev v2.0; parallel/elastic.py,
+    # docs/DISTRIBUTED.md "Elastic recovery"): the survivors sealed a
+    # shrunken membership generation after a peer loss -- ``survivors``
+    # are the surviving ORIGINAL rank ids, ``world_size`` the new world.
+    # Emitted once per shrink by every surviving rank.
+    "elastic_shrink": (
+        ("generation", "survivors", "world_size"),
+        ("lost_ranks", "attempt", "min_hosts"),
+    ),
+    # The shrunken world resumed the sweep from the newest checkpoint
+    # (rev v2.0): pairs with the preceding elastic_shrink; ``attempt``
+    # counts recovery rounds within one run.
+    "elastic_resume": (
+        ("generation", "attempt"),
+        ("step", "k", "world_size"),
+    ),
     # One per n_init > 1 fit (stream rev v1.4): which restart won and
     # every init's best criterion score (NaN/Inf scores are null).
     # ``mode`` is batched / sequential; ``batch_size`` the restart batch
@@ -249,10 +265,14 @@ EVENT_FIELDS: Dict[str, Tuple[Tuple[str, ...], Tuple[str, ...]]] = {
     # {flags, flag_names, fatal, counters, recoveries, io_retries};
     # all-zero flags on a clean run (docs/ROBUSTNESS.md).
     # ``em_backend`` (optional, rev v1.5) mirrors run_start's.
+    # ``elastic`` (optional, rev v2.0): present only when the run
+    # survived at least one elastic shrink -- {generation, world_size,
+    # shrinks, resumes}.
     "run_summary": (
         ("ideal_k", "score", "criterion", "final_loglik", "total_iters",
          "wall_s", "phase_profile", "compile", "metrics"),
-        ("per_process", "memory_stats", "buckets", "health", "em_backend"),
+        ("per_process", "memory_stats", "buckets", "health", "em_backend",
+         "elastic"),
     ),
 }
 

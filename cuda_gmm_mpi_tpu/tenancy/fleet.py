@@ -203,6 +203,10 @@ def _fit_fleet(tenants, config, model, verbose) -> FleetResult:
 
     out: List[Optional[TenantResult]] = [None] * len(tenants)
     group_meta: List[dict] = []
+    # One elastic-recovery budget spans the whole fleet fit: a peer loss
+    # during any group shrinks the world once and every LATER group fits
+    # over the survivors too (membership generations only move forward).
+    recovery = None
     for gi, group in enumerate(groups):
         packed = pack_group(group, tenants, config,
                             data_axis=int(getattr(model, "data_size", 1)))
@@ -215,10 +219,23 @@ def _fit_fleet(tenants, config, model, verbose) -> FleetResult:
             ckpt = SweepCheckpointer(
                 os.path.join(config.checkpoint_dir, f"group{gi}"),
                 keep=config.checkpoint_keep,
-                retries=config.checkpoint_retries)
+                retries=config.checkpoint_retries,
+                allow_world_change=config.elastic)
         t0 = time.perf_counter()
-        results = _run_group(model, config, packed, ckpt, rec, log,
-                             verbose, mode, gi)
+        while True:
+            try:
+                results = _run_group(model, config, packed, ckpt, rec, log,
+                                     verbose, mode, gi)
+                break
+            except supervisor.PeerLostError as e:
+                # Per-group elastic continue: shrink + resume THIS group
+                # from its own checkpoint subdirectory; completed groups'
+                # results are already in ``out`` and are not refitted.
+                if recovery is None:
+                    recovery = supervisor.ElasticRecovery.maybe(config)
+                if recovery is None:
+                    raise
+                config = recovery.recover(e, config)
         group_meta.append({
             "tenants": len(group.indices),
             "n_bucket": int(group.n_bucket),

@@ -49,6 +49,22 @@ Supported fault kinds (the spec is ``{kind: {params...}}``):
   or stuck host so the PEER's liveness watchdog (``PeerLostError`` +
   emergency checkpoint) can be rehearsed. The wedged process never
   returns; the test harness kills it.
+- ``rank_lost`` ``{"rank": r, "iter": i, "block": j, "where": w,
+  "times": n}`` -- the run supervisor's poll behaves as if the liveness
+  watchdog had just declared peer ``r`` dead (stale heartbeat), emitting
+  ``peer_lost`` and tripping the stop flag, WITHOUT any process actually
+  dying: the deterministic single-process driver for the elastic
+  shrink-and-continue path (``--elastic``) and its exit-75 fallback.
+  ``iter``/``block`` target one EM iteration / streaming block exactly
+  like ``preempt`` (segment-boundary polls match ``block: -1``);
+  ``where`` targets one poll site (e.g. ``sweep`` for between-K).
+  Consumed at the poll, host side.
+- ``collective_timeout`` ``{"name": b, "rank": r, "times": k}`` -- the
+  named filesystem-rendezvous barrier (``parallel.distributed.barrier``;
+  any barrier when ``name`` is omitted) raises the same
+  :class:`PeerLostError` a real timeout would, with ``rank`` as the
+  blamed peer, before any waiting happens -- so the collective-loss leg
+  of elastic recovery is rehearsable on one process.
 - ``serve_nan`` ``{"model": name, "times": n}`` -- the serving loop's
   coalesced dispatch for ``model`` (any model when omitted) returns
   all-NaN scores, standing in for a poisoned registry artifact so the
@@ -82,8 +98,9 @@ from typing import Any, Dict, Optional
 ENV_VAR = "GMM_FAULTS"
 
 KNOWN_KINDS = ("nan_loglik", "singular_cov", "poison_block", "read_slow",
-               "checkpoint_eio", "preempt", "rank_hang",
-               "serve_nan", "serve_slow", "registry_torn")
+               "checkpoint_eio", "preempt", "rank_hang", "rank_lost",
+               "collective_timeout", "serve_nan", "serve_slow",
+               "registry_torn")
 
 
 def _values_match(spec_val: Any, val: Any) -> bool:

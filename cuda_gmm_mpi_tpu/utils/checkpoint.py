@@ -125,7 +125,12 @@ def write_npz_atomic(directory: str, target: str,
     The write path every callback-safe checkpoint and registry artifact
     shares: the payload must survive a HOST crash, not just a process
     kill, so the data is fsynced before the atomic rename and the
-    directory entry after it. The tmp name is mkstemp-unique so
+    directory entry after it -- without the directory fsync a crash can
+    lose the RENAME and the restore walk-back would see its "newest"
+    step vanish. The directory fsync is POSIX-gated: Windows cannot
+    ``os.open`` a directory (rename durability is the filesystem's
+    business there), and crashing on the gate would un-durably fail a
+    write that already succeeded. The tmp name is mkstemp-unique so
     concurrent savers can never interleave writes into one file.
     """
     import tempfile
@@ -136,6 +141,13 @@ def write_npz_atomic(directory: str, target: str,
         f.flush()
         os.fsync(f.fileno())
     os.replace(tmp, target)
+    _fsync_dir(directory)
+
+
+def _fsync_dir(directory: str) -> None:
+    """POSIX-only durability fsync of a directory entry after a rename."""
+    if os.name != "posix":
+        return
     dir_fd = os.open(directory, os.O_RDONLY)
     try:
         os.fsync(dir_fd)
@@ -174,7 +186,8 @@ class SweepCheckpointer:
     reference envelope) on the checkpoint filesystem.
     """
 
-    def __init__(self, directory: str, keep: int = 2, retries: int = 3):
+    def __init__(self, directory: str, keep: int = 2, retries: int = 3,
+                 allow_world_change: bool = False):
         import orbax.checkpoint as ocp
 
         self._dir = os.path.abspath(os.path.join(directory, "sweep"))
@@ -182,8 +195,46 @@ class SweepCheckpointer:
         self._ckpt = ocp.StandardCheckpointer()
         self._keep = max(1, keep)
         self._retries = max(0, retries)
+        # Elastic runs restore checkpoints written at a DIFFERENT world
+        # size by design (the sweep state is replicated, so any world can
+        # restore it); non-elastic runs treat that as the misconfiguration
+        # it is and fail the walk-back with an explicit mismatch message.
+        self._allow_world_change = bool(allow_world_change)
         # Transient-failure retries observed so far (run_summary.health).
         self.io_retries = 0
+
+    @staticmethod
+    def _world_meta() -> Dict[str, Any]:
+        """World-size/generation stamp every save carries, so restore can
+        DIAGNOSE a world mismatch instead of surfacing it later as a
+        shape-mismatch traceback from deep inside npz loading."""
+        from ..parallel import elastic
+
+        return {"ckpt_world_size": np.asarray(elastic.world()[1],
+                                              np.int64),
+                "ckpt_generation": np.asarray(elastic.generation(),
+                                              np.int64)}
+
+    def _validate_meta(self, tree: Dict[str, Any], step: int) -> None:
+        """Raise an informative error when a stamped checkpoint was
+        written at a different world size and this run did not opt into
+        elastic world changes. Legacy checkpoints (no stamp) skip the
+        check. Runs inside the restore walk-back, so the message lands in
+        the aggregated :class:`CheckpointRestoreError`."""
+        if "ckpt_world_size" not in tree:
+            return
+        from ..parallel import elastic
+
+        saved_world = int(np.asarray(tree["ckpt_world_size"]))
+        saved_gen = int(np.asarray(tree.get("ckpt_generation", 0)))
+        here = int(elastic.world()[1])
+        if saved_world != here and not self._allow_world_change:
+            raise ValueError(
+                f"checkpoint step {step} was written at world size "
+                f"{saved_world} (membership generation {saved_gen}) but "
+                f"this run has {here} host(s); resume at the original "
+                "world size, or pass --elastic to accept a shrunken "
+                "world (docs/DISTRIBUTED.md 'Elastic recovery')")
 
     def _write_with_retries(self, op: str, step: int,
                             write: Callable[[], None]) -> bool:
@@ -287,7 +338,7 @@ class SweepCheckpointer:
         across ranks whether an attempt fails or succeeds (injected
         faults fire identically everywhere by construction).
         """
-        tree = dict(payload)
+        tree = dict(payload, **self._world_meta())
         tree["state"] = _to_tree(payload["state"])
         tree["best_state"] = _to_tree(payload["best_state"])
         path = os.path.join(self._dir, str(step))
@@ -316,7 +367,7 @@ class SweepCheckpointer:
 
         if jax.process_index() != 0:
             return
-        flat = self._flatten(payload)
+        flat = self._flatten(dict(payload, **self._world_meta()))
         target = os.path.join(self._dir, f"{step}.npz")
 
         # Bounded retry: this runs inside the ordered io_callback while
@@ -346,7 +397,8 @@ class SweepCheckpointer:
 
         if jax.process_index() != 0:
             return True
-        flat = self._flatten(dict(payload, em_iter=np.int64(em_iter)))
+        flat = self._flatten(dict(payload, em_iter=np.int64(em_iter),
+                                  **self._world_meta()))
         target = os.path.join(self._dir, f"{step}.iter{em_iter}.npz")
         ok = self._write_with_retries(
             "save_substep", step,
@@ -465,6 +517,7 @@ class SweepCheckpointer:
             path = os.path.join(self._dir, f"{s}.iter{i}.npz")
             try:
                 tree = _load_npz_tree(path)
+                self._validate_meta(tree, s)
             except Exception as e:
                 import warnings
 
@@ -486,6 +539,7 @@ class SweepCheckpointer:
             tree = self._ckpt.restore(os.path.join(self._dir, str(step)))
             tree["state"] = _from_tree(tree["state"])
             tree["best_state"] = _from_tree(tree["best_state"])
+        self._validate_meta(tree, step)
         tree["step"] = step
         return tree
 

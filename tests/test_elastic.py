@@ -1,0 +1,618 @@
+"""Elastic multi-host recovery (parallel/elastic.py; supervisor.
+ElasticRecovery; docs/DISTRIBUTED.md "Elastic recovery").
+
+The reference is fail-stop: one dead MPI rank kills or wedges the whole
+job. PR 4 upgraded the wedge to a loud exit 75; this round upgrades exit
+75 to *continuing*: survivors rendezvous on the checkpoint filesystem,
+seal a generation-stamped shrunken membership, adopt it as the world
+overlay, and refit from the newest checkpoint. The tier-1 tests here run
+the whole arc on ONE process via the simulated-membership harness (a
+pre-seeded 2-host generation-0 file plus an injected ``rank_lost``
+fault); the cross-process rendezvous protocol itself is exercised by the
+slow-marked multi-process test at the bottom.
+"""
+
+import json
+import os
+import subprocess
+import sys
+import time
+
+import numpy as np
+import pytest
+
+from cuda_gmm_mpi_tpu import GMMConfig, fit_gmm, supervisor
+from cuda_gmm_mpi_tpu.parallel import distributed, elastic
+from cuda_gmm_mpi_tpu.supervisor import (LivenessWatchdog, PeerLostError,
+                                         RunSupervisor)
+from cuda_gmm_mpi_tpu.testing import faults
+from cuda_gmm_mpi_tpu.utils import checkpoint as ckpt_mod
+
+from .conftest import communicate_or_kill, worker_env
+
+CLI = [sys.executable, "-m", "cuda_gmm_mpi_tpu.cli"]
+
+
+@pytest.fixture(autouse=True)
+def _clean_elastic_state():
+    """Module-level overlay/counters are process-wide; never leak them."""
+    elastic.reset()
+    yield
+    elastic.reset()
+
+
+def _sup():
+    return RunSupervisor(install_signals=False)
+
+
+def _cfg(ck, **kw):
+    base = dict(min_iters=8, max_iters=8, chunk_size=512, dtype="float64",
+                checkpoint_dir=ck, preempt_poll_iters=1, seed=3,
+                elastic_backoff_s=0.0)
+    base.update(kw)
+    return GMMConfig(**base)
+
+
+def _seed_two_hosts(ck):
+    """Pre-seed a generation-0 membership naming this process rank 0 of a
+    2-host world -- the single-process chaos harness's world on paper."""
+    mdir = elastic.membership_dir(ck)
+    elastic.write_membership(
+        mdir, elastic.Membership(generation=0, ranks=(0, 1), world_size0=2))
+    return mdir
+
+
+@pytest.fixture
+def blobs3(rng):
+    centers = rng.normal(scale=8.0, size=(3, 3))
+    return (centers[rng.integers(0, 3, 3000)]
+            + rng.normal(size=(3000, 3))).astype(np.float64)
+
+
+# ---------------------------------------------------------------------------
+# membership files
+# ---------------------------------------------------------------------------
+
+
+def test_membership_roundtrip_and_newest_generation(tmp_path):
+    d = str(tmp_path / "membership")
+    for g, ranks in ((0, (0, 1, 2, 3)), (2, (0, 3)), (1, (0, 1, 3))):
+        elastic.write_membership(
+            d, elastic.Membership(generation=g, ranks=ranks, world_size0=4))
+    newest = elastic.read_membership(d)
+    assert newest.generation == 2 and newest.ranks == (0, 3)
+    assert newest.world_size == 2 and newest.world_size0 == 4
+    g1 = elastic.read_membership(d, generation=1)
+    assert g1.ranks == (0, 1, 3)
+    # Positions in the sorted tuple are the new contiguous ranks.
+    assert newest.index_of(3) == 1 and newest.index_of(1) is None
+    # No tmp litter from the atomic publish.
+    assert not [f for f in os.listdir(d) if ".tmp" in f]
+
+
+def test_membership_missing_or_torn_reads_none(tmp_path):
+    d = str(tmp_path / "membership")
+    assert elastic.read_membership(d) is None
+    os.makedirs(d)
+    with open(os.path.join(d, "gen3.json"), "w") as f:
+        f.write('{"generation": 3, "ranks": [0')  # torn write
+    assert elastic.read_membership(d, generation=3) is None
+
+
+# ---------------------------------------------------------------------------
+# rendezvous protocol (single process; real two-process variant is below)
+# ---------------------------------------------------------------------------
+
+
+def test_rendezvous_coordinator_seals_when_all_announced(tmp_path):
+    d = str(tmp_path / "m")
+    prev = elastic.Membership(generation=0, ranks=(0, 1, 2), world_size0=3)
+    elastic.announce_alive(d, 1, 1)  # the other survivor is already in
+    sealed = elastic.rendezvous(d, my_rank=0, prev=prev, lost=(2,),
+                                window_s=5.0)
+    assert sealed.generation == 1 and sealed.ranks == (0, 1)
+    assert sealed.world_size0 == 3
+    # Published durably: a fresh read sees the same sealed world.
+    again = elastic.read_membership(d, generation=1)
+    assert again == sealed
+
+
+def test_rendezvous_window_close_seals_partial_survivors(tmp_path):
+    """A survivor that never announces within the window is left out:
+    the sealed world is the ANNOUNCED intersection, not the hoped-for
+    one, so the refit cannot hang waiting on a second dead peer."""
+    d = str(tmp_path / "m")
+    prev = elastic.Membership(generation=0, ranks=(0, 1, 2), world_size0=3)
+    t0 = time.monotonic()
+    sealed = elastic.rendezvous(d, my_rank=0, prev=prev, lost=(2,),
+                                window_s=0.2, poll_s=0.02)
+    assert time.monotonic() - t0 < 5.0
+    assert sealed.generation == 1 and sealed.ranks == (0,)
+
+
+def test_rendezvous_lost_and_excluded_ranks_raise(tmp_path):
+    d = str(tmp_path / "m")
+    prev = elastic.Membership(generation=0, ranks=(0, 1), world_size0=2)
+    with pytest.raises(PeerLostError):
+        elastic.rendezvous(d, my_rank=1, prev=prev, lost=(1,))
+    # Announced too late: generation already sealed without me.
+    elastic.write_membership(
+        d, elastic.Membership(generation=1, ranks=(0,), world_size0=2))
+    with pytest.raises(PeerLostError):
+        elastic.rendezvous(d, my_rank=1, prev=prev, lost=(2,), window_s=0.2)
+
+
+def test_rendezvous_noncoordinator_reads_published_or_times_out(tmp_path):
+    d = str(tmp_path / "m")
+    prev = elastic.Membership(generation=0, ranks=(0, 1, 2), world_size0=3)
+    # Published already: the non-coordinator adopts it without waiting.
+    elastic.write_membership(
+        d, elastic.Membership(generation=1, ranks=(0, 1), world_size0=3))
+    sealed = elastic.rendezvous(d, my_rank=1, prev=prev, lost=(2,),
+                                window_s=0.2)
+    assert sealed.ranks == (0, 1)
+    # Dead coordinator: bounded poll, then PeerLostError blaming IT.
+    d2 = str(tmp_path / "m2")
+    with pytest.raises(PeerLostError) as ei:
+        elastic.rendezvous(d2, my_rank=1, prev=prev, lost=(2,),
+                           window_s=0.2, poll_s=0.02)
+    assert ei.value.rank == 0
+
+
+def test_rendezvous_deterministic_for_survivor_set(tmp_path):
+    """Same survivor set -> same sealed membership, independent of the
+    order announcements landed (sorted ranks, single writer)."""
+    prev = elastic.Membership(generation=4, ranks=(1, 3, 5, 7),
+                              world_size0=8)
+    sealed = []
+    for trial, order in enumerate(((3, 7), (7, 3))):
+        d = str(tmp_path / f"m{trial}")
+        for r in order:
+            elastic.announce_alive(d, 5, r)
+        sealed.append(elastic.rendezvous(d, my_rank=1, prev=prev,
+                                         lost=(5,), window_s=5.0))
+    assert sealed[0] == sealed[1]
+    assert sealed[0].generation == 5 and sealed[0].ranks == (1, 3, 7)
+
+
+# ---------------------------------------------------------------------------
+# the world overlay
+# ---------------------------------------------------------------------------
+
+
+def test_world_overlay_and_run_summary_section():
+    assert elastic.current_membership() is None
+    assert elastic.generation() == 0
+    assert elastic.peer_ranks() is None
+    assert elastic.run_summary_section() is None
+
+    m = elastic.Membership(generation=2, ranks=(0, 3, 5), world_size0=6)
+    elastic.set_world_overlay(m, 3)
+    assert elastic.world() == (1, 3)  # contiguous rank over survivors
+    assert elastic.original_rank() == 3
+    assert elastic.peer_ranks() == [0, 5]
+    assert elastic.generation() == 2
+    elastic.note_shrink()
+    elastic.note_resume()
+    sec = elastic.run_summary_section()
+    assert sec == {"generation": 2, "world_size": 3,
+                   "shrinks": 1, "resumes": 1}
+    with pytest.raises(ValueError):
+        elastic.set_world_overlay(m, 4)  # not a member
+    elastic.clear_world_overlay()
+    assert elastic.current_membership() is None
+
+
+# ---------------------------------------------------------------------------
+# satellite: heartbeat staleness is reader-local (clock-skew regression)
+# ---------------------------------------------------------------------------
+
+
+def test_watchdog_staleness_is_reader_local_not_clock_skew(tmp_path):
+    """A peer whose filesystem mtimes are skewed far into the past (its
+    clock runs behind, or NTP stepped it) must NOT be declared stale
+    while its heartbeat keeps CHANGING; a peer whose heartbeat stops
+    changing must age by the reader's own monotonic clock regardless of
+    what wall-clock value the last mtime carries."""
+    hb = str(tmp_path / "hb")
+    wd = LivenessWatchdog(hb, rank=0, nproc=2, timeout_s=0.4,
+                          interval_s=60.0)
+    assert wd.peers == (1,)
+    distributed.write_rank_heartbeat(hb, 1)
+    path = distributed.heartbeat_path(hb, 1)
+
+    # Peer's clock is 10 minutes BEHIND: a wall-clock comparison would
+    # call this file 600s stale the instant it is written.
+    past = time.time() - 600.0
+    os.utime(path, (past, past))
+    assert wd.check_peers() is None
+    time.sleep(0.15)
+    assert wd.check_peers() is None  # fresh observation, not stale yet
+    # The peer heartbeats again (mtime CHANGES, still in the past):
+    # its staleness clock restarts.
+    os.utime(path, (past + 5.0, past + 5.0))
+    time.sleep(0.3)
+    assert wd.check_peers() is None
+    # Now the heartbeat stops changing: reader-local monotonic age grows
+    # past the timeout and the peer is declared lost -- even though the
+    # file is "only seconds old" by its own (future-skewed) mtime.
+    future = time.time() + 600.0
+    os.utime(path, (future, future))
+    wd.check_peers()  # observe the change once; clock restarts here
+    time.sleep(0.55)
+    worst = wd.check_peers()
+    assert worst is not None
+    assert worst[0] == 1 and worst[1] > 0.4
+
+
+def test_watchdog_peers_override_watches_survivors_only(tmp_path):
+    """An elastic refit passes the sealed membership's survivor ranks:
+    the watchdog must never wait on the heartbeat of the rank it just
+    shrank away (that file will be stale forever by design)."""
+    hb = str(tmp_path / "hb")
+    wd = LivenessWatchdog(hb, rank=0, nproc=3, timeout_s=0.2,
+                          interval_s=60.0, peers=[0, 2])
+    assert wd.peers == (2,)  # self filtered, lost rank 1 absent
+    distributed.write_rank_heartbeat(hb, 2)
+    # Rank 1 never heartbeats -- irrelevant: only rank 2 is watched.
+    assert wd.check_peers() is None
+
+
+# ---------------------------------------------------------------------------
+# satellite: directory-fsync POSIX gating
+# ---------------------------------------------------------------------------
+
+
+@pytest.mark.parametrize("fsync_dir", [ckpt_mod._fsync_dir,
+                                       elastic._fsync_dir])
+def test_fsync_dir_is_posix_gated(tmp_path, monkeypatch, fsync_dir):
+    """Both durable-rename helpers fsync the directory on POSIX and skip
+    -- instead of crashing on ``os.open(dir)`` -- elsewhere."""
+    d = str(tmp_path)
+    fsync_dir(d)  # POSIX: opens + fsyncs the dir without error
+
+    opened = []
+    monkeypatch.setattr(os, "name", "nt")
+    monkeypatch.setattr(os, "open",
+                        lambda *a, **k: opened.append(a) or 0)
+    fsync_dir(d)
+    assert opened == []  # gated out before any directory open
+
+
+def test_write_npz_atomic_survives_and_fsyncs(tmp_path):
+    target = str(tmp_path / "a.npz")
+    ckpt_mod.write_npz_atomic(str(tmp_path), target,
+                              {"x": np.arange(3.0)})
+    with np.load(target) as z:
+        np.testing.assert_array_equal(z["x"], np.arange(3.0))
+    assert not [f for f in os.listdir(tmp_path) if f.endswith(".tmp.npz")]
+
+
+# ---------------------------------------------------------------------------
+# satellite: checkpoint world-size/generation stamping + restore validation
+# ---------------------------------------------------------------------------
+
+
+def test_checkpoint_world_stamp_and_mismatch_walkback(tmp_path, blobs3):
+    ck = str(tmp_path / "ck")
+    with supervisor.use(_sup()):
+        fit_gmm(blobs3, 4, 2, config=_cfg(ck, min_iters=3, max_iters=3))
+
+    # Every step carries the world stamp (world size 1, generation 0),
+    # and the same world restores fine.
+    tree = ckpt_mod.SweepCheckpointer(ck).restore()
+    assert tree is not None
+    assert int(np.asarray(tree["ckpt_world_size"])) == 1
+    assert int(np.asarray(tree["ckpt_generation"])) == 0
+
+    # A different world without --elastic: the walk-back aggregates an
+    # INFORMATIVE mismatch error, not a shape traceback.
+    elastic.set_world_overlay(
+        elastic.Membership(generation=1, ranks=(0, 1), world_size0=2), 0)
+    with pytest.raises(ckpt_mod.CheckpointRestoreError) as ei:
+        ckpt_mod.SweepCheckpointer(ck).restore()
+    msg = str(ei.value.errors[0][1])
+    assert "world size 1" in msg and "2 host(s)" in msg
+    assert "--elastic" in msg
+
+    # Opting in (what an elastic run passes) accepts the world change.
+    tree = ckpt_mod.SweepCheckpointer(ck, allow_world_change=True).restore()
+    assert tree is not None
+
+
+# ---------------------------------------------------------------------------
+# rank_lost without --elastic: the exit-75 contract is unchanged
+# ---------------------------------------------------------------------------
+
+
+def test_rank_lost_without_elastic_raises_peer_lost(tmp_path, blobs3):
+    from cuda_gmm_mpi_tpu.telemetry import read_stream, validate_stream
+
+    ck = str(tmp_path / "ck")
+    mf = str(tmp_path / "m.jsonl")
+    with pytest.raises(PeerLostError) as ei:
+        with faults.use({"rank_lost": {"iter": 3, "rank": 1}}) as plan:
+            with supervisor.use(_sup()):
+                fit_gmm(blobs3, 6, 2, config=_cfg(ck, metrics_file=mf))
+    assert plan.fired["rank_lost"] == 1
+    assert ei.value.rank == 1
+    # The emergency intra-K sub-step was written before the raise.
+    subs = [f for f in os.listdir(os.path.join(ck, "sweep"))
+            if ".iter" in f]
+    assert len(subs) == 1
+
+    records = read_stream(mf)
+    assert validate_stream(records) == []
+    kinds = [r["event"] for r in records]
+    assert "peer_lost" in kinds
+    assert "elastic_shrink" not in kinds and "elastic_resume" not in kinds
+    pl = next(r for r in records if r["event"] == "peer_lost")
+    assert pl["rank"] == 1
+
+
+# ---------------------------------------------------------------------------
+# the tentpole: shrink + resume on an injected peer loss
+# ---------------------------------------------------------------------------
+
+
+def test_elastic_shrink_and_resume_end_to_end(tmp_path, blobs3):
+    """rank_lost mid-sweep with --elastic: ONE fit_gmm call survives the
+    loss -- rendezvous seals generation 1 over rank 0, the refit restores
+    the emergency checkpoint and finishes -- and the selected model is
+    identical to an uninterrupted run's (same winner K, same loglik)."""
+    from cuda_gmm_mpi_tpu.telemetry import read_stream, validate_stream
+    from cuda_gmm_mpi_tpu.telemetry.report import render_report
+
+    with supervisor.use(_sup()):
+        ref = fit_gmm(blobs3, 6, 2, config=_cfg(str(tmp_path / "ck_ref")))
+
+    ck = str(tmp_path / "ck")
+    mdir = _seed_two_hosts(ck)
+    mf = str(tmp_path / "m.jsonl")
+    with faults.use({"rank_lost": {"iter": 3, "rank": 1}}) as plan:
+        with supervisor.use(_sup()):
+            res = fit_gmm(blobs3, 6, 2,
+                          config=_cfg(ck, elastic=True, metrics_file=mf))
+    assert plan.fired["rank_lost"] == 1
+
+    # Deterministic for this survivor set: the shrunken world reproduces
+    # the uninterrupted run's selection exactly (well inside the
+    # health_regression_scale x convergence_epsilon acceptance bound).
+    assert res.ideal_num_clusters == ref.ideal_num_clusters
+    assert res.min_rissanen == ref.min_rissanen
+    assert res.final_loglik == ref.final_loglik
+    np.testing.assert_array_equal(np.asarray(res.means),
+                                  np.asarray(ref.means))
+
+    # Generation 1 is sealed on disk with the survivor set.
+    sealed = elastic.read_membership(mdir)
+    assert sealed.generation == 1 and sealed.ranks == (0,)
+    assert elastic.generation() == 1
+
+    # Telemetry: schema-valid stream, shrink -> resume arc, summary
+    # rollup, and the report renders the lifecycle.
+    records = read_stream(mf)
+    assert validate_stream(records) == []
+    shrink = next(r for r in records if r["event"] == "elastic_shrink")
+    assert shrink["generation"] == 1 and shrink["survivors"] == [0]
+    assert shrink["world_size"] == 1 and shrink["lost_ranks"] == [1]
+    resume = next(r for r in records if r["event"] == "elastic_resume")
+    assert resume["generation"] == 1 and resume["attempt"] == 1
+    summary = next(r for r in records if r["event"] == "run_summary")
+    assert summary["elastic"] == {"generation": 1, "world_size": 1,
+                                  "shrinks": 1, "resumes": 1}
+    rep = render_report(records)
+    assert "elastic_shrink" in rep and "elastic_resume" in rep
+    assert "Elastic: generation 1" in rep
+
+
+@pytest.mark.parametrize("name,spec,kw", [
+    ("mid_em", {"rank_lost": {"iter": 3, "rank": 1}}, {}),
+    ("between_k", {"rank_lost": {"where": "sweep", "rank": 1}}, {}),
+    ("mid_stream_block", {"rank_lost": {"iter": 2, "block": 3, "rank": 1}},
+     {"stream_events": True, "chunk_size": 256}),
+])
+def test_chaos_matrix_rank_lost_sites_resume_identically(
+        tmp_path, rng, name, spec, kw):
+    """The chaos matrix: a peer loss mid-EM, between K's, and mid
+    stream-block all shrink and resume to the uninterrupted result."""
+    centers = rng.normal(scale=8.0, size=(3, 3))
+    data = (centers[rng.integers(0, 3, 4096)]
+            + rng.normal(size=(4096, 3))).astype(np.float64)
+
+    with supervisor.use(_sup()):
+        ref = fit_gmm(data, 5, 2,
+                      config=_cfg(str(tmp_path / "ck_ref"), **kw))
+
+    ck = str(tmp_path / "ck")
+    _seed_two_hosts(ck)
+    with faults.use(spec) as plan:
+        with supervisor.use(_sup()):
+            res = fit_gmm(data, 5, 2, config=_cfg(ck, elastic=True, **kw))
+    assert plan.fired["rank_lost"] == 1
+    assert res.ideal_num_clusters == ref.ideal_num_clusters
+    assert res.final_loglik == ref.final_loglik
+    np.testing.assert_array_equal(np.asarray(res.means),
+                                  np.asarray(ref.means))
+    assert elastic.generation() == 1
+
+
+def test_elastic_survivor_set_determinism_across_runs(tmp_path, blobs3):
+    """Two independent recoveries over the same survivor set agree on the
+    sealed membership AND on the refit model -- the acceptance criteria's
+    determinism clause."""
+    results = []
+    for trial in range(2):
+        elastic.reset()
+        ck = str(tmp_path / f"ck{trial}")
+        mdir = _seed_two_hosts(ck)
+        with faults.use({"rank_lost": {"iter": 3, "rank": 1}}):
+            with supervisor.use(_sup()):
+                res = fit_gmm(blobs3, 6, 2, config=_cfg(ck, elastic=True))
+        results.append((elastic.read_membership(mdir), res))
+    (m0, r0), (m1, r1) = results
+    assert m0 == m1
+    assert r0.ideal_num_clusters == r1.ideal_num_clusters
+    assert r0.final_loglik == r1.final_loglik
+    np.testing.assert_array_equal(np.asarray(r0.means),
+                                  np.asarray(r1.means))
+
+
+def test_elastic_min_hosts_floor_gives_up(tmp_path, blobs3):
+    """A shrink below --min-hosts re-raises the original PeerLostError:
+    the exit-75 operator path, not a silently undersized fit."""
+    ck = str(tmp_path / "ck")
+    _seed_two_hosts(ck)
+    with pytest.raises(PeerLostError):
+        with faults.use({"rank_lost": {"iter": 3, "rank": 1}}):
+            with supervisor.use(_sup()):
+                fit_gmm(blobs3, 6, 2,
+                        config=_cfg(ck, elastic=True, min_hosts=2))
+
+
+def test_elastic_retry_budget_exhausts_to_peer_lost(tmp_path, blobs3):
+    """Repeated losses beyond elastic_max_retries propagate: the between-K
+    fault re-fires on every refit (times=2) and the second loss exceeds
+    the 1-attempt budget."""
+    ck = str(tmp_path / "ck")
+    _seed_two_hosts(ck)
+    with pytest.raises(PeerLostError):
+        with faults.use({"rank_lost": {"where": "sweep", "rank": 1,
+                                       "times": 2}}) as plan:
+            with supervisor.use(_sup()):
+                fit_gmm(blobs3, 6, 2,
+                        config=_cfg(ck, elastic=True,
+                                    elastic_max_retries=1))
+    assert plan.fired["rank_lost"] == 2
+
+
+def test_collective_timeout_fault_bounds_barrier(tmp_path):
+    """The collective_timeout chaos kind: an armed barrier raises the
+    exact PeerLostError a timed-out collective would, honoring the
+    optional name pin, BEFORE the single-process early return."""
+    with faults.use({"collective_timeout": {"rank": 1, "timeout_s": 7.5,
+                                            "name": "output_assembly"}}):
+        distributed.barrier("some_other_barrier")  # name pin: no fire
+        with pytest.raises(PeerLostError) as ei:
+            distributed.barrier("output_assembly")
+    assert ei.value.rank == 1 and ei.value.timeout_s == 7.5
+    with faults.use({"collective_timeout": {}}):  # untargeted: any barrier
+        with pytest.raises(PeerLostError) as ei:
+            distributed.barrier("anything")
+    assert ei.value.rank is None
+
+
+# ---------------------------------------------------------------------------
+# CLI: --elastic / --min-hosts, and exit-75 preservation without them
+# ---------------------------------------------------------------------------
+
+
+def _write_blob_file(tmp_path, rng, n=3000, d=3, k=4):
+    from cuda_gmm_mpi_tpu.io import write_bin
+
+    centers = rng.normal(scale=9.0, size=(k, d))
+    data = (centers[rng.integers(0, k, n)]
+            + rng.normal(size=(n, d))).astype(np.float32)
+    path = str(tmp_path / "events.bin")
+    write_bin(path, data)
+    return path
+
+
+def test_cli_elastic_requires_checkpoint_dir(tmp_path, rng):
+    infile = _write_blob_file(tmp_path, rng, n=256, d=2, k=2)
+    p = subprocess.Popen(
+        CLI + ["2", infile, str(tmp_path / "out"), "2", "--device=cpu",
+               "--elastic"],
+        stdout=subprocess.PIPE, stderr=subprocess.PIPE, env=worker_env(),
+        text=True)
+    out, err = communicate_or_kill(p, timeout=300)
+    assert p.returncode == 1, f"rc={p.returncode}:\n{out}\n{err}"
+    assert "elastic recovery requires checkpoint_dir" in err
+
+
+def test_cli_rank_lost_elastic_on_exits_0_off_exits_75(tmp_path, rng):
+    """The acceptance criteria, end to end through the CLI: an injected
+    rank_lost mid-sweep exits 0 with --elastic (same outputs as an
+    uninterrupted run) and keeps the exit-75 peer-loss contract without
+    it -- byte-identical output files either way."""
+    infile = _write_blob_file(tmp_path, rng)
+
+    def run(out, ckdir, *, extra=(), fault=None):
+        env = worker_env()
+        if fault is not None:
+            env["GMM_FAULTS"] = json.dumps(fault)
+        args = ["4", infile, str(out), "4", "--device=cpu",
+                "--dtype=float64", "--min-iters=6", "--max-iters=6",
+                "--sweep-k-buckets=off", "--preempt-poll-iters=1",
+                "--chunk-size=256", f"--checkpoint-dir={ckdir}",
+                *extra]
+        p = subprocess.Popen(CLI + args, stdout=subprocess.PIPE,
+                             stderr=subprocess.PIPE, env=env, text=True)
+        out_, err_ = communicate_or_kill(p, timeout=600)
+        return p.returncode, out_, err_
+
+    fault = {"rank_lost": {"iter": 3, "rank": 1}}
+
+    # Without --elastic: exit 75, untouched contract.
+    rc, o, e = run(tmp_path / "plain", str(tmp_path / "ck_plain"),
+                   fault=fault)
+    assert rc == 75, f"expected EX_TEMPFAIL:\n{o}\n{e[-3000:]}"
+    assert "Peer lost" in e
+
+    # With --elastic (membership pre-seeded to a 2-host world on paper):
+    # the same loss is survived in one invocation, exit 0.
+    ck = str(tmp_path / "ck_el")
+    _seed_two_hosts(ck)
+    rc2, o2, e2 = run(tmp_path / "el", ck,
+                      extra=["--elastic", "--min-hosts=1"], fault=fault)
+    assert rc2 == 0, f"elastic run failed:\n{o2}\n{e2[-3000:]}"
+
+    # Ground truth, and the byte-identity acceptance.
+    rc3, o3, e3 = run(tmp_path / "ref", str(tmp_path / "ck_ref"))
+    assert rc3 == 0, f"reference failed:\n{o3}\n{e3[-3000:]}"
+    assert (tmp_path / "el.summary").read_bytes() == \
+        (tmp_path / "ref.summary").read_bytes()
+    assert (tmp_path / "el.results").read_bytes() == \
+        (tmp_path / "ref.results").read_bytes()
+
+
+# ---------------------------------------------------------------------------
+# the real multi-process rendezvous (slow: spawns interpreters)
+# ---------------------------------------------------------------------------
+
+
+RENDEZVOUS_WORKER = r"""
+import sys
+from cuda_gmm_mpi_tpu.parallel import elastic
+
+d, r = sys.argv[1], int(sys.argv[2])
+prev = elastic.Membership(generation=0, ranks=(0, 1, 2), world_size0=3)
+m = elastic.rendezvous(d, my_rank=r, prev=prev, lost=(2,), window_s=30.0)
+print("SEALED", m.generation, ",".join(str(x) for x in m.ranks))
+"""
+
+
+@pytest.mark.slow
+def test_two_process_rendezvous_agrees_on_membership(tmp_path):
+    """The filesystem rendezvous across REAL processes: ranks 0 and 1 of
+    a 3-host world lose rank 2 concurrently; the coordinator (0) seals
+    once both announce, the poller (1) adopts the same file, and both
+    report the identical generation-1 membership."""
+    d = str(tmp_path / "membership")
+    procs = [subprocess.Popen(
+        [sys.executable, "-c", RENDEZVOUS_WORKER, d, str(r)],
+        stdout=subprocess.PIPE, stderr=subprocess.PIPE, env=worker_env(),
+        text=True) for r in (0, 1)]
+    outs = []
+    try:
+        for p in procs:
+            out, err = communicate_or_kill(p, timeout=300)
+            assert p.returncode == 0, f"rc={p.returncode}:\n{out}\n{err}"
+            outs.append([ln for ln in out.splitlines()
+                         if ln.startswith("SEALED")][-1])
+    finally:
+        for p in procs:
+            if p.poll() is None:
+                p.kill()
+                p.wait(timeout=60)
+    assert outs[0] == outs[1] == "SEALED 1 0,1"

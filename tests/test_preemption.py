@@ -229,22 +229,31 @@ def test_deadline_preempts_library_run(tmp_path, blobs3):
 
 
 def test_watchdog_detects_stale_peer(tmp_path):
-    """LivenessWatchdog.check_peers flags the peer whose heartbeat file
-    aged past the timeout, and a fresh heartbeat clears it."""
+    """LivenessWatchdog.check_peers flags the peer whose heartbeat stops
+    CHANGING for longer than the timeout of reader-local monotonic time,
+    and a fresh heartbeat clears it. A backdated mtime (writer clock
+    skew, an NTP step) is just a changed file, never instant staleness:
+    mtimes are compared only for equality, not against this host's
+    clock."""
     from cuda_gmm_mpi_tpu.parallel import distributed
     from cuda_gmm_mpi_tpu.supervisor import LivenessWatchdog
 
     d = str(tmp_path)
     distributed.write_rank_heartbeat(d, 0)
     distributed.write_rank_heartbeat(d, 1)
-    w = LivenessWatchdog(d, rank=0, nproc=2, timeout_s=5.0)
+    w = LivenessWatchdog(d, rank=0, nproc=2, timeout_s=0.4)
     assert w.check_peers() is None
     old = time.time() - 60.0
     os.utime(distributed.heartbeat_path(d, 1), (old, old))
+    assert w.check_peers() is None  # skew-immune: changed, not stale
+    deadline = time.time() + 10.0
     lost = w.check_peers()
+    while lost is None and time.time() < deadline:
+        time.sleep(0.05)
+        lost = w.check_peers()
     assert lost is not None
     rank, age = lost
-    assert rank == 1 and age > 5.0
+    assert rank == 1 and age > 0.4
     distributed.write_rank_heartbeat(d, 1)
     assert w.check_peers() is None
 
