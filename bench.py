@@ -60,6 +60,15 @@ walls and per-tenant winner/loglik parity bits in ONE record;
 ``vs_baseline`` is sequential / fleet. Size knobs: GMM_BENCH_TENANTS +
 GMM_BENCH_TENANCY_{N,D,K,ITERS} (run_tenancy_bench).
 
+Obs mode (``--obs`` or GMM_BENCH_OBS=1): telemetry-overhead A/B/C --
+one fit measured with telemetry off, with the --metrics-file stream,
+and with the full --metrics-port live plane (OpenMetrics exporter +
+resource sampler + trace spans) while a client thread scrapes /metrics
+throughout; ONE record carries all three walls, both overhead ratios,
+and the scrape/span/sampler health bits proving the plane actually ran.
+Size knobs: GMM_BENCH_OBS_{N,D,K,ITERS} + GMM_BENCH_OBS_BOUND
+(run_obs_bench).
+
 Ingest mode (``--ingest`` or GMM_BENCH_INGEST=1): host-resident vs
 pipelined out-of-core ingestion A/B on one BIN dataset -- each mode
 (resident / pipelined / pipelined+minibatch) fits in its own subprocess
@@ -721,6 +730,184 @@ def run_tenancy_bench(platform: str, accel_unavailable: bool) -> dict:
     return result
 
 
+def run_obs_bench(platform: str, accel_unavailable: bool) -> dict:
+    """The --obs mode: telemetry / live-plane overhead A/B/C.
+
+    Fits the SAME data with the same seed and config three times over one
+    shared model (shared compiled executables -- the A/B measures
+    instrumentation, not compilation):
+
+      off      no telemetry at all (the metrics_file=None fast path:
+               one ``active`` attribute check per touchpoint);
+      stream   ``--metrics-file`` JSONL stream only (rev <= v2.0 cost);
+      live     stream + ``--metrics-port`` live plane (rev v2.1):
+               OpenMetrics exporter + resource sampler + trace spans,
+               with a client thread scraping ``/metrics`` throughout
+               the fit to prove the endpoint serves parseable text
+               under load.
+
+    ONE JSON record carries all three walls and both overhead ratios
+    (stream/off, live/off). ``within_bound`` checks live/off against the
+    documented bound (docs/OBSERVABILITY.md "Overhead": default 1.5x on
+    these bench shapes; override GMM_BENCH_OBS_BOUND). Scrape health
+    rides along: scrape count, last-scrape parse verdict, and the span /
+    sampler-heartbeat record counts from the live stream.
+
+    Size knobs: GMM_BENCH_OBS_N (default 200k accel / 20k CPU),
+    GMM_BENCH_OBS_D (16 / 8), GMM_BENCH_OBS_K (16 / 8),
+    GMM_BENCH_OBS_ITERS (10 / 6).
+    """
+    import tempfile
+    import threading
+    import urllib.request
+
+    on_accel = platform not in ("cpu",)
+    n = int(os.environ.get("GMM_BENCH_OBS_N")
+            or (200_000 if on_accel else 20_000))
+    d = int(os.environ.get("GMM_BENCH_OBS_D") or (16 if on_accel else 8))
+    k = int(os.environ.get("GMM_BENCH_OBS_K") or (16 if on_accel else 8))
+    iters = int(os.environ.get("GMM_BENCH_OBS_ITERS")
+                or (10 if on_accel else 6))
+    chunk = int(os.environ.get("GMM_BENCH_CHUNK")
+                or (131072 if on_accel else 4096))
+    chunk = min(chunk, n)
+    bound = float(os.environ.get("GMM_BENCH_OBS_BOUND") or 1.5)
+
+    from cuda_gmm_mpi_tpu.config import GMMConfig
+    from cuda_gmm_mpi_tpu.models.gmm import GMMModel
+    from cuda_gmm_mpi_tpu.models.order_search import fit_gmm
+    from cuda_gmm_mpi_tpu.telemetry import exporter as tl_exporter
+    from cuda_gmm_mpi_tpu.telemetry import read_stream
+
+    rng = np.random.default_rng(7)
+    centers = rng.normal(scale=8.0, size=(k, d))
+    data = (centers[rng.integers(0, k, n)]
+            + rng.normal(scale=1.0, size=(n, d))).astype(np.float32)
+
+    tmp = tempfile.mkdtemp(prefix="gmm-obs-")
+    base = dict(min_iters=iters, max_iters=iters, chunk_size=chunk,
+                seed=0)
+    cfg_off = GMMConfig(**base)
+    cfg_stream = GMMConfig(metrics_file=os.path.join(tmp, "stream.jsonl"),
+                           **base)
+    cfg_live = GMMConfig(metrics_file=os.path.join(tmp, "live.jsonl"),
+                         metrics_port=0, **base)
+
+    model = GMMModel(cfg_off)
+    fit_gmm(data, k, k, cfg_off, model=model)  # warm: compile once
+    # Warm the TELEMETRY path too: the first recorder-active fit
+    # jit-compiles the streamed-loglik EM variant (a one-time cost of
+    # several hundred ms). Unwarmed, the stream pass would absorb it and
+    # the "overhead" ratios would measure compilation, not
+    # instrumentation.
+    fit_gmm(data, k, k,
+            GMMConfig(metrics_file=os.path.join(tmp, "warm.jsonl"),
+                      metrics_port=0, **base), model=model)
+
+    def timed(cfg):
+        t0 = time.perf_counter()
+        res = fit_gmm(data, k, k, cfg, model=model)
+        return time.perf_counter() - t0, res
+
+    off_wall, off_res = timed(cfg_off)
+    stream_wall, stream_res = timed(cfg_stream)
+
+    # Live pass: a background client scrapes /metrics for the fit's
+    # whole duration (current_exporter() resolves the ephemeral port the
+    # in-fit live plane bound), and the sampler cadence is shrunk so
+    # short bench fits still collect samples.
+    scrape = {"count": 0, "last": ""}
+    stop = threading.Event()
+
+    def _scraper():
+        while not stop.is_set():
+            ex = tl_exporter.current_exporter()
+            port = ex.port if ex is not None else None
+            if port:
+                try:
+                    with urllib.request.urlopen(
+                            f"http://127.0.0.1:{port}/metrics",
+                            timeout=2) as resp:
+                        scrape["last"] = resp.read().decode("utf-8")
+                    scrape["count"] += 1
+                except Exception:
+                    pass
+                stop.wait(0.005)
+            else:
+                # Warm fits make the live window short; poll tightly so
+                # the endpoint's lifetime can't slip between wakeups.
+                stop.wait(0.002)
+
+    sampler_env = os.environ.get("GMM_SAMPLER_INTERVAL_S")
+    os.environ.setdefault("GMM_SAMPLER_INTERVAL_S", "0.1")
+    scraper = threading.Thread(target=_scraper, daemon=True)
+    scraper.start()
+    try:
+        live_wall, live_res = timed(cfg_live)
+    finally:
+        stop.set()
+        scraper.join(timeout=5.0)
+        if sampler_env is None:
+            os.environ.pop("GMM_SAMPLER_INTERVAL_S", None)
+
+    def _openmetrics_ok(text: str) -> bool:
+        if not text.rstrip().endswith("# EOF"):
+            return False
+        for line in text.splitlines():
+            if not line or line.startswith("#"):
+                continue
+            parts = line.rsplit(" ", 1)
+            if len(parts) != 2:
+                return False
+            try:
+                float(parts[1])
+            except ValueError:
+                return False
+        return True
+
+    live_records = read_stream(cfg_live.metrics_file)
+    spans = sum(1 for r in live_records if r.get("event") == "span")
+    samples = sum(1 for r in live_records
+                  if r.get("event") == "heartbeat" and r.get("sampler"))
+
+    stream_overhead = stream_wall / max(off_wall, 1e-9)
+    live_overhead = live_wall / max(off_wall, 1e-9)
+    result = {
+        "metric": f"live-plane overhead, {n}x{d} K={k} ({platform})",
+        "value": round(live_overhead, 4),
+        "unit": "x",
+        # A/B ratio (live / off), NOT the NumPy baseline.
+        "vs_baseline": round(live_overhead, 4),
+        "accelerator_unavailable": accel_unavailable,
+        "obs": {
+            "n": n, "d": d, "k": k, "em_iters": iters,
+            "chunk_size": chunk,
+            "off_wall_s": round(off_wall, 4),
+            "stream_wall_s": round(stream_wall, 4),
+            "live_wall_s": round(live_wall, 4),
+            "stream_overhead": round(stream_overhead, 4),
+            "live_overhead": round(live_overhead, 4),
+            "documented_bound": bound,
+            "within_bound": bool(live_overhead <= bound),
+            "scrapes": int(scrape["count"]),
+            "scrape_parse_ok": bool(scrape["last"]
+                                    and _openmetrics_ok(scrape["last"])),
+            "span_records": int(spans),
+            "sampler_heartbeats": int(samples),
+            # The instrumentation must not change the arithmetic.
+            "loglik_bit_identical": bool(
+                off_res.final_loglik == stream_res.final_loglik
+                == live_res.final_loglik),
+        },
+        "measured_utc": time.strftime("%Y-%m-%dT%H:%M:%SZ", time.gmtime()),
+    }
+    if accel_unavailable:
+        result["platform_note"] = (
+            "accelerator tunnel unavailable (probe failed after retries); "
+            "this is a CPU-fallback measurement, not an accelerator result")
+    return result
+
+
 def run_serve_bench(platform: str, accel_unavailable: bool) -> dict:
     """The --serve mode: cold-vs-warm A/B of the serving subsystem.
 
@@ -1221,6 +1408,8 @@ def main() -> int:
                    or os.environ.get("GMM_BENCH_INGEST") == "1")
     want_elastic = ("--elastic" in sys.argv[1:]
                     or os.environ.get("GMM_BENCH_ELASTIC") == "1")
+    want_obs = ("--obs" in sys.argv[1:]
+                or os.environ.get("GMM_BENCH_OBS") == "1")
     spec = CONFIGS.get(cfg_name)
     if spec is None:
         print(
@@ -1351,6 +1540,14 @@ def main() -> int:
         # Warm elastic recovery vs cold restart A/B after an injected
         # peer loss (ignores --config; sized by GMM_BENCH_ELASTIC_*).
         result = run_elastic_bench(platform, accel_unavailable)
+        watchdog.cancel()
+        print(json.dumps(result))
+        return 3 if accel_unavailable else 0
+
+    if want_obs:
+        # Telemetry-off vs stream vs live-plane overhead A/B/C (ignores
+        # --config; sized by GMM_BENCH_OBS_*).
+        result = run_obs_bench(platform, accel_unavailable)
         watchdog.cancel()
         print(json.dumps(result))
         return 3 if accel_unavailable else 0

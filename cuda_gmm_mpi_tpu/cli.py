@@ -281,6 +281,15 @@ def build_parser() -> argparse.ArgumentParser:
                    "with the 7-category phase profile and metrics "
                    "registry) for every execution path; render it with "
                    "`gmm report FILE.jsonl` (docs/OBSERVABILITY.md)")
+    t.add_argument("--metrics-port", type=int, default=None,
+                   metavar="PORT",
+                   help="live observability plane (rev v2.1): serve "
+                   "Prometheus/OpenMetrics text on "
+                   "127.0.0.1:PORT/metrics (0 = OS-assigned ephemeral "
+                   "port), sample host RSS + device memory onto "
+                   "heartbeat records, and emit trace spans around the "
+                   "sweep / per-K EM / checkpoint phases (default: off; "
+                   "streams stay byte-identical)")
     t.add_argument("--init-from", default=None, metavar="MODEL.summary",
                    help="warm-start: initial means from a saved .summary "
                    "model (its K must equal num_clusters); covariances/"
@@ -302,6 +311,13 @@ def main(argv=None) -> int:
         from .telemetry import report_main
 
         return report_main(argv[1:])
+    if argv and argv[0] == "top":
+        # `gmm top <metrics.jsonl|stream-dir>`: alias for
+        # `gmm report --follow` -- a live one-screen view of a running
+        # fit or server, re-rendered as the stream grows.
+        from .telemetry import report_main
+
+        return report_main(["--follow"] + argv[1:])
     if argv and argv[0] == "export":
         # `gmm export`: persist a model (sweep checkpoint / .summary)
         # into a serving registry (docs/SERVING.md).
@@ -397,6 +413,7 @@ def main(argv=None) -> int:
             enable_output=not args.no_output,
             profile=args.profile,
             metrics_file=args.metrics_file,
+            metrics_port=args.metrics_port,
             checkpoint_dir=args.checkpoint_dir,
             checkpoint_keep=args.checkpoint_keep,
             checkpoint_retries=args.checkpoint_retries,
@@ -438,6 +455,7 @@ def main(argv=None) -> int:
         fit_only = [
             ("--sweep-log", args.sweep_log),
             ("--metrics-file", args.metrics_file),
+            ("--metrics-port", args.metrics_port is not None),
             ("--init-from", args.init_from),
             ("--checkpoint-dir", args.checkpoint_dir),
             ("--fused-sweep", args.fused_sweep),

@@ -274,6 +274,14 @@ class GMMConfig:
     # (metrics_line, --profile) are unaffected either way. Multi-host runs
     # write one coherent stream from process 0 with rank-tagged records.
     metrics_file: Optional[str] = None
+    # Live observability plane (stream rev v2.1; docs/OBSERVABILITY.md
+    # "Live metrics endpoint"): serve a Prometheus/OpenMetrics `/metrics`
+    # endpoint on this localhost port for the duration of the run, start
+    # the periodic resource sampler (memory heartbeats), and emit trace
+    # spans + a fit-scoped trace_id on the stream. 0 = OS-assigned
+    # ephemeral port (tests). None (default) = fully off: the stream is
+    # byte-identical to a pre-v2.1 run.
+    metrics_port: Optional[int] = None
     checkpoint_dir: Optional[str] = None
     seed: int = 0  # RNG seed for any randomized paths (reference is deterministic)
     # Initial means: 'even' = the reference's evenly-spaced event rows
@@ -335,6 +343,10 @@ class GMMConfig:
             )
         if self.max_clusters < 1:
             raise ValueError("max_clusters must be >= 1")
+        if self.metrics_port is not None and not (
+                0 <= self.metrics_port <= 65535):
+            raise ValueError(
+                f"metrics_port must be in [0, 65535], got {self.metrics_port}")
         if self.quad_mode not in ("expanded", "packed", "centered"):
             raise ValueError(f"unknown quad_mode: {self.quad_mode!r}")
         if self.covariance_type not in ("full", "diag", "spherical", "tied"):

@@ -34,6 +34,8 @@ from ..ops.merge import eliminate_and_reduce
 from ..state import GMMState, bucket_width, clone_state, compact
 from .. import telemetry
 from ..telemetry import RunRecorder
+from ..telemetry import exporter as tl_exporter
+from ..telemetry import spans as tl_spans
 from ..testing import faults
 from ..utils.logging_ import get_logger, metrics_line
 from ..utils.profiling import PhaseTimer
@@ -423,6 +425,23 @@ def fit_gmm(
             stack.enter_context(supervisor.use(supervisor.RunSupervisor(
                 max_runtime_s=config.max_runtime_s,
                 install_signals=False)))
+        if config.metrics_port is not None:
+            # Live observability plane (--metrics-port; stream rev v2.1):
+            # the OpenMetrics exporter + resource sampler run for the
+            # fit's duration, and a fit-scoped trace activates -- its id
+            # rides every stream record via the context, and the span
+            # emission points below light up. None (the default) skips
+            # ALL of this, keeping the stream byte-identical to pre-v2.1.
+            stack.enter_context(tl_exporter.live_plane(
+                config.metrics_port,
+                registry_provider=lambda: telemetry.current().metrics,
+                gauges_provider=elastic.live_gauges))
+            rec = telemetry.current()
+            tid = stack.enter_context(tl_spans.trace())
+            if rec.active:
+                rec.set_context(trace_id=tid)
+                stack.callback(rec.set_context, trace_id=None)
+            stack.enter_context(tl_spans.span("fit"))
         # Elastic retry loop (docs/DISTRIBUTED.md "Elastic recovery"): a
         # peer loss under --elastic shrinks the world via the checkpoint-FS
         # rendezvous and REFITS (resume="auto" restores the newest step)
@@ -608,12 +627,13 @@ def _fit_gmm(data, num_clusters, target_num_clusters, config, model,
                 # Profiling-only emission needs just the step scalars.
                 kwargs["emit_light"] = ckpt is None
             fused = maker(**kwargs)
-            fused_result = _run_fused_sweep(
-                fused, config, state, chunks, wts, epsilon,
-                num_clusters, stop_number, target_num_clusters,
-                n_events, n_dims, shift, verbose, host_range, model,
-                ckpt=ckpt, log=log, timer=timer,
-            )
+            with tl_spans.span("fused_sweep", start_k=int(num_clusters)):
+                fused_result = _run_fused_sweep(
+                    fused, config, state, chunks, wts, epsilon,
+                    num_clusters, stop_number, target_num_clusters,
+                    n_events, n_dims, shift, verbose, host_range, model,
+                    ckpt=ckpt, log=log, timer=timer,
+                )
             if isinstance(fused_result, GMMResult):
                 return fused_result
             # A counter vector instead of a result = the device program
@@ -743,6 +763,11 @@ def _fit_gmm(data, num_clusters, target_num_clusters, config, model,
     # zero-sync single-dispatch loop untouched.
     supervised = (sup.active and ckpt is not None
                   and hasattr(model, "run_em_resumable"))
+    # Non-lexical sweep span (rev v2.1): begin/end instead of a `with`
+    # because the loop raises through _shutdown_and_raise on preemption
+    # -- an un-ended span simply never emits, and its completed children
+    # (per-K EM, checkpoint saves) orphan-promote in the tree view.
+    sweep_span = tl_spans.begin("sweep", start_k=int(k))
     while k >= stop_number:
         if sup.active and sup.poll(where="sweep", k=int(k)):
             # Between-K stop: every completed K is already durable (the
@@ -758,7 +783,8 @@ def _fit_gmm(data, num_clusters, target_num_clusters, config, model,
         # buffers, so recovery needs a clone taken first (async device
         # copy, one parameter-set of HBM).
         rollback = clone_state(state) if recovery_on else None
-        with phase("e_step"):  # fused E+M loop (m_step/constants folded in)
+        # fused E+M loop (m_step/constants folded in); em_k = one K's EM
+        with tl_spans.span("em_k", k=int(k)), phase("e_step"):
             # donate=True: the EM carry is rebound every K, so the input
             # state's buffers are handed to the device for in-place reuse
             # (one state-size less peak HBM + copy traffic per K).
@@ -871,11 +897,12 @@ def _fit_gmm(data, num_clusters, target_num_clusters, config, model,
             # ladder is exhausted). The rung's model is adopted for the
             # rest of the sweep (sticky escalation); the already-dispatched
             # order reduction ran on the poisoned state, so redo it.
-            model, state, ll_f, iters_i, counts_np, ll_log = \
-                health.recover_em(
-                    model, config, rollback, chunks, wts, epsilon, k,
-                    trajectory=want_traj, rec=rec, log=log,
-                    faulty_counts=counts_np)
+            with tl_spans.span("recovery", k=int(k)):
+                model, state, ll_f, iters_i, counts_np, ll_log = \
+                    health.recover_em(
+                        model, config, rollback, chunks, wts, epsilon, k,
+                        trajectory=want_traj, rec=rec, log=log,
+                        faulty_counts=counts_np)
             n_recoveries += 1
             iters_i = np.asarray(iters_i)
             dt = time.perf_counter() - t0
@@ -995,7 +1022,7 @@ def _fit_gmm(data, num_clusters, target_num_clusters, config, model,
 
         if ckpt is not None:
             rec.metrics.count("checkpoint_saves") if rec.active else None
-            with phase("cpu"):
+            with tl_spans.span("checkpoint", step=int(step)), phase("cpu"):
                 ckpt.save(step, {
                     "state": _host_state(state, model),
                     "best_state": _host_state(best_state, model),
@@ -1013,6 +1040,7 @@ def _fit_gmm(data, num_clusters, target_num_clusters, config, model,
                 })
         step += 1
 
+    tl_spans.end(sweep_span)
     with phase("memcpy"):
         compact_state, n_active = compact(best_state)
     if verbose:

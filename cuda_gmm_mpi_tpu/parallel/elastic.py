@@ -352,6 +352,18 @@ def run_summary_section() -> Optional[dict]:
     }
 
 
+def live_gauges() -> dict:
+    """Elastic run gauges for the OpenMetrics exporter (rev v2.1;
+    telemetry/exporter.py): keys are final metric names. Cheap enough
+    to evaluate per scrape; generation 0 / launch world on clean runs,
+    so the gauges exist (and are alertable) before anything shrinks."""
+    return {
+        "gmm_elastic_generation": generation(),
+        "gmm_elastic_shrinks": int(_counters["shrinks"]),
+        "gmm_elastic_resumes": int(_counters["resumes"]),
+    }
+
+
 def reset() -> None:
     """Test hook: drop the overlay and counters (module state is
     process-wide)."""

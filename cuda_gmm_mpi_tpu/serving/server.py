@@ -67,6 +67,7 @@ Resilience rejections reply with a machine-readable token in ``error``
 from __future__ import annotations
 
 import collections
+import contextlib
 import json
 import os
 import queue
@@ -79,6 +80,8 @@ import numpy as np
 
 from .. import supervisor as supervisor_mod
 from .. import telemetry
+from ..telemetry import exporter as tl_exporter
+from ..telemetry import spans as tl_spans
 from ..testing import faults
 from .breaker import CircuitBreakers
 from .executor import ScoringExecutor, executor_for_model
@@ -92,15 +95,18 @@ _LATENCY_CAP = 100_000
 
 class _Pending:
     """One in-flight request: the decoded body, where to reply, when it
-    arrived, and when its budget runs out (None = no deadline)."""
+    arrived, when its budget runs out (None = no deadline), and -- under
+    the live plane (rev v2.1) -- its minted trace identity."""
 
-    __slots__ = ("req", "reply", "t0", "deadline")
+    __slots__ = ("req", "reply", "t0", "deadline", "trace_id")
 
     def __init__(self, req: dict, reply: Callable[[dict], None],
-                 default_deadline_ms: Optional[float] = None):
+                 default_deadline_ms: Optional[float] = None,
+                 trace_id: Optional[str] = None):
         self.req = req
         self.reply = reply
         self.t0 = time.perf_counter()
+        self.trace_id = trace_id
         ms = default_deadline_ms
         if isinstance(req, dict):
             raw = req.get("deadline_ms")
@@ -120,7 +126,8 @@ class GMMServer:
                  default_deadline_ms: Optional[float] = None,
                  breaker_threshold: int = 3,
                  breaker_backoff_s: float = 1.0,
-                 stack_models: bool = False):
+                 stack_models: bool = False,
+                 trace_requests: bool = False):
         self._registry = registry
         self._max_batch_rows = max(1, int(max_batch_rows))
         self._tick_s = max(0.0, float(tick_s))
@@ -162,6 +169,11 @@ class GMMServer:
         # dispatches, parity-tested. Opt-in (--stack-models).
         self._stack_models = bool(stack_models)
         self.stacked_batches = 0
+        # Live plane (rev v2.1; --metrics-port): mint a trace_id per
+        # admitted request (echoed in its response + tagged on its
+        # serve_request record) and emit spans around the route path.
+        # Off by default -- responses and streams stay byte-identical.
+        self._trace_requests = bool(trace_requests)
 
     # -- model / executor resolution ------------------------------------
 
@@ -266,13 +278,60 @@ class GMMServer:
         for i, req in enumerate(requests):
             def reply(resp, _i=i):
                 responses[_i] = resp
-            pendings.append(_Pending(req, reply))
+            pendings.append(_Pending(req, reply,
+                                     trace_id=self._mint_trace_id()))
         if coalesce:
             self._process(pendings)
         else:
             for p in pendings:
                 self._process([p])
         return [r for r in responses if r is not None]
+
+    def _mint_trace_id(self) -> Optional[str]:
+        return tl_spans.mint_trace_id() if self._trace_requests else None
+
+    @contextlib.contextmanager
+    def _route_trace(self, name: str, items=None):
+        """Span scope for one route's dispatch (rev v2.1): activates a
+        trace -- joining the first request's minted trace_id so a client
+        holding that id finds the server-side spans -- and opens the
+        ``serve_route`` root span. No-op unless trace_requests is on."""
+        if not self._trace_requests:
+            yield
+            return
+        tid = None
+        if items:
+            tid = getattr(items[0][0], "trace_id", None)
+        with tl_spans.trace(tid), tl_spans.span("serve_route", model=name):
+            yield
+
+    def live_gauges(self) -> Dict[str, float]:
+        """Point-in-time server gauges for the /metrics exporter (rev
+        v2.1). Reads only python-side counters -- safe to call from the
+        exporter's HTTP thread while the tick loop dispatches."""
+        ex = self.executor_stats()
+        lookups = ex.get("hits", 0) + ex.get("misses", 0)
+        br = self.breaker.stats()
+        return {
+            "gmm_serve_queue_rows": float(self._queued_rows),
+            "gmm_serve_requests": float(self.requests),
+            "gmm_serve_batches": float(self.batches),
+            "gmm_serve_rows": float(self.rows),
+            "gmm_serve_errors": float(self.errors),
+            "gmm_serve_shed": float(self.shed),
+            "gmm_serve_deadline_expired": float(self.deadline_expired),
+            "gmm_serve_reloads": float(self.reloads),
+            "gmm_serve_breaker_fastfails": float(self.breaker_fastfails),
+            "gmm_serve_breaker_open_routes": float(br["open_routes"]),
+            "gmm_serve_breaker_trips": float(br["trips"]),
+            "gmm_serve_stacked_batches": float(self.stacked_batches),
+            "gmm_serve_draining": float(self._draining.is_set()),
+            "gmm_executor_cache_hit_rate": (
+                float(ex.get("hits", 0)) / lookups if lookups else 0.0),
+            "gmm_executor_live_executables": float(
+                ex.get("live_executables", 0)),
+            "gmm_executor_compiles": float(ex.get("compiles", 0)),
+        }
 
     def _expire(self, p: _Pending) -> bool:
         """Reject ``p`` with ``deadline_expired`` when its budget ran
@@ -365,6 +424,11 @@ class GMMServer:
         validation, and the shifted row block. Returns ``(m, good,
         rows, t0)`` or None when every request was already answered
         (fast-fail / resolve error / all-bad rows)."""
+        with tl_spans.span("prepare", model=name):
+            return self._prepare_route_inner(name, version, items)
+
+    def _prepare_route_inner(self, name: str, version: Optional[int],
+                             items: List[Tuple[_Pending, np.ndarray]]):
         rec = telemetry.current()
         t0 = time.perf_counter()
         route = (name, version)
@@ -421,22 +485,26 @@ class GMMServer:
         open the whole group fast-fails with ``circuit_open`` before any
         of that cost. Client-content errors (wrong D) never touch the
         breaker."""
-        prep = self._prepare_route(name, version, items)
-        if prep is None:
-            return
-        m, good, rows, t0 = prep
-        ex = self._executor_for(m)
-        compiles_before = ex.compile_count
-        try:
-            w, logz = ex.infer(m.state, rows, want="proba")
-        except Exception as e:  # executor/compile failure: a route fault
-            self.breaker.record_failure((name, version), "executor")
-            for p, _ in good:
-                self._reply_error(p, f"dispatch failed: {e}", model=name)
-            return
-        compiled = ex.compile_count - compiles_before
-        self._answer_route(name, version, m, good, rows, w, logz, t0,
-                           compiled, int(ex.padded_rows(rows.shape[0])))
+        with self._route_trace(name, items):
+            prep = self._prepare_route(name, version, items)
+            if prep is None:
+                return
+            m, good, rows, t0 = prep
+            ex = self._executor_for(m)
+            compiles_before = ex.compile_count
+            try:
+                with tl_spans.span("dispatch", model=name):
+                    w, logz = ex.infer(m.state, rows, want="proba")
+            except Exception as e:  # executor/compile failure
+                self.breaker.record_failure((name, version), "executor")
+                for p, _ in good:
+                    self._reply_error(p, f"dispatch failed: {e}",
+                                      model=name)
+                return
+            compiled = ex.compile_count - compiles_before
+            self._answer_route(name, version, m, good, rows, w, logz,
+                               t0, compiled,
+                               int(ex.padded_rows(rows.shape[0])))
 
     def _dispatch_stacked(self, routes) -> None:
         """Cross-model coalescing (docs/TENANCY.md "Serving the fleet"):
@@ -448,6 +516,11 @@ class GMMServer:
         dispatches). Per-route error isolation is unchanged: breaker
         admission, registry errors, and the non-finite poison check all
         stay per (model, version)."""
+        with self._route_trace(
+                "stacked", routes[0][1] if routes else None):
+            self._dispatch_stacked_inner(routes)
+
+    def _dispatch_stacked_inner(self, routes) -> None:
         preps = []
         for (name, version), items in routes:
             prep = self._prepare_route(name, version, items)
@@ -470,9 +543,10 @@ class GMMServer:
             ex = self._executor_for(fam[0][2])
             compiles_before = ex.compile_count
             try:
-                outs, padded = ex.infer_stacked(
-                    [m.state for _, _, m, _, _, _ in fam],
-                    [rows for _, _, _, _, rows, _ in fam])
+                with tl_spans.span("dispatch", stacked=len(fam)):
+                    outs, padded = ex.infer_stacked(
+                        [m.state for _, _, m, _, _, _ in fam],
+                        [rows for _, _, _, _, rows, _ in fam])
             except Exception as e:
                 for name, version, m, good, rows, t0 in fam:
                     self.breaker.record_failure((name, version),
@@ -495,7 +569,8 @@ class GMMServer:
             ex = self._executor_for(m)
             compiles_before = ex.compile_count
             try:
-                w, logz = ex.infer(m.state, rows, want="proba")
+                with tl_spans.span("dispatch", model=name):
+                    w, logz = ex.infer(m.state, rows, want="proba")
             except Exception as e:
                 self.breaker.record_failure((name, version), "executor")
                 for p, _ in good:
@@ -514,6 +589,15 @@ class GMMServer:
         """The dispatch back half: poison check -> breaker verdict ->
         telemetry -> per-request slicing and replies (identical for
         per-model and stacked dispatches)."""
+        with tl_spans.span("answer", model=name):
+            self._answer_route_inner(name, version, m, good, rows, w,
+                                     logz, t0, compiled, padded_rows,
+                                     stacked)
+
+    def _answer_route_inner(self, name: str, version: Optional[int], m,
+                            good, rows, w, logz, t0, compiled: int,
+                            padded_rows: int,
+                            stacked: Optional[int] = None) -> None:
         rec = telemetry.current()
         if faults.take("serve_nan", model=name) is not None:
             w = np.full_like(w, np.nan)
@@ -574,6 +658,10 @@ class GMMServer:
     def _reply(self, p: _Pending, resp: dict) -> None:
         latency_ms = (time.perf_counter() - p.t0) * 1e3
         resp.setdefault("latency_ms", round(latency_ms, 3))
+        if p.trace_id is not None:
+            # Echo the request's trace identity so a client can join its
+            # response to the server-side span/serve_request records.
+            resp.setdefault("trace_id", p.trace_id)
         self.requests += 1
         self._latencies.append(latency_ms)
         rec = telemetry.current()
@@ -587,7 +675,9 @@ class GMMServer:
                      **({"version": resp["version"]}
                         if "version" in resp else {}),
                      **({"error": resp["error"]}
-                        if "error" in resp else {}))
+                        if "error" in resp else {}),
+                     **({"trace_id": p.trace_id}
+                        if p.trace_id is not None else {}))
             rec.metrics.count("serve_requests")
             rec.metrics.observe("serve.latency_ms", latency_ms)
         p.reply(resp)
@@ -667,7 +757,8 @@ class GMMServer:
             p = _Pending({}, reply)
             self._reply_error(p, f"not JSON: {e}")
             return
-        self.submit(_Pending(req, reply, self._default_deadline_ms))
+        self.submit(_Pending(req, reply, self._default_deadline_ms,
+                             trace_id=self._mint_trace_id()))
 
     def submit(self, p: _Pending) -> bool:
         """Admit ``p`` onto the batching queue, or shed it.
@@ -952,6 +1043,18 @@ def serve_main(argv=None) -> int:
                    "serve_batch / serve_summary plus the v1.7 "
                    "resilience events (serve_shed / serve_deadline / "
                    "serve_reload / circuit); render with `gmm report`")
+    p.add_argument("--metrics-port", type=int, default=None,
+                   metavar="PORT",
+                   help="live observability plane (rev v2.1): serve "
+                   "Prometheus/OpenMetrics text on "
+                   "127.0.0.1:PORT/metrics (0 = OS-assigned), sample "
+                   "host RSS + device memory onto heartbeat records, "
+                   "emit route spans, and echo a trace_id in every "
+                   "response (default: off; responses and streams stay "
+                   "byte-identical)")
+    p.add_argument("--trace-dir", default=None, metavar="DIR",
+                   help="capture a jax.profiler trace of the serve "
+                   "loop into DIR (view with TensorBoard or Perfetto)")
     r = p.add_argument_group(
         "resilience (docs/ROBUSTNESS.md \"Serving\")")
     r.add_argument("--max-runtime", type=float, default=None,
@@ -1017,7 +1120,8 @@ def serve_main(argv=None) -> int:
                        default_deadline_ms=args.default_deadline_ms,
                        breaker_threshold=args.breaker_threshold,
                        breaker_backoff_s=args.breaker_backoff_s,
-                       stack_models=args.stack_models)
+                       stack_models=args.stack_models,
+                       trace_requests=args.metrics_port is not None)
 
     rec = (telemetry.RunRecorder(args.metrics_file)
            if args.metrics_file else telemetry.RunRecorder())
@@ -1031,7 +1135,15 @@ def serve_main(argv=None) -> int:
     # support).
     sup = supervisor_mod.RunSupervisor(max_runtime_s=args.max_runtime)
 
-    with telemetry.use(rec), rec, supervisor_mod.use(sup):
+    from ..utils.profiling import trace as profiler_trace
+
+    with telemetry.use(rec), rec, supervisor_mod.use(sup), \
+            tl_exporter.live_plane(
+                args.metrics_port,
+                registry_provider=lambda: telemetry.current().metrics,
+                gauges_provider=server.live_gauges,
+                recorder=rec), \
+            profiler_trace(args.trace_dir):
         # Pre-resolve (and AOT-warm) the requested model set so the first
         # request never pays registry IO or a compile.
         names = args.models

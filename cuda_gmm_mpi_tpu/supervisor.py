@@ -633,6 +633,15 @@ class ElasticRecovery:
         return the refit config. Re-raises ``exc`` when recovery is out
         of budget, the lost rank is unidentifiable, or the world would
         shrink below ``min_hosts``."""
+        from .telemetry import spans as tl_spans
+
+        # The recovery phase gets its own trace span (rev v2.1): under
+        # --metrics-port a shrink-and-resume shows up in the fit's span
+        # tree with its measured cost, not just as shrink/resume events.
+        with tl_spans.span("elastic_recovery", attempt=self.attempt + 1):
+            return self._recover(exc, config)
+
+    def _recover(self, exc: PeerLostError, config):
         import dataclasses
 
         from . import telemetry

@@ -8,20 +8,32 @@ and ``gmm report`` / ``bench.py`` consume it instead of scraping stdout.
 
 Layering: ``schema`` is the wire contract, ``registry`` the numeric
 aggregates, ``recorder`` the event bus + ambient-activation plumbing,
-``report`` the offline renderer. ``utils.profiling.PhaseTimer`` and
-``utils.logging_.metrics_line`` are thin adapters over this package.
+``report`` the offline renderer (plus the ``--follow`` live tailer),
+``exporter`` the live OpenMetrics endpoint + resource sampler, and
+``spans`` the trace-span emission (rev v2.1 live plane).
+``utils.profiling.PhaseTimer`` and ``utils.logging_.metrics_line`` are
+thin adapters over this package.
 """
 
+from .exporter import (MetricsExporter, ResourceSampler, current_exporter,
+                       host_rss_bytes, live_plane, render_openmetrics)
 from .recorder import (RunRecorder, current, memory_stats, read_stream, use,
                        write_line)
 from .registry import MetricsRegistry
-from .report import render_phase_table, render_report, report_main
+from .report import (StreamTailer, follow_stream, render_follow,
+                     render_phase_table, render_report, report_main)
 from .schema import (EVENT_FIELDS, SCHEMA_VERSION, validate_record,
                      validate_stream)
+from .spans import build_span_tree, mint_trace_id, span
+from .spans import trace as trace_spans
 
 __all__ = [
     "RunRecorder", "MetricsRegistry", "current", "use", "write_line",
     "read_stream", "memory_stats",
     "render_phase_table", "render_report", "report_main",
+    "StreamTailer", "follow_stream", "render_follow",
     "EVENT_FIELDS", "SCHEMA_VERSION", "validate_record", "validate_stream",
+    "MetricsExporter", "ResourceSampler", "current_exporter",
+    "host_rss_bytes", "live_plane", "render_openmetrics",
+    "build_span_tree", "mint_trace_id", "span", "trace_spans",
 ]

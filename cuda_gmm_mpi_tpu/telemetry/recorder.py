@@ -122,7 +122,12 @@ class RunRecorder:
         if self._fh is None and self._path is not None:
             # Truncate: one run, one stream. Rank 0 only (host-0
             # aggregation); other ranks keep accumulating metrics.
-            self._fh = open(self._path, "w", encoding="utf-8")
+            # Line-buffered: paired with the per-record flush in emit()
+            # this is the durability guarantee --follow tailers and
+            # post-crash forensics rely on (a killed process never
+            # leaves a completed record stuck in a userspace buffer,
+            # and a reader only ever sees whole lines).
+            self._fh = open(self._path, "w", buffering=1, encoding="utf-8")
         return self._fh
 
     def emit(self, event: str, **fields) -> Optional[dict]:
@@ -134,6 +139,10 @@ class RunRecorder:
             "event": event,
             "schema": SCHEMA_VERSION,
             "ts": round(time.time(), 6),
+            # Process-monotonic sibling of ts (rev v2.1): report/--follow
+            # compute durations from mono_s deltas, immune to wall-clock
+            # slew. Comparable only within one process's records.
+            "mono_s": round(time.perf_counter(), 6),
             "run_id": self.run_id,
             "process": self._process,
         }

@@ -6,11 +6,23 @@ human-readable surfaces -- the 7-category phase-profile table
 live ``--profile`` print and the offline report are byte-compatible), the
 per-K selection sweep summary, and the per-iteration loglik trajectory --
 from the stream alone: no pickle, no state files, no devices.
+
+``gmm report --follow`` (alias ``gmm top``; rev v2.1) is the live
+counterpart: an incremental tailer over the same stream -- a single
+JSONL file, or a directory of per-rank ``*.jsonl`` streams -- that
+re-renders a one-screen view as records arrive. It leans on the
+recorder's line-buffered flush-per-record sink: a reader only ever sees
+whole lines, so the tailer never has to re-parse a torn record. Where
+``mono_s`` (rev v2.1 envelope) is present, rates and ages are computed
+from monotonic deltas rather than wall-clock ``ts``.
 """
 
 from __future__ import annotations
 
+import json
+import os
 import sys
+import time
 from typing import Dict, List, Optional
 
 from .schema import validate_stream
@@ -56,6 +68,11 @@ def _fmt_run_start(rec: dict) -> str:
     return "  ".join(str(b) for b in bits)
 
 
+def _count_spans(node: dict) -> int:
+    """Descendant count of one span-tree node (elision bookkeeping)."""
+    return sum(1 + _count_spans(c) for c in node["children"])
+
+
 def render_report(records: List[dict], max_trajectory_rows: int = 400) -> str:
     """The full ``gmm report`` text for one decoded stream."""
     out: List[str] = []
@@ -83,6 +100,10 @@ def render_report(records: List[dict], max_trajectory_rows: int = 400) -> str:
     fleet_summaries = [r for r in records
                        if r.get("event") == "fleet_summary"]
 
+    rebuckets = [r for r in records if r.get("event") == "rebucket"]
+    heartbeats = [r for r in records if r.get("event") == "heartbeat"]
+    span_recs = [r for r in records if r.get("event") == "span"]
+
     selects = [r for r in records if r.get("event") == "restart_select"]
     healths = [r for r in records if r.get("event") == "health"]
     recoveries = [r for r in records if r.get("event") == "recovery"]
@@ -108,6 +129,18 @@ def render_report(records: List[dict], max_trajectory_rows: int = 400) -> str:
                        f"  {r['seconds']:>9.3f}")
         if merges:
             out.append(f"  ({len(merges)} closest-pair merges)")
+        if rebuckets:
+            widths = ", ".join(
+                f"{r.get('from_width')}->{r.get('to_width')}"
+                for r in rebuckets[:8])
+            if len(rebuckets) > 8:
+                widths += ", ..."
+            out.append(f"  ({len(rebuckets)} bucket recompactions: "
+                       f"{widths})")
+        out.append("")
+    elif rebuckets:
+        out.append(f"{len(rebuckets)} bucket recompactions "
+                   "(rebucket; sweep_k_buckets)")
         out.append("")
 
     if iters:
@@ -352,6 +385,61 @@ def render_report(records: List[dict], max_trajectory_rows: int = 400) -> str:
                        f"{ck}")
         out.append("")
 
+    if heartbeats:
+        last = heartbeats[-1]
+        out.append(
+            f"Liveness: {len(heartbeats)} heartbeat(s), last "
+            f"phase={last.get('phase', '?')} at "
+            f"elapsed={float(last.get('elapsed_s', 0)):.0f}s")
+        samples = [r for r in heartbeats if r.get("sampler")]
+        rss = [int(r["rss_bytes"]) for r in samples
+               if r.get("rss_bytes") is not None]
+        if rss:
+            line = (f"  resources ({len(samples)} samples): host RSS "
+                    f"peak {max(rss) / 1e6:.1f} MB")
+            hbm = [int((r.get("memory_stats") or {}).get(
+                       "peak_bytes_in_use",
+                       (r.get("memory_stats") or {}).get(
+                           "bytes_in_use", 0)))
+                   for r in samples if r.get("memory_stats")]
+            if any(hbm):
+                line += f", device peak {max(hbm) / 1e6:.1f} MB"
+            out.append(line)
+        out.append("")
+
+    if span_recs:
+        from .spans import build_span_tree
+
+        traces = {str(r.get("trace_id")) for r in span_recs}
+        out.append(f"Trace spans (rev v2.1): {len(span_recs)} span(s) "
+                   f"in {len(traces)} trace(s)")
+        max_span_rows = 120
+        shown = 0
+        elided = 0
+        # Depth-first with an explicit stack; children are pre-sorted by
+        # start time in build_span_tree.
+        stack = [(root, 0) for root in reversed(build_span_tree(span_recs))]
+        while stack:
+            node, depth = stack.pop()
+            s = node["span"]
+            if shown >= max_span_rows:
+                elided += 1 + _count_spans(node)
+                continue
+            shown += 1
+            label = str(s.get("name", "?"))
+            for key in ("k", "group", "model", "step"):
+                if s.get(key) is not None:
+                    label += f" {key}={s[key]}"
+            status = ("" if s.get("status", "ok") == "ok"
+                      else f"  [{s.get('status')}]")
+            out.append(f"  {'  ' * depth}{label:<{max(30 - 2 * depth, 8)}s}"
+                       f" {float(s.get('duration_s', 0)):>9.3f}s{status}")
+            for child in reversed(node["children"]):
+                stack.append((child, depth + 1))
+        if elided:
+            out.append(f"  ... {elided} more span(s) elided")
+        out.append("")
+
     for s in summaries:
         prof = s.get("phase_profile") or {}
         if prof.get("seconds"):
@@ -408,6 +496,270 @@ def render_report(records: List[dict], max_trajectory_rows: int = 400) -> str:
     return "\n".join(out).rstrip() + "\n"
 
 
+# -- gmm report --follow / gmm top (rev v2.1) ---------------------------
+
+# Records that end a stream: once one arrives, the tailer renders a last
+# screen and exits instead of polling a finished run forever.
+_TERMINAL_EVENTS = frozenset(
+    ("run_summary", "serve_summary", "fleet_summary", "shutdown"))
+
+
+def _discover_streams(path: str) -> List[str]:
+    """The stream files behind one ``gmm top`` target: the file itself,
+    or every ``*.jsonl`` in a directory of per-rank streams."""
+    if os.path.isdir(path):
+        return sorted(
+            os.path.join(path, f) for f in os.listdir(path)
+            if f.endswith(".jsonl"))
+    return [path]
+
+
+class StreamTailer:
+    """Incremental reader of one JSONL stream file.
+
+    Keeps a byte offset; each :meth:`poll` returns the records completed
+    since the last one. Only whole lines are consumed -- a torn final
+    line (caught mid-write) stays unread until its newline lands, which
+    the recorder's flush-per-record sink guarantees eventually happens.
+    A file that SHRANK (a new run truncating the same path) restarts the
+    offset from zero.
+    """
+
+    def __init__(self, path: str):
+        self.path = path
+        self._offset = 0
+
+    def poll(self) -> List[dict]:
+        try:
+            size = os.path.getsize(self.path)
+        except OSError:
+            return []  # not created yet (or vanished): keep waiting
+        if size < self._offset:
+            self._offset = 0
+        if size == self._offset:
+            return []
+        with open(self.path, "rb") as fh:
+            fh.seek(self._offset)
+            chunk = fh.read()
+        nl = chunk.rfind(b"\n")
+        if nl < 0:
+            return []
+        consumed = chunk[:nl + 1]
+        self._offset += len(consumed)
+        records: List[dict] = []
+        for raw in consumed.splitlines():
+            raw = raw.strip()
+            if not raw:
+                continue
+            try:
+                records.append(json.loads(raw.decode("utf-8")))
+            except (ValueError, UnicodeDecodeError):
+                continue  # live view: skip a bad line, don't die
+        return records
+
+
+def _iter_rate(iters: List[dict], window: int = 50) -> Optional[float]:
+    """EM iterations/s over the trailing window -- from ``mono_s``
+    deltas when every record carries one (rev v2.1), immune to
+    wall-clock slew; ``ts`` fallback for older streams."""
+    if len(iters) < 2:
+        return None
+    tail = iters[-window:]
+    key = "mono_s" if all("mono_s" in r for r in tail) else "ts"
+    dt = float(tail[-1][key]) - float(tail[0][key])
+    if dt <= 0:
+        return None
+    return (len(tail) - 1) / dt
+
+
+def render_follow(records: List[dict]) -> str:
+    """The ``gmm top`` screen: a one-screen live view of the stream."""
+    if not records:
+        return "(gmm top: waiting for telemetry records...)\n"
+    by: Dict[str, List[dict]] = {}
+    for r in records:
+        by.setdefault(str(r.get("event")), []).append(r)
+    out: List[str] = []
+
+    starts = by.get("run_start", [])
+    fleet_starts = by.get("fleet_start", [])
+    head = ["gmm top"]
+    if starts:
+        s = starts[-1]
+        head.append(f"run {s.get('run_id', '?')}")
+        head.append(f"platform={s.get('platform', '?')}")
+        head.append(f"N={s.get('num_events', '?')} "
+                    f"D={s.get('num_dimensions', '?')}")
+        if s.get("path"):
+            head.append(f"path={s['path']}")
+    elif fleet_starts:
+        s = fleet_starts[-1]
+        head.append(f"fleet run {s.get('run_id', '?')}")
+        head.append(f"platform={s.get('platform', '?')}")
+    elif by.get("serve_request") or by.get("serve_batch"):
+        head.append(f"serve run {records[-1].get('run_id', '?')}")
+    out.append("  ".join(head))
+    out.append("")
+
+    iters = by.get("em_iter", [])
+    dones = by.get("em_done", [])
+    if iters:
+        cur = iters[-1]
+        rate = _iter_rate(iters)
+        line = (f"EM: K={cur.get('k')} iter={cur.get('iter')} "
+                f"loglik={float(cur.get('loglik', 0)):.6e}")
+        if cur.get("delta") is not None:
+            line += f" delta={float(cur['delta']):.3e}"
+        if rate is not None:
+            line += f"  ({rate:.1f} iters/s)"
+        out.append(line)
+    if dones:
+        import math
+
+        best = min(
+            (r for r in dones
+             if isinstance(r.get("score"), (int, float))
+             and not math.isnan(float(r["score"]))),
+            key=lambda r: float(r["score"]), default=None)
+        line = f"Sweep: {len(dones)} model order(s) done"
+        if best is not None:
+            line += (f"; best K={best.get('k')} "
+                     f"score={float(best['score']):.6e}")
+        out.append(line)
+
+    tenant_dones = by.get("tenant_done", [])
+    if fleet_starts or tenant_dones:
+        total = (fleet_starts[-1].get("tenants", "?")
+                 if fleet_starts else "?")
+        dropped = sum(1 for r in tenant_dones if r.get("dropped"))
+        out.append(f"Fleet: {len(tenant_dones)}/{total} tenant(s) done"
+                   + (f" ({dropped} dropped)" if dropped else ""))
+
+    serve_reqs = by.get("serve_request", [])
+    if serve_reqs:
+        failed = sum(1 for r in serve_reqs if not r.get("ok"))
+        rows = sum(int(r.get("n", 0)) for r in serve_reqs)
+        lat = sorted(float(r.get("latency_ms", 0.0))
+                     for r in serve_reqs[-200:])
+        p50 = lat[len(lat) // 2] if lat else 0.0
+        line = (f"Serve: {len(serve_reqs)} requests ({failed} failed), "
+                f"{rows} rows, p50 {p50:.2f} ms")
+        extras = []
+        for kind, tag in (("serve_shed", "shed"),
+                          ("serve_deadline", "deadline"),
+                          ("serve_reload", "reload")):
+            n = len(by.get(kind, []))
+            if n:
+                extras.append(f"{n} {tag}")
+        opens = sum(1 for r in by.get("circuit", [])
+                    if r.get("state") == "open")
+        if opens:
+            extras.append(f"{opens} breaker trip(s)")
+        if extras:
+            line += "  [" + ", ".join(extras) + "]"
+        out.append(line)
+
+    healths = by.get("health", [])
+    recoveries = by.get("recovery", [])
+    if healths or recoveries:
+        out.append(f"Health: {len(healths)} nonzero flag word(s), "
+                   f"{len(recoveries)} recovery action(s)")
+    shrinks = by.get("elastic_shrink", [])
+    if shrinks:
+        last = shrinks[-1]
+        out.append(f"Elastic: generation {last.get('generation')} "
+                   f"({last.get('world_size')} host(s))")
+
+    samples = [r for r in by.get("heartbeat", []) if r.get("sampler")]
+    if samples:
+        last = samples[-1]
+        line = "Resources:"
+        if last.get("rss_bytes") is not None:
+            line += f" host RSS {int(last['rss_bytes']) / 1e6:.1f} MB"
+        mem = last.get("memory_stats") or {}
+        if mem.get("bytes_in_use") is not None:
+            line += f", device {int(mem['bytes_in_use']) / 1e6:.1f} MB"
+            if mem.get("peak_bytes_in_use") is not None:
+                line += (" (peak "
+                         f"{int(mem['peak_bytes_in_use']) / 1e6:.1f} MB)")
+        out.append(line)
+
+    spans = by.get("span", [])
+    if spans:
+        last = spans[-1]
+        out.append(f"Spans: {len(spans)} closed, last "
+                   f"{last.get('name', '?')} "
+                   f"({float(last.get('duration_s', 0)):.3f}s)")
+
+    last = records[-1]
+    tail = f"last event: {last.get('event')}"
+    if last.get("ts") is not None:
+        age = max(0.0, time.time() - float(last["ts"]))
+        tail += f" ({age:.1f}s ago)"
+    if any(k in _TERMINAL_EVENTS for k in by):
+        # Anywhere, not just last: with the live plane on, the closing
+        # fit/fleet span records land AFTER run_summary (they close when
+        # the plane's ExitStack unwinds around the emitting code).
+        tail += "  -- stream ended"
+    out.append("")
+    out.append(tail)
+    return "\n".join(out) + "\n"
+
+
+def follow_stream(path: str, interval_s: float = 1.0,
+                  max_renders: Optional[int] = None, out=None) -> int:
+    """The ``--follow`` loop: poll, merge, re-render until the stream
+    ends (a terminal record) or ``max_renders`` screens were drawn."""
+    out = out if out is not None else sys.stdout
+    clear = bool(getattr(out, "isatty", lambda: False)())
+    tailers: Dict[str, StreamTailer] = {}
+    records: List[dict] = []
+    renders = 0
+    ended = False
+
+    def _poll_all() -> List[dict]:
+        for stream_path in _discover_streams(path):
+            if stream_path not in tailers:
+                tailers[stream_path] = StreamTailer(stream_path)
+        new: List[dict] = []
+        for t in tailers.values():
+            new.extend(t.poll())
+        return new
+
+    def _render() -> None:
+        nonlocal renders
+        if clear:
+            out.write("\x1b[2J\x1b[H")  # clear + home, like top(1)
+        elif renders:
+            out.write("\n--- refresh ---\n")
+        out.write(render_follow(records))
+        out.flush()
+        renders += 1
+
+    while True:
+        new = _poll_all()
+        if new or renders == 0:
+            records.extend(new)
+            _render()
+        ended = ended or any(
+            r.get("event") in _TERMINAL_EVENTS for r in new)
+        if ended:
+            # The run is over, but teardown records can TRAIL the
+            # terminal one (with the live plane on, the closing
+            # fit/fleet spans emit after run_summary, when the plane's
+            # ExitStack unwinds). One short drain catches them, then a
+            # final screen.
+            time.sleep(min(interval_s, 0.2))
+            tail_records = _poll_all()
+            if tail_records:
+                records.extend(tail_records)
+                _render()
+            return 0
+        if max_renders is not None and renders >= max_renders:
+            return 0
+        time.sleep(interval_s)
+
+
 def report_main(argv=None) -> int:
     """``gmm report <metrics.jsonl>``: render a stream on stdout."""
     import argparse
@@ -417,11 +769,29 @@ def report_main(argv=None) -> int:
     p = argparse.ArgumentParser(
         prog="gmm report",
         description="Render a --metrics-file JSONL telemetry stream: phase "
-        "profile, loglik trajectory, and model-order sweep summary.")
-    p.add_argument("metrics_file", help="JSONL stream from --metrics-file")
+        "profile, loglik trajectory, and model-order sweep summary. "
+        "--follow (alias: `gmm top`) tails a LIVE stream -- a file or a "
+        "directory of per-rank *.jsonl streams -- re-rendering a "
+        "one-screen view as records arrive.")
+    p.add_argument("metrics_file", help="JSONL stream from --metrics-file "
+                   "(with --follow: a file or a stream directory)")
     p.add_argument("--validate", action="store_true",
                    help="exit nonzero if any record fails schema validation")
+    p.add_argument("--follow", "-f", action="store_true",
+                   help="live view: poll the stream and re-render one "
+                   "screen as it grows; exits when the run's terminal "
+                   "record (run_summary / serve_summary / fleet_summary "
+                   "/ shutdown) arrives")
+    p.add_argument("--interval", type=float, default=1.0, metavar="S",
+                   help="--follow poll cadence in seconds (default 1)")
+    p.add_argument("--max-renders", type=int, default=None, metavar="N",
+                   help="--follow: stop after N screens (automation and "
+                   "tests; default: until the stream ends)")
     args = p.parse_args(argv)
+    if args.follow:
+        return follow_stream(args.metrics_file,
+                             interval_s=args.interval,
+                             max_renders=args.max_renders)
     try:
         records = read_stream(args.metrics_file)
     except OSError as e:

@@ -19,7 +19,14 @@ from typing import Any, Dict, Iterable, List, Tuple
 
 SCHEMA_VERSION = 1
 
-# Stamped on every record by the recorder.
+# Stamped on every record by the recorder. Rev v2.1 additions to the
+# envelope are OPTIONAL (not listed here -- old fixtures must keep
+# validating): ``mono_s``, the process-monotonic emission time
+# (time.perf_counter()), which report/--follow prefer over wall-clock
+# ``ts`` deltas for durations (``ts`` can jump under NTP slew -- the
+# clock-skew bug class the PR-11 watchdog fix addressed); and
+# ``trace_id``, the fit/request-scoped trace identity joining a record
+# to its span tree (telemetry/spans.py).
 COMMON_FIELDS = ("event", "schema", "ts", "run_id", "process")
 
 # event -> ((required fields), (optional well-known fields)). Optional
@@ -88,10 +95,14 @@ EVENT_FIELDS: Dict[str, Tuple[Tuple[str, ...], Tuple[str, ...]]] = {
         ("blocks_read", "peak_resident_blocks"),
         ("prefetch_wait_s", "bytes", "queue_depth"),
     ),
-    # Rate-limited liveness marker for long phases.
+    # Rate-limited liveness marker for long phases. The resource sampler
+    # (rev v2.1; telemetry/exporter.py, --metrics-port) stamps periodic
+    # heartbeats with ``rss_bytes`` (host VmRSS) and ``memory_stats``
+    # (first local device's memory_stats(): HBM in-use / peak) so memory
+    # high-water is observable DURING the run, not only at run_start.
     "heartbeat": (
         ("phase", "elapsed_s"),
-        ("k",),
+        ("k", "rss_bytes", "memory_stats", "sampler"),
     ),
     # One per nonzero health word observed (health.py): ``flags`` is the
     # packed bitmask, ``flag_names`` its decoded lanes, ``counters`` the
@@ -170,7 +181,9 @@ EVENT_FIELDS: Dict[str, Tuple[Tuple[str, ...], Tuple[str, ...]]] = {
     # ``error``.
     "serve_request": (
         ("model", "op", "n", "latency_ms"),
-        ("version", "ok", "error"),
+        # ``trace_id`` (rev v2.1): present under ``--metrics-port``; the
+        # same id is echoed in the client's response for joining.
+        ("version", "ok", "error", "trace_id"),
     ),
     # One per coalesced micro-batch dispatch: how many concurrent
     # requests' rows rode one padded executor call, the pow2-bucketed
@@ -255,6 +268,18 @@ EVENT_FIELDS: Dict[str, Tuple[Tuple[str, ...], Tuple[str, ...]]] = {
     "fleet_summary": (
         ("tenants", "dropped", "groups", "wall_s"),
         ("mode", "metrics"),
+    ),
+    # Trace span (rev v2.1; telemetry/spans.py): one per completed phase
+    # of a traced fit or serve request -- name, this span's id, its
+    # parent's id (absent on the root), and the measured duration.
+    # ``trace_id`` usually arrives via the recorder context (one trace
+    # per fit) but serve spans carry it per-record (one trace per
+    # request). ``t0_mono_s`` is the span's START on the process
+    # monotonic clock (the envelope's ``mono_s`` is the emission time =
+    # span END), so a reader can order siblings and compute self-time.
+    "span": (
+        ("name", "span_id", "duration_s"),
+        ("parent_id", "trace_id", "t0_mono_s", "k", "status"),
     ),
     # One per fit: final scores, the 7-category phase profile, the
     # compile-vs-execute split, and the metrics-registry snapshot.

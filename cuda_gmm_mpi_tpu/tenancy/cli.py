@@ -97,6 +97,16 @@ def build_fleet_parser() -> argparse.ArgumentParser:
                    help="fleet telemetry stream (rev v1.8: fleet_start "
                    "/ tenant_done / fleet_summary); render with "
                    "`gmm report`")
+    p.add_argument("--metrics-port", type=int, default=None,
+                   metavar="PORT",
+                   help="live observability plane (rev v2.1): serve "
+                   "Prometheus/OpenMetrics text on "
+                   "127.0.0.1:PORT/metrics (0 = OS-assigned), sample "
+                   "host RSS + device memory onto heartbeat records, "
+                   "and emit fleet/group trace spans (default: off)")
+    p.add_argument("--trace-dir", default=None, metavar="DIR",
+                   help="capture a jax.profiler trace of the fleet fit "
+                   "into DIR (view with TensorBoard or Perfetto)")
     p.add_argument("--verbose", "-v", action="store_true")
     return p
 
@@ -169,6 +179,7 @@ def fleet_main(argv=None) -> int:
             resume=args.resume,
             max_runtime_s=args.max_runtime,
             metrics_file=args.metrics_file,
+            metrics_port=args.metrics_port,
             fleet_mode=args.fleet_mode,
             fleet_group_size=args.fleet_group_size,
             enable_print=args.verbose,
@@ -193,11 +204,12 @@ def fleet_main(argv=None) -> int:
             print(str(err), file=sys.stderr)
             return 1
 
+    from ..utils.profiling import trace
     from .fleet import fit_fleet
 
     sup = supervisor_mod.RunSupervisor(max_runtime_s=args.max_runtime)
     try:
-        with supervisor_mod.use(sup):
+        with supervisor_mod.use(sup), trace(args.trace_dir):
             fleet = fit_fleet(tenants, config, verbose=args.verbose)
     except PreemptedError as e:
         print(f"Preempted -- {e}", file=sys.stderr)

@@ -56,6 +56,8 @@ from ..models.restarts import (
 from ..ops.formulas import model_score
 from ..state import clone_state, compact
 from ..telemetry import RunRecorder
+from ..telemetry import exporter as tl_exporter
+from ..telemetry import spans as tl_spans
 from ..utils.logging_ import get_logger
 from .packing import TenantSpec, pack_group, plan_fleet
 
@@ -147,6 +149,22 @@ def fit_fleet(tenants: List[TenantSpec], config: GMMConfig = GMMConfig(),
             stack.enter_context(supervisor.use(supervisor.RunSupervisor(
                 max_runtime_s=config.max_runtime_s,
                 install_signals=False)))
+        if config.metrics_port is not None:
+            # Live observability plane (rev v2.1): /metrics exporter +
+            # resource sampler + a fleet-rooted span trace. Entirely
+            # gated so metrics_port=None keeps streams byte-identical.
+            from ..parallel import elastic
+
+            stack.enter_context(tl_exporter.live_plane(
+                config.metrics_port,
+                registry_provider=lambda: telemetry.current().metrics,
+                gauges_provider=elastic.live_gauges))
+            rec = telemetry.current()
+            tid = stack.enter_context(tl_spans.trace())
+            if rec.active:
+                rec.set_context(trace_id=tid)
+                stack.callback(rec.set_context, trace_id=None)
+            stack.enter_context(tl_spans.span("fleet"))
         return _fit_fleet(tenants, config, model, verbose)
 
 
@@ -222,6 +240,10 @@ def _fit_fleet(tenants, config, model, verbose) -> FleetResult:
                 retries=config.checkpoint_retries,
                 allow_world_change=config.elastic)
         t0 = time.perf_counter()
+        # Non-lexical span (a preempt raises through the retry loop; an
+        # un-ended span simply never emits -- see telemetry/spans.py).
+        g_span = tl_spans.begin("fleet_group", group=gi,
+                                tenants=len(group.indices))
         while True:
             try:
                 results = _run_group(model, config, packed, ckpt, rec, log,
@@ -236,6 +258,7 @@ def _fit_fleet(tenants, config, model, verbose) -> FleetResult:
                 if recovery is None:
                     raise
                 config = recovery.recover(e, config)
+        tl_spans.end(g_span)
         group_meta.append({
             "tenants": len(group.indices),
             "n_bucket": int(group.n_bucket),

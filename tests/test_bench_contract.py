@@ -406,3 +406,47 @@ def test_ingest_ab_mode_contract():
     assert ab["minibatch_regression"] <= ab["minibatch_tolerance"]
     assert ab["minibatch_within_tolerance"] is True
     assert j["vs_baseline"] == ab["rss_growth_ratio"]
+
+
+def test_obs_mode_contract():
+    """--obs (GMM_BENCH_OBS=1) emits ONE JSON record carrying all three
+    walls (off / stream / live) plus both overhead ratios, live-scrape
+    health, and the bit-identity bit. `within_bound` must be PRESENT and
+    boolean but its truth is not asserted: at contract-test shapes the
+    fixed per-fit costs dominate and the ratio is noise -- the bound is
+    a measurement claim for bench shapes (docs/OBSERVABILITY.md), not a
+    structural invariant."""
+    r = _run({
+        "GMM_BENCH_CPU": "1",
+        "GMM_BENCH_OBS": "1",
+        "GMM_BENCH_OBS_N": "4000",
+        "GMM_BENCH_OBS_D": "4",
+        "GMM_BENCH_OBS_K": "4",
+        # Enough iterations that the (warm) live fit outlives several
+        # scraper polls and sampler ticks -- the scrape-health bits
+        # below must not race a millisecond fit window.
+        "GMM_BENCH_OBS_ITERS": "60",
+        "GMM_SAMPLER_INTERVAL_S": "0.05",
+    }, timeout=600)
+    assert r.returncode == 0, r.stderr
+    j = _json_line(r.stdout)
+    assert j["unit"] == "x" and j["value"] > 0
+    assert j["accelerator_unavailable"] is False
+    ab = j["obs"]
+    assert ab["n"] == 4000 and ab["k"] == 4 and ab["em_iters"] == 60
+    # all three walls in the SAME record, ratios consistent with them
+    for wall in ("off_wall_s", "stream_wall_s", "live_wall_s"):
+        assert ab[wall] > 0
+    assert ab["stream_overhead"] > 0 and ab["live_overhead"] > 0
+    assert j["value"] == ab["live_overhead"] == j["vs_baseline"]
+    assert ab["documented_bound"] > 1.0
+    assert isinstance(ab["within_bound"], bool)
+    # live-plane health: the endpoint was scraped DURING the fit and the
+    # last scrape parsed as OpenMetrics; the live stream carries spans
+    # and sampler heartbeats.
+    assert ab["scrapes"] >= 1
+    assert ab["scrape_parse_ok"] is True
+    assert ab["span_records"] > 0
+    assert ab["sampler_heartbeats"] >= 1
+    # Instrumentation must not change the arithmetic.
+    assert ab["loglik_bit_identical"] is True

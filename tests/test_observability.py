@@ -1,0 +1,563 @@
+"""Live observability plane (rev v2.1; docs/OBSERVABILITY.md):
+OpenMetrics exporter, resource sampler, trace spans, `gmm top`.
+
+Contracts:
+- schema <-> report drift: every event kind declared in
+  ``schema.EVENT_FIELDS`` has a renderer in ``gmm report`` (the
+  report-side counterpart of test_telemetry's emit-site drift test);
+- the exporter serves parseable OpenMetrics text whose gauges CHANGE
+  between scrapes of a live fit, from a plain HTTP client thread;
+- span records from a sweep fit reconstruct into a single-rooted tree
+  covering sweep / per-K EM / checkpoint; the serve route path nests
+  prepare/dispatch/answer under serve_route, and a client's echoed
+  trace_id finds the server-side records;
+- with --metrics-port unset the stream is byte-identical in shape: no
+  span records, no trace_id context, no sampler heartbeats;
+- the --follow tailer renders a GROWING stream incrementally (file and
+  per-rank directory targets) and exits on the terminal record.
+"""
+
+import json
+import os
+import threading
+import time
+import urllib.request
+
+import numpy as np
+import pytest
+
+from cuda_gmm_mpi_tpu import GMMConfig, fit_gmm, telemetry
+from cuda_gmm_mpi_tpu.telemetry import (MetricsExporter, MetricsRegistry,
+                                        ResourceSampler, build_span_tree,
+                                        render_openmetrics)
+from cuda_gmm_mpi_tpu.telemetry import exporter as tl_exporter
+from cuda_gmm_mpi_tpu.telemetry import report as report_mod
+from cuda_gmm_mpi_tpu.telemetry import schema
+from cuda_gmm_mpi_tpu.telemetry import spans as tl_spans
+from cuda_gmm_mpi_tpu.telemetry.report import (StreamTailer, follow_stream,
+                                               render_follow, report_main)
+
+from .conftest import make_blobs
+
+
+# ------------------------------------------------- schema <-> report drift
+
+
+def test_every_schema_event_kind_has_a_report_renderer():
+    """Adding an event to EVENT_FIELDS without teaching `gmm report` to
+    render it fails HERE, not in a user's unreadably silent report --
+    the report-side mirror of the emit-site drift test (PR 8)."""
+    import inspect
+
+    src = inspect.getsource(report_mod)
+    missing = [kind for kind in schema.EVENT_FIELDS
+               if f'"{kind}"' not in src]
+    assert not missing, (
+        f"event kinds with no renderer in telemetry/report.py: {missing}")
+
+
+# ------------------------------------------------------------ spans (unit)
+
+
+def _stream_recorder():
+    import io
+
+    buf = io.StringIO()
+    return telemetry.RunRecorder(stream=buf), buf
+
+
+def _records(buf):
+    return [json.loads(line) for line in buf.getvalue().splitlines()]
+
+
+def test_span_noop_without_active_trace():
+    """span() outside a trace() emits NOTHING -- the byte-identity gate:
+    instrumented code paths cost an attribute check when the plane is
+    off."""
+    rec, buf = _stream_recorder()
+    with tl_spans.span("phase", recorder=rec):
+        pass
+    assert tl_spans.begin("x", recorder=rec) is None
+    assert buf.getvalue() == ""
+
+
+def test_span_nesting_and_error_status():
+    rec, buf = _stream_recorder()
+    with tl_spans.trace() as tid:
+        with tl_spans.span("outer", recorder=rec):
+            with tl_spans.span("inner", recorder=rec, k=3):
+                pass
+        with pytest.raises(RuntimeError):
+            with tl_spans.span("boom", recorder=rec):
+                raise RuntimeError("x")
+    recs = _records(buf)
+    assert [r["name"] for r in recs] == ["inner", "outer", "boom"]
+    assert all(r["event"] == "span" and r["trace_id"] == tid for r in recs)
+    inner, outer, boom = recs
+    assert inner["parent_id"] == outer["span_id"]
+    assert "parent_id" not in outer and "parent_id" not in boom
+    assert inner["k"] == 3
+    assert boom["status"] == "error"
+    assert all(r["duration_s"] >= 0 for r in recs)
+    for r in recs:
+        assert not schema.validate_record(r), schema.validate_record(r)
+
+
+def test_nested_trace_reuses_outer_identity():
+    with tl_spans.trace() as outer_tid:
+        with tl_spans.trace() as inner_tid:
+            assert inner_tid == outer_tid
+        assert tl_spans.current_trace_id() == outer_tid
+    assert tl_spans.current_trace_id() is None
+
+
+def test_begin_end_survives_abandoned_children():
+    """A raise that abandons open child spans must not corrupt later
+    parentage: end() pops the handle AND everything above it."""
+    rec, buf = _stream_recorder()
+    with tl_spans.trace():
+        sweep = tl_spans.begin("sweep", recorder=rec)
+        tl_spans.begin("em_k", recorder=rec)  # abandoned (never ended)
+        tl_spans.end(sweep)
+        with tl_spans.span("after", recorder=rec):
+            pass
+    recs = _records(buf)
+    by_name = {r["name"]: r for r in recs}
+    assert set(by_name) == {"sweep", "after"}  # abandoned span never emits
+    assert "parent_id" not in by_name["after"]
+
+
+def test_build_span_tree_promotes_orphans():
+    recs = [
+        {"event": "span", "name": "child", "span_id": "c",
+         "parent_id": "never-ended", "trace_id": "t", "t0_mono_s": 2.0,
+         "duration_s": 0.1},
+        {"event": "span", "name": "root", "span_id": "r",
+         "trace_id": "t", "t0_mono_s": 1.0, "duration_s": 5.0},
+    ]
+    roots = build_span_tree(recs)
+    assert [n["span"]["name"] for n in roots] == ["root", "child"]
+
+
+# --------------------------------------------------------- exporter (unit)
+
+
+def test_render_openmetrics_exposition_format():
+    reg = MetricsRegistry()
+    reg.count("em_iters", 7)
+    reg.gauge("active_k", 12)
+    reg.observe("serve.latency_ms", 2.0)
+    reg.observe("serve.latency_ms", 4.0)
+    text = render_openmetrics(reg.snapshot(), {"gmm_custom": 1.5})
+    lines = text.splitlines()
+    assert lines[-1] == "# EOF"
+    assert "# TYPE gmm_em_iters counter" in lines
+    assert "gmm_em_iters_total 7" in lines
+    assert "# TYPE gmm_active_k gauge" in lines
+    assert "gmm_active_k 12" in lines
+    assert "# TYPE gmm_serve_latency_ms summary" in lines
+    assert "gmm_serve_latency_ms_count 2" in lines
+    assert "gmm_serve_latency_ms_sum 6" in lines
+    assert "gmm_custom 1.5" in lines
+    # Every sample line is "name value" with a float-parseable value.
+    for line in lines:
+        if line and not line.startswith("#"):
+            name, value = line.rsplit(" ", 1)
+            float(value)
+
+
+def test_exporter_scrape_and_derived_rate():
+    reg = MetricsRegistry()
+    gauges = {"gmm_run_k": 32.0}
+    with MetricsExporter(lambda: reg, lambda: gauges, port=0) as ex:
+        assert ex.port and ex.port > 0
+        assert tl_exporter.current_exporter() is ex
+
+        def scrape(path="/metrics"):
+            url = f"http://127.0.0.1:{ex.port}{path}"
+            with urllib.request.urlopen(url, timeout=5) as resp:
+                return resp.status, dict(resp.headers), \
+                    resp.read().decode("utf-8")
+
+        reg.count("em_iters", 10)
+        status, headers, body = scrape()
+        assert status == 200
+        assert headers["Content-Type"].startswith(
+            "application/openmetrics-text")
+        assert "gmm_em_iters_total 10" in body
+        assert "gmm_run_k 32" in body
+        assert body.endswith("# EOF\n")
+        reg.count("em_iters", 5)
+        _, _, body2 = scrape()
+        assert "gmm_em_iters_total 15" in body2       # gauges changed
+        assert "gmm_em_iters_per_s" in body2          # derived rate
+        with pytest.raises(urllib.error.HTTPError):
+            scrape("/nope")
+    assert tl_exporter.current_exporter() is None
+
+
+def test_resource_sampler_stamps_heartbeats():
+    rec, buf = _stream_recorder()
+    sampler = ResourceSampler(recorder=rec, interval_s=0.01)
+    out = sampler.sample_once()
+    assert out is not None and out["event"] == "heartbeat"
+    assert out["sampler"] is True and out["phase"] == "sampler"
+    assert not schema.validate_record(json.loads(json.dumps(out)))
+    recs = _records(buf)
+    assert recs and recs[0].get("rss_bytes", 1) > 0
+    # Inert recorder -> no-op, never a crash.
+    assert ResourceSampler(telemetry.RunRecorder()).sample_once() is None
+
+
+def test_host_rss_bytes_is_positive_here():
+    rss = tl_exporter.host_rss_bytes()
+    assert rss is not None and rss > 0
+
+
+# ------------------------------------------------------- fit e2e (plane on)
+
+
+@pytest.fixture(scope="module")
+def live_fit_stream(tmp_path_factory):
+    """One small fit with the full plane on, scraped from a thread while
+    it runs; module-scoped so the e2e assertions share the cost."""
+    tmp = tmp_path_factory.mktemp("liveplane")
+    path = str(tmp / "live.jsonl")
+    rng = np.random.default_rng(0)
+    data, _ = make_blobs(rng, n=1500, d=4, k=3)
+
+    bodies = []
+    stop = threading.Event()
+
+    def scraper():
+        while not stop.is_set():
+            ex = tl_exporter.current_exporter()
+            if ex is not None and ex.port:
+                try:
+                    url = f"http://127.0.0.1:{ex.port}/metrics"
+                    with urllib.request.urlopen(url, timeout=2) as resp:
+                        bodies.append(resp.read().decode("utf-8"))
+                except Exception:
+                    pass
+            stop.wait(0.01)
+
+    t = threading.Thread(target=scraper, daemon=True)
+    t.start()
+    os.environ["GMM_SAMPLER_INTERVAL_S"] = "0.05"
+    try:
+        cfg = GMMConfig(min_iters=3, max_iters=3, seed=0,
+                        chunk_size=512, metrics_file=path, metrics_port=0,
+                        checkpoint_dir=str(tmp / "ckpt"))
+        fit_gmm(data.astype(np.float32), 4, 0, cfg)
+    finally:
+        os.environ.pop("GMM_SAMPLER_INTERVAL_S", None)
+        stop.set()
+        t.join(timeout=5)
+    return telemetry.read_stream(path), bodies
+
+
+def test_live_fit_scrapes_parse_and_change(live_fit_stream):
+    _, bodies = live_fit_stream
+    assert len(bodies) >= 2, "exporter was never scraped during the fit"
+    for body in bodies:
+        assert body.endswith("# EOF\n")
+        for line in body.splitlines():
+            if line and not line.startswith("#"):
+                float(line.rsplit(" ", 1)[1])  # parseable exposition
+    assert len(set(bodies)) >= 2, "gauges never changed between scrapes"
+    # The run counters actually made it out the endpoint.
+    assert any("gmm_em_iters_total" in b for b in bodies)
+    assert any("gmm_elastic_generation" in b for b in bodies)
+
+
+def test_live_fit_span_tree_is_single_rooted(live_fit_stream):
+    records, _ = live_fit_stream
+    spans = [r for r in records if r["event"] == "span"]
+    assert spans, "plane-on fit emitted no spans"
+    assert not schema.validate_stream(spans)
+    assert len({s["trace_id"] for s in spans}) == 1
+    roots = build_span_tree(spans)
+    assert len(roots) == 1 and roots[0]["span"]["name"] == "fit"
+    names = {s["name"] for s in spans}
+    assert {"sweep", "em_k", "checkpoint"} <= names
+    sweep = [n for n in roots[0]["children"]
+             if n["span"]["name"] == "sweep"]
+    assert sweep, "sweep span is not a child of the fit root"
+    child_names = {c["span"]["name"] for c in sweep[0]["children"]}
+    assert {"em_k", "checkpoint"} <= child_names
+    # Every fit record carries the trace id context while the trace ran.
+    em_iters = [r for r in records if r["event"] == "em_iter"]
+    assert em_iters and all(
+        r.get("trace_id") == spans[0]["trace_id"] for r in em_iters)
+
+
+def test_live_fit_sampler_heartbeats_on_stream(live_fit_stream):
+    records, _ = live_fit_stream
+    samples = [r for r in records
+               if r["event"] == "heartbeat" and r.get("sampler")]
+    assert samples, "resource sampler left no heartbeat records"
+    assert all(r.get("rss_bytes", 0) > 0 for r in samples)
+
+
+def test_live_fit_stream_validates_and_has_mono_s(live_fit_stream):
+    records, _ = live_fit_stream
+    assert not schema.validate_stream(records)
+    assert all("mono_s" in r for r in records)
+    mono = [r["mono_s"] for r in records]
+    assert mono == sorted(mono), "mono_s must be monotonic within a run"
+
+
+def test_plane_off_stream_has_no_live_artifacts(tmp_path):
+    """--metrics-port unset: the stream carries NO spans, NO trace_id,
+    NO sampler heartbeats -- shape-identical to pre-v2.1 output."""
+    path = str(tmp_path / "off.jsonl")
+    rng = np.random.default_rng(0)
+    data, _ = make_blobs(rng, n=800, d=3, k=2)
+    cfg = GMMConfig(min_iters=2, max_iters=2, seed=0, chunk_size=512,
+                    metrics_file=path)
+    fit_gmm(data.astype(np.float32), 2, 2, cfg)
+    records = telemetry.read_stream(path)
+    assert records and not schema.validate_stream(records)
+    assert not any(r["event"] == "span" for r in records)
+    assert not any("trace_id" in r for r in records)
+    assert not any(r.get("sampler") for r in records)
+
+
+def test_metrics_port_validation():
+    assert GMMConfig(metrics_port=0).metrics_port == 0
+    assert GMMConfig().metrics_port is None
+    with pytest.raises(ValueError, match="metrics_port"):
+        GMMConfig(metrics_port=-1)
+    with pytest.raises(ValueError, match="metrics_port"):
+        GMMConfig(metrics_port=70000)
+
+
+# ----------------------------------------------------------- follow / top
+
+
+def _write_lines(path, records):
+    with open(path, "a", encoding="utf-8") as fh:
+        for r in records:
+            fh.write(json.dumps(r) + "\n")
+
+
+def _mk(event, i, **fields):
+    base = {"event": event, "schema": schema.SCHEMA_VERSION,
+            "ts": 1000.0 + i, "mono_s": 10.0 + i, "run_id": "r1",
+            "process": 0}
+    base.update(fields)
+    return base
+
+
+def test_stream_tailer_is_incremental_and_whole_line(tmp_path):
+    path = str(tmp_path / "s.jsonl")
+    t = StreamTailer(path)
+    assert t.poll() == []                      # not created yet
+    _write_lines(path, [_mk("run_start", 0, platform="cpu",
+                            num_events=10, num_dimensions=2, start_k=2)])
+    assert [r["event"] for r in t.poll()] == ["run_start"]
+    assert t.poll() == []                      # no growth, no records
+    # A torn trailing line stays unread until its newline arrives.
+    with open(path, "a", encoding="utf-8") as fh:
+        fh.write('{"event": "em_iter", "schema": 1, "ts": 1, ')
+    assert t.poll() == []
+    with open(path, "a", encoding="utf-8") as fh:
+        fh.write('"mono_s": 1, "run_id": "r1", "process": 0, "k": 2, '
+                 '"iter": 0, "loglik": -1.0, "wall_s": 0.1}\n')
+    assert [r["event"] for r in t.poll()] == ["em_iter"]
+
+
+def test_render_follow_live_view_content():
+    recs = [
+        _mk("run_start", 0, platform="cpu", num_events=100,
+            num_dimensions=4, start_k=4),
+        _mk("em_iter", 1, k=4, iter=0, loglik=-5.0, wall_s=0.1),
+        _mk("em_iter", 2, k=4, iter=1, loglik=-4.5, wall_s=0.1,
+            delta=0.5),
+        _mk("em_done", 3, k=4, loglik=-4.5, score=9.0, iters=2,
+            seconds=0.2),
+        _mk("heartbeat", 4, phase="sampler", elapsed_s=4.0, sampler=True,
+            rss_bytes=123_000_000),
+    ]
+    screen = render_follow(recs)
+    assert "gmm top" in screen
+    assert "K=4" in screen and "iters/s" in screen
+    assert "best K=4" in screen
+    assert "host RSS 123.0 MB" in screen
+    assert "last event: heartbeat" in screen
+    assert render_follow([]).startswith("(gmm top: waiting")
+    # mono_s drives the rate when present: 2 iters 1s apart = 1/s.
+    assert "(1.0 iters/s)" in screen
+
+
+def test_follow_renders_a_growing_stream(tmp_path, capsys):
+    """The --follow e2e: records appended WHILE the tailer polls show up
+    in later screens, and the terminal record ends the loop."""
+    path = str(tmp_path / "grow.jsonl")
+    _write_lines(path, [_mk("run_start", 0, platform="cpu",
+                            num_events=10, num_dimensions=2, start_k=2)])
+
+    def writer():
+        for i in range(1, 4):
+            time.sleep(0.08)
+            _write_lines(path, [_mk("em_iter", i, k=2, iter=i,
+                                    loglik=-5.0 + i, wall_s=0.1)])
+        time.sleep(0.08)
+        _write_lines(path, [_mk("run_summary", 9, ideal_k=2, score=1.0,
+                                final_loglik=-2.0, total_iters=3,
+                                wall_s=1.0)])
+
+    t = threading.Thread(target=writer)
+    t.start()
+    rc = follow_stream(path, interval_s=0.03)
+    t.join()
+    assert rc == 0
+    out = capsys.readouterr().out
+    assert "gmm top" in out
+    assert "stream ended" in out            # saw the terminal record
+    assert "iter=3" in out                  # saw records written mid-tail
+    assert out.count("--- refresh ---") >= 1
+
+
+def test_follow_merges_a_multi_rank_stream_directory(tmp_path, capsys):
+    d = tmp_path / "streams"
+    d.mkdir()
+    _write_lines(str(d / "rank0.jsonl"),
+                 [_mk("run_start", 0, platform="cpu", num_events=10,
+                      num_dimensions=2, start_k=2),
+                  _mk("em_iter", 1, k=2, iter=0, loglik=-3.0, wall_s=0.1)])
+    _write_lines(str(d / "rank1.jsonl"),
+                 [_mk("run_summary", 5, ideal_k=2, score=1.0,
+                      final_loglik=-2.0, total_iters=1, wall_s=0.5)])
+    rc = follow_stream(str(d), interval_s=0.01, max_renders=3)
+    assert rc == 0
+    out = capsys.readouterr().out
+    assert "EM: K=2" in out and "stream ended" in out
+
+
+def test_follow_terminates_despite_trailing_span_records(tmp_path, capsys):
+    """With the live plane on, the closing fit span lands AFTER
+    run_summary (it closes when fit_gmm's ExitStack unwinds around the
+    emitter) -- the tailer must still exit, and the trailing span must
+    make the final screen."""
+    path = str(tmp_path / "trail.jsonl")
+    _write_lines(path, [
+        _mk("run_start", 0, platform="cpu", num_events=10,
+            num_dimensions=2, start_k=2),
+        _mk("run_summary", 1, ideal_k=2, score=1.0, final_loglik=-2.0,
+            total_iters=3, wall_s=1.0),
+        _mk("span", 2, name="fit", span_id="abcd1234abcd1234",
+            trace_id="t1", t0_mono_s=9.0, duration_s=1.5),
+    ])
+    rc = follow_stream(path, interval_s=0.01)
+    assert rc == 0
+    out = capsys.readouterr().out
+    assert "stream ended" in out
+    assert "last fit (1.500s)" in out
+
+
+def test_report_main_follow_flag_and_top_alias(tmp_path, capsys):
+    path = str(tmp_path / "done.jsonl")
+    _write_lines(path, [
+        _mk("run_start", 0, platform="cpu", num_events=10,
+            num_dimensions=2, start_k=2),
+        _mk("run_summary", 1, ideal_k=2, score=1.0, final_loglik=-2.0,
+            total_iters=3, wall_s=1.0),
+    ])
+    assert report_main([path, "--follow", "--interval", "0.01"]) == 0
+    assert "stream ended" in capsys.readouterr().out
+    # `gmm top` routes to report --follow before argparse.
+    from cuda_gmm_mpi_tpu.cli import main
+
+    assert main(["top", path, "--interval", "0.01"]) == 0
+    assert "gmm top" in capsys.readouterr().out
+
+
+# ------------------------------------------------------------- serve spans
+
+
+def test_serve_trace_id_echo_joins_server_records(tmp_path):
+    from cuda_gmm_mpi_tpu import GaussianMixture
+    from cuda_gmm_mpi_tpu.serving import GMMServer, ModelRegistry
+
+    rng = np.random.default_rng(0)
+    data, _ = make_blobs(rng, n=400, d=4, k=3)
+    gm = GaussianMixture(3, target_components=3,
+                         config=GMMConfig(min_iters=3, max_iters=3,
+                                          chunk_size=256))
+    gm.fit(data.astype(np.float32))
+    gm.to_registry(str(tmp_path), "m")
+
+    rec, buf = _stream_recorder()
+    X = data[:16].astype(np.float32).tolist()
+    with telemetry.use(rec):
+        server = GMMServer(ModelRegistry(str(tmp_path)),
+                           trace_requests=True)
+        resps = server.handle_requests([
+            {"id": 1, "model": "m", "op": "score", "x": X},
+            {"id": 2, "model": "m", "op": "predict", "x": X},
+        ])
+    assert all(r["ok"] for r in resps)
+    tids = [r["trace_id"] for r in resps]
+    assert len(set(tids)) == 2              # one identity per request
+    recs = _records(buf)
+    reqs = [r for r in recs if r["event"] == "serve_request"]
+    # The echoed id joins the client to ITS server-side record.
+    assert sorted(r["trace_id"] for r in reqs) == sorted(tids)
+    spans = [r for r in recs if r["event"] == "span"]
+    roots = build_span_tree(spans)
+    assert [n["span"]["name"] for n in roots] == ["serve_route"]
+    hops = [c["span"]["name"] for c in roots[0]["children"]]
+    assert hops == ["prepare", "dispatch", "answer"]
+    # The route span joined the FIRST request's minted trace.
+    assert roots[0]["span"]["trace_id"] == tids[0]
+    assert not schema.validate_stream(recs)
+
+
+def test_serve_responses_unchanged_without_trace_requests(tmp_path):
+    from cuda_gmm_mpi_tpu import GaussianMixture
+    from cuda_gmm_mpi_tpu.serving import GMMServer, ModelRegistry
+
+    rng = np.random.default_rng(0)
+    data, _ = make_blobs(rng, n=400, d=4, k=3)
+    gm = GaussianMixture(3, target_components=3,
+                         config=GMMConfig(min_iters=3, max_iters=3,
+                                          chunk_size=256))
+    gm.fit(data.astype(np.float32))
+    gm.to_registry(str(tmp_path), "m")
+
+    rec, buf = _stream_recorder()
+    X = data[:8].astype(np.float32).tolist()
+    with telemetry.use(rec):
+        server = GMMServer(ModelRegistry(str(tmp_path)))
+        resps = server.handle_requests(
+            [{"id": 1, "model": "m", "op": "score", "x": X}])
+    assert "trace_id" not in resps[0]
+    recs = _records(buf)
+    assert not any(r["event"] == "span" for r in recs)
+    assert not any("trace_id" in r for r in recs)
+
+
+def test_server_live_gauges_are_exporter_ready(tmp_path):
+    from cuda_gmm_mpi_tpu import GaussianMixture
+    from cuda_gmm_mpi_tpu.serving import GMMServer, ModelRegistry
+
+    rng = np.random.default_rng(0)
+    data, _ = make_blobs(rng, n=400, d=4, k=3)
+    gm = GaussianMixture(3, target_components=3,
+                         config=GMMConfig(min_iters=3, max_iters=3,
+                                          chunk_size=256))
+    gm.fit(data.astype(np.float32))
+    gm.to_registry(str(tmp_path), "m")
+    server = GMMServer(ModelRegistry(str(tmp_path)))
+    X = data[:8].astype(np.float32).tolist()
+    server.handle_requests([{"id": 1, "model": "m", "op": "score",
+                             "x": X}])
+    gauges = server.live_gauges()
+    assert gauges["gmm_serve_requests"] == 1.0
+    assert gauges["gmm_serve_rows"] == 8.0
+    assert 0.0 <= gauges["gmm_executor_cache_hit_rate"] <= 1.0
+    assert all(isinstance(v, float) for v in gauges.values())
+    text = render_openmetrics({}, gauges)
+    assert "gmm_serve_queue_rows 0" in text
+    assert text.endswith("# EOF\n")
