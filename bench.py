@@ -69,6 +69,13 @@ and the scrape/span/sampler health bits proving the plane actually ran.
 Size knobs: GMM_BENCH_OBS_{N,D,K,ITERS} + GMM_BENCH_OBS_BOUND
 (run_obs_bench).
 
+Profile mode (``--profile`` or GMM_BENCH_PROFILE=1): rev v2.2 compile-
+introspection contract -- the same fit twice with the CompileWatch
+active, asserting the run_summary.profile block's shape (site compiles
+vs XLA compiles, per-site sums) and that the two identical runs
+``gmm diff`` clean (diff_exit 0 rides in the record; vs_baseline 1.0 =
+clean). Size knobs: GMM_BENCH_PROFILE_{N,D,K,ITERS} (run_profile_bench).
+
 Ingest mode (``--ingest`` or GMM_BENCH_INGEST=1): host-resident vs
 pipelined out-of-core ingestion A/B on one BIN dataset -- each mode
 (resident / pipelined / pipelined+minibatch) fits in its own subprocess
@@ -908,6 +915,115 @@ def run_obs_bench(platform: str, accel_unavailable: bool) -> dict:
     return result
 
 
+def run_profile_bench(platform: str, accel_unavailable: bool) -> dict:
+    """The --profile mode: compile-introspection + cross-run diff contract.
+
+    Runs the SAME fit twice (same data, same seed, same config, two
+    telemetry streams) with the rev v2.2 CompileWatch active, then:
+
+    * asserts the ``run_summary.profile`` block's SHAPE -- compiles /
+      compile_seconds / xla_compiles / xla_compile_seconds present and
+      coherent (site compiles <= XLA compiles, per-site counts sum to
+      the total) -- the machine contract docs/OBSERVABILITY.md v2.2
+      documents;
+    * feeds both streams through ``gmm diff`` (telemetry.diff.diff_main,
+      the same code path as the CLI) and records the exit code: two
+      back-to-back identical runs MUST diff clean (``diff_exit == 0``;
+      the default gates are count-shaped precisely so wall jitter
+      cannot trip them).
+
+    ``value`` is the first run's measured compile seconds (site builds).
+    Size knobs: GMM_BENCH_PROFILE_{N,D,K,ITERS}.
+    """
+    import tempfile
+
+    on_accel = platform not in ("cpu",)
+    n = int(os.environ.get("GMM_BENCH_PROFILE_N")
+            or (200_000 if on_accel else 20_000))
+    d = int(os.environ.get("GMM_BENCH_PROFILE_D") or (16 if on_accel else 8))
+    k = int(os.environ.get("GMM_BENCH_PROFILE_K") or (16 if on_accel else 8))
+    iters = int(os.environ.get("GMM_BENCH_PROFILE_ITERS")
+                or (10 if on_accel else 6))
+    chunk = int(os.environ.get("GMM_BENCH_CHUNK")
+                or (131072 if on_accel else 4096))
+    chunk = min(chunk, n)
+
+    from cuda_gmm_mpi_tpu.config import GMMConfig
+    from cuda_gmm_mpi_tpu.models.order_search import fit_gmm
+    from cuda_gmm_mpi_tpu.telemetry import read_stream
+    from cuda_gmm_mpi_tpu.telemetry.diff import diff_main, summarize_run
+
+    rng = np.random.default_rng(11)
+    centers = rng.normal(scale=8.0, size=(k, d))
+    data = (centers[rng.integers(0, k, n)]
+            + rng.normal(scale=1.0, size=(n, d))).astype(np.float32)
+
+    tmp = tempfile.mkdtemp(prefix="gmm-profile-")
+    streams = [os.path.join(tmp, f"{name}.jsonl") for name in ("a", "b")]
+    walls = []
+    for path in streams:
+        cfg = GMMConfig(min_iters=iters, max_iters=iters, chunk_size=chunk,
+                        seed=0, metrics_file=path)
+        t0 = time.perf_counter()
+        fit_gmm(data, k, k, cfg)
+        walls.append(time.perf_counter() - t0)
+
+    def _profile_of(path):
+        summaries = [r for r in read_stream(path)
+                     if r.get("event") == "run_summary"]
+        return (summaries[-1].get("profile") or {}) if summaries else {}
+
+    profiles = [_profile_of(p) for p in streams]
+    prof = profiles[0]
+    site_total = sum(int((s or {}).get("compiles", 0))
+                     for s in (prof.get("sites") or {}).values())
+    shape_ok = bool(
+        prof
+        and isinstance(prof.get("compiles"), int)
+        and isinstance(prof.get("xla_compiles"), int)
+        and prof.get("compile_seconds") is not None
+        and prof.get("xla_compile_seconds") is not None
+        and prof["compiles"] <= prof["xla_compiles"]
+        and site_total == prof["compiles"])
+
+    diff_exit = diff_main([streams[0], streams[1]])
+    rollup = summarize_run(read_stream(streams[0]))
+
+    result = {
+        "metric": f"compile seconds (profiled), {n}x{d} K={k} ({platform})",
+        "value": round(float(prof.get("compile_seconds") or 0.0), 4),
+        "unit": "s",
+        # Identical back-to-back runs must diff clean: 1.0 = clean.
+        "vs_baseline": 1.0 if diff_exit == 0 else 0.0,
+        "accelerator_unavailable": accel_unavailable,
+        "profile": {
+            "n": n, "d": d, "k": k, "em_iters": iters,
+            "chunk_size": chunk,
+            "walls_s": [round(w, 4) for w in walls],
+            "profile_shape_ok": shape_ok,
+            "compiles": int(prof.get("compiles", 0)),
+            "xla_compiles": int(prof.get("xla_compiles", 0)),
+            "compile_seconds": float(prof.get("compile_seconds") or 0.0),
+            "xla_compile_seconds": float(
+                prof.get("xla_compile_seconds") or 0.0),
+            "sites": {name: int((slot or {}).get("compiles", 0))
+                      for name, slot in (prof.get("sites") or {}).items()},
+            "cost_flops": (prof.get("cost") or {}).get("flops"),
+            "cost_bytes_accessed": (prof.get("cost") or {}).get(
+                "bytes_accessed"),
+            "second_run_has_profile": bool(profiles[1]),
+            "diff_exit": int(diff_exit),
+            "fingerprint": rollup.get("fingerprint"),
+        },
+        "measured_utc": time.strftime("%Y-%m-%dT%H:%M:%SZ", time.gmtime()),
+    }
+    if accel_unavailable:
+        result["platform_note"] = (
+            "accelerator tunnel unavailable (probe failed after retries); "
+            "this is a CPU-fallback measurement, not an accelerator result")
+    return result
+
+
 def run_serve_bench(platform: str, accel_unavailable: bool) -> dict:
     """The --serve mode: cold-vs-warm A/B of the serving subsystem.
 
@@ -1410,6 +1526,8 @@ def main() -> int:
                     or os.environ.get("GMM_BENCH_ELASTIC") == "1")
     want_obs = ("--obs" in sys.argv[1:]
                 or os.environ.get("GMM_BENCH_OBS") == "1")
+    want_profile = ("--profile" in sys.argv[1:]
+                    or os.environ.get("GMM_BENCH_PROFILE") == "1")
     spec = CONFIGS.get(cfg_name)
     if spec is None:
         print(
@@ -1548,6 +1666,14 @@ def main() -> int:
         # Telemetry-off vs stream vs live-plane overhead A/B/C (ignores
         # --config; sized by GMM_BENCH_OBS_*).
         result = run_obs_bench(platform, accel_unavailable)
+        watchdog.cancel()
+        print(json.dumps(result))
+        return 3 if accel_unavailable else 0
+
+    if want_profile:
+        # Compile-introspection profile shape + identical-runs-diff-clean
+        # contract (ignores --config; sized by GMM_BENCH_PROFILE_*).
+        result = run_profile_bench(platform, accel_unavailable)
         watchdog.cancel()
         print(json.dumps(result))
         return 3 if accel_unavailable else 0

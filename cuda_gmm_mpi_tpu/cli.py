@@ -31,7 +31,9 @@ def build_parser() -> argparse.ArgumentParser:
         "registry; `gmm serve` runs the micro-batched scoring loop over "
         "a registry (JSONL protocol; docs/SERVING.md); `gmm fleet` fits "
         "a manifest of per-tenant datasets as packed multi-tenant "
-        "dispatches (docs/TENANCY.md).",
+        "dispatches (docs/TENANCY.md); `gmm diff A B` compares two runs "
+        "with --fail-on regression gates (exit 0 clean / 1 regressed); "
+        "`gmm runs DIR` indexes historical run streams.",
     )
     from ._version import __version__
 
@@ -336,6 +338,19 @@ def main(argv=None) -> int:
         from .tenancy.cli import fleet_main
 
         return fleet_main(argv[1:])
+    if argv and argv[0] == "diff":
+        # `gmm diff A B`: cross-run regression analytics over two
+        # telemetry streams / bench records, with --fail-on gates and
+        # a CI exit-code contract (0 clean / 1 regressions / 2 usage).
+        from .telemetry.diff import diff_main
+
+        return diff_main(argv[1:])
+    if argv and argv[0] == "runs":
+        # `gmm runs DIR`: index historical run streams (run id, config
+        # fingerprint, backend, wall, iters/s, health).
+        from .telemetry.diff import runs_main
+
+        return runs_main(argv[1:])
     args = build_parser().parse_args(argv)
 
     # Platform must be pinned before JAX initializes its backends. Set the env

@@ -36,6 +36,7 @@ from .. import health
 from ..config import GMMConfig
 from ..ops.mstep import SuffStats, accumulate_stats, apply_mstep
 from ..ops.estep import posteriors
+from ..telemetry import profiling as tl_profiling
 from ..testing import faults
 
 
@@ -228,18 +229,23 @@ class GMMModel:
         key = (trajectory_len, donate)
         fn = self._em_exec_cache.get(key)
         if fn is None:
-            fn = self._em_exec_cache[key] = jax.jit(
-                functools.partial(
-                    em_while_loop, reduce_stats=self.reduce_stats,
-                    stats_fn=self.stats_fn, mstep_fn=self._mstep_fn,
-                    covariance_type=self.config.covariance_type,
-                    precompute_features=self.config.precompute_features,
-                    trajectory_len=trajectory_len,
-                    dynamic_range=self.config.covariance_dynamic_range,
-                    regression_scale=self.config.health_regression_scale,
-                    **self._kw),
-                donate_argnums=(0,) if donate else (),
-            )
+            # ProfiledExecutable (rev v2.2): a transparent proxy -- plain
+            # jit dispatch with no CompileWatch active, explicit timed
+            # AOT lower+compile (cost/memory introspection) under one.
+            fn = self._em_exec_cache[key] = tl_profiling.ProfiledExecutable(
+                jax.jit(
+                    functools.partial(
+                        em_while_loop, reduce_stats=self.reduce_stats,
+                        stats_fn=self.stats_fn, mstep_fn=self._mstep_fn,
+                        covariance_type=self.config.covariance_type,
+                        precompute_features=self.config.precompute_features,
+                        trajectory_len=trajectory_len,
+                        dynamic_range=self.config.covariance_dynamic_range,
+                        regression_scale=self.config.health_regression_scale,
+                        **self._kw),
+                    donate_argnums=(0,) if donate else (),
+                ),
+                site="em")
         return fn
 
     def run_em(self, state, data_chunks, wts_chunks, epsilon: float,
@@ -405,7 +411,7 @@ class GMMModel:
         fn = self._em_exec_cache.get(key)
         if fn is None:
             if self.batched_stats_fn is not None:
-                fn = jax.jit(
+                fn = tl_profiling.ProfiledExecutable(jax.jit(
                     functools.partial(
                         em_while_loop_batched,
                         batched_stats_fn=self.batched_stats_fn,
@@ -417,7 +423,8 @@ class GMMModel:
                         regression_scale=(
                             self.config.health_regression_scale),
                         **self._kw),
-                    donate_argnums=(0,) if donate else ())
+                    donate_argnums=(0,) if donate else ()),
+                    site="em_batched")
                 self._em_exec_cache[key] = fn
                 return fn
             em_fn = functools.partial(
@@ -438,8 +445,9 @@ class GMMModel:
                 return jax.vmap(run_one, in_axes=(0, 0, 0, 0))(
                     states, rids, lo_r, hi_r)
 
-            fn = self._em_exec_cache[key] = jax.jit(
-                batched, donate_argnums=(0,) if donate else ())
+            fn = self._em_exec_cache[key] = tl_profiling.ProfiledExecutable(
+                jax.jit(batched, donate_argnums=(0,) if donate else ()),
+                site="em_batched")
         return fn
 
     def run_em_batched(self, states, data_chunks, wts_chunks, epsilon: float,
@@ -694,8 +702,9 @@ class GMMModel:
                     (states, tids, data_chunks, wts_chunks, eps_t,
                      lo_t, hi_t))
 
-            fn = self._em_exec_cache[key] = jax.jit(
-                fleet, donate_argnums=(0,) if donate else ())
+            fn = self._em_exec_cache[key] = tl_profiling.ProfiledExecutable(
+                jax.jit(fleet, donate_argnums=(0,) if donate else ()),
+                site="em_fleet")
         return fn
 
     def run_em_fleet(self, states, data_chunks, wts_chunks, epsilons,

@@ -35,6 +35,7 @@ from ..state import GMMState, bucket_width, clone_state, compact
 from .. import telemetry
 from ..telemetry import RunRecorder
 from ..telemetry import exporter as tl_exporter
+from ..telemetry import profiling as tl_profiling
 from ..telemetry import spans as tl_spans
 from ..testing import faults
 from ..utils.logging_ import get_logger, metrics_line
@@ -242,7 +243,13 @@ def _emit_run_summary(rec, config, timer, sweep_log, ideal_k, best_score,
     first = em_walls[0] if em_walls else None
     warm = min(em_walls[1:]) if len(em_walls) > 1 else None
     elastic_section = elastic.run_summary_section()
+    # CompileWatch rollup (rev v2.2): MEASURED compile counts/seconds +
+    # cost/memory analyses + HBM watermarks, superseding (not replacing)
+    # the first-vs-warm estimate below -- ``gmm report`` prefers these
+    # and falls back to ``est_compile_s`` on pre-v2.2 streams.
+    watch = tl_profiling.active()
     fields = dict(
+        **({"profile": watch.snapshot()} if watch is not None else {}),
         **({"buckets": buckets} if buckets is not None else {}),
         **({"health": health_section} if health_section is not None else {}),
         # Elastic recovery rollup (rev v2.0): present only when the run
@@ -442,6 +449,15 @@ def fit_gmm(
                 rec.set_context(trace_id=tid)
                 stack.callback(rec.set_context, trace_id=None)
             stack.enter_context(tl_spans.span("fit"))
+        if telemetry.current().active and tl_profiling.active() is None:
+            # Compile & cost introspection (stream rev v2.2): the watch
+            # rides every active-recorder fit -- XLA compile listeners,
+            # executable-cache cost introspection, and memory watermarks
+            # all report through it into ``compile`` events and the
+            # ``run_summary.profile`` rollup. With no recorder there is
+            # no watch, and every instrumented path dispatches through
+            # plain jax.jit -- results stay byte-identical to pre-v2.2.
+            stack.enter_context(tl_profiling.watch())
         # Elastic retry loop (docs/DISTRIBUTED.md "Elastic recovery"): a
         # peer loss under --elastic shrinks the world via the checkpoint-FS
         # rendezvous and REFITS (resume="auto" restores the newest step)
@@ -768,6 +784,7 @@ def _fit_gmm(data, num_clusters, target_num_clusters, config, model,
     # -- an un-ended span simply never emits, and its completed children
     # (per-K EM, checkpoint saves) orphan-promote in the tree view.
     sweep_span = tl_spans.begin("sweep", start_k=int(k))
+    sweep_wm = tl_profiling.wm_begin("sweep")
     while k >= stop_number:
         if sup.active and sup.poll(where="sweep", k=int(k)):
             # Between-K stop: every completed K is already durable (the
@@ -784,7 +801,8 @@ def _fit_gmm(data, num_clusters, target_num_clusters, config, model,
         # copy, one parameter-set of HBM).
         rollback = clone_state(state) if recovery_on else None
         # fused E+M loop (m_step/constants folded in); em_k = one K's EM
-        with tl_spans.span("em_k", k=int(k)), phase("e_step"):
+        with tl_spans.span("em_k", k=int(k)), \
+                tl_profiling.watermark("em_k"), phase("e_step"):
             # donate=True: the EM carry is rebound every K, so the input
             # state's buffers are handed to the device for in-place reuse
             # (one state-size less peak HBM + copy traffic per K).
@@ -1041,6 +1059,7 @@ def _fit_gmm(data, num_clusters, target_num_clusters, config, model,
         step += 1
 
     tl_spans.end(sweep_span)
+    tl_profiling.wm_end(sweep_wm)
     with phase("memcpy"):
         compact_state, n_active = compact(best_state)
     if verbose:

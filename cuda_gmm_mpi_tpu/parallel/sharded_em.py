@@ -34,6 +34,7 @@ from ..config import GMMConfig
 from ..models.gmm import GMMModel, em_while_loop, resolve_iters
 from ..ops.mstep import SuffStats
 from ..ops.estep import posteriors
+from ..telemetry import profiling as tl_profiling
 from .mesh import (
     CLUSTER_AXIS, DATA_AXIS, make_mesh, pad_clusters, shard_chunks,
     state_pspecs,
@@ -391,17 +392,20 @@ class ShardedGMMModel:
             # loglik lanes ride the data psum, the per-cluster-shard state
             # lanes psum over the cluster axis inside health.state_counts).
             out_specs = out_specs + (scalar,)
-            fn = self._em_exec_cache[key] = jax.jit(
-                shard_map(
-                    em_fn,
-                    mesh=self.mesh,
-                    in_specs=(sspec, P(DATA_AXIS, None, None),
-                              P(DATA_AXIS, None), scalar, scalar, scalar),
-                    out_specs=out_specs,
-                    check_vma=False,
+            fn = self._em_exec_cache[key] = tl_profiling.ProfiledExecutable(
+                jax.jit(
+                    shard_map(
+                        em_fn,
+                        mesh=self.mesh,
+                        in_specs=(sspec, P(DATA_AXIS, None, None),
+                                  P(DATA_AXIS, None), scalar, scalar,
+                                  scalar),
+                        out_specs=out_specs,
+                        check_vma=False,
+                    ),
+                    donate_argnums=(0,) if donate else (),
                 ),
-                donate_argnums=(0,) if donate else (),
-            )
+                site="em_sharded")
         return fn
 
     def run_em(self, state, data_chunks, wts_chunks, epsilon: float,

@@ -41,6 +41,7 @@ from typing import Dict, Optional, Tuple
 import numpy as np
 
 from ..state import GMMState
+from ..telemetry import profiling as tl_profiling
 
 # Executable kinds: 'proba' returns (responsibilities [B, K], logZ [B]);
 # 'assign' returns (argmax labels int32 [B], logZ [B]) -- the hard-
@@ -199,7 +200,12 @@ class ScoringExecutor:
             self._cache.move_to_end(key)
             return fn
         self.misses += 1
-        fn = self._build(kind, block, kb, d)
+        # site_compile (rev v2.2): a passthrough with no CompileWatch
+        # active; under one, the build is timed and its cost/memory
+        # analyses land on the stream as an enriched ``compile`` event.
+        fn = tl_profiling.site_compile(
+            "serve", lambda: self._build(kind, block, kb, d),
+            key=f"{kind}:{block}x{d}:k{kb}")
         self.compiles += 1
         self._cache[key] = fn
         while len(self._cache) > self._max_execs:
@@ -305,8 +311,11 @@ class ScoringExecutor:
             active=sds((models, kb), jnp.bool_))
         x_struct = sds((models, block, d), dt)
         donate = (1,) if jax.default_backend() != "cpu" else ()
-        fn = jax.jit(stacked, donate_argnums=donate).lower(
-            state_struct, x_struct).compile()
+        fn = tl_profiling.site_compile(
+            "serve_stacked",
+            lambda: jax.jit(stacked, donate_argnums=donate).lower(
+                state_struct, x_struct).compile(),
+            key=f"stacked{models}:{block}x{d}:k{kb}")
         self.compiles += 1
         self._cache[key] = fn
         while len(self._cache) > self._max_execs:

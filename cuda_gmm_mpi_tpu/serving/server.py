@@ -81,6 +81,7 @@ import numpy as np
 from .. import supervisor as supervisor_mod
 from .. import telemetry
 from ..telemetry import exporter as tl_exporter
+from ..telemetry import profiling as tl_profiling
 from ..telemetry import spans as tl_spans
 from ..testing import faults
 from .breaker import CircuitBreakers
@@ -493,7 +494,8 @@ class GMMServer:
             ex = self._executor_for(m)
             compiles_before = ex.compile_count
             try:
-                with tl_spans.span("dispatch", model=name):
+                with tl_spans.span("dispatch", model=name), \
+                        tl_profiling.watermark("serve_dispatch"):
                     w, logz = ex.infer(m.state, rows, want="proba")
             except Exception as e:  # executor/compile failure
                 self.breaker.record_failure((name, version), "executor")
@@ -543,7 +545,8 @@ class GMMServer:
             ex = self._executor_for(fam[0][2])
             compiles_before = ex.compile_count
             try:
-                with tl_spans.span("dispatch", stacked=len(fam)):
+                with tl_spans.span("dispatch", stacked=len(fam)), \
+                        tl_profiling.watermark("serve_dispatch"):
                     outs, padded = ex.infer_stacked(
                         [m.state for _, _, m, _, _, _ in fam],
                         [rows for _, _, _, _, rows, _ in fam])
@@ -569,7 +572,8 @@ class GMMServer:
             ex = self._executor_for(m)
             compiles_before = ex.compile_count
             try:
-                with tl_spans.span("dispatch", model=name):
+                with tl_spans.span("dispatch", model=name), \
+                        tl_profiling.watermark("serve_dispatch"):
                     w, logz = ex.infer(m.state, rows, want="proba")
             except Exception as e:
                 self.breaker.record_failure((name, version), "executor")
@@ -728,6 +732,7 @@ class GMMServer:
         wall = time.perf_counter() - self._t_start
         if not rec.active:
             return None
+        watch = tl_profiling.active()
         return rec.emit(
             "serve_summary",
             requests=int(self.requests), batches=int(self.batches),
@@ -740,6 +745,11 @@ class GMMServer:
             executor=self.executor_stats(),
             stacked_batches=int(self.stacked_batches),
             metrics=rec.metrics.snapshot(),
+            # CompileWatch rollup (rev v2.2): run_summary.profile's
+            # serving sibling -- AOT compile counts/seconds + cost and
+            # memory analyses + serve-dispatch HBM watermarks.
+            **({"profile": watch.snapshot()} if watch is not None
+               else {}),
             **self.resilience_stats(),
         )
 
@@ -1143,6 +1153,8 @@ def serve_main(argv=None) -> int:
                 registry_provider=lambda: telemetry.current().metrics,
                 gauges_provider=server.live_gauges,
                 recorder=rec), \
+            (tl_profiling.watch() if rec.active
+             else contextlib.nullcontext()), \
             profiler_trace(args.trace_dir):
         # Pre-resolve (and AOT-warm) the requested model set so the first
         # request never pays registry IO or a compile.

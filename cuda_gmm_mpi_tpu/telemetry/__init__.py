@@ -11,12 +11,16 @@ aggregates, ``recorder`` the event bus + ambient-activation plumbing,
 ``report`` the offline renderer (plus the ``--follow`` live tailer),
 ``exporter`` the live OpenMetrics endpoint + resource sampler, and
 ``spans`` the trace-span emission (rev v2.1 live plane).
-``utils.profiling.PhaseTimer`` and ``utils.logging_.metrics_line`` are
-thin adapters over this package.
+``profiling`` the compile & cost introspection watch (rev v2.2), and
+``diff`` the cross-run regression analytics behind ``gmm diff`` /
+``gmm runs``. ``utils.profiling.PhaseTimer`` and
+``utils.logging_.metrics_line`` are thin adapters over this package.
 """
 
+from .diff import diff_main, runs_main, summarize_run
 from .exporter import (MetricsExporter, ResourceSampler, current_exporter,
                        host_rss_bytes, live_plane, render_openmetrics)
+from .profiling import CompileWatch, ProfiledExecutable, site_compile, watch
 from .recorder import (RunRecorder, current, memory_stats, read_stream, use,
                        write_line)
 from .registry import MetricsRegistry
@@ -36,4 +40,6 @@ __all__ = [
     "MetricsExporter", "ResourceSampler", "current_exporter",
     "host_rss_bytes", "live_plane", "render_openmetrics",
     "build_span_tree", "mint_trace_id", "span", "trace_spans",
+    "CompileWatch", "ProfiledExecutable", "site_compile", "watch",
+    "diff_main", "runs_main", "summarize_run",
 ]

@@ -95,15 +95,26 @@ def _fmt(value: Any) -> str:
 
 
 def render_openmetrics(snapshot: Dict[str, Dict[str, Any]],
-                       extra_gauges: Optional[Dict[str, Any]] = None) -> str:
+                       extra_gauges: Optional[Dict[str, Any]] = None,
+                       buckets: Optional[Dict[str, Any]] = None) -> str:
     """Registry snapshot (+ run gauges) -> OpenMetrics text exposition.
 
-    Counters become ``gmm_<name>_total``; gauges stay gauges; histogram
-    rollups expose ``_count`` / ``_sum`` plus ``_min`` / ``_max`` gauges
-    (the registry keeps rollups, not buckets). ``extra_gauges`` keys are
+    Counters become ``gmm_<name>_total``; gauges stay gauges. A
+    histogram with fixed-bucket counts available (rev v2.2;
+    ``buckets[key]`` = per-bucket counts over
+    ``registry.BUCKET_BOUNDS`` + the +Inf slot) renders as a real
+    OpenMetrics histogram -- cumulative ``_bucket{le=...}`` lines, so
+    serve latency p50/p99 are scrapeable -- with the extremes as
+    separate ``_minimum`` / ``_maximum`` gauge families (``_min`` /
+    ``_max`` are not valid histogram sample suffixes, and a strict
+    parser may reject the whole scrape over them); one without bucket
+    counts keeps the old summary rendering, ``_min`` / ``_max`` gauges
+    included, byte-identical to pre-v2.2. ``extra_gauges`` keys are
     already full metric names (the owning loop namespaces them). Ends
     with the mandatory ``# EOF``.
     """
+    from .registry import BUCKET_BOUNDS
+
     lines = []
     for key, value in sorted((snapshot.get("counters") or {}).items()):
         name = metric_name(key)
@@ -115,13 +126,28 @@ def render_openmetrics(snapshot: Dict[str, Dict[str, Any]],
         lines.append(f"{name} {_fmt(value)}")
     for key, h in sorted((snapshot.get("histograms") or {}).items()):
         name = metric_name(key)
-        lines.append(f"# TYPE {name} summary")
+        counts = (buckets or {}).get(key)
+        if counts:
+            lines.append(f"# TYPE {name} histogram")
+            cum = 0
+            for le, n in zip(BUCKET_BOUNDS, counts):
+                cum += int(n)
+                lines.append(
+                    f'{name}_bucket{{le="{_fmt(le)}"}} {cum}')
+            cum += int(counts[len(BUCKET_BOUNDS)]) \
+                if len(counts) > len(BUCKET_BOUNDS) else 0
+            lines.append(f'{name}_bucket{{le="+Inf"}} {cum}')
+        else:
+            lines.append(f"# TYPE {name} summary")
         lines.append(f"{name}_count {_fmt(h.get('count', 0))}")
         lines.append(f"{name}_sum {_fmt(h.get('sum', 0.0))}")
         for agg in ("min", "max"):
             if agg in h:
-                lines.append(f"# TYPE {name}_{agg} gauge")
-                lines.append(f"{name}_{agg} {_fmt(h[agg])}")
+                # Histogram form: the extremes get family names a strict
+                # parser cannot read as suffixed samples of ``name``.
+                suffix = agg if not counts else agg + "imum"
+                lines.append(f"# TYPE {name}_{suffix} gauge")
+                lines.append(f"{name}_{suffix} {_fmt(h[agg])}")
     for key, value in sorted((extra_gauges or {}).items()):
         name = _NAME_RE.sub("_", str(key))
         lines.append(f"# TYPE {name} gauge")
@@ -158,9 +184,22 @@ class MetricsExporter:
         return self._httpd.server_address[1] if self._httpd else None
 
     def render(self) -> str:
+        buckets: Dict[str, Any] = {}
         try:
             registry = self._registry_provider()
-            snapshot = registry.snapshot() if registry is not None else {}
+            if registry is None:
+                snapshot = {}
+            else:
+                # Fixed-bucket counts (rev v2.2): kept out of snapshot()
+                # so run_summary.metrics stays byte-stable; the scrape
+                # endpoint is where the buckets surface. One atomic
+                # locked read -- a histogram's _count and its cumulative
+                # +Inf bucket must agree on the same exposition.
+                pair_fn = getattr(registry, "snapshot_with_buckets", None)
+                if callable(pair_fn):
+                    snapshot, buckets = pair_fn()
+                else:
+                    snapshot = registry.snapshot()
         except Exception:
             snapshot = {}
         gauges: Dict[str, Any] = {}
@@ -183,7 +222,7 @@ class MetricsExporter:
                         rate = max(0.0, (iters - self._last_scrape[1]) / dt)
                 self._last_scrape = (now, iters)
                 gauges.setdefault("gmm_em_iters_per_s", round(rate, 3))
-        return render_openmetrics(snapshot, gauges)
+        return render_openmetrics(snapshot, gauges, buckets)
 
     def start(self) -> "MetricsExporter":
         if self._httpd is not None:

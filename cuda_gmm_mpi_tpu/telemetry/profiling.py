@@ -1,0 +1,533 @@
+"""Compile & cost introspection: the CompileWatch (stream rev v2.2).
+
+The reference binary's only performance signal is a wall-clock printf per
+EM phase (gaussian.cu:967); PR 13's live plane made the counters visible
+but still could not say WHERE compile time and memory go -- a retrace
+storm or a silent recompile shows up only as a slower wall. This module
+closes that gap with three instruments, all inert unless a
+:class:`CompileWatch` is active (the ``watch()`` context, entered by
+``fit_gmm``/``serve_main`` only when a recorder is already active -- so
+no-recorder runs stay byte-identical to pre-v2.2):
+
+* **XLA compile observation** -- one process-global ``jax.monitoring``
+  event-duration listener (registered lazily and exactly once;
+  jax.monitoring has no unregister, so the listener is permanent and
+  forwards to the CURRENT watch, a no-op when none is active) counts
+  every ``backend_compile`` with its wall seconds, tagged with the
+  active span/phase, and emits a ``compile`` telemetry event for
+  compiles the executable caches did not expect.
+
+* **Executable cost introspection** -- the memoized executable caches
+  (``models/gmm.py`` ``_em_*_executable`` variants via
+  :class:`ProfiledExecutable`, ``serving/executor.py`` AOT builds via
+  :func:`site_compile`) time their lower+compile and pull
+  ``compiled.cost_analysis()`` (flops, bytes accessed) and
+  ``memory_analysis()`` (argument/output/temp/generated-code bytes)
+  where the backend provides them, stamped into enriched ``compile``
+  events and rolled up into ``run_summary.profile``.
+
+* **Device memory watermarks** -- :func:`wm_begin`/:func:`wm_end` (and
+  the lexical :func:`watermark`) capture device ``memory_stats()`` peak
+  deltas attributed to span boundaries (``sweep`` / ``em_k`` /
+  ``serve_dispatch``); inert where the backend reports no stats (CPU).
+
+The watch feeds the metrics registry under ``compiles`` /
+``compile_seconds`` / ``hbm_peak_bytes``, which the OpenMetrics exporter
+renders as ``gmm_compiles_total`` / ``gmm_compile_seconds_total`` /
+``gmm_hbm_peak_bytes`` with no exporter-side wiring.
+"""
+
+from __future__ import annotations
+
+import contextlib
+import threading
+import time
+from typing import Any, Callable, Dict, Optional, Tuple
+
+from . import recorder as _recorder
+from . import spans as _spans
+
+# The per-XLA-compile signal: fired once per backend compilation (jit
+# tracing fires its own jaxpr events; this one is the actual compile).
+_XLA_COMPILE_EVENT = "/jax/core/compile/backend_compile_duration"
+
+_register_lock = threading.Lock()
+_current: Optional["CompileWatch"] = None
+_listener_registered = False
+
+
+class _SiteState(threading.local):
+    """Per-thread instrumentation state: ``depth`` > 0 while inside an
+    instrumented site compile (so the XLA listener does not double-emit
+    the event the site is about to emit enriched), and ``tag`` the
+    active phase label for listener attribution when no trace span is
+    open (metrics-file-only runs have no span stack)."""
+
+    def __init__(self):
+        self.depth = 0
+        self.tag: Optional[str] = None
+
+
+_tls = _SiteState()
+
+
+def active() -> Optional["CompileWatch"]:
+    """The process-global active watch (None = all instruments inert)."""
+    return _current
+
+
+def _on_event_duration(event: str, duration, **kwargs) -> None:
+    watch = _current
+    if watch is None or event != _XLA_COMPILE_EVENT:
+        return
+    try:
+        watch._observe_xla(float(duration))
+    except Exception:
+        # Observability must never take the run down: a broken listener
+        # degrades to missing compile records, not a failed fit.
+        pass
+
+
+def _ensure_listener() -> None:
+    # jax.monitoring listeners cannot be unregistered (jax 0.4 API), so
+    # one permanent forwarder is registered on first watch activation;
+    # it reads the mutable _current ref and is a no-op between watches.
+    global _listener_registered
+    if _listener_registered:
+        return
+    with _register_lock:
+        if _listener_registered:
+            return
+        try:
+            from jax import monitoring
+
+            monitoring.register_event_duration_secs_listener(
+                _on_event_duration)
+        except Exception:
+            # No jax.monitoring: XLA totals degrade to site-only numbers.
+            pass
+        _listener_registered = True
+
+
+def compiled_analyses(compiled) -> Tuple[Optional[dict], Optional[dict]]:
+    """(cost, memory) introspection of one compiled executable.
+
+    ``cost``: {flops, bytes_accessed} from ``cost_analysis()`` (dict or
+    one-element list depending on jax version). ``memory``:
+    {argument_bytes, output_bytes, temp_bytes, generated_code_bytes}
+    from ``memory_analysis()``. Either side is None where the backend
+    does not provide it -- both calls are best-effort by contract.
+    """
+    cost = None
+    try:
+        c = compiled.cost_analysis()
+        if isinstance(c, (list, tuple)):
+            c = c[0] if c else None
+        if isinstance(c, dict):
+            cost = {}
+            if c.get("flops") is not None:
+                cost["flops"] = float(c["flops"])
+            if c.get("bytes accessed") is not None:
+                cost["bytes_accessed"] = float(c["bytes accessed"])
+            cost = cost or None
+    except Exception:
+        pass
+    memory = None
+    try:
+        m = compiled.memory_analysis()
+        if m is not None:
+            memory = {}
+            for attr, name in (
+                    ("argument_size_in_bytes", "argument_bytes"),
+                    ("output_size_in_bytes", "output_bytes"),
+                    ("temp_size_in_bytes", "temp_bytes"),
+                    ("generated_code_size_in_bytes",
+                     "generated_code_bytes")):
+                v = getattr(m, attr, None)
+                if v is not None:
+                    memory[name] = int(v)
+            memory = memory or None
+    except Exception:
+        pass
+    return cost, memory
+
+
+class CompileWatch:
+    """Accumulating compile/cost/memory observations for one run.
+
+    Thread-safe: EM dispatch, serve tick loops, and io_callback threads
+    all report here. ``snapshot()`` is the ``run_summary.profile``
+    payload (and serve_summary's); per-observation detail lands on the
+    stream as ``compile`` events through the ambient recorder.
+    """
+
+    def __init__(self, recorder: Optional[Any] = None):
+        self._recorder = recorder
+        self._lock = threading.Lock()
+        # Shadowed outer watch (set by watch(); _register_lock-guarded).
+        self._prev: Optional["CompileWatch"] = None
+        # ``compile`` records observed before the owning loop wrote the
+        # stream head (run_start lands AFTER the prologue jit compiles
+        # in _prepare_fit; serve AOT warmup precedes the first serve
+        # event): buffered here and flushed behind the head so the
+        # stream-ordering contract (run_start first) holds.
+        self._pending: list = []
+        # Instrumented executable-cache compiles (the acceptance target:
+        # these must match the caches' own counters).
+        self.compiles = 0
+        self.compile_seconds = 0.0
+        # Every backend compile jax.monitoring saw (site compiles
+        # included; the superset catches retraces the caches missed).
+        self.xla_compiles = 0
+        self.xla_seconds = 0.0
+        self.by_phase: Dict[str, Dict[str, float]] = {}
+        self.sites: Dict[str, Dict[str, float]] = {}
+        self.cost: Dict[str, float] = {}
+        self.memory: Dict[str, int] = {}       # max over compiles
+        self.watermarks: Dict[str, Dict[str, int]] = {}
+        self.hbm_peak_bytes: Optional[int] = None
+
+    def _rec(self):
+        rec = self._recorder
+        return rec if rec is not None else _recorder.current()
+
+    def _emit_compile(self, rec, fields: Dict[str, Any]) -> None:
+        """Emit one ``compile`` record, buffering ahead of the stream head.
+
+        Until the recorder has written its first record (``run_start`` /
+        the first serve event), compile observations queue in
+        ``_pending``; once the head exists they flush in observation
+        order before the new record. ``flush()`` (called from
+        ``snapshot()`` and watch exit) drains stragglers so buffered
+        records still precede ``run_summary``.
+        """
+        with self._lock:
+            if not getattr(rec, "emitted", True):
+                self._pending.append(fields)
+                return
+            pending, self._pending = self._pending, []
+        for f in pending:
+            rec.emit("compile", **f)
+        rec.emit("compile", **fields)
+
+    def flush(self, force: bool = False) -> None:
+        """Drain buffered ``compile`` records once the stream is open.
+
+        A no-op while the recorder has still written nothing: records
+        that cannot yet be ordered behind the stream head are held
+        rather than emitted ahead of ``run_start``. ``force`` (watch
+        exit) writes them regardless -- a watch whose stream never grew
+        a head (library users recording only compiles) still delivers
+        its observations, and a fit that died before ``run_start``
+        leaves its compiles on the stream for forensics.
+        """
+        rec = self._rec()
+        if not rec.active or not (force or getattr(rec, "emitted", True)):
+            return
+        with self._lock:
+            pending, self._pending = self._pending, []
+        for f in pending:
+            rec.emit("compile", **f)
+
+    def _tag(self) -> Optional[str]:
+        return _spans.current_span_name() or _tls.tag
+
+    def _fold_phase(self, tag: Optional[str], seconds: float) -> None:
+        if not tag:
+            return
+        slot = self.by_phase.setdefault(tag, {"compiles": 0,
+                                              "seconds": 0.0})
+        slot["compiles"] += 1
+        slot["seconds"] = round(slot["seconds"] + seconds, 6)
+
+    def _observe_xla(self, seconds: float) -> None:
+        tag = self._tag()
+        in_site = _tls.depth > 0
+        with self._lock:
+            self.xla_compiles += 1
+            self.xla_seconds += seconds
+            if not in_site:
+                # Site compiles fold their own (more precise, analysis-
+                # enriched) observation; only unexpected compiles land
+                # in the phase table and registry from the listener.
+                self._fold_phase(tag, seconds)
+        rec = self._rec()
+        if in_site or not rec.active:
+            return
+        rec.metrics.count("compiles")
+        rec.metrics.count("compile_seconds", round(seconds, 6))
+        self._emit_compile(rec, dict(
+            source="xla", seconds=round(seconds, 6),
+            **({"phase": tag} if tag else {})))
+
+    def observe_site(self, site: str, seconds: float,
+                     cost: Optional[dict] = None,
+                     memory: Optional[dict] = None, **fields) -> None:
+        """Fold one instrumented lower+compile (and emit its event)."""
+        tag = self._tag()
+        with self._lock:
+            self.compiles += 1
+            self.compile_seconds += seconds
+            slot = self.sites.setdefault(site, {"compiles": 0,
+                                                "seconds": 0.0})
+            slot["compiles"] += 1
+            slot["seconds"] = round(slot["seconds"] + seconds, 6)
+            self._fold_phase(tag, seconds)
+            for k, v in (cost or {}).items():
+                self.cost[k] = self.cost.get(k, 0.0) + float(v)
+            for k, v in (memory or {}).items():
+                self.memory[k] = max(self.memory.get(k, 0), int(v))
+        rec = self._rec()
+        if not rec.active:
+            return
+        rec.metrics.count("compiles")
+        rec.metrics.count("compile_seconds", round(seconds, 6))
+        self._emit_compile(rec, dict(
+            source="aot", site=site, seconds=round(seconds, 6),
+            **({"phase": tag} if tag else {}),
+            **(cost or {}), **(memory or {}), **fields))
+
+    def observe_watermark(self, name: str, before: Optional[dict],
+                          after: Optional[dict]) -> None:
+        """Fold one span boundary's device memory_stats() delta."""
+        if not after:
+            return
+        peak = after.get("peak_bytes_in_use")
+        in_use = after.get("bytes_in_use")
+        base = (before or {}).get("bytes_in_use")
+        with self._lock:
+            w = self.watermarks.setdefault(
+                name, {"sections": 0, "peak_bytes": 0, "delta_bytes": 0})
+            w["sections"] += 1
+            if peak is not None:
+                w["peak_bytes"] = max(w["peak_bytes"], int(peak))
+                self.hbm_peak_bytes = max(self.hbm_peak_bytes or 0,
+                                          int(peak))
+            if in_use is not None and base is not None:
+                w["delta_bytes"] = max(w["delta_bytes"],
+                                       int(in_use) - int(base))
+            hbm = self.hbm_peak_bytes
+        rec = self._rec()
+        if rec.active and hbm is not None:
+            rec.metrics.gauge("hbm_peak_bytes", hbm)
+
+    def snapshot(self) -> dict:
+        """The ``run_summary.profile`` payload (empty sections omitted)."""
+        # Summary construction precedes the summary record: draining the
+        # buffer here puts any still-pending compile records on the
+        # stream BEFORE run_summary/serve_summary closes it.
+        self.flush()
+        with self._lock:
+            out: Dict[str, Any] = {
+                "compiles": int(self.compiles),
+                "compile_seconds": round(self.compile_seconds, 6),
+                "xla_compiles": int(self.xla_compiles),
+                "xla_compile_seconds": round(self.xla_seconds, 6),
+            }
+            if self.sites:
+                out["sites"] = {k: dict(v) for k, v in self.sites.items()}
+            if self.by_phase:
+                out["by_phase"] = {k: dict(v)
+                                   for k, v in self.by_phase.items()}
+            if self.cost:
+                out["cost"] = dict(self.cost)
+            if self.memory:
+                out["memory"] = dict(self.memory)
+            if self.watermarks:
+                out["watermarks"] = {k: dict(v)
+                                     for k, v in self.watermarks.items()}
+            if self.hbm_peak_bytes is not None:
+                out["hbm_peak_bytes"] = int(self.hbm_peak_bytes)
+            return out
+
+
+@contextlib.contextmanager
+def watch(recorder: Optional[Any] = None):
+    """Activate a :class:`CompileWatch` for the enclosed run.
+
+    Process-global (compiles arrive from io_callback and serve tick
+    threads, not just the caller's); nested activation shadows the
+    outer watch and restores it on exit. Activation and restore run
+    under ``_register_lock``, and each watch remembers the one it
+    shadowed: concurrent watches from different threads (a fit in one
+    thread while ``gmm serve`` runs in another) exit in ANY order
+    without a later-exiting context resurrecting an already-exited
+    watch -- an out-of-order exit splices itself out of the shadow
+    chain instead of blindly restoring its predecessor. Callers gate
+    activation on an active recorder so no-recorder runs never enter
+    here.
+    """
+    global _current
+    _ensure_listener()
+    w = CompileWatch(recorder)
+    with _register_lock:
+        w._prev = _current
+        _current = w
+    # A sweep that raised through its wm_begin/wm_end pair leaves a
+    # stale tag on this thread; a fresh watch must not inherit it.
+    _tls.tag = None
+    try:
+        yield w
+    finally:
+        with _register_lock:
+            if _current is w:
+                _current = w._prev
+            else:
+                node = _current
+                while node is not None and node._prev is not w:
+                    node = node._prev
+                if node is not None:
+                    node._prev = w._prev
+            w._prev = None
+        # Stragglers observed after the last snapshot() still land on
+        # the stream; on the fit/serve paths the buffer drained before
+        # run_summary/serve_summary, so a forced flush here only ever
+        # writes to head-less streams (compile-only library use,
+        # pre-run_start fatalities).
+        w.flush(force=True)
+
+
+def site_compile(site: str, build: Callable[[], Any], **fields):
+    """Run ``build`` (a lower+compile) under the active watch.
+
+    No watch: calls ``build`` directly -- zero added work on the
+    uninstrumented path. With a watch: times the build, suppresses the
+    XLA listener's duplicate event for its duration, pulls the cost /
+    memory analyses off the compiled result, and folds one enriched
+    ``compile`` observation. Returns whatever ``build`` returns.
+    """
+    watch_ = _current
+    if watch_ is None:
+        return build()
+    _tls.depth += 1
+    t0 = time.perf_counter()
+    try:
+        compiled = build()
+    finally:
+        _tls.depth -= 1
+    seconds = time.perf_counter() - t0
+    try:
+        cost, memory = compiled_analyses(compiled)
+        watch_.observe_site(site, seconds, cost, memory, **fields)
+    except Exception:
+        pass
+    return compiled
+
+
+def _arg_signature(args) -> Optional[tuple]:
+    """Hashable shape/dtype signature of one positional call.
+
+    Array leaves key by (shape, dtype, weak_type) -- VALUES stay out of
+    the key, so the dynamic scalar args (epsilon, min/max iters) reuse
+    one executable across values exactly like jit's own cache. Python
+    scalars key by type.
+    """
+    import jax
+
+    leaves, treedef = jax.tree_util.tree_flatten(args)
+    sig = []
+    for leaf in leaves:
+        shape = getattr(leaf, "shape", None)
+        dtype = getattr(leaf, "dtype", None)
+        if shape is not None and dtype is not None:
+            sig.append((tuple(shape), str(dtype),
+                        bool(getattr(leaf, "weak_type", False))))
+        else:
+            sig.append(type(leaf))
+    return (treedef, tuple(sig))
+
+
+class ProfiledExecutable:
+    """Transparent cost-introspection proxy over a memoized jit callable.
+
+    With no watch active every call falls straight through to the
+    wrapped ``jax.jit`` function -- same dispatch path, byte-identical
+    results. With a watch, calls route through an explicit
+    ``lower(*args).compile()`` per argument signature (mirroring jit's
+    shape-keyed cache, so a bucketed sweep still compiles once per
+    distinct padded width): the compile is timed, its cost / memory
+    analyses are captured, and warm calls dispatch the compiled object
+    directly. Any AOT failure (exotic shardings, backend quirks) falls
+    back to the plain jit call -- introspection degrades, results never
+    change.
+    """
+
+    def __init__(self, fn, site: str):
+        self._fn = fn
+        self._site = site
+        self._aot: Dict[tuple, Any] = {}
+
+    def __getattr__(self, name):
+        # lower(), clear_cache(), ... pass through to the jit callable.
+        return getattr(self._fn, name)
+
+    @property
+    def aot_compiles(self) -> int:
+        """Distinct signatures compiled under a watch (tests)."""
+        return len(self._aot)
+
+    def __call__(self, *args, **kwargs):
+        if _current is None or kwargs:
+            return self._fn(*args, **kwargs)
+        try:
+            key = _arg_signature(args)
+        except Exception:
+            return self._fn(*args)
+        compiled = self._aot.get(key)
+        if compiled is None:
+            try:
+                compiled = site_compile(
+                    self._site,
+                    lambda: self._fn.lower(*args).compile())
+            except Exception:
+                return self._fn(*args)
+            self._aot[key] = compiled
+        try:
+            return compiled(*args)
+        except (TypeError, ValueError):
+            # Aval mismatch beyond the signature (committed-device or
+            # sharding drift): rejected before execution, so re-running
+            # through jit is safe.
+            return self._fn(*args)
+
+
+# -- watermarks ----------------------------------------------------------
+
+def wm_begin(name: str) -> Optional[tuple]:
+    """Open a watermark section at a span boundary.
+
+    Returns an opaque handle for :func:`wm_end` (None-safe when no watch
+    is active, so call sites need no gate). Also tags the thread's phase
+    label for XLA-listener attribution -- metrics-file-only runs have no
+    trace spans to read the phase from.
+    """
+    if _current is None:
+        return None
+    prev_tag, _tls.tag = _tls.tag, name
+    return (name, _recorder.memory_stats(), prev_tag)
+
+
+def wm_end(handle: Optional[tuple]) -> None:
+    """Close a :func:`wm_begin` section: restore the phase tag and fold
+    the device memory delta (inert where memory_stats() is None)."""
+    if handle is None:
+        return
+    name, before, prev_tag = handle
+    _tls.tag = prev_tag
+    watch_ = _current
+    if watch_ is None:
+        return
+    try:
+        watch_.observe_watermark(name, before, _recorder.memory_stats())
+    except Exception:
+        pass
+
+
+@contextlib.contextmanager
+def watermark(name: str):
+    """Lexical watermark section (the ``with``-friendly wm_begin/wm_end)."""
+    handle = wm_begin(name)
+    try:
+        yield
+    finally:
+        wm_end(handle)

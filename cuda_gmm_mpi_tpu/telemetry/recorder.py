@@ -86,12 +86,25 @@ class RunRecorder:
         # one early liveness mark per run -- then rate-limiting kicks in.
         self._last_heartbeat = 0.0
         self._t0 = time.perf_counter()
+        self._emitted = False
         self.run_id = run_id or uuid.uuid4().hex[:12]
         self.metrics = MetricsRegistry()
 
     @property
     def active(self) -> bool:
         return self._path is not None or self._stream is not None
+
+    @property
+    def emitted(self) -> bool:
+        """Whether any record has been emitted -- i.e. the stream is open.
+
+        The owning loop's first record (``run_start`` / the first serve
+        event) defines the stream head; background observers
+        (telemetry.profiling's CompileWatch) consult this to buffer
+        their records until the head is written, preserving the
+        stream-ordering contract (docs/OBSERVABILITY.md).
+        """
+        return self._emitted
 
     def set_context(self, **fields) -> None:
         """Merge static fields into every subsequent record (None drops)."""
@@ -148,6 +161,7 @@ class RunRecorder:
         }
         rec.update(self._context)
         rec.update(fields)
+        self._emitted = True
         with self._lock:
             if self._writer:
                 sink = self._sink()

@@ -73,6 +73,39 @@ def _count_spans(node: dict) -> int:
     return sum(1 + _count_spans(c) for c in node["children"])
 
 
+def _render_span_profile(span_recs: List[dict], top_n: int = 10) -> List[str]:
+    """"Span profile" section (rev v2.2): the top-N slowest spans by
+    SELF time (total minus direct children), aggregated by span name --
+    where the wall actually went, not just where the tree is deepest."""
+    from .spans import build_span_tree
+
+    agg: Dict[str, List[float]] = {}  # name -> [self_s, total_s, count]
+    stack = list(build_span_tree(span_recs))
+    while stack:
+        node = stack.pop()
+        s = node["span"]
+        total = float(s.get("duration_s", 0) or 0)
+        child_s = sum(float(c["span"].get("duration_s", 0) or 0)
+                      for c in node["children"])
+        slot = agg.setdefault(str(s.get("name", "?")), [0.0, 0.0, 0])
+        slot[0] += max(total - child_s, 0.0)
+        slot[1] += total
+        slot[2] += 1
+        stack.extend(node["children"])
+    if not agg:
+        return []
+    rows = sorted(agg.items(), key=lambda kv: kv[1][0], reverse=True)
+    out = [f"Span profile (top {min(top_n, len(rows))} by self time):",
+           f"  {'span':<18s} {'self_s':>9s} {'total_s':>9s} {'count':>6s}"]
+    for name, (self_s, total_s, count) in rows[:top_n]:
+        out.append(f"  {name:<18s} {self_s:>9.3f} {total_s:>9.3f} "
+                   f"{count:>6d}")
+    if len(rows) > top_n:
+        out.append(f"  ... {len(rows) - top_n} more span name(s)")
+    out.append("")
+    return out
+
+
 def render_report(records: List[dict], max_trajectory_rows: int = 400) -> str:
     """The full ``gmm report`` text for one decoded stream."""
     out: List[str] = []
@@ -103,6 +136,7 @@ def render_report(records: List[dict], max_trajectory_rows: int = 400) -> str:
     rebuckets = [r for r in records if r.get("event") == "rebucket"]
     heartbeats = [r for r in records if r.get("event") == "heartbeat"]
     span_recs = [r for r in records if r.get("event") == "span"]
+    compile_recs = [r for r in records if r.get("event") == "compile"]
 
     selects = [r for r in records if r.get("event") == "restart_select"]
     healths = [r for r in records if r.get("event") == "health"]
@@ -440,23 +474,79 @@ def render_report(records: List[dict], max_trajectory_rows: int = 400) -> str:
             out.append(f"  ... {elided} more span(s) elided")
         out.append("")
 
+    if span_recs:
+        out.extend(_render_span_profile(span_recs))
+
+    if compile_recs:
+        # rev v2.2 (telemetry/profiling.py): per-compile observations --
+        # instrumented cache builds ("aot", with cost/memory analyses)
+        # vs. bare XLA backend compiles outside any site ("xla").
+        aot = [r for r in compile_recs if r.get("source") == "aot"]
+        xla = [r for r in compile_recs if r.get("source") != "aot"]
+        out.append(
+            f"Compile activity (rev v2.2): {len(aot)} instrumented "
+            f"cache build(s) ({sum(float(r.get('seconds', 0)) for r in aot):.3f}s), "
+            f"{len(xla)} other XLA compile(s) "
+            f"({sum(float(r.get('seconds', 0)) for r in xla):.3f}s)")
+        by_site: Dict[str, List[dict]] = {}
+        for r in aot:
+            by_site.setdefault(str(r.get("site", "?")), []).append(r)
+        for site, rs in sorted(by_site.items()):
+            line = (f"  {site}: {len(rs)} compile(s), "
+                    f"{sum(float(r.get('seconds', 0)) for r in rs):.3f}s")
+            flops = [float(r["flops"]) for r in rs
+                     if r.get("flops") is not None]
+            ba = [float(r["bytes_accessed"]) for r in rs
+                  if r.get("bytes_accessed") is not None]
+            if flops:
+                line += f"; max {max(flops):.3g} flops"
+            if ba:
+                line += f" / {max(ba) / 1e6:.1f} MB accessed"
+            temp = [int(r["temp_bytes"]) for r in rs
+                    if r.get("temp_bytes") is not None]
+            if temp:
+                line += f"; temp {max(temp) / 1e6:.1f} MB"
+            out.append(line)
+        out.append("")
+
     for s in summaries:
         prof = s.get("phase_profile") or {}
         if prof.get("seconds"):
             out.append(render_phase_table(prof["seconds"],
                                           prof.get("counts")))
         comp = s.get("compile") or {}
-        if comp:
+        watch_prof = s.get("profile") or {}
+        if comp or watch_prof:
             first = comp.get("first_call_s")
             warm = comp.get("warm_call_s")
+            # rev v2.2: prefer MEASURED compile seconds (CompileWatch)
+            # over the first-minus-warm heuristic; pre-v2.2 streams
+            # carry only est_compile_s and keep rendering through it.
+            measured = watch_prof.get("compile_seconds")
             est = comp.get("est_compile_s")
             out.append(
                 "Compile/execute split: first call "
                 + (f"{first:.3f}s" if first is not None else "-")
                 + ", warm call "
                 + (f"{warm:.3f}s" if warm is not None else "-")
-                + ", est. compile "
-                + (f"{est:.3f}s" if est is not None else "-"))
+                + ", compile "
+                + (f"{measured:.3f}s (measured)" if measured is not None
+                   else (f"{est:.3f}s (est.)" if est is not None else "-")))
+        if watch_prof:
+            line = (f"Profile (rev v2.2): {watch_prof.get('compiles', 0)} "
+                    f"site compile(s), "
+                    f"{watch_prof.get('xla_compiles', 0)} XLA compile(s) "
+                    f"({float(watch_prof.get('xla_compile_seconds', 0)):.3f}s"
+                    " total)")
+            cost = watch_prof.get("cost") or {}
+            if cost.get("flops") is not None:
+                line += (f"; peak program {float(cost['flops']):.3g} flops"
+                         f" / {float(cost.get('bytes_accessed', 0)) / 1e6:.1f}"
+                         " MB accessed")
+            if watch_prof.get("hbm_peak_bytes"):
+                line += (f"; HBM peak "
+                         f"{int(watch_prof['hbm_peak_bytes']) / 1e6:.1f} MB")
+            out.append(line)
         hs = s.get("health")
         if hs is not None:
             if hs.get("flags"):
@@ -777,6 +867,10 @@ def report_main(argv=None) -> int:
                    "(with --follow: a file or a stream directory)")
     p.add_argument("--validate", action="store_true",
                    help="exit nonzero if any record fails schema validation")
+    p.add_argument("--json", action="store_true",
+                   help="machine-readable rollup on stdout (the same "
+                   "flat-metric shape `gmm diff` compares) instead of "
+                   "the rendered report")
     p.add_argument("--follow", "-f", action="store_true",
                    help="live view: poll the stream and re-render one "
                    "screen as it grows; exits when the run's terminal "
@@ -806,5 +900,10 @@ def report_main(argv=None) -> int:
     errors = validate_stream(records)
     for e in errors:
         print(f"schema: {e}", file=sys.stderr)
-    print(render_report(records), end="")
+    if args.json:
+        from .diff import summarize_run
+
+        print(json.dumps(summarize_run(records), sort_keys=True))
+    else:
+        print(render_report(records), end="")
     return 1 if (errors and args.validate) else 0

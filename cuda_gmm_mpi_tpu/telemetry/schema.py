@@ -239,11 +239,13 @@ EVENT_FIELDS: Dict[str, Tuple[Tuple[str, ...], Tuple[str, ...]]] = {
     # the resilience counters: ``shed``, ``deadline_expired``,
     # ``reloads``, and the ``breaker`` {trips, closes, open_routes}
     # rollup.
+    # ``profile`` (optional, rev v2.2): the CompileWatch rollup for the
+    # serve session -- same shape as run_summary.profile.
     "serve_summary": (
         ("requests", "batches", "rows", "wall_s", "qps", "latency_ms",
          "metrics"),
         ("models", "executor", "errors", "shed", "deadline_expired",
-         "reloads", "breaker", "stacked_batches"),
+         "reloads", "breaker", "stacked_batches", "profile"),
     ),
     # Fleet fits (stream rev v1.8; tenancy/, docs/TENANCY.md): one per
     # `fit_fleet` invocation -- the fleet's identity card: tenant count,
@@ -269,6 +271,25 @@ EVENT_FIELDS: Dict[str, Tuple[Tuple[str, ...], Tuple[str, ...]]] = {
         ("tenants", "dropped", "groups", "wall_s"),
         ("mode", "metrics"),
     ),
+    # One per XLA compilation observed while a CompileWatch is active
+    # (stream rev v2.2; telemetry/profiling.py). ``source`` is ``aot``
+    # -- an instrumented executable-cache build (models/gmm.py EM
+    # executables, serving/executor.py AOT scoring programs): the
+    # lower+compile timed at the call site and enriched with
+    # ``compiled.cost_analysis()`` (``flops``, ``bytes_accessed``) and
+    # ``memory_analysis()`` (argument/output/temp/generated-code bytes)
+    # where the backend provides them -- or ``xla``: a bare
+    # jax.monitoring backend-compile observation OUTSIDE any
+    # instrumented site, i.e. a (re)compile the caches did not expect.
+    # ``site`` names the emitting cache (em / em_batched / em_fleet /
+    # serve / serve_stacked), ``phase`` the active span/phase tag,
+    # ``key`` the cache's own key string.
+    "compile": (
+        ("source", "seconds"),
+        ("site", "phase", "key", "flops", "bytes_accessed",
+         "argument_bytes", "output_bytes", "temp_bytes",
+         "generated_code_bytes"),
+    ),
     # Trace span (rev v2.1; telemetry/spans.py): one per completed phase
     # of a traced fit or serve request -- name, this span's id, its
     # parent's id (absent on the root), and the measured duration.
@@ -293,11 +314,18 @@ EVENT_FIELDS: Dict[str, Tuple[Tuple[str, ...], Tuple[str, ...]]] = {
     # ``elastic`` (optional, rev v2.0): present only when the run
     # survived at least one elastic shrink -- {generation, world_size,
     # shrinks, resumes}.
+    # ``profile`` (optional, rev v2.2): the CompileWatch rollup --
+    # {compiles, compile_seconds, xla_compiles, xla_compile_seconds,
+    # sites, by_phase, cost {flops, bytes_accessed}, memory
+    # {argument/output/temp/generated_code bytes}, watermarks,
+    # hbm_peak_bytes}; present only when profiling was active
+    # (telemetry/profiling.py), so pre-v2.2 readers and byte-identity
+    # fixtures are untouched.
     "run_summary": (
         ("ideal_k", "score", "criterion", "final_loglik", "total_iters",
          "wall_s", "phase_profile", "compile", "metrics"),
         ("per_process", "memory_stats", "buckets", "health", "em_backend",
-         "elastic"),
+         "elastic", "profile"),
     ),
 }
 

@@ -47,6 +47,10 @@ class _TraceState(threading.local):
     def __init__(self):
         self.trace_id: Optional[str] = None
         self.stack: List[str] = []
+        # Parallel to ``stack``: the open spans' NAMES, so observers
+        # (telemetry/profiling.py tags compile events with the active
+        # phase) can ask "where are we?" without a span-id lookup.
+        self.names: List[str] = []
 
 
 _tls = _TraceState()
@@ -59,6 +63,12 @@ def active() -> bool:
 
 def current_trace_id() -> Optional[str]:
     return _tls.trace_id
+
+
+def current_span_name() -> Optional[str]:
+    """The innermost open span's name on this thread (None outside any
+    span -- including always when no trace is active)."""
+    return _tls.names[-1] if _tls.names else None
 
 
 @contextlib.contextmanager
@@ -79,6 +89,7 @@ def trace(trace_id: Optional[str] = None):
     finally:
         _tls.trace_id = None
         _tls.stack = []
+        _tls.names = []
 
 
 class _OpenSpan:
@@ -114,6 +125,7 @@ def begin(name: str, recorder: Optional[Any] = None,
                        _tls.stack[-1] if _tls.stack else None,
                        tid, time.perf_counter(), dict(fields), rec)
     _tls.stack.append(handle.span_id)
+    _tls.names.append(name)
     return handle
 
 
@@ -124,7 +136,9 @@ def end(handle: Optional[_OpenSpan], status: str = "ok",
     if handle is None:
         return None
     if handle.span_id in _tls.stack:
-        del _tls.stack[_tls.stack.index(handle.span_id):]
+        i = _tls.stack.index(handle.span_id)
+        del _tls.stack[i:]
+        del _tls.names[i:]
     extra: Dict[str, Any] = dict(handle.fields)
     extra.update(fields)
     if handle.parent_id is not None:

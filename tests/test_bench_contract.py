@@ -450,3 +450,40 @@ def test_obs_mode_contract():
     assert ab["sampler_heartbeats"] >= 1
     # Instrumentation must not change the arithmetic.
     assert ab["loglik_bit_identical"] is True
+
+
+def test_profile_mode_contract():
+    """--profile (GMM_BENCH_PROFILE=1) emits ONE JSON record asserting
+    the rev v2.2 compile-introspection contract: the run_summary.profile
+    block has the documented shape (site compiles <= XLA compiles,
+    per-site counts summing to the total), and two back-to-back
+    identical runs `gmm diff` CLEAN (diff_exit 0, vs_baseline 1.0)."""
+    r = _run({
+        "GMM_BENCH_CPU": "1",
+        "GMM_BENCH_PROFILE": "1",
+        "GMM_BENCH_PROFILE_N": "4000",
+        "GMM_BENCH_PROFILE_D": "4",
+        "GMM_BENCH_PROFILE_K": "4",
+        "GMM_BENCH_PROFILE_ITERS": "3",
+    }, timeout=600)
+    assert r.returncode == 0, r.stderr
+    j = _json_line(r.stdout)
+    assert j["unit"] == "s" and j["value"] > 0
+    assert j["accelerator_unavailable"] is False
+    p = j["profile"]
+    assert p["n"] == 4000 and p["k"] == 4 and p["em_iters"] == 3
+    # the profile block's shape held (the in-bench assertions passed)
+    assert p["profile_shape_ok"] is True
+    assert p["compiles"] >= 1
+    assert p["compiles"] <= p["xla_compiles"]
+    assert p["compile_seconds"] > 0
+    assert sum(p["sites"].values()) == p["compiles"]
+    assert "em" in p["sites"]
+    # CPU provides cost analysis: the envelope numbers rode along
+    assert p["cost_flops"] and p["cost_flops"] > 0
+    assert p["cost_bytes_accessed"] and p["cost_bytes_accessed"] > 0
+    # BOTH runs carried a profile, and the identical pair diffed clean
+    assert p["second_run_has_profile"] is True
+    assert p["diff_exit"] == 0
+    assert j["vs_baseline"] == 1.0
+    assert p["fingerprint"]

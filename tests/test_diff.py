@@ -1,0 +1,204 @@
+"""``gmm diff`` / ``gmm runs`` cross-run regression analytics (round 15).
+
+Contracts under test (telemetry/diff.py, docs/API.md exit codes):
+
+  * two back-to-back same-config runs diff CLEAN (exit 0) -- the
+    default gates are count-shaped precisely so wall jitter can't trip
+    them;
+  * an injected slowdown (read_slow fault on the pipelined ingest path)
+    trips a --fail-on gate, NAMES the regressed metric, and exits 1 --
+    the CI contract;
+  * the --fail-on spec grammar: relative (``>N%``), absolute (``>N``),
+    and lower-is-worse (``<``) directions, zero-baseline semantics, and
+    bad specs / unreadable targets exiting 2;
+  * ``gmm runs DIR`` indexes historical streams (run id, fingerprint,
+    backend, wall, health) and exits 2 on a non-directory;
+  * ``gmm report --json`` emits the same rollup shape diff consumes.
+"""
+
+import json
+import pathlib
+
+import numpy as np
+import pytest
+
+from cuda_gmm_mpi_tpu import GMMConfig, fit_gmm
+from cuda_gmm_mpi_tpu.cli import main as cli_main
+from cuda_gmm_mpi_tpu.io import FileSource, write_bin
+from cuda_gmm_mpi_tpu.telemetry import read_stream
+from cuda_gmm_mpi_tpu.telemetry.diff import (FailSpec, diff_main, runs_main,
+                                             summarize_run)
+from cuda_gmm_mpi_tpu.telemetry.report import report_main
+from cuda_gmm_mpi_tpu.testing import faults
+
+from .conftest import make_blobs
+
+
+@pytest.fixture(scope="module")
+def two_streams(tmp_path_factory):
+    """Two fits of the same data under the same config, two streams.
+
+    Module-scoped: five tests consume the identical pair read-only, so
+    the four EM fits (and their jit compiles) run once per session.
+    Tests that need EXTRA streams must write them to their own tmp_path,
+    never into this directory (the `gmm runs` test indexes it)."""
+    gen = np.random.default_rng(1234)
+    data, _ = make_blobs(gen, n=400, d=3, k=3, dtype=np.float32)
+    base = tmp_path_factory.mktemp("two_streams")
+    paths = []
+    for name in ("a", "b"):
+        path = str(base / f"{name}.jsonl")
+        cfg = GMMConfig(min_iters=2, max_iters=2, chunk_size=128, seed=0,
+                        metrics_file=path)
+        fit_gmm(data, 3, 3, cfg)
+        paths.append(path)
+    return paths
+
+
+def test_diff_identical_runs_clean(two_streams, capsys):
+    """The CI baseline: same config, same data -> exit 0 through the
+    real CLI dispatch, with the shared-metric table rendered."""
+    a, b = two_streams
+    assert cli_main(["diff", a, b]) == 0
+    out = capsys.readouterr().out
+    assert "clean: no regressions" in out
+    assert "REGRESSION" not in out
+    # same config -> same fingerprint -> no mismatch note
+    assert "fingerprints differ" not in out
+
+
+def test_diff_injected_ingest_regression_names_metric(tmp_path, rng,
+                                                      capsys):
+    """A read_slow fault on run B's pipelined ingestion shifts the
+    prefetch wait; the --fail-on gate trips, names the metric, exits 1."""
+    n, chunk = 1024, 128
+    data, _ = make_blobs(rng, n=n, d=3, k=3, dtype=np.float32)
+    bin_path = str(tmp_path / "events.bin")
+    write_bin(bin_path, data)
+    kw = dict(min_iters=2, max_iters=2, chunk_size=chunk, seed=0,
+              stream_events=True, ingest="pipelined")
+
+    a = str(tmp_path / "a.jsonl")
+    fit_gmm(FileSource(bin_path), 3, 3,
+            config=GMMConfig(metrics_file=a, **kw))
+    b = str(tmp_path / "b.jsonl")
+    with faults.use({"read_slow": {"ms": 50, "block": 1, "times": 3}}):
+        fit_gmm(FileSource(bin_path), 3, 3,
+                config=GMMConfig(metrics_file=b, **kw))
+
+    waits = [summarize_run(read_stream(p))["metrics"].get(
+        "ingest.prefetch_wait_s", 0.0) for p in (a, b)]
+    assert waits[1] > waits[0]  # the fault really moved the metric
+
+    spec = "ingest.prefetch_wait_s>0.05"
+    assert cli_main(["diff", a, b, "--fail-on", spec]) == 1
+    out = capsys.readouterr().out
+    assert "REGRESSION ingest.prefetch_wait_s" in out
+    assert "1 regression(s)" in out
+    # ...and the unfaulted pair still diffs clean under the same gate
+    assert diff_main([a, a, "--fail-on", spec]) == 0
+
+
+def test_fail_spec_grammar():
+    rel = FailSpec("wall_s>15%")
+    assert rel.relative and rel.op == ">" and rel.threshold == 15.0
+    assert rel.check(100.0, 110.0) is None           # +10% <= 15%
+    assert "wall_s" in rel.check(100.0, 120.0)       # +20% trips
+    assert rel.check(None, 120.0) is None            # not comparable
+    assert rel.check(0.0, 0.0) is None               # zero baseline, clean
+    assert rel.check(0.0, 5.0) is not None           # from-zero regression
+
+    lower = FailSpec("iters_per_s<10%")
+    assert lower.check(100.0, 95.0) is None          # -5% ok
+    assert "iters_per_s" in lower.check(100.0, 80.0)  # -20% trips
+
+    absolute = FailSpec("serve.p99_ms>5")
+    assert not absolute.relative
+    assert absolute.check(10.0, 14.0) is None        # +4 <= 5
+    assert "serve.p99_ms" in absolute.check(10.0, 16.0)
+
+    for bad in ("wall_s", ">5", "wall_s>", "wall_s>abc", ""):
+        with pytest.raises(ValueError):
+            FailSpec(bad)
+
+
+def test_diff_usage_errors_exit_2(two_streams, tmp_path, capsys):
+    a, b = two_streams
+    assert diff_main([a, str(tmp_path / "missing.jsonl")]) == 2
+    assert diff_main([a, b, "--fail-on", "bogus-spec"]) == 2
+    capsys.readouterr()
+
+
+def test_diff_json_and_custom_gate(two_streams, tmp_path, rng, capsys):
+    """--json emits the machine contract: both rollups, the gate list,
+    and the named regressions; a total_iters>0 absolute gate on unequal
+    runs trips it."""
+    a, b = two_streams
+    assert cli_main(["diff", a, b, "--json"]) == 0
+    doc = json.loads(capsys.readouterr().out)
+    assert doc["clean"] is True and doc["regressions"] == []
+    assert doc["a"]["metrics"]["total_iters"] \
+        == doc["b"]["metrics"]["total_iters"]
+    assert doc["a"]["fingerprint"] == doc["b"]["fingerprint"]
+    assert any(s.startswith("compiles>") for s in doc["fail_on"])
+
+    # a third run with MORE iterations and a different chunking: the
+    # custom absolute gate names the iteration growth
+    data, _ = make_blobs(rng, n=400, d=3, k=3, dtype=np.float32)
+    c = str(tmp_path / "c.jsonl")
+    fit_gmm(data, 3, 3, GMMConfig(min_iters=4, max_iters=4,
+                                  chunk_size=64, seed=0, metrics_file=c))
+    rc = diff_main([a, c, "--json", "--no-default-gates",
+                    "--fail-on", "total_iters>0"])
+    doc = json.loads(capsys.readouterr().out)
+    assert rc == 1 and doc["clean"] is False
+    assert any("total_iters" in r for r in doc["regressions"])
+    # chunk_size is a config-identity field -> fingerprints differ ->
+    # the comparison renders with a loud note instead of failing
+    assert any("fingerprints differ" in n_ for n_ in doc["notes"])
+
+
+def test_summarize_run_id_without_run_start():
+    """serve-only and run_summary-only streams still report their run_id
+    (regression: setdefault on the pre-seeded None key was a no-op, so
+    `gmm diff`/`gmm runs` showed '?' for every headless stream)."""
+    serve = summarize_run([{"event": "serve_summary", "run_id": "abc123",
+                            "requests": 4, "wall_s": 1.0}])
+    assert serve["run_id"] == "abc123"
+    summary = summarize_run([{"event": "run_summary", "run_id": "def456",
+                              "wall_s": 2.0, "total_iters": 3}])
+    assert summary["run_id"] == "def456"
+
+
+def test_runs_indexes_stream_directory(two_streams, tmp_path, capsys):
+    stream_dir = str(pathlib.Path(two_streams[0]).parent)
+    assert cli_main(["runs", stream_dir]) == 0
+    out = capsys.readouterr().out
+    assert "a.jsonl" in out and "b.jsonl" in out
+    assert "ok" in out  # clean health column
+
+    assert runs_main([stream_dir, "--json"]) == 0
+    doc = json.loads(capsys.readouterr().out)
+    assert len(doc["runs"]) == 2
+    row = doc["runs"][0]
+    assert row["run_id"] and row["fingerprint"] and row["backend"]
+    assert row["wall_s"] > 0 and row["health"] == "ok"
+    # both rows carry the same config fingerprint
+    assert len({r["fingerprint"] for r in doc["runs"]}) == 1
+
+    assert runs_main([str(tmp_path / "nope")]) == 2
+    capsys.readouterr()
+
+
+def test_report_json_is_the_diff_rollup(two_streams, capsys):
+    """`gmm report --json` and summarize_run are the SAME shape -- one
+    rollup for humans' diffs and scripts alike."""
+    a, _ = two_streams
+    assert report_main(["--json", a]) == 0
+    doc = json.loads(capsys.readouterr().out)
+    assert doc == json.loads(json.dumps(summarize_run(read_stream(a)),
+                                        sort_keys=True))
+    m = doc["metrics"]
+    assert m["wall_s"] > 0 and m["total_iters"] > 0
+    assert m["compiles"] >= 1  # the v2.2 profile fold rode along
+    assert doc["kind"] == "stream" and doc["fingerprint"]

@@ -148,6 +148,8 @@ def test_render_openmetrics_exposition_format():
     reg.gauge("active_k", 12)
     reg.observe("serve.latency_ms", 2.0)
     reg.observe("serve.latency_ms", 4.0)
+    # Without bucket data (pre-v2.2 callers) the histogram renders as an
+    # OpenMetrics summary -- the backward-compatible shape.
     text = render_openmetrics(reg.snapshot(), {"gmm_custom": 1.5})
     lines = text.splitlines()
     assert lines[-1] == "# EOF"
@@ -164,6 +166,50 @@ def test_render_openmetrics_exposition_format():
         if line and not line.startswith("#"):
             name, value = line.rsplit(" ", 1)
             float(value)
+
+
+def test_render_openmetrics_histogram_buckets():
+    """rev v2.2: with fixed-bucket data the serve-latency histogram
+    renders as a true OpenMetrics histogram -- cumulative ``_bucket{le=}``
+    series plus ``_count/_sum`` and the extremes as separate
+    ``_minimum/_maximum`` gauge families (``_min/_max`` are not valid
+    histogram sample suffixes for strict parsers; the summary form keeps
+    them), while ``snapshot()`` itself stays byte-stable for pre-v2.2
+    consumers."""
+    reg = MetricsRegistry()
+    reg.observe("serve.latency_ms", 2.0)
+    reg.observe("serve.latency_ms", 4.0)
+    reg.observe("serve.latency_ms", 9000.0)
+    snap = reg.snapshot()
+    # the 4-key summary contract is untouched by bucket collection
+    assert snap["histograms"]["serve.latency_ms"] == {
+        "count": 3, "sum": 9006.0, "min": 2.0, "max": 9000.0}
+    text = render_openmetrics(snap, None, reg.snapshot_buckets())
+    lines = text.splitlines()
+    assert "# TYPE gmm_serve_latency_ms histogram" in lines
+    assert "# TYPE gmm_serve_latency_ms summary" not in text
+    bucket_lines = [l for l in lines
+                    if l.startswith("gmm_serve_latency_ms_bucket{le=")]
+    assert bucket_lines, text
+    # cumulative counts, ending at the +Inf catch-all == total count
+    counts = [float(l.rsplit(" ", 1)[1]) for l in bucket_lines]
+    assert counts == sorted(counts)
+    assert bucket_lines[-1] == 'gmm_serve_latency_ms_bucket{le="+Inf"} 3'
+    # the le="2.5" bucket holds the 2.0 observation
+    assert any('le="2.5"} 1' in l for l in bucket_lines)
+    # _count/_sum survive alongside the buckets; the extremes move to
+    # distinct gauge families so the histogram family stays strictly
+    # parseable (no _min/_max samples under a histogram TYPE)
+    assert "gmm_serve_latency_ms_count 3" in lines
+    assert "gmm_serve_latency_ms_sum 9006" in lines
+    assert "# TYPE gmm_serve_latency_ms_minimum gauge" in lines
+    assert "gmm_serve_latency_ms_minimum 2" in lines
+    assert "gmm_serve_latency_ms_maximum 9000" in lines
+    assert "gmm_serve_latency_ms_min 2" not in lines
+    assert "gmm_serve_latency_ms_max 9000" not in lines
+    for line in lines:
+        if line and not line.startswith("#"):
+            float(line.rsplit(" ", 1)[1])
 
 
 def test_exporter_scrape_and_derived_rate():

@@ -1,0 +1,211 @@
+"""Compile & cost introspection: CompileWatch + ProfiledExecutable (round 15).
+
+Contracts under test (telemetry/profiling.py, docs/OBSERVABILITY.md v2.2):
+
+  * with a recorder active, ``fit_gmm`` activates a CompileWatch:
+    ``compile`` events validate against the schema and
+    ``run_summary.profile``'s site counts MATCH the executable caches'
+    own observed compile counts -- plain EM, batched-restart, and
+    serving (ScoringExecutor) paths;
+  * cost/memory introspection rides the events where the backend
+    provides analyses (CPU does: flops + bytes accessed + temp bytes);
+  * with NO recorder, profiling is inert -- no watch activates, the
+    proxies dispatch the plain jitted path, and the arithmetic is
+    bit-identical to an instrumented run;
+  * ProfiledExecutable keys its AOT cache by argument SIGNATURE (shape /
+    dtype / weak-type), never by value: dynamic scalar args don't leak
+    one compile per value.
+"""
+
+import numpy as np
+import pytest
+
+from cuda_gmm_mpi_tpu import GMMConfig, GaussianMixture, fit_gmm, telemetry
+from cuda_gmm_mpi_tpu.models.gmm import GMMModel
+from cuda_gmm_mpi_tpu.serving import ScoringExecutor
+from cuda_gmm_mpi_tpu.telemetry import (RunRecorder, read_stream,
+                                        validate_stream)
+from cuda_gmm_mpi_tpu.telemetry import profiling as tl_profiling
+
+from .conftest import make_blobs
+
+
+def _last_profile(recs):
+    summaries = [r for r in recs if r["event"] == "run_summary"]
+    assert summaries, "no run_summary in stream"
+    prof = summaries[-1].get("profile")
+    assert prof is not None, "recorder-active fit emitted no profile"
+    return prof
+
+
+def _aot_events(recs):
+    return [r for r in recs if r["event"] == "compile"
+            and r["source"] == "aot"]
+
+
+def test_profile_compiles_match_em_cache(tmp_path, rng):
+    """Plain fit path: run_summary.profile.compiles == the EM executable
+    cache's own observed AOT build count, and every instrumented build
+    emitted one enriched compile event."""
+    data, _ = make_blobs(rng, n=400, d=3, k=3, dtype=np.float32)
+    path = str(tmp_path / "m.jsonl")
+    cfg = GMMConfig(min_iters=2, max_iters=2, chunk_size=128,
+                    metrics_file=path)
+    model = GMMModel(cfg)
+    fit_gmm(data, 3, 3, cfg, model=model)
+
+    recs = read_stream(path)
+    assert validate_stream(recs) == []
+    prof = _last_profile(recs)
+    cache_compiles = sum(fn.aot_compiles
+                         for fn in model._em_exec_cache.values())
+    assert cache_compiles > 0
+    assert prof["compiles"] == cache_compiles
+    assert prof["sites"]["em"]["compiles"] == cache_compiles
+    aot = _aot_events(recs)
+    assert len(aot) == cache_compiles
+    assert all(r["site"] == "em" for r in aot)
+    # site builds are a subset of all XLA compiles, never double-counted
+    assert prof["compiles"] <= prof["xla_compiles"]
+    # seconds carry no such ordering: site walls include tracing/lowering
+    # time the backend-compile listener never sees
+    assert prof["compile_seconds"] > 0
+    assert prof["xla_compile_seconds"] > 0
+    assert sum(s["seconds"] for s in prof["sites"].values()) \
+        == pytest.approx(prof["compile_seconds"], abs=1e-4)
+    # CPU provides both analyses: cost + memory enrichment present
+    assert prof["cost"]["flops"] > 0
+    assert prof["cost"]["bytes_accessed"] > 0
+    assert prof["memory"]["temp_bytes"] >= 0
+    enriched = [r for r in aot if r.get("flops") is not None]
+    assert enriched, "no compile event carried cost analysis"
+
+
+def test_profile_compiles_match_batched_restart_cache(tmp_path, rng):
+    """Batched-restart path: the vmapped restart executable's builds are
+    attributed to the em_batched site and the cache count still matches
+    the rollup."""
+    data, _ = make_blobs(rng, n=400, d=3, k=3, dtype=np.float32)
+    path = str(tmp_path / "m.jsonl")
+    cfg = GMMConfig(min_iters=2, max_iters=2, chunk_size=128, n_init=2,
+                    restart_batch_size=2, metrics_file=path)
+    model = GMMModel(cfg)
+    fit_gmm(data, 3, 2, cfg, model=model)
+
+    recs = read_stream(path)
+    assert validate_stream(recs) == []
+    prof = _last_profile(recs)
+    cache_compiles = sum(fn.aot_compiles
+                         for fn in model._em_exec_cache.values())
+    assert cache_compiles > 0
+    assert prof["compiles"] == cache_compiles
+    assert "em_batched" in prof["sites"]
+    assert sum(s["compiles"] for s in prof["sites"].values()) \
+        == prof["compiles"]
+    assert len(_aot_events(recs)) == cache_compiles
+
+
+def test_profile_serving_executor_counts(tmp_path, rng):
+    """Serving path: ScoringExecutor's own compile counter and the watch
+    rollup agree, warm traffic moves neither, and the compile events are
+    tagged site=serve with the executor's cache key."""
+    data, _ = make_blobs(rng, n=300, d=4, k=3, dtype=np.float64)
+    gm = GaussianMixture(
+        3, target_components=3,
+        config=GMMConfig(min_iters=3, max_iters=3, chunk_size=128))
+    gm.fit(data.astype(np.float32))
+    state = gm.result_.state
+    X = data.astype(np.float32)
+
+    ex = ScoringExecutor(min_block=32, max_block=256)
+    path = str(tmp_path / "serve.jsonl")
+    with telemetry.use(RunRecorder(path)) as rec, rec:
+        with tl_profiling.watch(rec) as w:
+            ex.infer(state, X[:20])   # block 32: compile 1
+            ex.infer(state, X[:60])   # block 64: compile 2
+            ex.infer(state, X[:20])   # warm: no compile
+            snap = w.snapshot()
+    assert ex.compiles == 2
+    assert snap["sites"]["serve"]["compiles"] == ex.compiles
+    recs = read_stream(path)
+    assert validate_stream(recs) == []
+    aot = _aot_events(recs)
+    assert len(aot) == 2
+    assert all(r["site"] == "serve" and r.get("key") for r in aot)
+
+
+def test_no_recorder_profiling_inert_and_bit_identical(tmp_path, rng):
+    """The byte-identity gate: without a recorder no watch activates,
+    and instrumenting a run changes nothing about the arithmetic."""
+    data, _ = make_blobs(rng, n=400, d=3, k=3, dtype=np.float32)
+    base = dict(min_iters=3, max_iters=3, chunk_size=128, seed=0)
+    r0 = fit_gmm(data, 3, 3, GMMConfig(**base))
+    assert tl_profiling.active() is None
+    assert not telemetry.current().active
+
+    path = str(tmp_path / "m.jsonl")
+    r1 = fit_gmm(data, 3, 3, GMMConfig(metrics_file=path, **base))
+    assert tl_profiling.active() is None  # watch closed with the fit
+    assert r1.final_loglik == r0.final_loglik  # bit-identical, not approx
+    np.testing.assert_array_equal(np.asarray(r1.means),
+                                  np.asarray(r0.means))
+    # ...and the instrumented run really was instrumented
+    assert _last_profile(read_stream(path))["compiles"] > 0
+
+
+def test_compile_events_buffer_until_stream_head(tmp_path):
+    """Stream-ordering contract: compiles observed before the owning
+    loop writes its first record (prologue jits) buffer inside the
+    watch and flush BEHIND the head, so run_start stays record 0."""
+    path = str(tmp_path / "m.jsonl")
+    with telemetry.use(RunRecorder(path)) as rec, rec:
+        with tl_profiling.watch(rec) as w:
+            w.observe_site("em", 0.5)    # pre-head: buffered, not written
+            rec.emit("run_start", start_k=3)
+            w.observe_site("em", 0.25)   # head exists: drains, then emits
+    recs = read_stream(path)
+    assert [r["event"] for r in recs] == ["run_start", "compile", "compile"]
+    # observation order survives the buffer
+    assert [r["seconds"] for r in recs[1:]] == [0.5, 0.25]
+
+
+def test_watch_out_of_order_exit_keeps_active_watch():
+    """Concurrent watches (a fit in one thread, serve in another) may
+    exit in any order: the earlier-entered watch exiting first must not
+    tear down -- and its later exit must not resurrect -- the other."""
+    cm_a, cm_b = tl_profiling.watch(), tl_profiling.watch()
+    w_a = cm_a.__enter__()
+    w_b = cm_b.__enter__()
+    assert tl_profiling.active() is w_b
+    cm_a.__exit__(None, None, None)      # out-of-order: a exits first
+    assert tl_profiling.active() is w_b
+    cm_b.__exit__(None, None, None)
+    assert tl_profiling.active() is None
+    assert w_a is not w_b
+
+
+def test_profiled_executable_signature_keying():
+    """AOT cache keys are argument signatures: same shape/dtype with
+    different VALUES reuses one executable; a new shape compiles anew;
+    without a watch the proxy is a transparent passthrough."""
+    import jax
+    import jax.numpy as jnp
+
+    fn = tl_profiling.ProfiledExecutable(jax.jit(lambda x, s: x * s),
+                                         site="em")
+    # no watch: plain dispatch, nothing counted
+    np.testing.assert_allclose(
+        np.asarray(fn(jnp.ones((4,), jnp.float32), jnp.float32(2.0))),
+        2.0 * np.ones(4, np.float32))
+    assert fn.aot_compiles == 0
+
+    with tl_profiling.watch() as w:
+        a = fn(jnp.ones((4,), jnp.float32), jnp.float32(2.0))
+        b = fn(jnp.full((4,), 3.0, jnp.float32), jnp.float32(5.0))
+        assert fn.aot_compiles == 1  # value change, same signature
+        c = fn(jnp.ones((8,), jnp.float32), jnp.float32(2.0))
+        assert fn.aot_compiles == 2  # shape change: one more build
+    np.testing.assert_allclose(np.asarray(a), 2.0 * np.ones(4))
+    np.testing.assert_allclose(np.asarray(b), 15.0 * np.ones(4))
+    np.testing.assert_allclose(np.asarray(c), 2.0 * np.ones(8))
+    assert w.snapshot()["sites"]["em"]["compiles"] == 2

@@ -286,6 +286,32 @@ def test_registry_and_schema_units():
         assert any(f in e for e in validate_record(partial)), f
 
 
+def test_compile_event_schema_and_profile_rollup():
+    """rev v2.2 drift guards: the ``compile`` event validates with its
+    two-field envelope, the enriched cost/memory fields are DECLARED
+    optionals (readers may rely on the names), and run_summary /
+    serve_summary both carry the optional ``profile`` rollup."""
+    from cuda_gmm_mpi_tpu.telemetry.schema import EVENT_FIELDS
+
+    comp = {"event": "compile", "schema": 1, "ts": 0.0, "run_id": "x",
+            "process": 0, "source": "aot", "seconds": 0.25}
+    assert validate_record(comp) == []
+    enriched = dict(comp, site="em", phase="sweep", key="em:0",
+                    flops=1e6, bytes_accessed=2e6, argument_bytes=100,
+                    output_bytes=50, temp_bytes=9, generated_code_bytes=1)
+    assert validate_record(enriched) == []
+    assert any("seconds" in e for e in validate_record(
+        {k: v for k, v in comp.items() if k != "seconds"}))
+    req, opt = EVENT_FIELDS["compile"]
+    assert set(req) == {"source", "seconds"}
+    for f in ("site", "phase", "key", "flops", "bytes_accessed",
+              "argument_bytes", "output_bytes", "temp_bytes",
+              "generated_code_bytes"):
+        assert f in opt, f
+    assert "profile" in EVENT_FIELDS["run_summary"][1]
+    assert "profile" in EVENT_FIELDS["serve_summary"][1]
+
+
 def test_ambient_recorder_is_reused(tmp_path, rng):
     """A library-activated recorder wins over config.metrics_file: the fit
     rides the ambient stream instead of truncating a second file."""
