@@ -76,6 +76,15 @@ vs XLA compiles, per-site sums) and that the two identical runs
 ``gmm diff`` clean (diff_exit 0 rides in the record; vs_baseline 1.0 =
 clean). Size knobs: GMM_BENCH_PROFILE_{N,D,K,ITERS} (run_profile_bench).
 
+Timeline mode (``--timeline`` or GMM_BENCH_TIMELINE=1): rev v2.3 trace
+export contract -- one fit with the live plane on (spans + clock-bearing
+heartbeats), its stream exported through ``telemetry.timeline`` into a
+Chrome/Perfetto trace, the emitted document re-checked by the
+``--validate`` structural oracle; ONE record carries the event / slice /
+counter / track counts, the stream's alignment mode (must be "clock"),
+and the validate-pass bit (vs_baseline 1.0 = clean). Size knobs:
+GMM_BENCH_TIMELINE_{N,D,K,ITERS} (run_timeline_bench).
+
 Ingest mode (``--ingest`` or GMM_BENCH_INGEST=1): host-resident vs
 pipelined out-of-core ingestion A/B on one BIN dataset -- each mode
 (resident / pipelined / pipelined+minibatch) fits in its own subprocess
@@ -1147,6 +1156,107 @@ def run_serve_bench(platform: str, accel_unavailable: bool) -> dict:
     return result
 
 
+def run_timeline_bench(platform: str, accel_unavailable: bool) -> dict:
+    """The --timeline mode: rev v2.3 Perfetto trace-export contract.
+
+    Runs ONE fit with the live observability plane active (metrics_port=0
+    -> trace spans + clock-anchored heartbeats on the stream), exports
+    the stream through ``telemetry.timeline.build_timeline`` -- the same
+    code path as ``gmm timeline`` -- and holds the result to the export's
+    own contract:
+
+    * the emitted document passes ``validate_trace`` (the ``--validate``
+      structural oracle: known phases, nonnegative X durations,
+      per-track timestamp order, flow pairing, nonzero events);
+    * alignment mode is ``clock`` (a v2.3 recorder MUST anchor its own
+      stream; ``estimated`` here means the clock pairs went missing);
+    * the trace actually carries slices (spans + em_iter) and counter
+      samples, not just instants.
+
+    ``value`` is the export wall (build + write + reload + validate).
+    Size knobs: GMM_BENCH_TIMELINE_{N,D,K,ITERS}.
+    """
+    import json as json_mod
+    import tempfile
+
+    on_accel = platform not in ("cpu",)
+    n = int(os.environ.get("GMM_BENCH_TIMELINE_N")
+            or (200_000 if on_accel else 20_000))
+    d = int(os.environ.get("GMM_BENCH_TIMELINE_D") or (16 if on_accel else 8))
+    k = int(os.environ.get("GMM_BENCH_TIMELINE_K") or (16 if on_accel else 8))
+    iters = int(os.environ.get("GMM_BENCH_TIMELINE_ITERS")
+                or (10 if on_accel else 6))
+    chunk = int(os.environ.get("GMM_BENCH_CHUNK")
+                or (131072 if on_accel else 4096))
+    chunk = min(chunk, n)
+
+    from cuda_gmm_mpi_tpu.config import GMMConfig
+    from cuda_gmm_mpi_tpu.models.order_search import fit_gmm
+    from cuda_gmm_mpi_tpu.telemetry.timeline import (
+        build_timeline, summarize_trace, validate_trace)
+
+    rng = np.random.default_rng(13)
+    centers = rng.normal(scale=8.0, size=(k, d))
+    data = (centers[rng.integers(0, k, n)]
+            + rng.normal(scale=1.0, size=(n, d))).astype(np.float32)
+
+    tmp = tempfile.mkdtemp(prefix="gmm-timeline-")
+    stream = os.path.join(tmp, "fit.jsonl")
+    cfg = GMMConfig(min_iters=iters, max_iters=iters, chunk_size=chunk,
+                    seed=0, metrics_file=stream, metrics_port=0)
+    t0 = time.perf_counter()
+    fit_gmm(data, k, k, cfg)
+    fit_wall = time.perf_counter() - t0
+
+    out = os.path.join(tmp, "fit.trace.json")
+    t0 = time.perf_counter()
+    doc = build_timeline([stream])
+    with open(out, "w", encoding="utf-8") as fh:
+        json_mod.dump(doc, fh)
+    with open(out, "r", encoding="utf-8") as fh:
+        reloaded = json_mod.load(fh)
+    errors = validate_trace(reloaded)
+    export_wall = time.perf_counter() - t0
+
+    summary = summarize_trace(reloaded)
+    validate_ok = not errors
+    clean = bool(validate_ok
+                 and summary["alignment"] == "clock"
+                 and summary["slices"] > 0
+                 and summary["counters"] > 0)
+
+    result = {
+        "metric": f"timeline export wall, {n}x{d} K={k} ({platform})",
+        "value": round(export_wall, 4),
+        "unit": "s",
+        # Export must validate clean with clock alignment: 1.0 = clean.
+        "vs_baseline": 1.0 if clean else 0.0,
+        "accelerator_unavailable": accel_unavailable,
+        "timeline": {
+            "n": n, "d": d, "k": k, "em_iters": iters,
+            "chunk_size": chunk,
+            "fit_wall_s": round(fit_wall, 4),
+            "export_wall_s": round(export_wall, 4),
+            "events": summary["events"],
+            "slices": summary["slices"],
+            "counters": summary["counters"],
+            "instants": summary["instants"],
+            "flows": summary["flows"],
+            "tracks": summary["tracks"],
+            "alignment": summary["alignment"],
+            "validate_ok": validate_ok,
+            "validate_errors": len(errors),
+            "trace_bytes": os.path.getsize(out),
+        },
+        "measured_utc": time.strftime("%Y-%m-%dT%H:%M:%SZ", time.gmtime()),
+    }
+    if accel_unavailable:
+        result["platform_note"] = (
+            "accelerator tunnel unavailable (probe failed after retries); "
+            "this is a CPU-fallback measurement, not an accelerator result")
+    return result
+
+
 def run_ingest_bench(platform: str, accel_unavailable: bool) -> dict:
     """The --ingest mode: host-resident vs pipelined out-of-core A/B.
 
@@ -1528,6 +1638,8 @@ def main() -> int:
                 or os.environ.get("GMM_BENCH_OBS") == "1")
     want_profile = ("--profile" in sys.argv[1:]
                     or os.environ.get("GMM_BENCH_PROFILE") == "1")
+    want_timeline = ("--timeline" in sys.argv[1:]
+                     or os.environ.get("GMM_BENCH_TIMELINE") == "1")
     spec = CONFIGS.get(cfg_name)
     if spec is None:
         print(
@@ -1674,6 +1786,15 @@ def main() -> int:
         # Compile-introspection profile shape + identical-runs-diff-clean
         # contract (ignores --config; sized by GMM_BENCH_PROFILE_*).
         result = run_profile_bench(platform, accel_unavailable)
+        watchdog.cancel()
+        print(json.dumps(result))
+        return 3 if accel_unavailable else 0
+
+    if want_timeline:
+        # Perfetto trace-export contract: live-plane fit -> build_timeline
+        # -> validate oracle (ignores --config; sized by
+        # GMM_BENCH_TIMELINE_*).
+        result = run_timeline_bench(platform, accel_unavailable)
         watchdog.cancel()
         print(json.dumps(result))
         return 3 if accel_unavailable else 0

@@ -345,6 +345,14 @@ def main(argv=None) -> int:
         from .telemetry.diff import diff_main
 
         return diff_main(argv[1:])
+    if argv and argv[0] == "timeline":
+        # `gmm timeline RUN [RUN ...]`: export recorded streams (file,
+        # per-rank directory, fit + serve together) as ONE Chrome
+        # trace-event JSON for Perfetto / chrome://tracing, with
+        # cross-stream clock alignment (docs/OBSERVABILITY.md).
+        from .telemetry.timeline import timeline_main
+
+        return timeline_main(argv[1:])
     if argv and argv[0] == "runs":
         # `gmm runs DIR`: index historical run streams (run id, config
         # fingerprint, backend, wall, iters/s, health).

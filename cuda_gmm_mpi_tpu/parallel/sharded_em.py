@@ -296,6 +296,19 @@ class ShardedGMMModel:
                                          P(DATA_AXIS, None))
         self._inference_cache = None  # one-slot (id(state) -> prepared)
 
+        # Rank-tag the ambient telemetry stream (rev v2.3): per-rank
+        # records carry the pre-shrink rank and world size, so `gmm
+        # timeline` can lay multi-host stream directories out as one
+        # Perfetto track per rank. Context-only -- inactive recorders
+        # (the default) emit nothing, keeping no-recorder runs
+        # byte-identical.
+        from ..telemetry import recorder as _tl_recorder
+        from . import elastic as _elastic
+        rec = _tl_recorder.current()
+        if rec.active:
+            rec.set_context(rank=int(_elastic.original_rank()),
+                            world_size=int(jax.process_count()))
+
     def prepare(self, state, data_chunks, wts_chunks, host_local: bool = False):
         """Pad K to the cluster-axis size and place data sharded on the mesh.
 

@@ -1156,6 +1156,11 @@ def serve_main(argv=None) -> int:
             (tl_profiling.watch() if rec.active
              else contextlib.nullcontext()), \
             profiler_trace(args.trace_dir):
+        # Head-of-stream heartbeat (rev v2.3): the serve stream's first
+        # record, so it carries the clock/clock0 anchor pair that lets
+        # `gmm timeline` align this stream against a fit stream. The
+        # rate limiter starts open, so this emits immediately.
+        rec.heartbeat("serve")
         # Pre-resolve (and AOT-warm) the requested model set so the first
         # request never pays registry IO or a compile.
         names = args.models

@@ -13,8 +13,10 @@ aggregates, ``recorder`` the event bus + ambient-activation plumbing,
 ``spans`` the trace-span emission (rev v2.1 live plane).
 ``profiling`` the compile & cost introspection watch (rev v2.2), and
 ``diff`` the cross-run regression analytics behind ``gmm diff`` /
-``gmm runs``. ``utils.profiling.PhaseTimer`` and
-``utils.logging_.metrics_line`` are thin adapters over this package.
+``gmm runs``, and ``timeline`` the Perfetto/Chrome trace export with
+cross-stream clock alignment behind ``gmm timeline`` (rev v2.3).
+``utils.profiling.PhaseTimer`` and ``utils.logging_.metrics_line`` are
+thin adapters over this package.
 """
 
 from .diff import diff_main, runs_main, summarize_run
@@ -30,6 +32,8 @@ from .schema import (EVENT_FIELDS, SCHEMA_VERSION, validate_record,
                      validate_stream)
 from .spans import build_span_tree, mint_trace_id, span
 from .spans import trace as trace_spans
+from .timeline import (build_timeline, fit_alignment, summarize_trace,
+                       timeline_main, validate_trace)
 
 __all__ = [
     "RunRecorder", "MetricsRegistry", "current", "use", "write_line",
@@ -42,4 +46,6 @@ __all__ = [
     "build_span_tree", "mint_trace_id", "span", "trace_spans",
     "CompileWatch", "ProfiledExecutable", "site_compile", "watch",
     "diff_main", "runs_main", "summarize_run",
+    "build_timeline", "fit_alignment", "summarize_trace",
+    "timeline_main", "validate_trace",
 ]

@@ -239,11 +239,18 @@ def summarize_bench(record: dict) -> dict:
             "metrics": metrics}
 
 
-def _stream_files(path: str) -> List[str]:
+def stream_files(path: str) -> List[str]:
+    """The stream files behind one run target: the file itself, or every
+    ``*.jsonl`` in a directory of per-rank streams. Shared by ``gmm
+    diff``, ``gmm runs``, and ``gmm timeline`` (telemetry/timeline.py),
+    which all accept the same target grammar."""
     if os.path.isdir(path):
         return sorted(os.path.join(path, f) for f in os.listdir(path)
                       if f.endswith(".jsonl"))
     return [path]
+
+
+_stream_files = stream_files  # historical private name (pre-v2.3 callers)
 
 
 def load_target(path: str) -> dict:

@@ -58,6 +58,23 @@ def write_line(record: Dict[str, Any], stream=None) -> str:
     return line
 
 
+def _clock_pair() -> Dict[str, float]:
+    """An atomically-sampled (wall, mono) clock pair (stream rev v2.3).
+
+    ``wall`` is CLOCK_REALTIME (``time.time()``), ``mono`` the process
+    monotonic clock (``time.perf_counter()``) -- sampled back-to-back,
+    with the wall read bracketed by two mono reads so the pair's skew is
+    bounded by half the bracket width. One pair per stream head plus one
+    per heartbeat lets ``gmm timeline`` estimate every stream's
+    mono->wall offset (and its drift) and merge multi-rank / fit+serve
+    streams onto one timebase (docs/OBSERVABILITY.md "Timeline export").
+    """
+    m0 = time.perf_counter()
+    wall = time.time()
+    m1 = time.perf_counter()
+    return {"wall": round(wall, 6), "mono": round((m0 + m1) / 2.0, 6)}
+
+
 class RunRecorder:
     """Schema-versioned JSONL event bus for one run.
 
@@ -87,6 +104,11 @@ class RunRecorder:
         self._last_heartbeat = 0.0
         self._t0 = time.perf_counter()
         self._emitted = False
+        # v2.3: the recorder-start clock pair (CLOCK_REALTIME wall +
+        # perf_counter mono, sampled back-to-back). The stream head
+        # carries it alongside a fresh emit-time pair so readers get two
+        # alignment anchors even before the first heartbeat.
+        self._clock0 = _clock_pair()
         self.run_id = run_id or uuid.uuid4().hex[:12]
         self.metrics = MetricsRegistry()
 
@@ -161,6 +183,18 @@ class RunRecorder:
         }
         rec.update(self._context)
         rec.update(fields)
+        # v2.3 alignment anchors: the stream head (run_start / a serve
+        # stream's first record) and every heartbeat carry an
+        # atomically-sampled wall/mono clock pair; the head additionally
+        # carries the recorder-construction pair (clock0) so even a
+        # heartbeat-free stream holds two anchors for drift estimation.
+        # Explicit-kwarg clock (tests, replayers) wins.
+        if "clock" not in fields:
+            if not self._emitted:
+                rec["clock"] = _clock_pair()
+                rec["clock0"] = dict(self._clock0)
+            elif event == "heartbeat":
+                rec["clock"] = _clock_pair()
         self._emitted = True
         with self._lock:
             if self._writer:

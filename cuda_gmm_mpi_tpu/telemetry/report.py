@@ -628,9 +628,17 @@ class StreamTailer:
             self._offset = 0
         if size == self._offset:
             return []
-        with open(self.path, "rb") as fh:
-            fh.seek(self._offset)
-            chunk = fh.read()
+        try:
+            with open(self.path, "rb") as fh:
+                fh.seek(self._offset)
+                chunk = fh.read()
+        except OSError:
+            # The path races with the run: it can vanish between getsize
+            # and open, or turn out to be a directory (a `gmm top` target
+            # that did not exist at startup and was later created as a
+            # per-rank stream dir -- follow_stream's per-poll rescan then
+            # tails the member files; this placeholder just stays quiet).
+            return []
         nl = chunk.rfind(b"\n")
         if nl < 0:
             return []
@@ -808,6 +816,10 @@ def follow_stream(path: str, interval_s: float = 1.0,
     ended = False
 
     def _poll_all() -> List[dict]:
+        # Re-discover EVERY poll, not just at startup: rank files that
+        # join late (elastic regrowth, slow NFS create, a serve stream
+        # landing beside a fit stream) get a tailer mid-follow and their
+        # records appear on the next screen.
         for stream_path in _discover_streams(path):
             if stream_path not in tailers:
                 tailers[stream_path] = StreamTailer(stream_path)

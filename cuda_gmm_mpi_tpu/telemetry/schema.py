@@ -27,7 +27,19 @@ SCHEMA_VERSION = 1
 # clock-skew bug class the PR-11 watchdog fix addressed); and
 # ``trace_id``, the fit/request-scoped trace identity joining a record
 # to its span tree (telemetry/spans.py).
+#
+# Rev v2.3 optional envelope additions: ``clock``, an atomically-sampled
+# {"wall", "mono"} pair carried by the stream head (run_start / a serve
+# stream's first record) and every heartbeat -- the cross-stream
+# alignment anchor ``gmm timeline`` uses to merge multi-rank and
+# fit+serve streams onto one timebase; the head also carries ``clock0``,
+# the recorder-construction pair, so a heartbeat-free stream still holds
+# two anchors for drift estimation. ``validate_record`` checks the
+# pair's shape wherever it appears.
 COMMON_FIELDS = ("event", "schema", "ts", "run_id", "process")
+
+# The v2.3 clock-pair shape shared by ``clock`` and ``clock0``.
+CLOCK_FIELDS = ("wall", "mono")
 
 # event -> ((required fields), (optional well-known fields)). Optional
 # fields are documented for readers; unknown extras are always legal.
@@ -298,9 +310,13 @@ EVENT_FIELDS: Dict[str, Tuple[Tuple[str, ...], Tuple[str, ...]]] = {
     # request). ``t0_mono_s`` is the span's START on the process
     # monotonic clock (the envelope's ``mono_s`` is the emission time =
     # span END), so a reader can order siblings and compute self-time.
+    # ``thread`` (rev v2.3) is the emitting OS thread id: serve routes
+    # span concurrent threads, and ``gmm timeline`` keys its per-rank
+    # sub-tracks on it so overlapping spans from different threads
+    # never collide on one rendered lane.
     "span": (
         ("name", "span_id", "duration_s"),
-        ("parent_id", "trace_id", "t0_mono_s", "k", "status"),
+        ("parent_id", "trace_id", "t0_mono_s", "k", "status", "thread"),
     ),
     # One per fit: final scores, the 7-category phase profile, the
     # compile-vs-execute split, and the metrics-registry snapshot.
@@ -345,6 +361,20 @@ def validate_record(rec: Any) -> List[str]:
     if rec.get("schema") not in (None, SCHEMA_VERSION):
         errors.append(
             f"schema version {rec.get('schema')!r} != {SCHEMA_VERSION}")
+    for pair_field in ("clock", "clock0"):
+        pair = rec.get(pair_field)
+        if pair is None:
+            continue
+        if not isinstance(pair, dict):
+            errors.append(f"{pair_field} is {type(pair).__name__}, "
+                          f"not an object")
+            continue
+        for f in CLOCK_FIELDS:
+            if not isinstance(pair.get(f), (int, float)) \
+                    or isinstance(pair.get(f), bool):
+                errors.append(
+                    f"{pair_field}.{f} must be a number, "
+                    f"got {pair.get(f)!r}")
     event = rec.get("event")
     spec = EVENT_FIELDS.get(event) if isinstance(event, str) else None
     if spec is None:

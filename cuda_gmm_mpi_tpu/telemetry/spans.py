@@ -147,6 +147,10 @@ def end(handle: Optional[_OpenSpan], status: str = "ok",
         "span", name=handle.name, span_id=handle.span_id,
         trace_id=handle.trace_id, t0_mono_s=round(handle.t0, 6),
         duration_s=round(time.perf_counter() - handle.t0, 6),
+        # rev v2.3: the emitting OS thread, so timeline readers can lane
+        # concurrent serve routes separately (spans nest per thread by
+        # construction, but only per thread).
+        thread=threading.get_native_id(),
         status=status, **extra)
 
 

@@ -487,3 +487,40 @@ def test_profile_mode_contract():
     assert p["diff_exit"] == 0
     assert j["vs_baseline"] == 1.0
     assert p["fingerprint"]
+
+
+def test_timeline_mode_contract():
+    """--timeline (GMM_BENCH_TIMELINE=1) emits ONE JSON record asserting
+    the rev v2.3 trace-export contract: a live-plane fit's stream exports
+    to a Chrome/Perfetto trace that passes the --validate structural
+    oracle, with clock (not estimated) alignment and real slice/counter
+    content (vs_baseline 1.0 = clean)."""
+    r = _run({
+        "GMM_BENCH_CPU": "1",
+        "GMM_BENCH_TIMELINE": "1",
+        "GMM_BENCH_TIMELINE_N": "4000",
+        "GMM_BENCH_TIMELINE_D": "4",
+        "GMM_BENCH_TIMELINE_K": "4",
+        "GMM_BENCH_TIMELINE_ITERS": "3",
+        # fast sampler so heartbeats (and their clock anchors) land even
+        # in a short fit
+        "GMM_SAMPLER_INTERVAL_S": "0.05",
+    }, timeout=600)
+    assert r.returncode == 0, r.stderr
+    j = _json_line(r.stdout)
+    assert j["unit"] == "s" and j["value"] > 0
+    assert j["accelerator_unavailable"] is False
+    t = j["timeline"]
+    assert t["n"] == 4000 and t["k"] == 4 and t["em_iters"] == 3
+    # the emitted document passed its own structural oracle
+    assert t["validate_ok"] is True
+    assert t["validate_errors"] == 0
+    # a v2.3 recorder anchors its own stream: never "estimated"
+    assert t["alignment"] == "clock"
+    # real content: span/em slices, counter samples, >0 bytes on disk
+    assert t["slices"] > 0
+    assert t["counters"] > 0
+    assert t["events"] >= t["slices"] + t["counters"]
+    assert t["tracks"] >= 1
+    assert t["trace_bytes"] > 0
+    assert j["vs_baseline"] == 1.0

@@ -607,3 +607,124 @@ def test_server_live_gauges_are_exporter_ready(tmp_path):
     text = render_openmetrics({}, gauges)
     assert "gmm_serve_queue_rows 0" in text
     assert text.endswith("# EOF\n")
+
+
+# --------------------------------------- late-join rank files (rev v2.3)
+
+
+def test_follow_picks_up_rank_file_created_after_tailing_begins(
+        tmp_path, capsys):
+    """A rank file that lands in the stream directory AFTER the follow
+    loop starts (elastic regrowth, slow NFS create, a serve stream
+    appearing beside a fit stream) must get a tailer mid-follow -- here
+    the late file carries the ONLY terminal record, so the loop can only
+    exit by discovering it."""
+    d = tmp_path / "streams"
+    d.mkdir()
+    _write_lines(str(d / "rank0.jsonl"),
+                 [_mk("run_start", 0, platform="cpu", num_events=10,
+                      num_dimensions=2, start_k=2),
+                  _mk("em_iter", 1, k=2, iter=0, loglik=-3.0,
+                      wall_s=0.1)])
+
+    def late_writer():
+        time.sleep(0.1)
+        _write_lines(str(d / "rank1.jsonl"),
+                     [_mk("em_iter", 2, k=2, iter=1, loglik=-2.5,
+                          wall_s=0.1),
+                      _mk("run_summary", 5, ideal_k=2, score=1.0,
+                          final_loglik=-2.0, total_iters=2, wall_s=0.5)])
+
+    t = threading.Thread(target=late_writer)
+    t.start()
+    rc = follow_stream(str(d), interval_s=0.03)
+    t.join()
+    assert rc == 0
+    out = capsys.readouterr().out
+    assert "stream ended" in out            # terminal came from rank1
+    assert "iter=1" in out                  # as did its data record
+
+
+def test_stream_tailer_poll_survives_path_becoming_a_directory(tmp_path):
+    """A `gmm top` target that did not exist at startup can appear as a
+    DIRECTORY (per-rank streams): the dir-path tailer created while the
+    path was absent must keep returning [] instead of raising
+    IsADirectoryError, leaving discovery to per-file tailers."""
+    path = str(tmp_path / "later")
+    t = StreamTailer(path)
+    assert t.poll() == []                  # nothing there yet
+    os.mkdir(path)
+    _write_lines(os.path.join(path, "rank0.jsonl"),
+                 [_mk("run_start", 0, platform="cpu", num_events=10,
+                      num_dimensions=2, start_k=2)])
+    assert t.poll() == []                  # a directory, not a stream
+
+
+# ----------------------------------------- span profile self-time (unit)
+
+
+def test_span_profile_self_time_matches_hand_computed_fixture():
+    """The "Span profile" table's SELF time must equal total minus the
+    sum of DIRECT children, per node, aggregated by name -- pinned
+    against a hand-built tree: fit(10.0) -> sweep(8.0) -> [em_k(3.0),
+    em_k(2.0), checkpoint(1.0)]."""
+    recs = [
+        _mk("span", 0, name="fit", span_id="f" * 16, trace_id="t1",
+            t0_mono_s=0.0, duration_s=10.0),
+        _mk("span", 1, name="sweep", span_id="s" * 16, trace_id="t1",
+            parent_id="f" * 16, t0_mono_s=0.5, duration_s=8.0),
+        _mk("span", 2, name="em_k", span_id="a" * 16, trace_id="t1",
+            parent_id="s" * 16, t0_mono_s=1.0, duration_s=3.0),
+        _mk("span", 3, name="em_k", span_id="b" * 16, trace_id="t1",
+            parent_id="s" * 16, t0_mono_s=4.0, duration_s=2.0),
+        _mk("span", 4, name="checkpoint", span_id="c" * 16,
+            trace_id="t1", parent_id="s" * 16, t0_mono_s=6.0,
+            duration_s=1.0),
+    ]
+    lines = report_mod._render_span_profile(recs)
+    rows = {}
+    for line in lines[2:]:
+        parts = line.split()
+        if len(parts) == 4 and parts[0] != "...":
+            rows[parts[0]] = (float(parts[1]), float(parts[2]),
+                              int(parts[3]))
+    # fit: 10 total - 8 (sweep) = 2 self; sweep: 8 - (3+2+1) = 2 self;
+    # leaves: self == total; counts aggregate by name.
+    assert rows["fit"] == (2.0, 10.0, 1)
+    assert rows["sweep"] == (2.0, 8.0, 1)
+    assert rows["em_k"] == (5.0, 5.0, 2)
+    assert rows["checkpoint"] == (1.0, 1.0, 1)
+    # Sorted by self time descending.
+    assert list(rows)[0] == "em_k"
+
+
+def test_span_profile_orphans_and_overrun_children_clamp_to_zero():
+    """Two edge cases the math must survive: a child whose parent never
+    emitted (crash mid-phase -- orphan-promoted, counted fully), and a
+    node whose direct children SUM past its own total (overlapping
+    retries) -- self time clamps at 0.0, never negative."""
+    recs = [
+        # Orphan: parent_id points at a span that never completed.
+        _mk("span", 0, name="recovery", span_id="a" * 16, trace_id="t1",
+            parent_id="gone000000000000", t0_mono_s=1.0, duration_s=4.0),
+        # Overrun: children total 5.0 under a 3.0 parent.
+        _mk("span", 1, name="retry", span_id="b" * 16, trace_id="t1",
+            parent_id="p" * 16, t0_mono_s=2.0, duration_s=2.5),
+        _mk("span", 2, name="retry", span_id="c" * 16, trace_id="t1",
+            parent_id="p" * 16, t0_mono_s=3.0, duration_s=2.5),
+        _mk("span", 3, name="dispatch", span_id="p" * 16, trace_id="t1",
+            t0_mono_s=2.0, duration_s=3.0),
+    ]
+    lines = report_mod._render_span_profile(recs)
+    rows = {}
+    for line in lines[2:]:
+        parts = line.split()
+        if len(parts) == 4 and parts[0] != "...":
+            rows[parts[0]] = (float(parts[1]), float(parts[2]),
+                              int(parts[3]))
+    assert rows["recovery"] == (4.0, 4.0, 1)      # orphan counted fully
+    assert rows["dispatch"] == (0.0, 3.0, 1)      # clamped, not -2.0
+    assert rows["retry"] == (5.0, 5.0, 2)
+    # The tree itself promoted the orphan to a root.
+    roots = build_span_tree(recs)
+    assert {r["span"]["name"] for r in roots} == {"recovery", "dispatch"}

@@ -1,0 +1,401 @@
+"""Unified timeline export (rev v2.3; docs/OBSERVABILITY.md "Timeline
+export"): `gmm timeline` -> Chrome trace-event JSON + clock alignment.
+
+Contracts:
+- the recorder anchors its own stream: the FIRST record carries an
+  atomically-sampled ``clock``/``clock0`` wall+mono pair and every
+  heartbeat refreshes ``clock`` (both directions schema-checked);
+- two per-rank streams with wildly different (and skewed) mono bases
+  merge onto ONE wall timebase, aligned within the heartbeat-anchor
+  tolerance the export itself reports;
+- a fit stream and a serve stream export together, with flow arrows
+  joining a client's ``serve_request`` slice to the server-side
+  ``serve_route`` span tree that answered it (same trace_id);
+- pre-v2.3 streams (no clock anchors) still export, loudly marked
+  ``alignment: estimated``; streams with no ``mono_s`` at all fall back
+  to raw ``ts``;
+- ``--validate`` is a real structural oracle: it passes this exporter's
+  output and fails hand-broken documents (unknown phases, negative
+  durations, backwards per-track timestamps, unpaired flows);
+- the CLI honors the diff-family exit contract: 0 exported, 2 usage /
+  unreadable.
+"""
+
+import io
+import json
+import os
+
+import pytest
+
+from cuda_gmm_mpi_tpu.telemetry import RunRecorder, schema
+from cuda_gmm_mpi_tpu.telemetry import timeline as tl
+from cuda_gmm_mpi_tpu.telemetry.timeline import (build_timeline,
+                                                 fit_alignment,
+                                                 summarize_trace,
+                                                 timeline_main,
+                                                 validate_trace)
+
+
+def _mk(event, ts, mono, **fields):
+    base = {"event": event, "schema": schema.SCHEMA_VERSION,
+            "ts": round(ts, 6), "mono_s": round(mono, 6),
+            "run_id": "r1", "process": 0}
+    base.update(fields)
+    return base
+
+
+def _write(path, records):
+    with open(path, "w", encoding="utf-8") as fh:
+        for r in records:
+            fh.write(json.dumps(r) + "\n")
+
+
+def _clock(ts, mono):
+    return {"wall": round(ts, 6), "mono": round(mono, 6)}
+
+
+def _rank_stream(rank, mono_base, skew=0.0, wall_base=1000.0):
+    """A fit-shaped stream whose mono clock starts at ``mono_base`` and
+    drifts by ``skew`` seconds per second against the wall clock."""
+
+    def mono(t):  # t = seconds since run start, on the WALL clock
+        return mono_base + t * (1.0 + skew)
+
+    recs = [_mk("run_start", wall_base, mono(0.0), rank=rank,
+                platform="cpu", num_events=100, num_dimensions=4,
+                start_k=4, clock=_clock(wall_base, mono(0.0)),
+                clock0=_clock(wall_base, mono(0.0)))]
+    for i in range(4):
+        t = 1.0 + i
+        recs.append(_mk("em_iter", wall_base + t, mono(t), rank=rank,
+                        k=4, iter=i, loglik=-5.0 + i, wall_s=0.5))
+    for t in (2.0, 4.0):
+        recs.append(_mk("heartbeat", wall_base + t, mono(t), rank=rank,
+                        phase="em", elapsed_s=t, rss_bytes=1e8 + t,
+                        clock=_clock(wall_base + t, mono(t))))
+    recs.append(_mk("run_summary", wall_base + 5.0, mono(5.0), rank=rank,
+                    ideal_k=4, score=1.0, final_loglik=-1.0,
+                    total_iters=4, wall_s=5.0))
+    return recs
+
+
+# -------------------------------------------- recorder clock anchoring
+
+
+def test_recorder_anchors_first_record_and_heartbeats():
+    """The v2.3 emit contract: clock+clock0 on the stream's first
+    record, a fresh clock on every heartbeat, nothing on other records
+    -- both directions, so the anchors can't silently spread or dry up."""
+    buf = io.StringIO()
+    rec = RunRecorder(stream=buf)
+    rec.emit("run_start", platform="cpu", num_events=1,
+             num_dimensions=1, start_k=1, epsilon=1e-3)
+    rec.emit("em_iter", k=1, iter=0, loglik=-1.0, wall_s=0.1,
+             delta=0.0, epsilon=1e-3, timing={})
+    rec.heartbeat("em")
+    records = [json.loads(ln) for ln in buf.getvalue().splitlines()]
+    first, em, hb = records
+    assert first["event"] == "run_start"
+    for field in ("clock", "clock0"):
+        pair = first[field]
+        assert isinstance(pair["wall"], float)
+        assert isinstance(pair["mono"], float)
+    # clock0 is the construction-time anchor: no later than emit time.
+    assert first["clock0"]["mono"] <= first["clock"]["mono"]
+    assert "clock" not in em and "clock0" not in em
+    assert hb["event"] == "heartbeat"
+    assert "clock" in hb and "clock0" not in hb
+    assert hb["clock"]["mono"] >= first["clock"]["mono"]
+    # The stream passes schema validation with the anchors on it.
+    assert not schema.validate_stream(records)
+
+
+def test_schema_rejects_malformed_clock_pairs():
+    good = _mk("heartbeat", 1000.0, 10.0, phase="em", elapsed_s=1.0,
+               clock=_clock(1000.0, 10.0))
+    assert not schema.validate_record(good)
+    bad_shape = dict(good, clock=[1000.0, 10.0])
+    assert any("clock" in e for e in schema.validate_record(bad_shape))
+    bad_field = dict(good, clock={"wall": 1000.0, "mono": "ten"})
+    assert any("mono" in e for e in schema.validate_record(bad_field))
+    bad_bool = dict(good, clock0={"wall": True, "mono": 10.0})
+    assert any("clock0" in e for e in schema.validate_record(bad_bool))
+
+
+# ------------------------------------------------------ alignment maths
+
+
+def test_fit_alignment_recovers_offset_and_skew():
+    """Anchors from a stream whose mono clock starts 490s behind the
+    wall AND drifts 1ms/s: the fitted a*mono+b mapping must land every
+    anchor within the residual the fit itself reports (and that residual
+    must be tiny -- the anchors are exact here)."""
+    recs = _rank_stream(0, mono_base=510.0, skew=0.001)
+    align = fit_alignment(recs)
+    assert align["mode"] == "clock"
+    assert align["anchors"] == 3          # run head + two heartbeats
+    assert abs(align["a"] - 1.0 / 1.001) < 1e-6
+    assert align["residual_s"] < 1e-3
+    # The mapping reproduces the generating wall times.
+    for r in recs:
+        wall = tl._wall_of(r, align)
+        assert abs(wall - r["ts"]) < 1e-3
+
+
+def test_fit_alignment_falls_back_estimated_then_wall():
+    pre_v23 = [_mk("run_start", 1000.0, 10.0, platform="cpu",
+                   num_events=1, num_dimensions=1, start_k=1),
+               _mk("em_iter", 1001.0, 11.0, k=1, iter=0, loglik=-1.0,
+                   wall_s=0.5)]
+    align = fit_alignment(pre_v23)
+    assert align["mode"] == "estimated"
+    assert align["anchors"] == 2          # per-record (ts, mono_s) pairs
+    assert abs(tl._wall_of(pre_v23[1], align) - 1001.0) < 1e-6
+    no_mono = [{"event": "em_iter", "schema": 1, "ts": 1001.0,
+                "run_id": "r1", "process": 0, "k": 1, "iter": 0,
+                "loglik": -1.0, "wall_s": 0.5}]
+    align = fit_alignment(no_mono)
+    assert align["mode"] == "wall"
+    assert tl._wall_of(no_mono[0], align) == 1001.0
+
+
+def test_fit_alignment_clamps_garbage_slope():
+    """Anchors implying a 2x mono-vs-wall rate are corrupt, not drift:
+    the fit must refuse the slope (keep a=1) rather than smear events."""
+    recs = [_mk("run_start", 1000.0, 10.0, num_events=1,
+                num_dimensions=1, start_k=1, platform="cpu",
+                clock=_clock(1000.0, 10.0), clock0=_clock(1000.0, 10.0)),
+            _mk("heartbeat", 1010.0, 15.0, phase="em", elapsed_s=10.0,
+                clock=_clock(1010.0, 15.0))]
+    align = fit_alignment(recs)
+    assert align["a"] == 1.0
+
+
+# ------------------------------------------------- two-rank merge (e2e)
+
+
+def test_two_rank_skewed_streams_align_on_one_timebase(tmp_path):
+    """The acceptance scenario: rank streams with mono bases 500s apart
+    (plus drift on one) merge into a validate-clean trace where
+    same-wall-moment events from both ranks land at the same exported
+    timestamp, within the per-stream residual tolerance."""
+    d = tmp_path / "streams"
+    d.mkdir()
+    _write(str(d / "rank0.jsonl"), _rank_stream(0, mono_base=10.0))
+    _write(str(d / "rank1.jsonl"),
+           _rank_stream(1, mono_base=510.0, skew=0.0005))
+    doc = build_timeline([str(d)])
+    assert validate_trace(doc) == []
+    meta = doc["metadata"]
+    assert meta["alignment"] == "clock"
+    assert [s["rank"] for s in meta["streams"]] == [0, 1]
+    tolerance_s = max(s["residual_s"] for s in meta["streams"]) + 1e-3
+    # Each rank's iter=i em slice was generated at the SAME wall time;
+    # after alignment their exported ts must agree within tolerance.
+    slices = [e for e in doc["traceEvents"] if e.get("cat") == "em_iter"]
+    by_rank = {}
+    for e in slices:
+        by_rank.setdefault(e["pid"], []).append(e)
+    assert len(by_rank) == 2
+    a, b = (sorted(evs, key=lambda e: e["ts"])
+            for evs in by_rank.values())
+    assert len(a) == len(b) == 4
+    for ea, eb in zip(a, b):
+        assert abs(ea["ts"] - eb["ts"]) <= tolerance_s * 1e6
+    # Counters rode along: one RSS track per rank.
+    rss = [e for e in doc["traceEvents"] if e.get("ph") == "C"
+           and e["name"] == "host RSS bytes"]
+    assert {e["pid"] for e in rss} == set(by_rank)
+
+
+def test_pre_v23_streams_export_as_estimated(tmp_path, capsys):
+    """Streams recorded before the clock anchors still export -- via
+    per-record (ts, mono_s) pairs -- and BOTH the document metadata and
+    the CLI's stderr banner say so."""
+    path = str(tmp_path / "old.jsonl")
+    recs = _rank_stream(0, mono_base=10.0)
+    for r in recs:
+        r.pop("clock", None)
+        r.pop("clock0", None)
+    _write(path, recs)
+    doc = build_timeline([path])
+    assert doc["metadata"]["alignment"] == "estimated"
+    assert validate_trace(doc) == []
+    assert timeline_main([path, "--validate"]) == 0
+    err = capsys.readouterr().err
+    assert "alignment: estimated" in err
+
+
+# --------------------------------------------------- fit + serve flows
+
+
+def test_fit_and_serve_streams_join_via_flow_arrows(tmp_path):
+    """A client-side serve_request slice and the server-side serve_route
+    span tree carry the same trace_id; exporting the two streams
+    together must join them with a PAIRED s/f flow arrow."""
+    fit = str(tmp_path / "fit.jsonl")
+    _write(fit, _rank_stream(0, mono_base=10.0))
+    tid = "a1b2c3d4e5f60718"
+    serve = str(tmp_path / "serve.jsonl")
+    base = 1002.0
+    serve_recs = [
+        _mk("heartbeat", base, 900.0, path="serve", phase="serve",
+            elapsed_s=0.0, clock=_clock(base, 900.0),
+            clock0=_clock(base, 900.0)),
+        _mk("span", base + 0.2, 900.2, path="serve", name="prepare",
+            span_id="b" * 16, parent_id="a" * 16, trace_id=tid,
+            t0_mono_s=900.11, duration_s=0.04, status="ok"),
+        _mk("span", base + 0.3, 900.3, path="serve", name="serve_route",
+            span_id="a" * 16, trace_id=tid, t0_mono_s=900.1,
+            duration_s=0.2, status="ok"),
+        _mk("serve_request", base + 0.35, 900.35, path="serve",
+            model="m", op="score", n=8, ok=True, latency_ms=250.0,
+            trace_id=tid),
+        _mk("serve_summary", base + 1.0, 901.0, path="serve",
+            requests=1, rows=8),
+    ]
+    _write(serve, serve_recs)
+    doc = build_timeline([fit, serve])
+    assert validate_trace(doc) == []
+    assert doc["metadata"]["flow_count"] == 1
+    starts = [e for e in doc["traceEvents"] if e.get("ph") == "s"]
+    ends = [e for e in doc["traceEvents"] if e.get("ph") == "f"]
+    assert len(starts) == len(ends) == 1
+    assert starts[0]["id"] == ends[0]["id"] == tid
+    # The finish side binds to the serve_route span's track, enclosing
+    # (bp: "e") so Perfetto attaches it to the slice, not an instant.
+    route = [e for e in doc["traceEvents"]
+             if e.get("cat") == "span" and e["name"] == "serve_route"]
+    assert ends[0]["pid"] == route[0]["pid"]
+    assert ends[0]["bp"] == "e"
+    # Spans nest: prepare sits inside serve_route on the same track.
+    prep = [e for e in doc["traceEvents"]
+            if e.get("cat") == "span" and e["name"] == "prepare"][0]
+    assert prep["pid"] == route[0]["pid"]
+    assert prep["ts"] >= route[0]["ts"]
+    assert prep["ts"] + prep["dur"] <= route[0]["ts"] + route[0]["dur"] \
+        + 1.0
+
+
+def test_unmatched_trace_ids_produce_no_dangling_flows(tmp_path):
+    """A serve_request whose trace_id has no server-side span (tracing
+    off on the server) must produce NO flow start -- an unpaired `s` is
+    a validation error by design."""
+    path = str(tmp_path / "serve.jsonl")
+    _write(path, [
+        _mk("heartbeat", 1000.0, 10.0, path="serve", phase="serve",
+            elapsed_s=0.0, clock=_clock(1000.0, 10.0),
+            clock0=_clock(1000.0, 10.0)),
+        _mk("serve_request", 1000.5, 10.5, path="serve", model="m",
+            op="score", n=8, ok=True, latency_ms=100.0,
+            trace_id="deadbeefdeadbeef"),
+    ])
+    doc = build_timeline([path])
+    assert validate_trace(doc) == []
+    assert doc["metadata"]["flow_count"] == 0
+    assert not [e for e in doc["traceEvents"] if e.get("ph") in "sf"]
+
+
+# ------------------------------------------------- validate (the oracle)
+
+
+def test_validate_trace_catches_structural_breakage():
+    base = {"ph": "X", "name": "x", "cat": "c", "pid": 1, "tid": 1,
+            "ts": 1.0, "dur": 2.0, "args": {}}
+    ok = {"traceEvents": [dict(base)], "displayTimeUnit": "ms"}
+    assert validate_trace(ok) == []
+    assert validate_trace([]) != []                     # not an object
+    assert validate_trace({"traceEvents": 3}) != []     # not a list
+    assert any("no events" in e for e in
+               validate_trace({"traceEvents": []}))
+    assert any("unknown ph" in e for e in validate_trace(
+        {"traceEvents": [dict(base, ph="Z")]}))
+    assert any("bad dur" in e for e in validate_trace(
+        {"traceEvents": [dict(base, dur=-1.0)]}))
+    assert any("bad ts" in e for e in validate_trace(
+        {"traceEvents": [dict(base, ts=-5.0)]}))
+    assert any("backwards" in e for e in validate_trace(
+        {"traceEvents": [dict(base, ts=9.0), dict(base, ts=1.0)]}))
+    # Different tracks may interleave timestamps freely.
+    assert validate_trace({"traceEvents": [
+        dict(base, ts=9.0), dict(base, ts=1.0, tid=2)]}) == []
+    assert any("E without open B" in e for e in validate_trace(
+        {"traceEvents": [{"ph": "E", "pid": 1, "tid": 1, "ts": 1.0}]}))
+    assert any("unmatched B" in e for e in validate_trace(
+        {"traceEvents": [{"ph": "B", "name": "b", "pid": 1, "tid": 1,
+                          "ts": 1.0}]}))
+    assert any("counter args" in e for e in validate_trace(
+        {"traceEvents": [{"ph": "C", "name": "c", "pid": 1, "ts": 1.0,
+                          "args": {"v": "NaN-ish"}}]}))
+    assert any("start without finish" in e for e in validate_trace(
+        {"traceEvents": [dict(base),
+                         {"ph": "s", "id": "t1", "pid": 1, "tid": 1,
+                          "ts": 1.0}]}))
+    assert any("finish without start" in e for e in validate_trace(
+        {"traceEvents": [dict(base),
+                         {"ph": "f", "bp": "e", "id": "t1", "pid": 1,
+                          "tid": 1, "ts": 1.0}]}))
+    assert any("precedes" in e for e in validate_trace(
+        {"traceEvents": [dict(base),
+                         {"ph": "s", "id": "t1", "pid": 1, "tid": 1,
+                          "ts": 5.0},
+                         {"ph": "f", "bp": "e", "id": "t1", "pid": 1,
+                          "tid": 1, "ts": 1.0}]}))
+
+
+# ------------------------------------------------------------- CLI / exit
+
+
+def test_timeline_cli_exports_and_validates(tmp_path, capsys):
+    d = tmp_path / "streams"
+    d.mkdir()
+    _write(str(d / "rank0.jsonl"), _rank_stream(0, mono_base=10.0))
+    _write(str(d / "rank1.jsonl"), _rank_stream(1, mono_base=510.0))
+    out = str(tmp_path / "run.trace.json")
+    assert timeline_main([str(d), "-o", out, "--validate",
+                          "--json"]) == 0
+    captured = capsys.readouterr()
+    summary = json.loads(captured.out.strip().splitlines()[-1])
+    assert summary["validate_ok"] is True
+    assert summary["alignment"] == "clock"
+    assert summary["events"] > 0 and summary["pids"] == 2
+    assert summary["out"] == out
+    doc = json.load(open(out, encoding="utf-8"))
+    assert validate_trace(doc) == []
+    assert summarize_trace(doc)["events"] == summary["events"]
+    # Perfetto needs named processes to be navigable.
+    names = [e["args"]["name"] for e in doc["traceEvents"]
+             if e.get("ph") == "M" and e["name"] == "process_name"]
+    assert len(names) == 2 and any("rank 1" in n for n in names)
+
+
+def test_timeline_cli_default_output_path(tmp_path, capsys):
+    path = str(tmp_path / "fit.jsonl")
+    _write(path, _rank_stream(0, mono_base=10.0))
+    assert timeline_main([path]) == 0
+    capsys.readouterr()
+    assert os.path.exists(str(tmp_path / "fit.trace.json"))
+
+
+def test_timeline_cli_exit_2_on_usage_and_unreadable(tmp_path, capsys):
+    assert timeline_main([]) == 2                       # usage
+    missing = str(tmp_path / "nope.jsonl")
+    assert timeline_main([missing]) == 2                # unreadable
+    empty = str(tmp_path / "empty.jsonl")
+    _write(empty, [])
+    assert timeline_main([empty]) == 2                  # empty stream
+    notastream = str(tmp_path / "not.jsonl")
+    with open(notastream, "w", encoding="utf-8") as fh:
+        fh.write('{"foo": 1}\n')
+    assert timeline_main([notastream]) == 2             # no event records
+    capsys.readouterr()
+
+
+def test_timeline_routes_through_gmm_cli(tmp_path, capsys):
+    from cuda_gmm_mpi_tpu.cli import main
+
+    path = str(tmp_path / "fit.jsonl")
+    _write(path, _rank_stream(0, mono_base=10.0))
+    assert main(["timeline", path, "--validate"]) == 0
+    out = capsys.readouterr().out
+    assert "alignment: clock" in out and "validate: clean" in out
