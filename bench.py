@@ -52,6 +52,17 @@ breaker trips / reloads -- stream rev v1.7) so soak runs surface
 degradation, all-zero on a clean A/B. Size knobs:
 GMM_BENCH_SERVE_{N,D,K,REQUESTS} (run_serve_bench).
 
+Drift mode (``--drift`` or GMM_BENCH_DRIFT=1): rev v2.4 drift-plane
+contract -- fit + export a model (training envelope in the registry),
+serve it with the drift plane on, replay in-distribution traffic then
+deliberately shifted traffic, and flush one drift window per phase;
+ONE record carries psi_in (must sit under the alarm threshold),
+psi_shifted (must sit over it), the drift_alarm-fired bit, and the
+drift-on/drift-off serve wall ratio on identical warmed traffic
+(``vs_baseline`` = that overhead ratio; the plane reuses the request's
+own 'proba' block, so ~1.0 is the expectation). Size knobs:
+GMM_BENCH_DRIFT_{N,D,K,REQUESTS} (run_drift_bench).
+
 Tenancy mode (``--tenancy`` or GMM_BENCH_TENANCY=1): batched-fleet-vs-
 sequential multi-tenant A/B -- T independent per-tenant datasets fitted
 once through ``fit_fleet`` (packed groups, one fleet EM dispatch per
@@ -1156,6 +1167,141 @@ def run_serve_bench(platform: str, accel_unavailable: bool) -> dict:
     return result
 
 
+def run_drift_bench(platform: str, accel_unavailable: bool) -> dict:
+    """The --drift mode: rev v2.4 serve-time drift-detection contract.
+
+    Fits a small mixture (its training envelope lands in the registry
+    export), serves it with the drift plane enabled, and replays two
+    traffic phases -- rows drawn from the TRAINING data, then the same
+    rows with a deliberate mean shift -- flushing one drift window after
+    each. The contract under test:
+
+    * psi_in (in-distribution window) stays under the alarm threshold
+      and psi_shifted (shifted window) lands over it -- the detector
+      separates the phases;
+    * the shifted window raised a ``drift_alarm`` (observational: the
+      breaker stays untouched);
+    * drift-on steady-state serving costs ~ the same wall as drift-off
+      on identical warmed traffic (``vs_baseline`` is that ratio): the
+      plane folds in the request's own 'proba' block, no extra
+      dispatches.
+
+    Size knobs: GMM_BENCH_DRIFT_{N,D,K,REQUESTS}.
+    """
+    on_accel = platform not in ("cpu",)
+    k = int(os.environ.get("GMM_BENCH_DRIFT_K") or (16 if on_accel else 8))
+    n = int(os.environ.get("GMM_BENCH_DRIFT_N")
+            or (100_000 if on_accel else 4_000))
+    d = int(os.environ.get("GMM_BENCH_DRIFT_D") or (8 if on_accel else 4))
+    n_requests = int(os.environ.get("GMM_BENCH_DRIFT_REQUESTS") or 80)
+    threshold = 0.2
+
+    import tempfile
+
+    from cuda_gmm_mpi_tpu.config import GMMConfig
+    from cuda_gmm_mpi_tpu.estimator import GaussianMixture
+    from cuda_gmm_mpi_tpu.serving import (GMMServer, ModelRegistry,
+                                          ScoringExecutor)
+
+    rng = np.random.default_rng(42)
+    centers = rng.normal(scale=8.0, size=(k, d))
+    data = (centers[rng.integers(0, k, n)]
+            + rng.normal(scale=1.0, size=(n, d))).astype(np.float32)
+    gm = GaussianMixture(
+        k, target_components=k,
+        config=GMMConfig(min_iters=5, max_iters=5,
+                         chunk_size=min(65536, n)))
+    gm.fit(data)
+
+    def request(i, rows, shift=0.0):
+        lo = rng.integers(0, n - rows)
+        x = data[lo:lo + rows] + np.float32(shift)
+        return {"id": int(i), "model": "bench", "op": "score_samples",
+                "x": x.tolist()}
+
+    with tempfile.TemporaryDirectory() as root:
+        registry = ModelRegistry(root)
+        gm.to_registry(registry, "bench")
+        envelope_ok = registry.load_envelope("bench") is not None
+
+        executor = ScoringExecutor(min_block=256, max_block=4096)
+        sizes = [64, 100, 180, 250]
+
+        def replay(server, phase_shift, count):
+            t0 = time.perf_counter()
+            for i in range(count):
+                rows = sizes[i % len(sizes)]
+                resp = server.handle_requests(
+                    [request(i, rows, phase_shift)])[0]
+                assert resp["ok"], resp
+            return time.perf_counter() - t0
+
+        # Drift-off baseline: same registry, same (pre-warmed after the
+        # first replay) executor, drift plane fully disabled.
+        server_off = GMMServer(registry, executor=executor, warm=False)
+        replay(server_off, 0.0, len(sizes))  # warm every N-bucket
+        wall_off = replay(server_off, 0.0, n_requests)
+
+        # Drift-on server: huge interval so the timer never fires
+        # mid-phase -- windows are flushed explicitly per phase.
+        server_on = GMMServer(registry, executor=executor, warm=False,
+                              drift_interval_s=3600.0,
+                              drift_psi_threshold=threshold)
+        replay(server_on, 0.0, len(sizes))
+        server_on.flush_drift()  # discard the warm-up window
+        compiles_before = executor.compile_count
+
+        wall_on = replay(server_on, 0.0, n_requests)
+        rows_in = server_on.flush_drift()
+        wall_shifted = replay(server_on, 6.0, n_requests)
+        rows_shifted = server_on.flush_drift()
+        new_compiles = executor.compile_count - compiles_before
+
+    psi_in = rows_in[0]["psi"] if rows_in else None
+    psi_shifted = rows_shifted[0]["psi"] if rows_shifted else None
+    alarm_in = bool(rows_in and rows_in[0]["alarm"])
+    alarm_shifted = bool(rows_shifted and rows_shifted[0]["alarm"])
+    overhead = wall_on / max(wall_off, 1e-9)
+    detected = bool(psi_in is not None and psi_shifted is not None
+                    and not alarm_in and alarm_shifted
+                    and psi_shifted > psi_in)
+    result = {
+        "metric": f"serve drift-plane overhead (K={k}, D={d}, "
+                  f"{platform})",
+        "value": round(overhead, 4),
+        "unit": "x",
+        # Drift-on / drift-off wall on identical warmed traffic (NOT the
+        # NumPy baseline): ~1.0 = the plane is free, as designed.
+        "vs_baseline": round(overhead, 4),
+        "accelerator_unavailable": accel_unavailable,
+        "drift": {
+            "train_n": n, "d": d, "k": k, "requests": n_requests,
+            "threshold": threshold,
+            "envelope_in_registry": envelope_ok,
+            "psi_in": psi_in,
+            "psi_shifted": psi_shifted,
+            "alarm_in": alarm_in,
+            "alarm_fired": alarm_shifted,
+            "detected": detected,
+            "wall_off_s": round(wall_off, 4),
+            "wall_on_s": round(wall_on, 4),
+            "wall_shifted_s": round(wall_shifted, 4),
+            "overhead": round(overhead, 4),
+            # Drift sampling must stay on the answered block: zero new
+            # executor compiles across both drift-on phases.
+            "new_compiles": int(new_compiles),
+            "zero_recompile": bool(new_compiles == 0),
+            "drift_stats": server_on.drift_stats(),
+        },
+        "measured_utc": time.strftime("%Y-%m-%dT%H:%M:%SZ", time.gmtime()),
+    }
+    if accel_unavailable:
+        result["platform_note"] = (
+            "accelerator tunnel unavailable (probe failed); this is a "
+            "CPU-fallback measurement of the drift plane")
+    return result
+
+
 def run_timeline_bench(platform: str, accel_unavailable: bool) -> dict:
     """The --timeline mode: rev v2.3 Perfetto trace-export contract.
 
@@ -1628,6 +1774,8 @@ def main() -> int:
                      or os.environ.get("GMM_BENCH_ENVELOPE") == "1")
     want_serve = ("--serve" in sys.argv[1:]
                   or os.environ.get("GMM_BENCH_SERVE") == "1")
+    want_drift = ("--drift" in sys.argv[1:]
+                  or os.environ.get("GMM_BENCH_DRIFT") == "1")
     want_tenancy = ("--tenancy" in sys.argv[1:]
                     or os.environ.get("GMM_BENCH_TENANCY") == "1")
     want_ingest = ("--ingest" in sys.argv[1:]
@@ -1746,6 +1894,15 @@ def main() -> int:
         # Serving cold-vs-warm A/B over the AOT executable cache
         # (ignores --config; sized by GMM_BENCH_SERVE_*).
         result = run_serve_bench(platform, accel_unavailable)
+        watchdog.cancel()
+        print(json.dumps(result))
+        return 3 if accel_unavailable else 0
+
+    if want_drift:
+        # Serve-time drift-detection contract: in-distribution vs
+        # shifted traffic through the drift plane (ignores --config;
+        # sized by GMM_BENCH_DRIFT_*).
+        result = run_drift_bench(platform, accel_unavailable)
         watchdog.cancel()
         print(json.dumps(result))
         return 3 if accel_unavailable else 0

@@ -33,6 +33,8 @@ def build_parser() -> argparse.ArgumentParser:
         "a manifest of per-tenant datasets as packed multi-tenant "
         "dispatches (docs/TENANCY.md); `gmm diff A B` compares two runs "
         "with --fail-on regression gates (exit 0 clean / 1 regressed); "
+        "`gmm drift TARGET` compares a serve stream or dataset against "
+        "a registry version's training envelope (PSI/KS drift gates); "
         "`gmm runs DIR` indexes historical run streams.",
     )
     from ._version import __version__
@@ -345,6 +347,15 @@ def main(argv=None) -> int:
         from .telemetry.diff import diff_main
 
         return diff_main(argv[1:])
+    if argv and argv[0] == "drift":
+        # `gmm drift TARGET`: compare a recorded serve stream or a raw
+        # dataset file against a registry version's training envelope
+        # (PSI/KS/occupancy shift) with --fail-on gates and the same
+        # 0/1/2 exit contract as `gmm diff`; --rebuild-envelope
+        # backfills envelope.json for existing versions.
+        from .telemetry.drift import drift_main
+
+        return drift_main(argv[1:])
     if argv and argv[0] == "timeline":
         # `gmm timeline RUN [RUN ...]`: export recorded streams (file,
         # per-rank directory, fit + serve together) as ONE Chrome

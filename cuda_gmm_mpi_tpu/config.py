@@ -282,6 +282,17 @@ class GMMConfig:
     # ephemeral port (tests). None (default) = fully off: the stream is
     # byte-identical to a pre-v2.1 run.
     metrics_port: Optional[int] = None
+    # Training drift envelope (stream rev v2.4; telemetry/sketch.py,
+    # docs/OBSERVABILITY.md "Drift detection"): at fit end, one extra
+    # streamed pass over the (already device-resident) training data
+    # through the final parameters sketches the per-event score
+    # distribution + per-cluster responsibility occupancy; the envelope
+    # rides GMMResult/run_summary and is persisted as envelope.json on
+    # registry export -- the reference distribution serve-time drift is
+    # measured against. Observational: envelope failures never fail a
+    # fit. False = skip the pass (envelope.json can be backfilled later
+    # with `gmm drift --rebuild-envelope`).
+    envelope: bool = True
     checkpoint_dir: Optional[str] = None
     seed: int = 0  # RNG seed for any randomized paths (reference is deterministic)
     # Initial means: 'even' = the reference's evenly-spaced event rows

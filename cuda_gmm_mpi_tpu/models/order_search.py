@@ -36,6 +36,7 @@ from .. import telemetry
 from ..telemetry import RunRecorder
 from ..telemetry import exporter as tl_exporter
 from ..telemetry import profiling as tl_profiling
+from ..telemetry import sketch as tl_sketch
 from ..telemetry import spans as tl_spans
 from ..testing import faults
 from ..utils.logging_ import get_logger, metrics_line
@@ -221,9 +222,64 @@ def _reseed_and_refit(model, config, state, chunks, wts, epsilon, k,
     return best
 
 
+def compute_envelope(model, state, chunks, n_valid, k):
+    """Training drift envelope (stream rev v2.4; telemetry/sketch.py):
+    one streamed pass of the fit data through the FINAL compacted
+    parameters, sketching the per-event score distribution and argmax
+    responsibility occupancy -- the reference distribution serve-time
+    drift (PSI/KS vs this envelope) is measured against.
+
+    ``chunks`` is the device-resident chunked training data in the
+    model's centered frame (the serve path shifts requests into the
+    same frame, so fit-time and serve-time scores are comparable);
+    ``n_valid`` the local un-padded row count. Reuses the
+    ``infer_posteriors`` block executable (iter_memberships' pattern)
+    -- peak host memory is one [B, K] block. Observational by
+    contract: any failure returns None instead of raising, and a lazy
+    (pipelined) source is skipped (`gmm drift --rebuild-envelope`
+    backfills those). Multi-host runs merge per-rank sketches through
+    ``allgather_json`` -- every rank must call this (the collective is
+    reached even when the local pass fails).
+    """
+    log = get_logger()
+    local = None
+    try:
+        block = np.asarray(jax.device_get(chunks))
+        d = block.shape[-1]
+        rows = block.reshape(-1, d)[:int(n_valid)]
+        B = int(getattr(model, "inference_block", 0) or 1)
+        k = int(k)
+        sk = tl_sketch.StreamSketch()
+        occ = np.zeros(k, dtype=np.int64)
+        for lo in range(0, rows.shape[0], B):
+            xb = rows[lo:lo + B]
+            valid = xb.shape[0]
+            if valid < B:  # pad the tail to the jitted block shape
+                xb = np.concatenate(
+                    [xb, np.zeros((B - valid, d), xb.dtype)])
+            w, logz = model.infer_posteriors(state, xb)
+            w_host = np.asarray(jax.device_get(w))[:valid, :k]
+            sk.update(np.asarray(jax.device_get(logz))[:valid])
+            occ += np.bincount(np.argmax(w_host, axis=1), minlength=k)
+        local = tl_sketch.make_envelope(sk, occ, k=k,
+                                        num_events=rows.shape[0])
+    except Exception:  # noqa: BLE001 -- observational, never run-fatal
+        log.warning("envelope computation failed; fit continues "
+                    "without one", exc_info=True)
+    if jax.process_count() > 1:
+        try:
+            from ..parallel.distributed import allgather_json
+
+            return tl_sketch.merge_envelopes(allgather_json(local))
+        except Exception:  # noqa: BLE001
+            log.warning("envelope allgather failed", exc_info=True)
+            return None
+    return local
+
+
 def _emit_run_summary(rec, config, timer, sweep_log, ideal_k, best_score,
                       best_ll, em_walls, buckets=None, health_section=None,
-                      em_backend=None):
+                      em_backend=None, envelope=None):
     """Final ``run_summary`` record: scores, 7-category phase profile,
     compile/execute split, metrics-registry snapshot, and (multi-host)
     every rank's snapshot gathered to the one stream process 0 writes.
@@ -260,6 +316,9 @@ def _emit_run_summary(rec, config, timer, sweep_log, ideal_k, best_score,
         # jnp / custom; stream rev v1.5) -- mirrors run_start so a
         # summary-only consumer sees it too.
         **({"em_backend": em_backend} if em_backend is not None else {}),
+        # Training drift envelope (rev v2.4): the fit data's score
+        # sketch + occupancy, the serve-time drift reference.
+        **({"envelope": envelope} if envelope is not None else {}),
         ideal_k=int(ideal_k),
         score=float(best_score),
         criterion=config.criterion,
@@ -335,6 +394,13 @@ class GMMResult:
     # agree on this at identical seeds (the winner-parity contract,
     # models/restarts.py).
     init_index: Optional[int] = None
+    # Training drift envelope (stream rev v2.4; telemetry/sketch.py
+    # make_envelope): the fit data's per-event score sketch + per-
+    # cluster responsibility occupancy under the final parameters --
+    # persisted as envelope.json on registry export, the reference
+    # distribution serve-time drift is measured against. None when
+    # envelope computation was disabled, failed, or the source was lazy.
+    envelope: Optional[dict] = None
     # The fitted model (jitted executables already built) so the output path
     # reuses compiled posteriors instead of building a fresh GMMModel.
     model: Optional[object] = dataclasses.field(default=None, repr=False)
@@ -1070,6 +1136,17 @@ def _fit_gmm(data, num_clusters, target_num_clusters, config, model,
     health_section = health.health_summary(
         health_totals, recoveries=n_recoveries,
         io_retries=(ckpt.io_retries if ckpt is not None else 0))
+    # Training drift envelope (rev v2.4): one extra scoring pass over
+    # the device-resident chunks through the final parameters. Lazy
+    # (pipelined) sources are skipped -- their chunks are a consumed
+    # stream, not a resident array (backfill: gmm drift
+    # --rebuild-envelope).
+    envelope = None
+    if config.envelope and not hasattr(chunks, "close"):
+        n_local = (host_range[1] - host_range[0] if host_range
+                   else n_events)
+        envelope = compute_envelope(model, compact_state, chunks,
+                                    n_local, n_active)
     _emit_run_summary(
         rec, config, timer, sweep_log, n_active,
         float(min_rissanen), float(best_ll), em_walls,
@@ -1080,7 +1157,8 @@ def _fit_gmm(data, num_clusters, target_num_clusters, config, model,
             em_compiles=len(set(em_widths)),
             rebuckets=n_rebuckets,
         ),
-        health_section=health_section)
+        health_section=health_section,
+        envelope=envelope)
     if hasattr(chunks, "close") and getattr(model, "_restart_cache",
                                             None) is None:
         # Pipelined ingestion owner: stop the prefetch worker and emit
@@ -1101,6 +1179,7 @@ def _fit_gmm(data, num_clusters, target_num_clusters, config, model,
         profile_report=timer.report() if timer else None,
         host_range=host_range,
         health=health_section,
+        envelope=envelope,
         model=model,
     )
 
@@ -1784,6 +1863,12 @@ def _run_fused_sweep(fused, config, state, chunks, wts, epsilon,
         )
 
     health_section = health.health_summary(health_counts)
+    envelope = None
+    if config.envelope and not hasattr(chunks, "close"):
+        n_local = (host_range[1] - host_range[0] if host_range
+                   else n_events)
+        envelope = compute_envelope(model, compact_state, chunks,
+                                    n_local, n_active)
     if rec.active:
         # The fused device program exposes per-K granularity only (its EM
         # iterations never touch the host), so the stream carries em_done
@@ -1807,7 +1892,8 @@ def _run_fused_sweep(fused, config, state, chunks, wts, epsilon,
                           float(best_riss), float(best_ll),
                           [s for _, s in sorted(step_secs.items())],
                           health_section=health_section,
-                          em_backend=getattr(model, "estep_backend", None))
+                          em_backend=getattr(model, "estep_backend", None),
+                          envelope=envelope)
 
     return GMMResult(
         state=compact_state,
@@ -1823,6 +1909,7 @@ def _run_fused_sweep(fused, config, state, chunks, wts, epsilon,
         profile_report=profile_report,
         host_range=host_range,
         health=health_section,
+        envelope=envelope,
         model=model,
     )
 

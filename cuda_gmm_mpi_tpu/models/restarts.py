@@ -309,6 +309,7 @@ def fit_restarts_batched(data, num_clusters, target_num_clusters, config,
     """
     from .order_search import (
         GMMResult, _emit_run_summary, _null_phase, _prepare_fit,
+        compute_envelope,
     )
 
     log = get_logger(config)
@@ -461,6 +462,15 @@ def fit_restarts_batched(data, num_clusters, target_num_clusters, config,
         print(f"best of {R_total} inits: "
               f"{config.criterion}={winner['min_riss']:.6e} "
               f"K={winner['n_active']}")
+    # Training drift envelope (rev v2.4) for the WINNING init's
+    # parameters; lazy sources are skipped (backfill with `gmm drift
+    # --rebuild-envelope`).
+    envelope = None
+    if config.envelope and source is None and not hasattr(chunks, "close"):
+        n_local = (host_range[1] - host_range[0] if host_range
+                   else n_events)
+        envelope = compute_envelope(model, winner["state"], chunks,
+                                    n_local, winner["n_active"])
     return GMMResult(
         state=winner["state"],
         ideal_num_clusters=winner["n_active"],
@@ -475,6 +485,7 @@ def fit_restarts_batched(data, num_clusters, target_num_clusters, config,
         profile_report=None,
         host_range=host_range,
         health=health_section,
+        envelope=envelope,
         model=model,
         init_index=int(winner["init"]),
     )

@@ -47,6 +47,13 @@ from ..utils.checkpoint import flatten_tree, load_npz_tree, write_npz_atomic
 
 MODEL_FILE = "model.npz"
 MANIFEST_FILE = "manifest.json"
+# Training drift envelope (stream rev v2.4; telemetry/sketch.py): the
+# fit data's score sketch + responsibility occupancy, persisted NEXT TO
+# the model artifact. Optional by contract -- versions predating it (or
+# fits that skipped the envelope pass) load fine without one, and `gmm
+# drift --rebuild-envelope` can backfill it atomically without touching
+# model.npz/manifest.json bit-identity.
+ENVELOPE_FILE = "envelope.json"
 MANIFEST_SCHEMA = 1
 
 _NAME_RE = re.compile(r"^[A-Za-z0-9][A-Za-z0-9._-]*$")
@@ -76,6 +83,10 @@ class ServedModel:
     state: GMMState
     data_shift: np.ndarray  # [D] float64
     manifest: Dict[str, Any]
+    # Training drift envelope (envelope.json; rev v2.4) -- None for
+    # versions that carry none. The server's drift plane compares
+    # serve-time score/occupancy windows against it.
+    envelope: Optional[Dict[str, Any]] = None
 
     @property
     def k(self) -> int:
@@ -226,13 +237,22 @@ class ModelRegistry:
         }
         if extra:
             manifest.update(extra)
+        envelope = getattr(result, "envelope", None)
+        if envelope is not None:
+            # Small identity stanza only; the full envelope rides its
+            # own sidecar file (ENVELOPE_FILE).
+            from ..telemetry.sketch import envelope_stanza
+
+            manifest["envelope"] = envelope_stanza(envelope)
         return self._write_version(name, version, state,
                                    np.asarray(result.data_shift,
-                                              np.float64), manifest)
+                                              np.float64), manifest,
+                                   envelope=envelope)
 
     def _write_version(self, name: str, version: Optional[int],
                        state: GMMState, data_shift: np.ndarray,
-                       manifest: Dict[str, Any]) -> int:
+                       manifest: Dict[str, Any],
+                       envelope: Optional[Dict[str, Any]] = None) -> int:
         name = self._check_name(name)
         existing = self.versions(name)
         if version is None:
@@ -252,6 +272,13 @@ class ModelRegistry:
         flat = flatten_tree({"state": host_state,
                              "data_shift": data_shift})
         write_npz_atomic(vdir, os.path.join(vdir, MODEL_FILE), flat)
+        if envelope is not None:
+            # Envelope sidecar BEFORE the manifest: the manifest stays
+            # the one commit record, so a crash here leaves an
+            # ignorable orphan, never a committed version missing its
+            # declared envelope.
+            _write_json_atomic(os.path.join(vdir, ENVELOPE_FILE),
+                               envelope)
         # Manifest last: its presence is the commit record.
         tmp = os.path.join(vdir, MANIFEST_FILE + ".tmp")
         with open(tmp, "w", encoding="utf-8") as f:
@@ -332,8 +359,49 @@ class ModelRegistry:
         shift = np.asarray(tree.get("data_shift",
                                     np.zeros((state.num_dimensions,))),
                            np.float64)
+        # Envelope sidecar: optional by contract. Absent (pre-v2.4
+        # versions, envelope-off fits) or unreadable -> None, never a
+        # load failure -- drift observability must not break serving.
+        envelope = None
+        env_path = os.path.join(vdir, ENVELOPE_FILE)
+        if os.path.isfile(env_path):
+            try:
+                with open(env_path, encoding="utf-8") as f:
+                    envelope = json.load(f)
+            except (OSError, ValueError) as e:
+                warnings.warn(
+                    f"registry model {name!r} v{version}: unreadable "
+                    f"envelope.json ({e}); drift statistics unavailable "
+                    "for this version", RuntimeWarning)
         return ServedModel(name=name, version=int(version), state=state,
-                           data_shift=shift, manifest=manifest)
+                           data_shift=shift, manifest=manifest,
+                           envelope=envelope)
+
+    # -- drift envelopes -------------------------------------------------
+
+    def load_envelope(self, name: str,
+                      version: Optional[int] = None) -> Optional[dict]:
+        """The training envelope of ``name``@``version`` (default:
+        newest), or None when that version carries none."""
+        return self.load(name, version).envelope
+
+    def publish_envelope(self, name: str, version: int,
+                         envelope: Dict[str, Any]) -> None:
+        """Atomically (re)publish ``envelope.json`` for an EXISTING
+        version -- the `gmm drift --rebuild-envelope` backfill path.
+
+        Versions are immutable ARTIFACTS, not immutable directories:
+        the envelope is observability metadata, so writing it must not
+        (and does not) touch ``model.npz`` or ``manifest.json`` --
+        their bytes, and therefore ``latest_fingerprint``'s
+        mtime_ns:size commit record, stay bit-identical.
+        """
+        if version not in self.versions(self._check_name(name)):
+            raise RegistryError(
+                f"{name!r} has no version {version} "
+                f"(existing: {self.versions(name)})")
+        vdir = os.path.join(self._root, name, str(int(version)))
+        _write_json_atomic(os.path.join(vdir, ENVELOPE_FILE), envelope)
 
     def _validate(self, name, version, manifest, state: GMMState) -> None:
         """The loud manifest-vs-arrays contract: serving a model whose
@@ -481,7 +549,21 @@ class ModelRegistry:
                     covariance_type=row.get("covariance_type", "full"),
                     dtype=row.get("dtype", "float32"),
                     version=version)
-                audit.append({"name": name, "version": int(v)})
+                entry = {"name": name, "version": int(v)}
+                env_path = row.get("envelope")
+                if env_path:
+                    # Republish the fleet fit's per-tenant training
+                    # envelope next to the exported version (rev v2.4).
+                    # Per-tenant containment applies here too: a torn
+                    # envelope file degrades to an envelope-less
+                    # version, it does not void the export.
+                    try:
+                        with open(env_path, encoding="utf-8") as f:
+                            self.publish_envelope(name, v, json.load(f))
+                        entry["envelope"] = True
+                    except (OSError, ValueError) as e:
+                        entry["envelope_error"] = str(e)
+                audit.append(entry)
             except (RegistryError, OSError, ValueError) as e:
                 # Per-tenant containment: one torn summary must not
                 # void its siblings' exports.
@@ -515,6 +597,17 @@ class ModelRegistry:
 def _finite_or_none(x) -> Optional[float]:
     x = float(x)
     return x if np.isfinite(x) else None
+
+
+def _write_json_atomic(path: str, obj: Any) -> None:
+    """tmp + fsync + rename in the artifact's own directory (the
+    manifest write discipline, shared by the envelope sidecar)."""
+    tmp = path + ".tmp"
+    with open(tmp, "w", encoding="utf-8") as f:
+        json.dump(obj, f, indent=1, sort_keys=True)
+        f.flush()
+        os.fsync(f.fileno())
+    os.replace(tmp, path)
 
 
 def export_main(argv=None) -> int:

@@ -82,6 +82,7 @@ from .. import supervisor as supervisor_mod
 from .. import telemetry
 from ..telemetry import exporter as tl_exporter
 from ..telemetry import profiling as tl_profiling
+from ..telemetry import sketch as tl_sketch
 from ..telemetry import spans as tl_spans
 from ..testing import faults
 from .breaker import CircuitBreakers
@@ -128,7 +129,9 @@ class GMMServer:
                  breaker_threshold: int = 3,
                  breaker_backoff_s: float = 1.0,
                  stack_models: bool = False,
-                 trace_requests: bool = False):
+                 trace_requests: bool = False,
+                 drift_interval_s: Optional[float] = None,
+                 drift_psi_threshold: Optional[float] = 0.2):
         self._registry = registry
         self._max_batch_rows = max(1, int(max_batch_rows))
         self._tick_s = max(0.0, float(tick_s))
@@ -175,6 +178,29 @@ class GMMServer:
         # serve_request record) and emit spans around the route path.
         # Off by default -- responses and streams stay byte-identical.
         self._trace_requests = bool(trace_requests)
+        # Drift observability plane (stream rev v2.4; --drift-interval-s,
+        # docs/OBSERVABILITY.md "Drift detection"): per-(model, version)
+        # windowed sketches of request scores + argmax-assignment
+        # occupancy, compared against each version's TRAINING envelope
+        # (registry envelope.json) every interval as a `drift` event
+        # (PSI / KS / occupancy L1). Sampling is FREE by construction:
+        # every op already rides the one AOT 'proba' dispatch, so the
+        # window folds in the (w, logz) block the answers are sliced
+        # from -- no extra executor call, no new compiles. PSI past
+        # ``drift_psi_threshold`` raises a `drift_alarm` event --
+        # observational only: it never trips the circuit breaker. Off
+        # by default -- responses, streams, and /metrics stay
+        # byte-identical (the PR-13 plane-off contract).
+        self._drift_interval_s = (float(drift_interval_s)
+                                  if drift_interval_s else None)
+        self._drift_psi_threshold = (
+            float(drift_psi_threshold)
+            if drift_psi_threshold is not None else None)
+        # (name, actual version) -> {"sketch", "occ", "env", "version"}
+        self._drift_windows: Dict[Tuple[str, int], dict] = {}
+        self._drift_last: Dict[str, dict] = {}  # "name@v" -> last stats
+        self.drift_events = 0
+        self.drift_alarms = 0
 
     # -- model / executor resolution ------------------------------------
 
@@ -313,7 +339,21 @@ class GMMServer:
         ex = self.executor_stats()
         lookups = ex.get("hits", 0) + ex.get("misses", 0)
         br = self.breaker.stats()
+        # Drift gauges (rev v2.4) appear ONLY when the drift plane is
+        # on: a drift-off server's /metrics text stays byte-identical.
+        drift: Dict[str, float] = {}
+        if self._drift_interval_s is not None:
+            last = list(self._drift_last.values())
+            drift = {
+                "gmm_drift_psi": float(max(
+                    (r["psi"] for r in last), default=0.0)),
+                "gmm_drift_ks": float(max(
+                    (r["ks"] for r in last), default=0.0)),
+                "gmm_drift_events_total": float(self.drift_events),
+                "gmm_drift_alarms_total": float(self.drift_alarms),
+            }
         return {
+            **drift,
             "gmm_serve_queue_rows": float(self._queued_rows),
             "gmm_serve_requests": float(self.requests),
             "gmm_serve_batches": float(self.batches),
@@ -623,6 +663,8 @@ class GMMServer:
                     "the failure")
             return
         self.breaker.record_success((name, version))
+        if self._drift_interval_s is not None:
+            self._drift_observe(name, m, w, logz)
         wall_ms = (time.perf_counter() - t0) * 1e3
         self.batches += 1
         self.rows += int(rows.shape[0])
@@ -658,6 +700,101 @@ class GMMServer:
                 "version": m.version, "op": op, "n": n,
                 "result": result,
             })
+
+    # -- drift plane (rev v2.4) ------------------------------------------
+
+    def _drift_observe(self, name: str, m, w, logz) -> None:
+        """Fold one answered dispatch's (w, logz) block into the route's
+        drift window. Zero-dispatch-cost by design: the block is the
+        same host array the per-request answers are sliced from.
+        Versions without a training envelope are skipped -- there is
+        nothing to compare against (backfill with `gmm drift
+        --rebuild-envelope`)."""
+        env = m.envelope
+        if not env or not env.get("score"):
+            return
+        key = (name, int(m.version))
+        win = self._drift_windows.get(key)
+        if win is None:
+            # Window sketches adopt the ENVELOPE's bucket ladder, so
+            # PSI/KS compare bucket-for-bucket by construction.
+            win = self._drift_windows[key] = {
+                "sketch": tl_sketch.StreamSketch(env["score"]["bounds"]),
+                "occ": np.zeros(int(env.get("k", m.k)), np.int64),
+                "env": env,
+            }
+        win["sketch"].update(logz)
+        k = min(int(m.k), len(win["occ"]))
+        win["occ"] += np.bincount(
+            np.argmax(np.asarray(w)[:, :k], axis=1),
+            minlength=len(win["occ"])).astype(np.int64)
+
+    def flush_drift(self) -> List[dict]:
+        """Close every non-empty drift window: emit one ``drift`` event
+        per route (PSI / KS / occupancy L1 vs the training envelope),
+        raise ``drift_alarm`` where PSI crossed the threshold, reset the
+        windows, and return the stats list. Runs on the tick-loop thread
+        (run_loop's drift timer) and once more at serve shutdown so a
+        short-lived serve still reports its traffic. Observational only
+        -- the breaker is never touched."""
+        if self._drift_interval_s is None:
+            return []
+        rec = telemetry.current()
+        out: List[dict] = []
+        for (name, version), win in self._drift_windows.items():
+            sk = win["sketch"]
+            if sk.count == 0:
+                continue
+            stats = tl_sketch.compare_to_envelope(win["env"], sk,
+                                                  win["occ"])
+            thr = self._drift_psi_threshold
+            alarm = thr is not None and stats["psi"] > thr
+            self.drift_events += 1
+            row = dict(stats, model=name, version=int(version),
+                       alarm=bool(alarm))
+            self._drift_last[f"{name}@{version}"] = row
+            out.append(row)
+            if rec.active:
+                rec.emit(
+                    "drift", model=name, version=int(version),
+                    alarm=bool(alarm),
+                    # The window's raw mergeable summary rides along so
+                    # `gmm drift` can re-aggregate a recorded stream
+                    # offline at any window granularity.
+                    score_sketch=sk.to_dict(),
+                    occupancy=[int(c) for c in win["occ"]],
+                    train_rows=int(win["env"]["score"].get("count", 0)),
+                    **({"threshold": thr} if thr is not None else {}),
+                    **stats)
+                rec.metrics.count("drift_windows")
+                rec.metrics.series("drift_psi", stats["psi"])
+            if alarm:
+                self.drift_alarms += 1
+                if rec.active:
+                    # Health-event conventions (named flags, counted,
+                    # instants in `gmm timeline`) WITHOUT being a
+                    # health.py fault lane: drift is a property of the
+                    # traffic, not of the numerics.
+                    rec.emit("drift_alarm", model=name,
+                             version=int(version), psi=stats["psi"],
+                             threshold=float(thr), ks=stats["ks"],
+                             occupancy_l1=stats["occupancy_l1"],
+                             window_rows=stats["window_rows"],
+                             flag_names=["drift_psi"])
+                    rec.metrics.count("drift_alarms")
+            win["sketch"] = tl_sketch.StreamSketch(sk.bounds)
+            win["occ"] = np.zeros_like(win["occ"])
+        return out
+
+    def drift_stats(self) -> Dict[str, Any]:
+        """The rev v2.4 drift rollup (serve_summary.drift): windows
+        emitted, alarms raised, and each route's last window stats."""
+        return {
+            "windows": int(self.drift_events),
+            "alarms": int(self.drift_alarms),
+            "threshold": self._drift_psi_threshold,
+            "last": dict(self._drift_last),
+        }
 
     def _reply(self, p: _Pending, resp: dict) -> None:
         latency_ms = (time.perf_counter() - p.t0) * 1e3
@@ -730,6 +867,10 @@ class GMMServer:
         snapshot."""
         rec = telemetry.current()
         wall = time.perf_counter() - self._t_start
+        # Close out any partial drift windows first (rev v2.4): a serve
+        # session shorter than one drift interval still reports what it
+        # saw, and the drift events precede the summary in the stream.
+        self.flush_drift()
         if not rec.active:
             return None
         watch = tl_profiling.active()
@@ -750,6 +891,10 @@ class GMMServer:
             # memory analyses + serve-dispatch HBM watermarks.
             **({"profile": watch.snapshot()} if watch is not None
                else {}),
+            # Drift rollup (rev v2.4): only when the plane is on, so
+            # drift-off streams stay byte-identical.
+            **({"drift": self.drift_stats()}
+               if self._drift_interval_s is not None else {}),
             **self.resilience_stats(),
         )
 
@@ -859,6 +1004,10 @@ class GMMServer:
         reason = "shutdown"
         next_reload = (time.perf_counter() + reload_interval_s
                        if reload_interval_s else None)
+        # Drift windows close on the tick-loop thread too (rev v2.4),
+        # so window state never needs a lock.
+        next_drift = (time.perf_counter() + self._drift_interval_s
+                      if self._drift_interval_s else None)
         idle_since = time.perf_counter()
         while True:
             if self._stop.is_set():
@@ -875,6 +1024,10 @@ class GMMServer:
                     and time.perf_counter() >= next_reload):
                 self.maybe_reload()
                 next_reload = time.perf_counter() + reload_interval_s
+            if (next_drift is not None
+                    and time.perf_counter() >= next_drift):
+                self.flush_drift()
+                next_drift = time.perf_counter() + self._drift_interval_s
             # Bounded wait so signals/deadline/reload stay responsive
             # even on an idle queue.
             wait = 0.1 if idle_timeout_s is None else min(
@@ -1100,6 +1253,24 @@ def serve_main(argv=None) -> int:
                    help="base seconds an open breaker fast-fails "
                    "before half-opening; doubles per consecutive "
                    "trip with deterministic jitter (default 1)")
+    dr = p.add_argument_group(
+        "drift observability (docs/OBSERVABILITY.md \"Drift "
+        "detection\")")
+    dr.add_argument("--drift-interval-s", type=float, default=None,
+                    metavar="SECONDS",
+                    help="opt-in drift plane (stream rev v2.4): sketch "
+                    "every route's request scores + cluster occupancy "
+                    "and emit a `drift` event per interval -- PSI/KS "
+                    "vs the model's training envelope "
+                    "(envelope.json) plus occupancy L1 shift. Free on "
+                    "the dispatch path (rides the answered 'proba' "
+                    "block); default: off -- responses, streams, and "
+                    "/metrics stay byte-identical")
+    dr.add_argument("--drift-psi-threshold", type=float, default=0.2,
+                    metavar="PSI",
+                    help="PSI above this raises a `drift_alarm` event "
+                    "(observational only -- never trips the breaker; "
+                    "default 0.2, the conventional major-shift line)")
     p.add_argument("--stack-models", action="store_true",
                    help="cross-model coalescing: one tick's requests "
                    "for DIFFERENT models of one numeric family score "
@@ -1131,7 +1302,9 @@ def serve_main(argv=None) -> int:
                        breaker_threshold=args.breaker_threshold,
                        breaker_backoff_s=args.breaker_backoff_s,
                        stack_models=args.stack_models,
-                       trace_requests=args.metrics_port is not None)
+                       trace_requests=args.metrics_port is not None,
+                       drift_interval_s=args.drift_interval_s,
+                       drift_psi_threshold=args.drift_psi_threshold)
 
     rec = (telemetry.RunRecorder(args.metrics_file)
            if args.metrics_file else telemetry.RunRecorder())

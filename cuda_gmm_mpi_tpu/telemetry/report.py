@@ -127,6 +127,9 @@ def render_report(records: List[dict], max_trajectory_rows: int = 400) -> str:
     serve_reloads = [r for r in records
                      if r.get("event") == "serve_reload"]
     circuits = [r for r in records if r.get("event") == "circuit"]
+    drift_windows = [r for r in records if r.get("event") == "drift"]
+    drift_alarms = [r for r in records
+                    if r.get("event") == "drift_alarm"]
 
     fleet_starts = [r for r in records if r.get("event") == "fleet_start"]
     tenant_dones = [r for r in records if r.get("event") == "tenant_done"]
@@ -227,7 +230,8 @@ def render_report(records: List[dict], max_trajectory_rows: int = 400) -> str:
         out.append("")
 
     if (serve_reqs or serve_batches or serve_summaries or serve_sheds
-            or serve_deadlines or serve_reloads or circuits):
+            or serve_deadlines or serve_reloads or circuits
+            or drift_windows):
         out.append("Serving (rev v1.6; docs/SERVING.md):")
         if serve_reqs:
             by_model: Dict[str, List[dict]] = {}
@@ -282,6 +286,29 @@ def render_report(records: List[dict], max_trajectory_rows: int = 400) -> str:
                         f"backoff {r.get('backoff_s')}s)")
             out.append(f"  circuit {r.get('model')}{ver}: "
                        f"{r.get('state')}{tail}")
+        if drift_windows:
+            # Drift plane (rev v2.4): latest window per (model, version);
+            # alarm count from the dedicated drift_alarm records so a
+            # superseded window's alarm still shows.
+            latest_w: Dict[str, dict] = {}
+            for r in drift_windows:
+                ver = r.get("version")
+                key = (f"{r.get('model')}@{ver}" if ver is not None
+                       else str(r.get("model")))
+                latest_w[key] = r
+            for key, r in sorted(latest_w.items()):
+                flag = " ALARM" if r.get("alarm") else ""
+                out.append(
+                    f"  drift {key}: psi {float(r.get('psi', 0)):.4f} "
+                    f"ks {float(r.get('ks', 0)):.4f} "
+                    f"occ_l1 {float(r.get('occupancy_l1', 0)):.4f} "
+                    f"over {int(r.get('window_rows', 0))} rows "
+                    f"({len(drift_windows)} window(s)){flag}")
+            if drift_alarms:
+                out.append(
+                    f"  {len(drift_alarms)} drift alarm(s) "
+                    f"(psi threshold "
+                    f"{drift_alarms[-1].get('threshold')})")
         for s in serve_summaries:
             lat = s.get("latency_ms") or {}
             out.append(
@@ -755,6 +782,25 @@ def render_follow(records: List[dict]) -> str:
             extras.append(f"{opens} breaker trip(s)")
         if extras:
             line += "  [" + ", ".join(extras) + "]"
+        out.append(line)
+
+    drifts = by.get("drift", [])
+    if drifts:
+        # Drift rollup (rev v2.4): latest window per model; alarms from
+        # the dedicated drift_alarm records so a scrolled-off window
+        # still counts.
+        latest: Dict[str, dict] = {}
+        for r in drifts:
+            latest[str(r.get("model"))] = r
+        worst = max(latest.values(),
+                    key=lambda r: float(r.get("psi", 0.0)))
+        alarms = len(by.get("drift_alarm", []))
+        line = (f"drift: {len(drifts)} window(s), "
+                f"worst psi {float(worst.get('psi', 0.0)):.4f} "
+                f"ks {float(worst.get('ks', 0.0)):.4f} "
+                f"({worst.get('model')})")
+        if alarms:
+            line += f"  [{alarms} ALARM(s)]"
         out.append(line)
 
     healths = by.get("health", [])

@@ -256,8 +256,38 @@ EVENT_FIELDS: Dict[str, Tuple[Tuple[str, ...], Tuple[str, ...]]] = {
     "serve_summary": (
         ("requests", "batches", "rows", "wall_s", "qps", "latency_ms",
          "metrics"),
+        # ``drift`` (optional, rev v2.4): the drift-plane rollup --
+        # {windows, alarms, last {model-> last stats}}; present only
+        # when --drift-interval-s was set, so drift-off streams stay
+        # byte-identical.
         ("models", "executor", "errors", "shed", "deadline_expired",
-         "reloads", "breaker", "stacked_batches", "profile"),
+         "reloads", "breaker", "stacked_batches", "profile", "drift"),
+    ),
+    # One per elapsed drift window per served (model, version) route
+    # (stream rev v2.4; serving/server.py --drift-interval-s,
+    # docs/OBSERVABILITY.md "Drift detection"): the window's request-
+    # score sketch and assignment occupancy compared against the
+    # model's TRAINING envelope (registry envelope.json). ``psi`` /
+    # ``ks`` are over the shared score-bucket ladder, ``occupancy_l1``
+    # over normalized per-cluster assignment mass, ``window_rows`` the
+    # rows observed in the window. ``score_sketch`` / ``occupancy``
+    # (optional) carry the window's raw mergeable summary so ``gmm
+    # drift`` can re-aggregate a recorded stream offline at any window
+    # granularity. ``alarm`` marks windows whose PSI crossed
+    # --drift-psi-threshold (the paired drift_alarm record follows).
+    "drift": (
+        ("model", "psi", "ks", "occupancy_l1", "window_rows"),
+        ("version", "alarm", "threshold", "score_sketch", "occupancy",
+         "mean_score", "train_rows"),
+    ),
+    # The drift alarm (rev v2.4): PSI crossed the configured threshold
+    # for a route's window. Rides the health-event conventions (named
+    # flags, counted in the metrics registry, rendered as instants by
+    # ``gmm timeline``) but is OBSERVATIONAL ONLY -- it is not a
+    # health.py fault lane and never trips the serving circuit breaker.
+    "drift_alarm": (
+        ("model", "psi", "threshold"),
+        ("version", "ks", "occupancy_l1", "window_rows", "flag_names"),
     ),
     # Fleet fits (stream rev v1.8; tenancy/, docs/TENANCY.md): one per
     # `fit_fleet` invocation -- the fleet's identity card: tenant count,
@@ -337,11 +367,17 @@ EVENT_FIELDS: Dict[str, Tuple[Tuple[str, ...], Tuple[str, ...]]] = {
     # hbm_peak_bytes}; present only when profiling was active
     # (telemetry/profiling.py), so pre-v2.2 readers and byte-identity
     # fixtures are untouched.
+    # ``envelope`` (optional, rev v2.4): the training drift envelope --
+    # the fit data's per-event score sketch + responsibility occupancy
+    # (telemetry/sketch.py make_envelope), the reference distribution
+    # serve-time drift is measured against; absent when envelope
+    # computation is disabled (config.envelope=False) or the data
+    # source was lazy/pipelined.
     "run_summary": (
         ("ideal_k", "score", "criterion", "final_loglik", "total_iters",
          "wall_s", "phase_profile", "compile", "metrics"),
         ("per_process", "memory_stats", "buckets", "health", "em_backend",
-         "elastic", "profile"),
+         "elastic", "profile", "envelope"),
     ),
 }
 

@@ -19,9 +19,10 @@ Event mapping (the full table lives in docs/OBSERVABILITY.md):
   rows, flops), each ending at its record's emission time;
 * sampler ``heartbeat`` resource stamps and stream-derived rates ->
   ``C`` counter tracks (host RSS, device bytes, EM iters/s, queued
-  rows);
-* ``health`` / ``preempt`` / ``elastic_shrink`` / ``circuit`` / ... ->
-  instant events;
+  rows), and rev v2.4 ``drift`` windows -> per-model PSI/KS counter
+  tracks;
+* ``health`` / ``preempt`` / ``elastic_shrink`` / ``circuit`` /
+  ``drift_alarm`` / ... -> instant events;
 * serve ``trace_id`` s -> flow arrows (``s``/``f``) joining a client's
   request slice to the server-side ``serve_route`` span that answered
   it.
@@ -80,6 +81,7 @@ _THREAD_INSTANTS = frozenset((
     "health", "recovery", "io_retry", "preempt", "shutdown", "peer_lost",
     "elastic_shrink", "elastic_resume", "circuit", "serve_shed",
     "serve_deadline", "serve_reload", "merge", "rebucket",
+    "drift_alarm",
 ))
 _PROCESS_INSTANTS = frozenset((
     "run_start", "run_summary", "serve_summary", "fleet_start",
@@ -421,6 +423,18 @@ def build_timeline(targets: List[str]) -> dict:
                     events.append({"ph": "C", "name": "device bytes",
                                    "pid": s.pid, "ts": ts,
                                    "args": {"bytes_in_use": dev}})
+                continue
+            if kind == "drift":
+                # Drift windows (rev v2.4) -> per-model PSI/KS counter
+                # tracks: distribution shift against time, next to the
+                # serve slices that produced it.
+                model = rec.get("model", "?")
+                for field in ("psi", "ks"):
+                    v = _num(rec.get(field))
+                    if v is not None:
+                        events.append({
+                            "ph": "C", "name": f"drift {field} ({model})",
+                            "pid": s.pid, "ts": ts, "args": {field: v}})
                 continue
             if kind == "serve_shed":
                 queued = _num(rec.get("queued_rows"))

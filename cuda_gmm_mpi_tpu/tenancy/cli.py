@@ -248,6 +248,15 @@ def fleet_main(argv=None) -> int:
                                             f"{tr.name}.summary")
                 write_summary(summary_path, r, enable_output=True)
                 row["summary"] = os.path.abspath(summary_path)
+                if getattr(r, "envelope", None) is not None:
+                    # Per-tenant training drift envelope (rev v2.4):
+                    # `gmm export --fleet` republishes it next to the
+                    # tenant's registry version (envelope.json).
+                    env_path = os.path.join(
+                        args.out_dir, f"{tr.name}.envelope.json")
+                    with open(env_path, "w", encoding="utf-8") as f:
+                        json.dump(r.envelope, f, sort_keys=True)
+                    row["envelope"] = os.path.abspath(env_path)
         rows.append(row)
 
     exported = 0

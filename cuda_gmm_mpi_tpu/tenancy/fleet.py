@@ -349,7 +349,7 @@ def _run_group(model, config, packed, ckpt, rec, log, verbose, mode,
     """
     from ..models.order_search import (
         _COV_CODE, _CRITERION_CODE, _resume_mismatch, _shutdown_and_raise,
-        GMMResult,
+        GMMResult, compute_envelope,
     )
 
     sup = supervisor.current()
@@ -597,6 +597,14 @@ def _run_group(model, config, packed, ckpt, rec, log, verbose, mode,
         lane = jax.tree_util.tree_map(
             lambda a, _t=t: jnp.asarray(np.asarray(a)[_t]), host_best)
         compact_state, n_active = compact(lane)
+        # Per-tenant training drift envelope (rev v2.4): the tenant's
+        # own packed rows through its winning parameters; rides the
+        # tenant's GMMResult into summaries and registry exports.
+        envelope = None
+        if config.envelope:
+            envelope = compute_envelope(
+                model, compact_state, packed.chunks[t],
+                int(packed.n_events[t]), int(n_active))
         results.append(TenantResult(
             name=packed.names[t], index=packed.group.indices[t],
             group=group_index,
@@ -616,6 +624,7 @@ def _run_group(model, config, packed, ckpt, rec, log, verbose, mode,
                     health_lane[t],
                     io_retries=(ckpt.io_retries if ckpt is not None
                                 else 0)),
+                envelope=envelope,
                 model=model,
             )))
     return results

@@ -283,6 +283,42 @@ def test_serve_mode_contract():
     assert abs(j["vs_baseline"] - ratio) <= 0.01 * ratio + 0.01
 
 
+def test_drift_mode_contract():
+    """--drift (GMM_BENCH_DRIFT=1) emits ONE JSON record proving the rev
+    v2.4 drift plane end to end: the envelope landed in the registry,
+    in-distribution traffic sits under the PSI threshold without an
+    alarm, deliberately shifted traffic sits over it AND raised the
+    drift_alarm, sketching performed zero new compiles on the warmed
+    path, and value/vs_baseline is the drift-on/off wall ratio."""
+    r = _run({
+        "GMM_BENCH_CPU": "1",
+        "GMM_BENCH_DRIFT": "1",
+        "GMM_BENCH_DRIFT_N": "2000",
+        "GMM_BENCH_DRIFT_D": "3",
+        "GMM_BENCH_DRIFT_K": "4",
+        "GMM_BENCH_DRIFT_REQUESTS": "40",
+    }, timeout=600)
+    assert r.returncode == 0, r.stderr
+    j = _json_line(r.stdout)
+    assert j["unit"] == "x" and j["value"] > 0
+    assert j["accelerator_unavailable"] is False
+    d = j["drift"]
+    assert d["envelope_in_registry"] is True
+    # the detection contract, both directions, in the SAME record
+    assert d["psi_in"] < d["threshold"] < d["psi_shifted"]
+    assert d["alarm_in"] is False and d["alarm_fired"] is True
+    assert d["detected"] is True
+    # sketching rides the answered host block: no new executor work
+    assert d["new_compiles"] == 0 and d["zero_recompile"] is True
+    assert d["wall_on_s"] > 0 and d["wall_off_s"] > 0
+    assert j["vs_baseline"] == d["overhead"]
+    stats = d["drift_stats"]
+    # three flushed windows (discarded warm-up + in-dist + shifted),
+    # exactly one alarm -- from the shifted phase
+    assert stats["windows"] == 3 and stats["alarms"] == 1
+    assert stats["last"]["bench@1"]["alarm"] is True
+
+
 def test_probe_budget_fails_over_after_one_hang():
     """Default probe budget: ONE attempt -- a hung probe fails over to
     CPU immediately instead of burning the old 5 x 90s retry ladder

@@ -1,0 +1,606 @@
+"""Drift observability (stream rev v2.4; docs/OBSERVABILITY.md
+"Drift detection"): training-score envelopes, streaming serve-time
+sketches, and the `gmm drift` analytics CLI.
+
+Contracts:
+- StreamSketch MERGES exactly: any split of a stream, merged in any
+  order, reproduces the one-shot sketch (buckets/count/min/max bit
+  for bit, moments to float rounding) -- the property that lets
+  per-rank/per-window/per-tenant sketches compose;
+- PSI / KS / occupancy_l1 match hand-computed pinned fixtures,
+  including the PSI_EPS clamp on empty buckets;
+- a fit records the training envelope into run_summary, the registry
+  sidecar (envelope.json) and the manifest stanza; envelope=False
+  removes all three;
+- the serve drift plane emits schema-valid `drift` windows vs the
+  envelope (in-distribution traffic stays quiet; shifted traffic
+  raises `drift_alarm`) and feeds the /metrics drift gauges;
+- `gmm drift` honours the 0/1/2 exit contract for dataset AND stream
+  targets, names the tripped metric, and --rebuild-envelope backfills
+  envelope.json while leaving model.npz/manifest.json bit-identical;
+- `gmm top` renders the drift rollup line; `gmm timeline` renders
+  per-model PSI/KS counter tracks and drift_alarm instants;
+- export_fleet republishes per-tenant envelopes next to the exported
+  versions.
+"""
+
+import hashlib
+import json
+import math
+import os
+
+import numpy as np
+import pytest
+
+from cuda_gmm_mpi_tpu import GMMConfig, GaussianMixture, telemetry
+from cuda_gmm_mpi_tpu.serving import GMMServer, ModelRegistry
+from cuda_gmm_mpi_tpu.telemetry import sketch as tl_sketch
+from cuda_gmm_mpi_tpu.telemetry.schema import (EVENT_FIELDS,
+                                               validate_stream)
+from cuda_gmm_mpi_tpu.telemetry.sketch import (SCORE_BOUNDS, StreamSketch,
+                                               compare_to_envelope,
+                                               envelope_stanza, ks,
+                                               make_envelope,
+                                               merge_envelopes,
+                                               occupancy_l1, psi)
+
+from .conftest import make_blobs
+
+
+def fitted(rng, *, k=3, d=4, n=600, envelope=True, dtype="float32"):
+    data, _ = make_blobs(rng, n=n, d=d, k=k, dtype=np.float64)
+    gm = GaussianMixture(
+        k, target_components=k,
+        config=GMMConfig(min_iters=4, max_iters=4, chunk_size=256,
+                         dtype=dtype, envelope=envelope))
+    gm.fit(data.astype(np.dtype(dtype)))
+    return gm, data.astype(np.dtype(dtype))
+
+
+def write_bin(path, arr):
+    """The fit CLI's BIN input format: int32 [n, d] header + f32 rows."""
+    arr = np.asarray(arr, np.float32)
+    with open(str(path), "wb") as f:
+        np.asarray(arr.shape, np.int32).tofile(f)
+        arr.tofile(f)
+    return str(path)
+
+
+class _StreamSink:
+    def __init__(self, records):
+        self._records = records
+
+    def write(self, line):
+        self._records.append(json.loads(line))
+
+    def flush(self):
+        pass
+
+
+# ------------------------------------------------------------- sketches
+
+
+def test_sketch_merge_matches_oneshot_for_any_split(rng):
+    """The mergeability property: random splits, merged in a shuffled
+    order, reproduce the one-shot sketch -- counts exactly, moments to
+    float rounding. This is what makes per-rank envelopes and windowed
+    serve sketches re-aggregable."""
+    values = np.concatenate([
+        rng.normal(-40.0, 30.0, size=500),
+        rng.exponential(200.0, size=300),
+        [0.0, -1e5, 1e5, np.nan, np.inf, -np.inf],  # non-finite dropped
+    ])
+    one = StreamSketch().update(values)
+    assert one.count == 500 + 300 + 3  # finite rows only
+
+    for trial in range(5):
+        cuts = np.sort(rng.integers(0, len(values), size=7))
+        parts = np.split(values, cuts)
+        rng.shuffle(parts)
+        sketches = [StreamSketch().update(p) for p in parts]
+        merged = sketches[0]
+        for sk in sketches[1:]:
+            merged.merge(sk)
+        assert merged.buckets == one.buckets, trial
+        assert merged.count == one.count
+        assert merged.vmin == one.vmin and merged.vmax == one.vmax
+        # Chan's formulas are associative only up to float rounding;
+        # the error scale is the value spread, not the mean.
+        spread = one.vmax - one.vmin
+        assert merged.mean == pytest.approx(one.mean, abs=1e-9 * spread)
+        assert merged.m2 == pytest.approx(one.m2, rel=1e-9)
+        assert merged.variance == pytest.approx(one.variance, rel=1e-9)
+
+
+def test_sketch_roundtrip_and_ladder_guards(rng):
+    """to_dict/from_dict round-trips every field; merging mismatched
+    ladders and deserializing a wrong-width histogram both fail loudly
+    (a silent ladder mismatch would corrupt every PSI downstream)."""
+    sk = StreamSketch().update(rng.normal(size=64))
+    back = StreamSketch.from_dict(json.loads(json.dumps(sk.to_dict())))
+    assert back.buckets == sk.buckets and back.count == sk.count
+    assert back.mean == sk.mean and back.m2 == sk.m2
+    assert back.vmin == sk.vmin and back.vmax == sk.vmax
+    assert back.bounds == sk.bounds
+
+    empty = StreamSketch().to_dict()
+    assert empty["min"] is None and empty["max"] is None
+    restored = StreamSketch.from_dict(empty)
+    assert restored.count == 0 and restored.vmin == math.inf
+    # merging an empty sketch is the identity
+    before = sk.to_dict()
+    assert sk.merge(restored).to_dict() == before
+
+    with pytest.raises(ValueError, match="different bucket ladders"):
+        sk.merge(StreamSketch(bounds=(0.0, 1.0)))
+    bad = sk.to_dict()
+    bad["buckets"] = bad["buckets"][:-1]
+    with pytest.raises(ValueError, match="buckets"):
+        StreamSketch.from_dict(bad)
+
+
+def test_psi_ks_occupancy_pinned_fixtures():
+    """Hand-computed drift statistics: the numbers `gmm drift` gates on
+    are pinned here, including the PSI_EPS clamp behaviour."""
+    # identical distributions: exactly zero
+    assert psi([50, 50], [50, 50]) == 0.0
+    assert ks([50, 50], [50, 50]) == 0.0
+    # [.5,.5] -> [.9,.1]: psi = .4*ln(1.8) + (-.4)*ln(.2)
+    expect = 0.4 * math.log(1.8) - 0.4 * math.log(0.2)
+    assert psi([50, 50], [90, 10]) == pytest.approx(expect, rel=1e-12)
+    assert ks([50, 50], [90, 10]) == pytest.approx(0.4, rel=1e-12)
+    # disjoint mass: both sides clamp to PSI_EPS -> ~2*ln(1/eps)
+    expect = 2 * (1 - tl_sketch.PSI_EPS) * math.log(1 / tl_sketch.PSI_EPS)
+    assert psi([100, 0], [0, 100]) == pytest.approx(expect, rel=1e-9)
+    assert ks([100, 0], [0, 100]) == 1.0
+    # scale invariance: proportions, not counts
+    assert psi([5, 5], [9, 1]) == pytest.approx(
+        psi([500, 500], [900, 100]), rel=1e-12)
+    with pytest.raises(ValueError, match="bucket count mismatch"):
+        psi([1, 2], [1, 2, 3])
+    with pytest.raises(ValueError, match="bucket count mismatch"):
+        ks([1, 2], [1, 2, 3])
+
+    assert occupancy_l1([1, 1], [3, 1]) == pytest.approx(0.5)
+    assert occupancy_l1([4], [2, 2]) == pytest.approx(1.0)  # zero-pads
+    assert occupancy_l1([7, 3], [70, 30]) == 0.0
+
+
+def test_envelope_make_merge_stanza_compare(rng):
+    """make_envelope/merge_envelopes/envelope_stanza/compare_to_envelope
+    compose: per-shard envelopes merge into the whole-data envelope, and
+    a window drawn from the training data itself scores ~0 drift."""
+    scores = rng.normal(-12.0, 4.0, size=900)
+    occ = [300, 450, 150]
+    whole = make_envelope(StreamSketch().update(scores), occ,
+                          k=3, num_events=900)
+    parts = [make_envelope(StreamSketch().update(chunk),
+                           [c // 3 for c in occ], k=3, num_events=300)
+             for chunk in np.split(scores, 3)]
+    merged = merge_envelopes(parts)
+    assert merged["score"]["buckets"] == whole["score"]["buckets"]
+    assert merged["score"]["count"] == 900 and merged["num_events"] == 900
+    assert merged["occupancy"] == occ and merged["k"] == 3
+    assert merge_envelopes([]) is None
+    assert merge_envelopes([None, {}]) is None
+
+    stanza = envelope_stanza(whole)
+    assert stanza["rows"] == 900 and stanza["k"] == 3
+    assert stanza["buckets"] == len(SCORE_BOUNDS) + 1
+    assert stanza["version"] == tl_sketch.ENVELOPE_VERSION
+    assert stanza["mean_score"] == pytest.approx(scores.mean(), rel=1e-9)
+
+    stats = compare_to_envelope(
+        whole, StreamSketch().update(scores), occ)
+    assert stats == {"psi": 0.0, "ks": 0.0, "occupancy_l1": 0.0,
+                     "window_rows": 900}
+    with pytest.raises(ValueError, match="ladder"):
+        compare_to_envelope(
+            whole, StreamSketch(bounds=(0.0, 1.0)).update([0.5]), occ)
+
+
+# ----------------------------------------------- training-time envelope
+
+
+def test_fit_builds_envelope_into_summary_and_registry(rng, tmp_path):
+    """The training half of the loop: a fit sketches its own scores and
+    responsibilities into result.envelope, run_summary.envelope, the
+    registry envelope.json sidecar AND the manifest stanza; envelope=False
+    removes all of them (the pre-v2.4 stream shape)."""
+    n = 600
+    stream = []
+    rec = telemetry.RunRecorder(stream=_StreamSink(stream))
+    with telemetry.use(rec), rec:
+        gm, data = fitted(rng, n=n)
+    env = gm.result_.envelope
+    assert env is not None and env["score"]["count"] == n
+    assert sum(env["occupancy"]) == n
+    assert env["k"] == gm.n_components_
+    assert validate_stream(stream) == []
+    summary = [r for r in stream if r["event"] == "run_summary"][-1]
+    assert summary["envelope"]["score"]["buckets"] == \
+        env["score"]["buckets"]
+
+    reg = ModelRegistry(str(tmp_path))
+    v = gm.to_registry(reg, "m")
+    assert os.path.exists(str(tmp_path / "m" / str(v) / "envelope.json"))
+    served = reg.load("m")
+    assert served.envelope["score"] == env["score"]
+    assert served.manifest["envelope"]["rows"] == n
+    assert reg.load_envelope("m") == served.envelope
+
+    # envelope off: no sidecar, no stanza, no run_summary field
+    stream2 = []
+    rec2 = telemetry.RunRecorder(stream=_StreamSink(stream2))
+    with telemetry.use(rec2), rec2:
+        gm_off, _ = fitted(rng, envelope=False)
+    assert gm_off.result_.envelope is None
+    summary2 = [r for r in stream2 if r["event"] == "run_summary"][-1]
+    assert "envelope" not in summary2
+    gm_off.to_registry(reg, "off")
+    assert not os.path.exists(str(tmp_path / "off" / "1" /
+                                  "envelope.json"))
+    off = reg.load("off")
+    assert off.envelope is None and "envelope" not in off.manifest
+
+
+# ------------------------------------------------- serve-time drift plane
+
+
+def serve_traffic(server, data, shift=0.0, requests=12, rows=40):
+    for i in range(requests):
+        lo = (i * 17) % (len(data) - rows)
+        x = (data[lo:lo + rows] + np.float32(shift)).tolist()
+        resp = server.handle_requests(
+            [{"id": i, "model": "m", "op": "score_samples", "x": x}])[0]
+        assert resp["ok"], resp
+
+
+def test_serve_drift_windows_and_alarm_end_to_end(rng, tmp_path):
+    """The acceptance path: in-distribution traffic produces a quiet
+    `drift` window (PSI under threshold, no alarm); mean-shifted traffic
+    trips `drift_alarm`; both validate against rev v2.4 and feed the
+    drift gauges and the serve rollup."""
+    gm, data = fitted(rng)
+    gm.to_registry(str(tmp_path), "m")
+    server = GMMServer(ModelRegistry(str(tmp_path)),
+                       drift_interval_s=3600.0, drift_psi_threshold=0.2)
+    stream = []
+    rec = telemetry.RunRecorder(stream=_StreamSink(stream))
+    with telemetry.use(rec):
+        serve_traffic(server, data, shift=0.0)
+        quiet = server.flush_drift()
+        serve_traffic(server, data, shift=8.0)
+        loud = server.flush_drift()
+
+    assert len(quiet) == 1 and len(loud) == 1
+    assert quiet[0]["psi"] < 0.2 and not quiet[0]["alarm"]
+    assert loud[0]["psi"] > 0.2 and loud[0]["alarm"]
+    assert loud[0]["ks"] > quiet[0]["ks"]
+    assert quiet[0]["window_rows"] == loud[0]["window_rows"] == 480
+
+    assert validate_stream(stream) == []
+    drifts = [r for r in stream if r["event"] == "drift"]
+    alarms = [r for r in stream if r["event"] == "drift_alarm"]
+    assert len(drifts) == 2 and len(alarms) == 1
+    # windows carry their mergeable raw summary for offline re-analysis
+    for r in drifts:
+        sk = StreamSketch.from_dict(r["score_sketch"])
+        assert sk.count == r["window_rows"]
+        assert sum(r["occupancy"]) == r["window_rows"]
+        assert r["train_rows"] == 600
+    assert alarms[0]["model"] == "m" and alarms[0]["threshold"] == 0.2
+    assert alarms[0]["psi"] == loud[0]["psi"]
+    assert alarms[0]["flag_names"] == ["drift_psi"]
+
+    stats = server.drift_stats()
+    assert stats["windows"] == 2 and stats["alarms"] == 1
+    assert stats["threshold"] == 0.2
+    assert stats["last"]["m@1"]["alarm"] is True
+    gauges = server.live_gauges()
+    assert gauges["gmm_drift_psi"] == loud[0]["psi"]
+    assert gauges["gmm_drift_events_total"] == 2.0
+    assert gauges["gmm_drift_alarms_total"] == 1.0
+
+
+def test_drift_event_schema_pinned_both_directions():
+    """Schema drift guard for the new rev v2.4 events, both ways: the
+    field tables are exactly what the emit sites send (a field added to
+    the emitter without a declaration fails the global emit-site scan;
+    a field dropped from the emitter fails HERE), and drift-off servers
+    expose no drift gauges."""
+    req, opt = EVENT_FIELDS["drift"]
+    assert set(req) == {"model", "psi", "ks", "occupancy_l1",
+                        "window_rows"}
+    for f in ("version", "alarm", "threshold", "score_sketch",
+              "occupancy", "mean_score", "train_rows"):
+        assert f in opt, f
+    req_a, opt_a = EVENT_FIELDS["drift_alarm"]
+    assert set(req_a) == {"model", "psi", "threshold"}
+    for f in ("version", "ks", "occupancy_l1", "window_rows",
+              "flag_names"):
+        assert f in opt_a, f
+    # the serve_summary rollup and run_summary envelope are DECLARED
+    # optionals (drift-off streams stay byte-identical without them)
+    assert "drift" in EVENT_FIELDS["serve_summary"][1]
+    assert "envelope" in EVENT_FIELDS["run_summary"][1]
+    # both events really have emit sites in the serve drift plane
+    import inspect
+
+    from cuda_gmm_mpi_tpu.serving import server as server_mod
+    src = inspect.getsource(server_mod)
+    assert '"drift"' in src and '"drift_alarm"' in src
+
+
+# --------------------------------------------------------- gmm drift CLI
+
+
+@pytest.fixture()
+def drift_world(rng, tmp_path):
+    """A registry with an enveloped model + in-distribution and shifted
+    BIN datasets -- the shared stage for the CLI exit-code matrix."""
+    gm, data = fitted(rng)
+    reg_dir = str(tmp_path / "reg")
+    gm.to_registry(reg_dir, "m")
+    in_dist = write_bin(tmp_path / "in.bin", data)
+    shifted = write_bin(tmp_path / "shift.bin", data + np.float32(8.0))
+    return {"reg": reg_dir, "in": in_dist, "shifted": shifted,
+            "gm": gm, "data": data, "tmp": tmp_path}
+
+
+def test_gmm_drift_exit_code_matrix(drift_world, capsys):
+    """The 0/1/2 contract, dataset mode: clean gate -> 0 naming no
+    failures; tripped gate -> 1 naming the metric; usage errors (bad
+    spec, relative spec, missing --model, unknown model, stream-only
+    flag on a dataset) -> 2."""
+    from cuda_gmm_mpi_tpu.cli import main as cli_main
+
+    w = drift_world
+    # in-distribution data scores PSI == 0 against its own envelope
+    assert cli_main(["drift", w["in"], "--registry", w["reg"],
+                     "--model", "m", "--fail-on", "psi>0.2"]) == 0
+    out = capsys.readouterr().out
+    assert "clean" in out and "psi" in out
+
+    # shifted data trips the gate and NAMES the metric
+    assert cli_main(["drift", w["shifted"], "--registry", w["reg"],
+                     "--model", "m", "--fail-on", "psi>0.2",
+                     "--fail-on", "ks>0.5"]) == 1
+    out = capsys.readouterr().out
+    assert "DRIFT psi:" in out and "DRIFT ks:" in out
+    assert "2 gate(s) tripped" in out
+
+    # --json carries the whole verdict machine-readably
+    assert cli_main(["drift", w["shifted"], "--registry", w["reg"],
+                     "--model", "m", "--fail-on", "psi>0.2",
+                     "--json"]) == 1
+    verdict = json.loads(capsys.readouterr().out)
+    assert verdict["model"] == "m" and verdict["version"] == 1
+    assert verdict["source"] == "dataset" and not verdict["clean"]
+    assert verdict["psi"] > 0.2 and verdict["train_rows"] == 600
+    assert verdict["failures"] and "psi" in verdict["failures"][0]
+
+    # report-only (no gates) is exit 0 even on shifted data
+    assert cli_main(["drift", w["shifted"], "--registry", w["reg"],
+                     "--model", "m"]) == 0
+    capsys.readouterr()
+
+    # usage errors: all exit 2 with a reason on stdout
+    cases = [
+        (["drift", w["in"], "--registry", w["reg"], "--model", "m",
+          "--fail-on", "totally_bogus>1"], "unknown drift metric"),
+        (["drift", w["in"], "--registry", w["reg"], "--model", "m",
+          "--fail-on", "psi>10%"], "absolute"),
+        (["drift", w["in"], "--registry", w["reg"]], "need --model"),
+        (["drift", w["in"], "--registry", w["reg"],
+          "--model", "ghost"], "unknown model"),
+        (["drift", str(w["tmp"] / "missing.bin"), "--registry",
+          w["reg"], "--model", "m"], "gmm drift:"),
+    ]
+    for argv, needle in cases:
+        assert cli_main(argv) == 2, argv
+        assert needle in capsys.readouterr().out, argv
+
+
+def test_gmm_drift_no_envelope_is_exit_2_with_backfill_hint(
+        rng, drift_world, capsys):
+    """A version without an envelope cannot be judged: exit 2 pointing
+    at the --rebuild-envelope backfill, not a crash or a fake 0."""
+    from cuda_gmm_mpi_tpu.cli import main as cli_main
+
+    w = drift_world
+    gm_off, _ = fitted(rng, envelope=False)
+    gm_off.to_registry(w["reg"], "bare")
+    assert cli_main(["drift", w["in"], "--registry", w["reg"],
+                     "--model", "bare", "--fail-on", "psi>0.2"]) == 2
+    out = capsys.readouterr().out
+    assert "no training envelope" in out
+    assert "--rebuild-envelope" in out
+
+
+def test_gmm_drift_rebuild_envelope_is_bit_identical(rng, drift_world,
+                                                     capsys):
+    """--rebuild-envelope backfills envelope.json for an envelope-less
+    version WITHOUT touching model.npz or manifest.json (byte-hashed),
+    after which the same data judges clean with psi == 0."""
+    from cuda_gmm_mpi_tpu.cli import main as cli_main
+
+    w = drift_world
+    gm_off, data = fitted(rng, envelope=False)
+    gm_off.to_registry(w["reg"], "bare")
+    vdir = w["tmp"] / "reg" / "bare" / "1"
+    assert not (vdir / "envelope.json").exists()
+    before = {f: hashlib.sha256((vdir / f).read_bytes()).hexdigest()
+              for f in ("model.npz", "manifest.json")}
+    dataset = write_bin(w["tmp"] / "bare.bin", data)
+
+    # a stream target cannot rebuild (it only holds windowed sketches)
+    assert cli_main(["drift", str(w["tmp"] / "s.jsonl"), "--registry",
+                     w["reg"], "--model", "bare",
+                     "--rebuild-envelope"]) == 2
+    capsys.readouterr()
+
+    assert cli_main(["drift", dataset, "--registry", w["reg"],
+                     "--model", "bare", "--rebuild-envelope",
+                     "--json"]) == 0
+    rebuilt = json.loads(capsys.readouterr().out)
+    assert rebuilt["rebuilt"] is True
+    assert rebuilt["envelope"]["rows"] == len(data)
+    assert (vdir / "envelope.json").exists()
+    after = {f: hashlib.sha256((vdir / f).read_bytes()).hexdigest()
+             for f in ("model.npz", "manifest.json")}
+    assert after == before, "rebuild touched the immutable artifacts"
+
+    assert cli_main(["drift", dataset, "--registry", w["reg"],
+                     "--model", "bare", "--fail-on", "psi>0.2",
+                     "--json"]) == 0
+    verdict = json.loads(capsys.readouterr().out)
+    assert verdict["clean"] and verdict["psi"] == 0.0
+
+
+def test_gmm_drift_stream_mode_reaggregates_windows(rng, tmp_path,
+                                                    capsys):
+    """Stream mode: `gmm drift` merges a recorded stream's windowed
+    sketches (exact merge) back into one window, infers the model from
+    a single-model stream, and gates it -- shifted serve traffic exits
+    1 naming psi; the same stream re-judged per --model also works."""
+    from cuda_gmm_mpi_tpu.cli import main as cli_main
+
+    gm, data = fitted(rng)
+    reg_dir = str(tmp_path / "reg")
+    gm.to_registry(reg_dir, "m")
+    server = GMMServer(ModelRegistry(reg_dir),
+                       drift_interval_s=3600.0, drift_psi_threshold=0.2)
+    stream = str(tmp_path / "serve.jsonl")
+    rec = telemetry.RunRecorder(path=stream, run_id="drift-e2e")
+    with telemetry.use(rec), rec:
+        serve_traffic(server, data, shift=8.0, requests=6)
+        server.flush_drift()
+        serve_traffic(server, data, shift=8.0, requests=6)
+        server.flush_drift()
+
+    assert cli_main(["drift", stream, "--registry", reg_dir,
+                     "--fail-on", "psi>0.2", "--json"]) == 1
+    verdict = json.loads(capsys.readouterr().out)
+    assert verdict["model"] == "m" and verdict["version"] == 1
+    assert verdict["source"] == "stream"
+    assert verdict["window_rows"] == 480  # both windows re-aggregated
+    assert verdict["psi"] > 0.2
+    assert "psi" in verdict["failures"][0]
+
+    # window_rows is a gateable metric (catch an empty serve session)
+    assert cli_main(["drift", stream, "--registry", reg_dir,
+                     "--fail-on", "window_rows<100000"]) == 1
+    capsys.readouterr()
+    # a stream with no drift events is a usage error, not a clean pass
+    empty = str(tmp_path / "empty.jsonl")
+    with open(empty, "w") as f:
+        f.write(json.dumps({"event": "run_start", "schema": 1,
+                            "ts": 0.0, "run_id": "x"}) + "\n")
+    assert cli_main(["drift", empty, "--registry", reg_dir]) == 2
+    assert "no drift events" in capsys.readouterr().out
+
+
+# -------------------------------------------------- top / timeline / fleet
+
+
+def test_report_follow_renders_drift_rollup(rng, tmp_path):
+    """`gmm top`'s renderer shows the drift rollup: window count, the
+    worst model's PSI/KS, and the alarm count when alarms fired."""
+    from cuda_gmm_mpi_tpu.telemetry.report import render_follow
+
+    gm, data = fitted(rng)
+    gm.to_registry(str(tmp_path), "m")
+    server = GMMServer(ModelRegistry(str(tmp_path)),
+                       drift_interval_s=3600.0, drift_psi_threshold=0.2)
+    stream = []
+    rec = telemetry.RunRecorder(stream=_StreamSink(stream))
+    with telemetry.use(rec):
+        serve_traffic(server, data, shift=8.0, requests=6)
+        server.flush_drift()
+    text = render_follow(stream)
+    assert "drift: 1 window(s)" in text
+    assert "worst psi" in text and "(m)" in text
+    assert "1 ALARM(s)" in text
+
+    # the static `gmm report` renders the same windows under Serving:
+    # latest window per model@version plus the alarm-count line
+    from cuda_gmm_mpi_tpu.telemetry.report import render_report
+    static = render_report(stream)
+    assert "drift m@1: psi " in static
+    assert "(1 window(s)) ALARM" in static
+    assert "1 drift alarm(s) (psi threshold 0.2)" in static
+
+    # quiet windows render without the alarm suffix
+    server2 = GMMServer(ModelRegistry(str(tmp_path)),
+                        drift_interval_s=3600.0, drift_psi_threshold=0.2)
+    quiet = []
+    rec2 = telemetry.RunRecorder(stream=_StreamSink(quiet))
+    with telemetry.use(rec2):
+        serve_traffic(server2, data, shift=0.0, requests=6)
+        server2.flush_drift()
+    text = render_follow(quiet)
+    assert "drift: 1 window(s)" in text and "ALARM" not in text
+
+
+def test_timeline_renders_drift_counters_and_alarm_instant(rng,
+                                                           tmp_path):
+    """`gmm timeline`: drift windows become per-model PSI/KS counter
+    tracks and drift_alarm becomes an instant, and the trace validates."""
+    from cuda_gmm_mpi_tpu.telemetry.timeline import (build_timeline,
+                                                     validate_trace)
+
+    gm, data = fitted(rng)
+    gm.to_registry(str(tmp_path / "reg"), "m")
+    server = GMMServer(ModelRegistry(str(tmp_path / "reg")),
+                       drift_interval_s=3600.0, drift_psi_threshold=0.2)
+    stream = str(tmp_path / "serve.jsonl")
+    rec = telemetry.RunRecorder(path=stream, run_id="drift-tl")
+    with telemetry.use(rec), rec:
+        serve_traffic(server, data, shift=8.0, requests=6)
+        server.flush_drift()
+    doc = build_timeline([stream])
+    assert validate_trace(doc) == []
+    events = doc["traceEvents"]
+    counters = {e["name"] for e in events if e["ph"] == "C"}
+    assert "drift psi (m)" in counters and "drift ks (m)" in counters
+    psi_track = [e for e in events
+                 if e["ph"] == "C" and e["name"] == "drift psi (m)"]
+    assert psi_track[0]["args"]["psi"] > 0.2
+    instants = [e for e in events if e["ph"] == "i"
+                and "drift_alarm" in e["name"]]
+    assert instants, "drift_alarm did not render as an instant"
+
+
+def test_export_fleet_republishes_tenant_envelopes(rng, tmp_path):
+    """S1: export_fleet carries per-tenant envelope.json sidecars from a
+    fleet out-dir into the registry versions it publishes."""
+    from cuda_gmm_mpi_tpu.io import write_summary
+    from cuda_gmm_mpi_tpu.tenancy import TenantSpec, fit_fleet
+
+    data, _ = make_blobs(rng, n=300, d=3, k=2, dtype=np.float64)
+    spec = TenantSpec("acme", data, 2)
+    fleet = fit_fleet([spec], GMMConfig(min_iters=2, max_iters=2,
+                                        chunk_size=256, dtype="float64"))
+    tr = fleet["acme"]
+    assert tr.result.envelope is not None  # fleet fits sketch too
+    assert tr.result.envelope["score"]["count"] == 300
+
+    out = tmp_path / "out"
+    out.mkdir()
+    write_summary(str(out / "acme.summary"), tr.result)
+    env_path = out / "acme.envelope.json"
+    env_path.write_text(json.dumps(tr.result.envelope, sort_keys=True))
+    (out / "fleet.json").write_text(json.dumps({
+        "schema": 1,
+        "tenants": [{"name": "acme", "dropped": False,
+                     "summary": str(out / "acme.summary"),
+                     "envelope": str(env_path),
+                     "covariance_type": "full", "dtype": "float64"}],
+    }))
+    reg = ModelRegistry(str(tmp_path / "reg"))
+    audit = reg.export_fleet(str(out))
+    row = {r["name"]: r for r in audit}["acme"]
+    assert row["version"] == 1 and row["envelope"] is True
+    republished = reg.load_envelope("acme")
+    assert republished == tr.result.envelope

@@ -1068,3 +1068,68 @@ def test_stacked_falls_back_per_model_when_family_is_single(rng,
         a = {k: v for k, v in a.items() if k != "latency_ms"}
         b = {k: v for k, v in b.items() if k != "latency_ms"}
         assert a == b
+
+
+# ------------------------------------------------- drift plane (rev v2.4)
+#
+# S3 contracts of the drift-observability PR (docs/OBSERVABILITY.md
+# "Drift detection"): the plane adds ZERO compiles on a warmed serve
+# path (it samples the already-answered host block), and a drift-off
+# server is byte-identical to pre-v2.4 behavior -- same responses, no
+# drift records in the stream, no drift gauges on /metrics.
+
+
+def test_drift_plane_warm_path_zero_recompile(rng, tmp_path):
+    """The PR-7 zero-recompile contract survives the drift plane: after
+    per-bucket warm-up, varying-N traffic (in-distribution AND shifted)
+    with drift enabled performs no new traces or compiles -- sketching
+    happens on the host block the answers are sliced from."""
+    gm, data = fitted(rng)
+    gm.to_registry(str(tmp_path), "m")
+    ex = ScoringExecutor(min_block=32, max_block=256)
+    server = GMMServer(ModelRegistry(str(tmp_path)), executor=ex,
+                       warm=False, drift_interval_s=3600.0,
+                       drift_psi_threshold=0.2)
+    for n in (32, 64, 128, 256):     # warm one request per bucket
+        server.handle_requests([{"id": 0, "model": "m", "op": "score",
+                                 "x": data[:n].tolist()}])
+    server.flush_drift()             # discard the warm-up window
+    c0 = ex.compile_count
+    for i, n in enumerate(rng.integers(1, 257, size=40)):
+        shift = 8.0 if i % 2 else 0.0
+        x = (data[:int(n)] + np.float32(shift)).tolist()
+        resp = server.handle_requests(
+            [{"id": i, "model": "m", "op": "score_samples", "x": x}])[0]
+        assert resp["ok"]
+    rows = server.flush_drift()
+    assert ex.compile_count == c0, "drift plane traced/compiled"
+    assert rows and rows[0]["window_rows"] > 0
+
+
+def test_drift_off_server_is_byte_identical(rng, tmp_path):
+    """Plane-off contract (the PR-13 shape): without --drift-interval-s
+    the responses equal a drift-on server's bit for bit, the telemetry
+    stream carries NO drift/drift_alarm records, and /metrics exposes
+    no drift gauges."""
+    gm, data = fitted(rng)
+    gm.to_registry(str(tmp_path), "m")
+    reg = ModelRegistry(str(tmp_path))
+    off = GMMServer(reg)
+    on = GMMServer(reg, drift_interval_s=3600.0)
+    reqs = serve_requests(data)
+    stream = []
+    rec = telemetry.RunRecorder(stream=_StreamSink(stream))
+    with telemetry.use(rec):
+        got_off = off.handle_requests(reqs)
+        off.flush_drift()
+    got_on = on.handle_requests(reqs)
+    on.flush_drift()
+    for a, b in zip(got_off, got_on):
+        a = {k: v for k, v in a.items() if k != "latency_ms"}
+        b = {k: v for k, v in b.items() if k != "latency_ms"}
+        assert a == b
+    kinds = {r["event"] for r in stream}
+    assert "drift" not in kinds and "drift_alarm" not in kinds
+    assert off.drift_stats()["windows"] == 0
+    assert not any(k.startswith("gmm_drift") for k in off.live_gauges())
+    assert any(k.startswith("gmm_drift") for k in on.live_gauges())
