@@ -1761,6 +1761,133 @@ CONFIGS = {
 }
 
 
+def run_tune_bench(platform: str, accel_unavailable: bool) -> dict:
+    """The --tune mode: autotuned-vs-hand-set-default A/B in ONE record.
+
+    Probes the full chunk-size ladder into a throwaway tuning DB
+    (``tuning.probe``, ``GMM_BENCH_TUNE_PROBE_ITERS`` EM iterations per
+    candidate), resolves a config through ``autotune='db'``, then fits
+    the same data at a fixed K twice -- once with the GMMConfig defaults
+    (``autotune='off'``, chunk 65536: the hand-set geometry this PR
+    replaces), once with the tuned knobs. Both sides warm their own
+    model first so compile stays out of the timed walls.
+
+    ``vs_baseline`` is the default/tuned wall ratio (>1 = the tuner
+    won). The record carries every resolved decision (knob, chosen,
+    source, candidate walls), the probe's own cost, and parity: knob
+    sets that come out identical guarantee bit-equal logliks; a
+    different chunk size is the documented reduction-order tolerance
+    class (float32 rel ~1e-6; see docs/PERF.md "Autotuning") and the
+    measured rel diff is recorded either way.
+
+    Size knobs: GMM_BENCH_TUNE_N (default 200k accel / 20k CPU),
+    GMM_BENCH_TUNE_D (16), GMM_BENCH_TUNE_K (8), GMM_BENCH_TUNE_ITERS
+    (timed EM iterations, 5), GMM_BENCH_TUNE_PROBE_ITERS (2).
+    """
+    on_accel = platform not in ("cpu",)
+    n = int(os.environ.get("GMM_BENCH_TUNE_N")
+            or (200_000 if on_accel else 20_000))
+    d = int(os.environ.get("GMM_BENCH_TUNE_D") or 16)
+    k = int(os.environ.get("GMM_BENCH_TUNE_K") or 8)
+    iters = int(os.environ.get("GMM_BENCH_TUNE_ITERS") or 5)
+    probe_iters = int(os.environ.get("GMM_BENCH_TUNE_PROBE_ITERS") or 2)
+
+    import dataclasses
+    import tempfile
+
+    from cuda_gmm_mpi_tpu.config import GMMConfig
+    from cuda_gmm_mpi_tpu.models.gmm import GMMModel
+    from cuda_gmm_mpi_tpu.models.order_search import fit_gmm
+    from cuda_gmm_mpi_tpu.tuning import (TuningDB, probe_knob,
+                                         resolve_fit_config_ex)
+    from cuda_gmm_mpi_tpu.tuning.autotune import _platform_key
+
+    rng = np.random.default_rng(7)
+    centers = rng.normal(scale=8.0, size=(k, d))
+    data = (
+        centers[rng.integers(0, k, n)]
+        + rng.normal(scale=1.0, size=(n, d))
+    ).astype(np.float32)
+
+    tmp = tempfile.mkdtemp(prefix="gmm_tune_bench_")
+    dbp = os.path.join(tmp, "tuning.json")
+    base = dict(min_iters=iters, max_iters=iters, seed=0)
+
+    # Offline probe sweep (the `gmm tune` path), timed separately: the
+    # tuner's own cost must never hide inside either A/B wall.
+    cfg0 = GMMConfig(**base)
+    key = _platform_key(cfg0, n, d, k)
+    db = TuningDB.open(dbp)
+    t0 = time.perf_counter()
+    probe_knob(cfg0, data, k, key, db, "chunk_size", iters=probe_iters,
+               full_ladder=True)
+    db.save()
+    probe_wall = time.perf_counter() - t0
+
+    tuned_cfg, decisions = resolve_fit_config_ex(
+        GMMConfig(autotune="db", tuning_db=dbp, **base), data, k)
+
+    def one(cfg):
+        model = GMMModel(cfg)
+        warm = dataclasses.replace(cfg, min_iters=1, max_iters=1)
+        fit_gmm(data, k, k, warm, model=model)
+        t1 = time.perf_counter()
+        res = fit_gmm(data, k, k, cfg, model=model)
+        wall = time.perf_counter() - t1
+        return {
+            "wall_s": round(wall, 3),
+            "chunk_size": int(cfg.chunk_size),
+            "estep_backend": cfg.estep_backend,
+            "final_loglik": float(res.final_loglik),
+            "ideal_k": int(res.ideal_num_clusters),
+        }
+
+    default = one(cfg0)
+    tuned = one(tuned_cfg)
+    speedup = default["wall_s"] / max(tuned["wall_s"], 1e-9)
+    bit_parity_expected = (
+        tuned["chunk_size"] == default["chunk_size"]
+        and tuned["estep_backend"] == default["estep_backend"])
+    rel_ll = (abs(tuned["final_loglik"] - default["final_loglik"])
+              / max(abs(default["final_loglik"]), 1e-30))
+    parity_ok = ((rel_ll == 0.0) if bit_parity_expected
+                 else rel_ll <= 1e-5)
+    result = {
+        "metric": f"autotuned vs default wall ({n}x{d}, K={k}, "
+                  f"{platform})",
+        "value": tuned["wall_s"],
+        "unit": "s",
+        # A/B ratio (default / tuned): > 1 means the tuner won.
+        "vs_baseline": round(speedup, 3),
+        "accelerator_unavailable": accel_unavailable,
+        "tune": {
+            "n": n, "d": d, "k": k, "em_iters": iters,
+            "probe_iters": probe_iters,
+            "probe_wall_s": round(probe_wall, 3),
+            "tuning_key": key.as_str(),
+            "decisions": [
+                {"knob": dec["knob"], "chosen": dec["chosen"],
+                 "source": dec["source"],
+                 "default": dec.get("default"),
+                 "candidates": dec.get("candidates") or {}}
+                for dec in decisions],
+            "default": default,
+            "tuned": tuned,
+            "speedup": round(speedup, 3),
+            "bit_parity_expected": bit_parity_expected,
+            "rel_loglik_diff": rel_ll,
+            "parity_ok": parity_ok,
+            "ideal_k_equal": tuned["ideal_k"] == default["ideal_k"],
+        },
+        "measured_utc": time.strftime("%Y-%m-%dT%H:%M:%SZ", time.gmtime()),
+    }
+    if accel_unavailable:
+        result["platform_note"] = (
+            "accelerator tunnel unavailable (probe failed after retries); "
+            "this is a CPU-fallback measurement, not an accelerator result")
+    return result
+
+
 def main() -> int:
     cfg_name = "north"
     for a in sys.argv[1:]:
@@ -1788,6 +1915,8 @@ def main() -> int:
                     or os.environ.get("GMM_BENCH_PROFILE") == "1")
     want_timeline = ("--timeline" in sys.argv[1:]
                      or os.environ.get("GMM_BENCH_TIMELINE") == "1")
+    want_tune = ("--tune" in sys.argv[1:]
+                 or os.environ.get("GMM_BENCH_TUNE") == "1")
     spec = CONFIGS.get(cfg_name)
     if spec is None:
         print(
@@ -1952,6 +2081,15 @@ def main() -> int:
         # -> validate oracle (ignores --config; sized by
         # GMM_BENCH_TIMELINE_*).
         result = run_timeline_bench(platform, accel_unavailable)
+        watchdog.cancel()
+        print(json.dumps(result))
+        return 3 if accel_unavailable else 0
+
+    if want_tune:
+        # Autotuned-vs-default A/B: probe the chunk ladder into a scratch
+        # tuning DB, resolve through autotune='db', fit both sides
+        # (ignores --config; sized by GMM_BENCH_TUNE_*).
+        result = run_tune_bench(platform, accel_unavailable)
         watchdog.cancel()
         print(json.dumps(result))
         return 3 if accel_unavailable else 0

@@ -150,6 +150,20 @@ def build_parser() -> argparse.ArgumentParser:
                    "path, 'auto' routes per docs/PERF.md. The backend "
                    "that actually ran lands on the telemetry stream as "
                    "em_backend")
+    t.add_argument("--autotune", default="off",
+                   choices=["off", "db", "probe"],
+                   help="profile-guided knob resolution (docs/PERF.md "
+                   "'Autotuning'): 'db' resolves unset tunable knobs "
+                   "(chunk size, E-step backend, sweep bucketing, "
+                   "restart batch) from the nearest recorded profile in "
+                   "the tuning database, 'probe' measures missing rows "
+                   "first (2-3 real EM iterations per candidate). "
+                   "Explicitly-passed knobs are never touched; results "
+                   "stay in the documented parity class. Default off "
+                   "(byte-identical streams)")
+    t.add_argument("--tuning-db", default=None, metavar="PATH",
+                   help="tuning database path (default GMM_TUNING_DB or "
+                   "~/.cache/gmm/tuning.json); `gmm tune` writes it")
     t.add_argument("--precompute-features", action="store_true",
                    help="hoist the [N, F] outer-product features out of the "
                    "EM loop (built once, held in HBM: N*F*4 bytes); "
@@ -364,6 +378,14 @@ def main(argv=None) -> int:
         from .telemetry.timeline import timeline_main
 
         return timeline_main(argv[1:])
+    if argv and argv[0] == "tune":
+        # `gmm tune`: offline autotuner sweep -- probe candidate knob
+        # settings at a shape, write the tuning DB, print the decision
+        # table a later --autotune=db run resolves from (docs/PERF.md
+        # "Autotuning").
+        from .tuning.cli import tune_main
+
+        return tune_main(argv[1:])
     if argv and argv[0] == "runs":
         # `gmm runs DIR`: index historical run streams (run id, config
         # fingerprint, backend, wall, iters/s, health).
@@ -438,6 +460,8 @@ def main(argv=None) -> int:
             restart_batch_size=args.restart_batch_size,
             use_pallas=args.pallas,
             estep_backend=args.estep_backend,
+            autotune=args.autotune,
+            tuning_db=args.tuning_db,
             fused_sweep=args.fused_sweep,
             sweep_k_buckets=args.sweep_k_buckets,
             device=args.device,
@@ -501,6 +525,7 @@ def main(argv=None) -> int:
             ("--stream-events", args.stream_events),
             ("--ingest", args.ingest != "resident"),
             ("--em-mode", args.em_mode != "full"),
+            ("--autotune", args.autotune != "off"),
         ]
         for flag, present in fit_only:
             if present:

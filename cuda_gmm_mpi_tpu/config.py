@@ -293,6 +293,23 @@ class GMMConfig:
     # fit. False = skip the pass (envelope.json can be backfilled later
     # with `gmm drift --rebuild-envelope`).
     envelope: bool = True
+    # Profile-guided autotuning (docs/PERF.md "Autotuning"; tuning/):
+    #   'off' (default): every knob runs exactly as set -- streams and
+    #     results stay byte-identical to pre-tuner behavior.
+    #   'db': resolve unset tunable knobs (chunk_size, estep_backend,
+    #     sweep_k_buckets, restart_batch_size, fleet_mode) from the
+    #     nearest recorded profile in the tuning database, falling back
+    #     to the static cost model; knobs whose value differs from the
+    #     dataclass default are treated as user-pinned and never touched.
+    #   'probe': like 'db', but missing rows are measured first by a
+    #     bounded microprobe (2-3 real EM iterations per candidate) and
+    #     written back to the database.
+    # Every resolved decision is emitted as a `tune` telemetry event
+    # (schema rev v2.5) when a recorder is active.
+    autotune: str = "off"
+    # Tuning database path. None = GMM_TUNING_DB or
+    # ~/.cache/gmm/tuning.json (tuning.db.default_db_path).
+    tuning_db: Optional[str] = None
     checkpoint_dir: Optional[str] = None
     seed: int = 0  # RNG seed for any randomized paths (reference is deterministic)
     # Initial means: 'even' = the reference's evenly-spaced event rows
@@ -358,6 +375,10 @@ class GMMConfig:
                 0 <= self.metrics_port <= 65535):
             raise ValueError(
                 f"metrics_port must be in [0, 65535], got {self.metrics_port}")
+        if self.autotune not in ("off", "db", "probe"):
+            raise ValueError(
+                f"unknown autotune mode: {self.autotune!r} "
+                "(expected 'off', 'db' or 'probe')")
         if self.quad_mode not in ("expanded", "packed", "centered"):
             raise ValueError(f"unknown quad_mode: {self.quad_mode!r}")
         if self.covariance_type not in ("full", "diag", "spherical", "tied"):

@@ -284,10 +284,13 @@ def _emit_run_summary(rec, config, timer, sweep_log, ideal_k, best_score,
     compile/execute split, metrics-registry snapshot, and (multi-host)
     every rank's snapshot gathered to the one stream process 0 writes.
 
-    The compile split is the first-vs-warm estimate: the first K's EM call
-    compiles the executable the later Ks reuse, so
-    ``first_call_s - min(warm calls)`` bounds the compile cost (single-K
-    runs carry nulls -- there is no warm call to difference against).
+    The compile split is MEASURED: ``profile.compile_seconds`` (the
+    CompileWatch rollup below) is the wall XLA actually spent building
+    executables. The ``compile`` dict keeps the raw first/warm call
+    walls for context, but the old derived ``est_compile_s``
+    (first - warm) estimate is gone -- ``gmm report`` labels the
+    measured source and renders the estimate only for pre-v2.2 streams
+    that carry nothing else.
 
     ``buckets`` (host-driven sweeps) describes the cluster-width bucketing:
     ``{mode, em_widths, em_compiles, rebuckets}`` -- em_compiles is the
@@ -300,9 +303,9 @@ def _emit_run_summary(rec, config, timer, sweep_log, ideal_k, best_score,
     warm = min(em_walls[1:]) if len(em_walls) > 1 else None
     elastic_section = elastic.run_summary_section()
     # CompileWatch rollup (rev v2.2): MEASURED compile counts/seconds +
-    # cost/memory analyses + HBM watermarks, superseding (not replacing)
-    # the first-vs-warm estimate below -- ``gmm report`` prefers these
-    # and falls back to ``est_compile_s`` on pre-v2.2 streams.
+    # cost/memory analyses + HBM watermarks -- since rev v2.5 the ONLY
+    # compile-cost source this stream emits (``est_compile_s`` deleted;
+    # report still renders it, labeled "(est.)", for old fixtures).
     watch = tl_profiling.active()
     fields = dict(
         **({"profile": watch.snapshot()} if watch is not None else {}),
@@ -330,9 +333,6 @@ def _emit_run_summary(rec, config, timer, sweep_log, ideal_k, best_score,
         compile={
             "first_call_s": (round(first, 6) if first is not None else None),
             "warm_call_s": (round(warm, 6) if warm is not None else None),
-            "est_compile_s": (round(max(first - warm, 0.0), 6)
-                              if first is not None and warm is not None
-                              else None),
         },
         metrics=rec.metrics.snapshot(),
         memory_stats=telemetry.memory_stats(),
@@ -524,6 +524,17 @@ def fit_gmm(
             # no watch, and every instrumented path dispatches through
             # plain jax.jit -- results stay byte-identical to pre-v2.2.
             stack.enter_context(tl_profiling.watch())
+        if config.autotune != "off":
+            # Profile-guided knob resolution (tuning/, docs/PERF.md
+            # "Autotuning"): runs ONCE per fit, under the ambient
+            # recorder so the per-knob `tune` events ride this stream.
+            # The resolved config comes back with autotune='off' --
+            # restart and elastic re-entries inherit the decisions
+            # instead of re-probing (and re-emitting) per sub-fit.
+            from ..tuning import resolve_fit_config
+
+            config = resolve_fit_config(config, data, num_clusters,
+                                        log=get_logger(config))
         # Elastic retry loop (docs/DISTRIBUTED.md "Elastic recovery"): a
         # peer loss under --elastic shrinks the world via the checkpoint-FS
         # rendezvous and REFITS (resume="auto" restores the newest step)

@@ -57,6 +57,7 @@ import numpy as np
 from .. import health, supervisor, telemetry
 from ..ops.formulas import convergence_epsilon, model_score
 from ..ops.merge import eliminate_and_reduce
+from ..ops.pallas import resolve_estep_backend
 from ..ops.seeding import seed_states_batched
 from ..state import clone_state, compact
 from ..testing import faults
@@ -91,6 +92,15 @@ def restart_batch_auto_cap(config, n_events: int, n_dims: int,
     budget defaults to 1/4 of host memory (CPU tier-1 runs device = host;
     on real accelerators HBM is the binding constraint and the explicit
     knobs take over): GMM_RESTART_MEM_BYTES overrides it directly.
+
+    When the batched PALLAS path will run, host bytes are not the only
+    budget: every restart lane holds its own A/h/g parameter blocks and
+    statistics accumulators ([R, F, K]-shaped replication) resident in
+    VMEM for the whole grid, while the per-tile event block is shared
+    across lanes. R is therefore additionally capped by the VMEM budget
+    (~16 MiB/core; GMM_RESTART_VMEM_BYTES overrides) -- without this
+    term the host-memory heuristic happily picks an R whose lane blocks
+    alone overflow VMEM and the kernel fails to lower.
     """
     env = os.environ.get("GMM_RESTART_MEM_BYTES")
     if env not in (None, ""):
@@ -102,7 +112,20 @@ def restart_batch_auto_cap(config, n_events: int, n_dims: int,
     B = max(1, min(int(config.chunk_size), int(n_events)))
     K, D = int(num_clusters), int(n_dims)
     per_restart = itemsize * (B * (K + D * D + D) * 3 + K * D * D * 4)
-    return max(1, int(budget // max(per_restart, 1)))
+    cap = max(1, int(budget // max(per_restart, 1)))
+    if resolve_estep_backend(config)[0].startswith("pallas"):
+        # Per-lane VMEM residency of the batched kernel (f32 always):
+        # A [F, K] + h [D, K] + g [1, K] inputs and the mirrored
+        # [K, F]/[K, D]/[1, K] accumulator scratch.
+        F = D if config.covariance_type in ("diag", "spherical") else D * D
+        per_lane_vmem = 4 * (2 * F * K + 2 * D * K + 2 * K + 2)
+        tile = 4 * int(config.pallas_block_b) * (D + 1)
+        vmem_env = os.environ.get("GMM_RESTART_VMEM_BYTES")
+        vmem_budget = int(vmem_env) if vmem_env not in (None, "") \
+            else 16 << 20
+        cap = min(cap, max(1, (vmem_budget - tile)
+                           // max(per_lane_vmem, 1)))
+    return cap
 
 
 def resolve_restart_batch_size(config, model, data, num_clusters=None,

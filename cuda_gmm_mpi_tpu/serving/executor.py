@@ -451,15 +451,15 @@ class ScoringExecutor:
 
 @functools.lru_cache(maxsize=None)
 def _shared_executor(dtype: str, diag_only: bool, quad_mode: str,
-                     matmul_precision: str,
-                     max_block: int) -> ScoringExecutor:
+                     matmul_precision: str, max_block: int,
+                     min_block: int = 256) -> ScoringExecutor:
     max_block = max(1, int(max_block))
     return ScoringExecutor(dtype=dtype, diag_only=diag_only,
                            quad_mode=quad_mode,
                            matmul_precision=matmul_precision,
                            # Small-chunk configs (tests fit with
                            # chunk_size < 256) cap the floor too.
-                           min_block=min(256, max_block),
+                           min_block=min(int(min_block), max_block),
                            max_block=max_block)
 
 
@@ -478,8 +478,15 @@ def executor_for_config(config) -> ScoringExecutor:
 
 def executor_for_model(model: "ServedModel",
                        **kw) -> ScoringExecutor:  # noqa: F821
-    """The shared executor for one registry :class:`ServedModel`."""
+    """The shared executor for one registry :class:`ServedModel`.
+
+    ``min_block``/``max_block`` overrides come from the serving
+    autotuner (``tuning.resolve_serving_blocks``) when the server runs
+    with ``--autotune db``; the defaults are the hand-set pre-tuner
+    geometry.
+    """
     return _shared_executor(model.dtype, model.diag_only,
                             kw.pop("quad_mode", "expanded"),
                             kw.pop("matmul_precision", "highest"),
-                            kw.pop("max_block", 65536))
+                            kw.pop("max_block", 65536),
+                            kw.pop("min_block", 256))

@@ -131,7 +131,22 @@ class GMMServer:
                  stack_models: bool = False,
                  trace_requests: bool = False,
                  drift_interval_s: Optional[float] = None,
-                 drift_psi_threshold: Optional[float] = 0.2):
+                 drift_psi_threshold: Optional[float] = 0.2,
+                 autotune: str = "off",
+                 tuning_db: Optional[str] = None):
+        if autotune not in ("off", "db"):
+            raise ValueError(
+                f"serving autotune must be 'off' or 'db', got {autotune!r}"
+                " (the probe rung belongs to `gmm tune`, not a live "
+                "scoring loop)")
+        # Profile-guided executor geometry (docs/PERF.md "Autotuning"):
+        # 'db' resolves each served family's min/max event-block bounds
+        # from the tuning database (nearest recorded serve row; static
+        # defaults otherwise) and emits one `tune` event per decision on
+        # the serve stream. 'off' keeps the hand-set defaults and a
+        # byte-identical stream.
+        self._autotune = autotune
+        self._tuning_db = tuning_db
         self._registry = registry
         self._max_batch_rows = max(1, int(max_batch_rows))
         self._tick_s = max(0.0, float(tick_s))
@@ -279,7 +294,15 @@ class GMMServer:
         key = (m.dtype, m.diag_only)
         ex = self._executors.get(key)
         if ex is None:
-            ex = self._executors[key] = executor_for_model(m)
+            kw = {}
+            if self._autotune == "db":
+                from ..tuning import resolve_serving_blocks
+
+                blocks, _ = resolve_serving_blocks(
+                    m.dtype, m.diag_only, m.d, m.k,
+                    tuning_db=self._tuning_db)
+                kw.update(blocks)
+            ex = self._executors[key] = executor_for_model(m, **kw)
         return ex
 
     def executor_stats(self) -> Dict[str, int]:
@@ -1215,6 +1238,15 @@ def serve_main(argv=None) -> int:
                    "emit route spans, and echo a trace_id in every "
                    "response (default: off; responses and streams stay "
                    "byte-identical)")
+    p.add_argument("--autotune", default="off", choices=["off", "db"],
+                   help="resolve executor block bounds per served "
+                   "family from the tuning database (nearest recorded "
+                   "serve row; docs/PERF.md 'Autotuning'). Decisions "
+                   "land on the serve stream as `tune` events. Default "
+                   "off: hand-set geometry, byte-identical stream")
+    p.add_argument("--tuning-db", default=None, metavar="PATH",
+                   help="tuning database path (default GMM_TUNING_DB or "
+                   "~/.cache/gmm/tuning.json)")
     p.add_argument("--trace-dir", default=None, metavar="DIR",
                    help="capture a jax.profiler trace of the serve "
                    "loop into DIR (view with TensorBoard or Perfetto)")
@@ -1304,7 +1336,9 @@ def serve_main(argv=None) -> int:
                        stack_models=args.stack_models,
                        trace_requests=args.metrics_port is not None,
                        drift_interval_s=args.drift_interval_s,
-                       drift_psi_threshold=args.drift_psi_threshold)
+                       drift_psi_threshold=args.drift_psi_threshold,
+                       autotune=args.autotune,
+                       tuning_db=args.tuning_db)
 
     rec = (telemetry.RunRecorder(args.metrics_file)
            if args.metrics_file else telemetry.RunRecorder())

@@ -46,6 +46,9 @@ _FINGERPRINT_FIELDS = (
 )
 
 # Count-shaped metrics that must not increase between comparable runs.
+# ``tune.regressions`` counts autotune decisions whose measured wall/iter
+# came in >20% over the recorded profile that chose them (stale tuning-DB
+# rows page instead of silently pessimizing; docs/PERF.md "Autotuning").
 DEFAULT_FAIL_ON = (
     "compiles>0",
     "xla_compiles>0",
@@ -55,7 +58,11 @@ DEFAULT_FAIL_ON = (
     "serve.errors>0",
     "serve.shed>0",
     "serve.deadline_expired>0",
+    "tune.regressions>0",
 )
+
+#: a tuned run this much slower than its own recorded profile regresses.
+TUNE_REGRESSION_TOLERANCE = 1.20
 
 
 def _num(value) -> Optional[float]:
@@ -120,10 +127,13 @@ def summarize_run(records: List[dict]) -> dict:
             stack.extend(node["children"])
 
     n_compile_events = 0
+    tune_events: List[dict] = []
     for r in records:
         ev = r.get("event")
         if ev == "compile":
             n_compile_events += 1
+        elif ev == "tune":
+            tune_events.append(r)
         elif ev == "ingest_summary":
             for src, dst in (("prefetch_wait_s", "ingest.prefetch_wait_s"),
                              ("blocks_read", "ingest.blocks_read"),
@@ -179,6 +189,9 @@ def summarize_run(records: List[dict]) -> dict:
         if wall and iters is not None and wall > 0:
             metrics["iters_per_s"] = round(iters / wall, 3)
         comp = s.get("compile") or {}
+        # Pre-v2.5 streams only: the derived first-vs-warm estimate was
+        # deleted once CompileWatch's measured compile_seconds (folded
+        # from ``profile`` below) covered every run.
         v = _num(comp.get("est_compile_s"))
         if v is not None:
             metrics["est_compile_s"] = v
@@ -203,6 +216,26 @@ def summarize_run(records: List[dict]) -> dict:
         metrics["health_flagged"] = float(flagged)
         if info["run_id"] is None:
             info["run_id"] = s.get("run_id")
+
+    if tune_events:
+        # Autotune audit (rev v2.5): how many knobs the resolver touched,
+        # and how many of its MEASURED predictions (db/probe rows carry a
+        # wall/iter; static predictions are too coarse to gate on) the
+        # run's actual wall/iter blew through by >20%.
+        metrics["tune.decisions"] = float(len(tune_events))
+        wall = metrics.get("wall_s")
+        iters = metrics.get("total_iters")
+        measured = (wall / iters if wall and iters else None)
+        regressions = 0
+        for t in tune_events:
+            pred = _num(t.get("predicted_s"))
+            if pred is None or pred <= 0 \
+                    or t.get("source") not in ("db", "probe"):
+                continue
+            if measured is not None \
+                    and measured > TUNE_REGRESSION_TOLERANCE * pred:
+                regressions += 1
+        metrics["tune.regressions"] = float(regressions)
 
     info["metrics"] = metrics
     return info

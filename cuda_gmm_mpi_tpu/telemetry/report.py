@@ -140,6 +140,7 @@ def render_report(records: List[dict], max_trajectory_rows: int = 400) -> str:
     heartbeats = [r for r in records if r.get("event") == "heartbeat"]
     span_recs = [r for r in records if r.get("event") == "span"]
     compile_recs = [r for r in records if r.get("event") == "compile"]
+    tune_recs = [r for r in records if r.get("event") == "tune"]
 
     selects = [r for r in records if r.get("event") == "restart_select"]
     healths = [r for r in records if r.get("event") == "health"]
@@ -154,6 +155,24 @@ def render_report(records: List[dict], max_trajectory_rows: int = 400) -> str:
     for s in starts:
         out.append(_fmt_run_start(s))
     if starts:
+        out.append("")
+
+    if tune_recs:
+        # Autotune decisions (rev v2.5): what the profile-guided
+        # resolver picked, from which fallback-ladder rung, against
+        # which recorded/modelled wall.
+        out.append(f"Autotune ({len(tune_recs)} decision(s)):")
+        for r in tune_recs:
+            line = (f"  {r.get('knob')}: {r.get('chosen')} "
+                    f"[{r.get('source')}]")
+            if r.get("default") not in (None, r.get("chosen")):
+                line += f" (default {r.get('default')})"
+            pred = r.get("predicted_s")
+            if isinstance(pred, (int, float)):
+                line += f", predicted {float(pred):.4f}s/iter"
+            if r.get("surface") not in (None, "fit"):
+                line += f" ({r.get('surface')})"
+            out.append(line)
         out.append("")
 
     if dones:

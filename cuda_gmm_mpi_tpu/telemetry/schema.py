@@ -289,6 +289,24 @@ EVENT_FIELDS: Dict[str, Tuple[Tuple[str, ...], Tuple[str, ...]]] = {
         ("model", "psi", "threshold"),
         ("version", "ks", "occupancy_l1", "window_rows", "flag_names"),
     ),
+    # Autotune decision (stream rev v2.5; tuning/, docs/PERF.md
+    # "Autotuning"): one per knob the profile-guided resolver touched.
+    # ``chosen`` is the value the run actually used, ``source`` the
+    # fallback-ladder rung that supplied it ('db' = recorded profile,
+    # 'probe' = measured this run by the microprobe, 'static' = cost
+    # model), ``candidates`` a {candidate: wall_per_iter_s|null} map of
+    # what was considered, ``predicted_s`` the chosen candidate's
+    # recorded/modelled wall per EM iteration (the ``gmm diff``
+    # ``tune.regressions`` gate compares the run's measured wall/iter
+    # against it), ``key`` the tuning-DB shape key that resolved,
+    # ``surface`` = fit|fleet|serve, ``default`` the pre-resolution
+    # value. Only ``autotune != 'off'`` runs emit these -- the default
+    # stream stays byte-identical.
+    "tune": (
+        ("knob", "chosen", "source"),
+        ("candidates", "predicted_s", "key", "surface", "default",
+         "distance"),
+    ),
     # Fleet fits (stream rev v1.8; tenancy/, docs/TENANCY.md): one per
     # `fit_fleet` invocation -- the fleet's identity card: tenant count,
     # packed-group count, and the dispatch mode ('scan' = bit-exact

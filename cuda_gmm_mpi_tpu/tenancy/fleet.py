@@ -165,6 +165,20 @@ def fit_fleet(tenants: List[TenantSpec], config: GMMConfig = GMMConfig(),
                 rec.set_context(trace_id=tid)
                 stack.callback(rec.set_context, trace_id=None)
             stack.enter_context(tl_spans.span("fleet"))
+        if config.autotune != "off" and tenants:
+            # Profile-guided knob resolution (tuning/): fleet_mode and
+            # chunk_size from the nearest recorded profile at the
+            # fleet's LARGEST packed shape (db/static only -- a fleet
+            # fit never burns tenant wall probing). The resolved config
+            # comes back autotune='off' so nothing downstream re-runs
+            # this; `tune` events ride the ambient stream.
+            from ..tuning import resolve_fleet_config_ex
+
+            config, _ = resolve_fleet_config_ex(
+                config,
+                max(int(t.data.shape[0]) for t in tenants),
+                int(tenants[0].data.shape[1]),
+                max(int(t.num_clusters) for t in tenants))
         return _fit_fleet(tenants, config, model, verbose)
 
 

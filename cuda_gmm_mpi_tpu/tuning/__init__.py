@@ -1,0 +1,33 @@
+"""Profile-guided autotuning (docs/PERF.md "Autotuning").
+
+The closed loop over PR 14's passive cost introspection: recorded
+per-shape profiles (``tuning.db``) + a bounded microprobe
+(``tuning.probe``) + a static cost model (``tuning.cost``) resolve the
+port's hand-set launch-geometry analogs — ``chunk_size``, the E-step
+backend, sweep bucketing, restart batching, serving block bounds, fleet
+dispatch mode — per (platform, device_kind, shape) instead of per
+editor session. ``GMMConfig.autotune='off'`` (the default) keeps every
+stream and result byte-identical to pre-tuner behavior; ``'db'`` and
+``'probe'`` resolve through ``tuning.autotune``'s fallback ladder and
+emit one ``tune`` telemetry event per decision. ``gmm tune`` is the
+offline sweep (``tuning.cli``).
+"""
+
+from .autotune import (  # noqa: F401
+    FIT_KNOBS,
+    emit_decisions,
+    explicit_knobs,
+    resolve_fit_config,
+    resolve_fit_config_ex,
+    resolve_fleet_config_ex,
+    resolve_serving_blocks,
+)
+from .cost import em_iteration_cost, predict_iteration_wall  # noqa: F401
+from .db import (  # noqa: F401
+    KNOBS,
+    TuningDB,
+    TuningKey,
+    default_db_path,
+    pow2_bucket,
+)
+from .probe import PROBEABLE, probe_knob  # noqa: F401

@@ -144,6 +144,25 @@ def write_npz_atomic(directory: str, target: str,
     _fsync_dir(directory)
 
 
+def write_json_atomic(path: str, obj: Any) -> None:
+    """``write_npz_atomic``'s sibling for JSON artifacts (tuning DB,
+    manifests): tmp + fsync + ``os.replace`` + dir fsync, same crash
+    contract. Keys are sorted so two writers producing the same logical
+    content produce the same bytes (diff-able artifacts)."""
+    import json
+    import tempfile
+
+    directory = os.path.dirname(os.path.abspath(path)) or "."
+    fd, tmp = tempfile.mkstemp(dir=directory, suffix=".tmp.json")
+    with os.fdopen(fd, "w") as f:
+        json.dump(obj, f, indent=1, sort_keys=True)
+        f.write("\n")
+        f.flush()
+        os.fsync(f.fileno())
+    os.replace(tmp, path)
+    _fsync_dir(directory)
+
+
 def _fsync_dir(directory: str) -> None:
     """POSIX-only durability fsync of a directory entry after a rename."""
     if os.name != "posix":

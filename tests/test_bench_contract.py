@@ -560,3 +560,40 @@ def test_timeline_mode_contract():
     assert t["tracks"] >= 1
     assert t["trace_bytes"] > 0
     assert j["vs_baseline"] == 1.0
+
+
+def test_tune_ab_mode_contract():
+    """--tune (GMM_BENCH_TUNE=1) emits ONE JSON record carrying the
+    probe sweep's decisions, BOTH walls (default geometry vs tuned), and
+    parity in the same run -- vs_baseline is the default/tuned ratio.
+    Tiny shape + 1 probe iteration so the full ladder stays
+    tier-1-fast."""
+    r = _run({
+        "GMM_BENCH_CPU": "1",
+        "GMM_BENCH_TUNE": "1",
+        "GMM_BENCH_TUNE_N": "4000",
+        "GMM_BENCH_TUNE_D": "4",
+        "GMM_BENCH_TUNE_K": "4",
+        "GMM_BENCH_TUNE_ITERS": "2",
+        "GMM_BENCH_TUNE_PROBE_ITERS": "1",
+    }, timeout=600)
+    assert r.returncode == 0, r.stderr
+    j = _json_line(r.stdout)
+    assert j["unit"] == "s" and j["value"] > 0
+    assert j["accelerator_unavailable"] is False
+    t = j["tune"]
+    assert t["n"] == 4000 and t["k"] == 4 and t["em_iters"] == 2
+    # the probe's own wall is reported separately, never inside a side
+    assert t["probe_wall_s"] > 0
+    assert t["default"]["wall_s"] > 0 and t["tuned"]["wall_s"] > 0
+    assert j["vs_baseline"] == t["speedup"]
+    # chunk_size came from the measured sweep (the DB it just wrote)
+    by_knob = {d["knob"]: d for d in t["decisions"]}
+    assert by_knob["chunk_size"]["source"] == "db"
+    assert len(by_knob["chunk_size"]["candidates"]) >= 2
+    assert t["tuned"]["chunk_size"] == int(by_knob["chunk_size"]["chosen"])
+    # numerical parity asserted in the SAME record as the walls
+    assert t["parity_ok"] is True
+    assert t["ideal_k_equal"] is True
+    if t["bit_parity_expected"]:
+        assert t["rel_loglik_diff"] == 0.0

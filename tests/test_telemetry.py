@@ -96,8 +96,9 @@ def _check_stream(records, *, start_k, stop_k, path):
     assert set(CATEGORIES) <= set(prof["seconds"])
     assert set(CATEGORIES) <= set(prof["counts"])
     comp = summary["compile"]
-    assert set(comp) == {"first_call_s", "warm_call_s", "est_compile_s"}
-    assert comp["first_call_s"] > 0 and comp["est_compile_s"] >= 0
+    # Measured-only since rev v2.5: the est_compile_s heuristic is gone.
+    assert set(comp) == {"first_call_s", "warm_call_s"}
+    assert comp["first_call_s"] > 0
     counters = summary["metrics"]["counters"]
     assert counters["em_iters"] == len(iters)
     assert counters["h2d_bytes"] > 0

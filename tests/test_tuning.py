@@ -1,0 +1,486 @@
+"""Profile-guided autotuner (ISSUE 17).
+
+Acceptance contract: the tuning DB round-trips atomically and resolves
+nearest-key within a (platform, device_kind, covariance, dtype) family;
+explicitly-set knobs always win over the resolver; the microprobe ranks
+candidates deterministically (fixed candidate order, stable tie-breaks);
+tuned configs never change numerical results -- bit-parity when the
+resolved knobs equal the defaults, the documented reduction-order
+tolerance class otherwise -- across the plain, sharded, restart, and
+serving paths; the v2.5 ``tune`` event is schema-pinned in both
+directions; and the ``restart_batch_size`` auto cap respects the batched
+Pallas path's per-lane VMEM blocks.
+"""
+
+import dataclasses
+import json
+import os
+
+import numpy as np
+import pytest
+
+from cuda_gmm_mpi_tpu import GMMConfig, fit_gmm, telemetry
+from cuda_gmm_mpi_tpu.telemetry.schema import (EVENT_FIELDS,
+                                               validate_record,
+                                               validate_stream)
+from cuda_gmm_mpi_tpu.tuning import (FIT_KNOBS, PROBEABLE, TuningDB,
+                                     TuningKey, default_db_path,
+                                     emit_decisions, explicit_knobs,
+                                     pow2_bucket, probe_knob,
+                                     resolve_fit_config_ex,
+                                     resolve_serving_blocks)
+from cuda_gmm_mpi_tpu.tuning import probe as probe_mod
+from cuda_gmm_mpi_tpu.tuning.autotune import _platform_key
+
+from .conftest import make_blobs
+
+
+def _key(n=20000, d=16, k=8, cov="full", dtype="float32"):
+    return TuningKey.for_shape("cpu", "cpu", n, d, k, cov, dtype)
+
+
+# ------------------------------------------------------------------ db
+
+
+def test_pow2_bucket_and_key_roundtrip():
+    assert pow2_bucket(1) == 1
+    assert pow2_bucket(4096) == 4096
+    assert pow2_bucket(4097) == 8192
+    k = _key()
+    assert k.as_str() == "cpu|cpu|n32768|d16|k8|full|float32"
+    assert TuningKey.from_str(k.as_str()) == k
+    assert TuningKey.from_str("garbage") is None
+    assert TuningKey.from_str("a|b|c|d|e|f|g") is None
+
+
+def test_db_roundtrip_and_atomic_persistence(tmp_path):
+    p = str(tmp_path / "tuning.json")
+    db = TuningDB.open(p)
+    assert db.entries == {} and db.load_error is None
+    key = _key()
+    db.record(key, "chunk_size", 4096, {"wall_per_iter_s": 0.02}, "probe")
+    db.record(key, "chunk_size", 8192, {"wall_per_iter_s": 0.01}, "probe")
+    db.save()
+    # the file is well-formed, versioned JSON...
+    raw = json.loads(open(p).read())
+    assert raw["version"] == 1
+    # ...and a fresh open reads back the argmin choice
+    db2 = TuningDB.open(p)
+    slot = db2.lookup(key, "chunk_size")
+    assert slot["chosen"] == "8192" and slot["distance"] == 0.0
+    assert set(slot["candidates"]) == {"4096", "8192"}
+
+
+def test_db_chosen_ties_break_toward_smaller_candidate(tmp_path):
+    db = TuningDB(str(tmp_path / "t.json"))
+    key = _key()
+    db.record(key, "chunk_size", 8192, {"wall_per_iter_s": 0.01})
+    db.record(key, "chunk_size", 4096, {"wall_per_iter_s": 0.01})
+    assert db.lookup(key, "chunk_size")["chosen"] == "4096"
+
+
+def test_db_unreadable_or_alien_version_degrades_to_empty(tmp_path):
+    p = tmp_path / "bad.json"
+    p.write_text("{not json")
+    db = TuningDB.open(str(p))
+    assert db.entries == {} and "unreadable" in db.load_error
+    p.write_text(json.dumps({"version": 999, "entries": {"x": {}}}))
+    db = TuningDB.open(str(p))
+    assert db.entries == {} and "version" in db.load_error
+
+
+def test_nearest_key_resolution_stays_in_family(tmp_path):
+    db = TuningDB(str(tmp_path / "t.json"))
+    near = _key(n=40000)    # n65536: one octave from n32768
+    far = _key(n=500000)    # n524288
+    db.record(near, "chunk_size", 8192, {"wall_per_iter_s": 0.01})
+    db.record(far, "chunk_size", 65536, {"wall_per_iter_s": 0.05})
+    got = db.nearest(_key(), "chunk_size")
+    assert got["chosen"] == "8192"
+    assert got["key"] == near.as_str() and got["distance"] == 1.0
+    # a different dtype/covariance is a different family: no transfer
+    assert db.nearest(_key(dtype="float64"), "chunk_size") is None
+    assert db.nearest(_key(cov="diag"), "chunk_size") is None
+    # exact rows win over nearer neighbors
+    db.record(_key(), "chunk_size", 2048, {"wall_per_iter_s": 0.02})
+    assert db.nearest(_key(), "chunk_size")["chosen"] == "2048"
+
+
+def test_default_db_path_env_precedence(monkeypatch, tmp_path):
+    monkeypatch.setenv("GMM_TUNING_DB", str(tmp_path / "x.json"))
+    assert default_db_path() == str(tmp_path / "x.json")
+    monkeypatch.delenv("GMM_TUNING_DB")
+    monkeypatch.setenv("XDG_CACHE_HOME", str(tmp_path / "cache"))
+    assert default_db_path() == str(tmp_path / "cache" / "gmm"
+                                    / "tuning.json")
+
+
+# -------------------------------------------------------------- resolver
+
+
+def test_autotune_field_validated():
+    with pytest.raises(ValueError, match="autotune"):
+        GMMConfig(autotune="always")
+
+
+def test_explicit_knob_precedence(tmp_path, rng):
+    """A user-pinned knob is never overwritten, even when the DB has a
+    measured row saying otherwise."""
+    data, _ = make_blobs(rng, n=500, d=4, k=3, dtype=np.float32)
+    dbp = str(tmp_path / "t.json")
+    db = TuningDB(dbp)
+    cfg = GMMConfig(autotune="db", tuning_db=dbp, chunk_size=12345,
+                    min_iters=2, max_iters=2)
+    key = _platform_key(cfg, data.shape[0], data.shape[1], 3)
+    db.record(key, "chunk_size", 256, {"wall_per_iter_s": 1e-6})
+    db.save()
+    assert "chunk_size" in explicit_knobs(cfg)
+    resolved, decisions = resolve_fit_config_ex(cfg, data, 3)
+    assert resolved.chunk_size == 12345
+    assert resolved.autotune == "off"  # sub-fits must not re-resolve
+    assert "chunk_size" not in {d["knob"] for d in decisions}
+
+
+def test_resolver_prefers_db_row_over_static(tmp_path, rng):
+    data, _ = make_blobs(rng, n=500, d=4, k=3, dtype=np.float32)
+    dbp = str(tmp_path / "t.json")
+    db = TuningDB(dbp)
+    cfg = GMMConfig(autotune="db", tuning_db=dbp, min_iters=2,
+                    max_iters=2)
+    key = _platform_key(cfg, data.shape[0], data.shape[1], 3)
+    db.record(key, "chunk_size", 256, {"wall_per_iter_s": 1e-6})
+    db.save()
+    resolved, decisions = resolve_fit_config_ex(cfg, data, 3)
+    assert resolved.chunk_size == 256
+    by_knob = {d["knob"]: d for d in decisions}
+    assert by_knob["chunk_size"]["source"] == "db"
+    assert by_knob["chunk_size"]["predicted_s"] == pytest.approx(1e-6)
+    # knobs with no row fall down the ladder to the static model
+    assert by_knob["estep_backend"]["source"] == "static"
+    assert by_knob["estep_backend"]["chosen"] == "jnp"
+
+
+def test_resolver_corrupt_db_row_falls_back_to_static(tmp_path, rng):
+    data, _ = make_blobs(rng, n=500, d=4, k=3, dtype=np.float32)
+    dbp = str(tmp_path / "t.json")
+    db = TuningDB(dbp)
+    cfg = GMMConfig(autotune="db", tuning_db=dbp, min_iters=2,
+                    max_iters=2)
+    key = _platform_key(cfg, data.shape[0], data.shape[1], 3)
+    db.record(key, "chunk_size", "not-a-number", {"wall_per_iter_s": 0.1})
+    db.save()
+    _, decisions = resolve_fit_config_ex(cfg, data, 3)
+    by_knob = {d["knob"]: d for d in decisions}
+    assert by_knob["chunk_size"]["source"] == "static"
+
+
+# ---------------------------------------------------------------- probe
+
+
+def _fake_clock(walls):
+    """A deterministic _time_fit: wall keyed by the candidate value the
+    probe wrote into the config."""
+
+    def fake(config, data, num_clusters):
+        w = walls[config.chunk_size]
+        return w + 0.5, w  # first call pays a fixed fake compile
+
+    return fake
+
+
+def test_probe_determinism_fixed_candidate_order(tmp_path, monkeypatch,
+                                                 rng):
+    data, _ = make_blobs(rng, n=5000, d=4, k=3, dtype=np.float32)
+    walls = {1024: 0.04, 2048: 0.03, 4096: 0.01, 8192: 0.01}
+    monkeypatch.setattr(probe_mod, "_time_fit", _fake_clock(walls))
+    rows = []
+    for i in range(2):
+        db = TuningDB(str(tmp_path / f"t{i}.json"))
+        key = _key(n=5000, d=4, k=3)
+        slot = probe_knob(GMMConfig(), data, 3, key, db, "chunk_size",
+                          iters=2, full_ladder=True)
+        rows.append(slot)
+    # identical ranking both runs, tie (4096 vs 8192) broken small
+    assert rows[0]["chosen"] == rows[1]["chosen"] == "4096"
+    assert list(rows[0]["candidates"]) == list(rows[1]["candidates"])
+    prof = rows[0]["candidates"]["4096"]
+    assert prof["wall_per_iter_s"] == pytest.approx(0.01 / 2)
+    assert prof["compile_s"] == pytest.approx(0.5)
+    assert prof["probe_iters"] == 2 and prof["flops"] > 0
+
+
+def test_probe_skips_single_candidate_knobs(tmp_path, rng):
+    """estep_backend off-TPU admits only jnp: the probe must answer None
+    (static is free) instead of timing a foregone conclusion."""
+    data, _ = make_blobs(rng, n=500, d=4, k=3, dtype=np.float32)
+    db = TuningDB(str(tmp_path / "t.json"))
+    got = probe_knob(GMMConfig(), data, 3, _key(n=500, d=4, k=3), db,
+                     "estep_backend", iters=1)
+    assert got is None and db.entries == {}
+
+
+def test_probe_mode_records_rows_and_reuses_them(tmp_path, monkeypatch,
+                                                 rng):
+    """autotune='probe' measures missing rows once, persists them, and a
+    second resolution reads the row back as a db hit.
+
+    N=40000 so the bounded in-fit ladder (+/- 2 octaves around the
+    incumbent 65536, clamped to the data) holds several candidates."""
+    data, _ = make_blobs(rng, n=40000, d=4, k=3, dtype=np.float32)
+    walls = {16384: 0.03, 32768: 0.01, 65536: 0.02, 131072: 0.04}
+    monkeypatch.setattr(probe_mod, "_time_fit", _fake_clock(walls))
+    dbp = str(tmp_path / "t.json")
+    cfg = GMMConfig(autotune="probe", tuning_db=dbp, min_iters=2,
+                    max_iters=2)
+    resolved, decisions = resolve_fit_config_ex(cfg, data, 3)
+    by_knob = {d["knob"]: d for d in decisions}
+    assert by_knob["chunk_size"]["source"] == "probe"
+    assert resolved.chunk_size == 32768
+    assert os.path.exists(dbp)  # probe rows persist for the next run
+    _, decisions2 = resolve_fit_config_ex(
+        dataclasses.replace(cfg), data, 3)
+    by_knob2 = {d["knob"]: d for d in decisions2}
+    assert by_knob2["chunk_size"]["source"] == "db"
+    assert by_knob2["chunk_size"]["chosen"] == 32768
+
+
+# ----------------------------------------------------- parity matrix
+
+
+def _fit_pair(data, k, base, tmp_path):
+    """(off_result, tuned_result, tuned_config) for one parity leg."""
+    dbp = str(tmp_path / "parity.json")
+    off = fit_gmm(data, k, k, GMMConfig(**base))
+    cfg = GMMConfig(autotune="db", tuning_db=dbp, **base)
+    tuned_cfg, _ = resolve_fit_config_ex(cfg, data, k)
+    tuned = fit_gmm(data, k, k, tuned_cfg)
+    return off, tuned, tuned_cfg
+
+
+def _assert_parity(off, tuned, tuned_cfg, base):
+    """Bit-parity when every resolved knob equals the default; else the
+    documented reduction-order class (float64 rel <= 1e-12)."""
+    d0 = GMMConfig(**base)
+    same_knobs = all(getattr(tuned_cfg, kn) == getattr(d0, kn)
+                     for kn in FIT_KNOBS)
+    if same_knobs:
+        assert tuned.final_loglik == off.final_loglik
+        np.testing.assert_array_equal(np.asarray(tuned.state.means),
+                                      np.asarray(off.state.means))
+    else:
+        assert np.dtype(d0.dtype) == np.float64  # the <=1e-12 claim
+        rel = abs(tuned.final_loglik - off.final_loglik) / abs(
+            off.final_loglik)
+        assert rel <= 1e-12
+        # canonicalize component order: a restart sweep may return the
+        # same mixture with its components permuted
+        def canon(m):
+            m = np.asarray(m)
+            return m[np.lexsort(m.T[::-1])]
+
+        np.testing.assert_allclose(canon(tuned.state.means),
+                                   canon(off.state.means),
+                                   rtol=1e-12, atol=1e-12)
+    assert tuned.ideal_num_clusters == off.ideal_num_clusters
+
+
+def test_parity_plain(rng, tmp_path):
+    data, _ = make_blobs(rng, n=4000, d=5, k=3, dtype=np.float64)
+    base = dict(dtype="float64", min_iters=4, max_iters=4, seed=0)
+    _assert_parity(*_fit_pair(data, 3, base, tmp_path), base)
+
+
+def test_parity_sharded(rng, tmp_path):
+    data, _ = make_blobs(rng, n=4096, d=5, k=3, dtype=np.float64)
+    base = dict(dtype="float64", min_iters=4, max_iters=4, seed=0,
+                mesh_shape=(8, 1))
+    _assert_parity(*_fit_pair(data, 3, base, tmp_path), base)
+
+
+def test_parity_restarts(rng, tmp_path):
+    data, _ = make_blobs(rng, n=2000, d=4, k=3, dtype=np.float64)
+    base = dict(dtype="float64", min_iters=3, max_iters=3, seed=0,
+                n_init=3)
+    _assert_parity(*_fit_pair(data, 3, base, tmp_path), base)
+
+
+def test_parity_serving_blocks_bit_identical(rng, tmp_path):
+    """A tuned serving executor (different min/max block) scores the
+    exact same bits: block geometry is padding, never math."""
+    from cuda_gmm_mpi_tpu import GaussianMixture
+    from cuda_gmm_mpi_tpu.serving.executor import (_shared_executor,
+                                                   executor_for_config)
+
+    data, _ = make_blobs(rng, n=600, d=4, k=3, dtype=np.float64)
+    data = data.astype(np.float32)
+    gm = GaussianMixture(3, target_components=3,
+                         config=GMMConfig(min_iters=4, max_iters=4,
+                                          chunk_size=256))
+    gm.fit(data)
+    state = gm.result_.state
+    X = data[:333]
+
+    dbp = str(tmp_path / "serve.json")
+    db = TuningDB(dbp)
+    skey = _platform_key(GMMConfig(), 65536, 4, 3)
+    db.record(skey, "serve_min_block", 64, {"wall_per_iter_s": 0.01},
+              source="bench")
+    db.record(skey, "serve_max_block", 1024, {"wall_per_iter_s": 0.01},
+              source="bench")
+    db.save()
+    blocks, decisions = resolve_serving_blocks("float32", False, 4, 3,
+                                               tuning_db=dbp)
+    assert blocks == {"min_block": 64, "max_block": 1024}
+    assert {d["source"] for d in decisions} == {"db"}
+
+    ex_default = executor_for_config(gm.config)
+    ex_tuned = _shared_executor("float32", False, "expanded", "highest",
+                                blocks["max_block"], blocks["min_block"])
+    np.testing.assert_array_equal(ex_tuned.score_samples(state, X),
+                                  ex_default.score_samples(state, X))
+
+
+def test_serving_blocks_torn_pair_guard(tmp_path):
+    """min_block > max_block from two stale rows must not build an
+    impossible executor."""
+    dbp = str(tmp_path / "serve.json")
+    db = TuningDB(dbp)
+    skey = _platform_key(GMMConfig(), 65536, 4, 3)
+    db.record(skey, "serve_min_block", 4096, {"wall_per_iter_s": 0.01})
+    db.record(skey, "serve_max_block", 512, {"wall_per_iter_s": 0.01})
+    db.save()
+    blocks, _ = resolve_serving_blocks("float32", False, 4, 3,
+                                       tuning_db=dbp)
+    assert blocks["min_block"] <= blocks["max_block"]
+
+
+def test_autotune_off_emits_no_tune_events(rng, tmp_path):
+    """The default path stays byte-identical: zero tune records."""
+    data, _ = make_blobs(rng, n=500, d=4, k=3, dtype=np.float32)
+    path = str(tmp_path / "m.jsonl")
+    fit_gmm(data, 3, 3, GMMConfig(min_iters=2, max_iters=2,
+                                  metrics_file=path))
+    recs = [json.loads(ln) for ln in open(path)]
+    assert validate_stream(recs) == []
+    assert not any(r["event"] == "tune" for r in recs)
+
+
+def test_autotune_db_emits_schema_valid_tune_events(rng, tmp_path):
+    data, _ = make_blobs(rng, n=500, d=4, k=3, dtype=np.float32)
+    path = str(tmp_path / "m.jsonl")
+    fit_gmm(data, 3, 3, GMMConfig(autotune="db", min_iters=2,
+                                  max_iters=2, metrics_file=path,
+                                  tuning_db=str(tmp_path / "t.json")))
+    recs = [json.loads(ln) for ln in open(path)]
+    assert validate_stream(recs) == []
+    tunes = [r for r in recs if r["event"] == "tune"]
+    assert {t["knob"] for t in tunes} >= {"chunk_size", "estep_backend"}
+    assert all(t["source"] in ("db", "probe", "static") for t in tunes)
+    assert all(t["surface"] == "fit" for t in tunes)
+    summary = recs[-1]
+    assert summary["metrics"]["counters"]["tune_decisions"] == len(tunes)
+
+
+# -------------------------------------------------------- schema drift
+
+
+def test_tune_event_schema_pinned_both_directions():
+    """v2.5 drift test: the declared shape is pinned here, and an
+    emitted record must carry exactly what the schema declares."""
+    required, optional = EVENT_FIELDS["tune"]
+    assert set(required) == {"knob", "chosen", "source"}
+    assert set(optional) == {"candidates", "predicted_s", "key",
+                             "surface", "default", "distance"}
+
+    stream = []
+
+    class Sink:
+        def write(self, line):
+            stream.append(json.loads(line))
+
+        def flush(self):
+            pass
+
+        def close(self):
+            pass
+
+    rec = telemetry.RunRecorder(stream=Sink())
+    with telemetry.use(rec):
+        emit_decisions([{
+            "knob": "chunk_size", "chosen": 8192, "source": "db",
+            "candidates": {"8192": 0.01}, "predicted_s": 0.01,
+            "key": _key().as_str(), "default": 65536,
+        }])
+    tune = [r for r in stream if r["event"] == "tune"]
+    assert len(tune) == 1
+    assert validate_record(tune[0]) == []
+    # ...and a record missing a required field / an undeclared event
+    # kind both fail (the other drift direction -- emitting a kind the
+    # schema never declared -- is covered stream-wide by
+    # test_telemetry.test_every_emitted_event_kind_is_declared_in_schema)
+    bad = dict(tune[0])
+    del bad["source"]
+    assert validate_record(bad)
+    assert validate_record(dict(tune[0], event="tune_v2"))
+
+
+def test_fit_knobs_are_probeable_or_resolvable():
+    assert set(PROBEABLE) <= set(FIT_KNOBS)
+
+
+# ---------------------------------------------- restart auto cap (VMEM)
+
+
+def test_restart_auto_cap_accounts_for_pallas_vmem(monkeypatch):
+    """Satellite: the batched Pallas path's per-lane VMEM blocks bound
+    the restart batch; the jnp path keeps the host-memory-only cap."""
+    from cuda_gmm_mpi_tpu.models.restarts import restart_batch_auto_cap
+
+    jnp_cap = restart_batch_auto_cap(GMMConfig(), 20000, 32, 64)
+    # a 1 MiB VMEM budget binds hard at D=32, K=64 full covariance
+    monkeypatch.setenv("GMM_RESTART_VMEM_BYTES", str(1 << 20))
+    pal_cap = restart_batch_auto_cap(
+        GMMConfig(estep_backend="pallas"), 20000, 32, 64)
+    assert 1 <= pal_cap < jnp_cap
+    # per-lane bytes: f32 * (2*F*K + 2*D*K + 2*K + 2), F = D*D
+    per_lane = 4 * (2 * 32 * 32 * 64 + 2 * 32 * 64 + 2 * 64 + 2)
+    tile = 4 * GMMConfig().pallas_block_b * (32 + 1)
+    assert pal_cap == max(1, ((1 << 20) - tile) // per_lane)
+    # diag covariance shrinks F from D^2 to D: a larger cap fits
+    diag_cap = restart_batch_auto_cap(
+        GMMConfig(estep_backend="pallas", covariance_type="diag"),
+        20000, 32, 64)
+    assert diag_cap > pal_cap
+
+
+# -------------------------------------------------------- diff gate
+
+
+def test_diff_tune_regression_metric():
+    """`gmm diff`'s default gate input: a measured wall/iter >20% over a
+    db/probe prediction counts; static predictions and within-tolerance
+    measurements never do; tune-free streams carry no tune.* metrics at
+    all (the gate self-skips)."""
+    from cuda_gmm_mpi_tpu.telemetry.diff import (DEFAULT_FAIL_ON,
+                                                 summarize_run)
+
+    assert "tune.regressions>0" in DEFAULT_FAIL_ON
+
+    def stream(pred, source):
+        return [
+            {"event": "run_start", "run_id": "r", "path": "in-memory"},
+            {"event": "tune", "knob": "chunk_size", "chosen": 2048,
+             "source": source, "predicted_s": pred},
+            # measured wall/iter = 10 / 10 = 1.0 s
+            {"event": "run_summary", "wall_s": 10.0, "total_iters": 10},
+        ]
+
+    m = summarize_run(stream(0.5, "db"))["metrics"]     # 1.0 > 1.2*0.5
+    assert m["tune.decisions"] == 1.0
+    assert m["tune.regressions"] == 1.0
+    m = summarize_run(stream(0.9, "db"))["metrics"]     # within 20%
+    assert m["tune.regressions"] == 0.0
+    m = summarize_run(stream(0.5, "static"))["metrics"]  # never gated
+    assert m["tune.regressions"] == 0.0
+    m = summarize_run(stream(0.5, "db")[:1] + stream(0.5, "db")[2:])
+    assert "tune.regressions" not in m["metrics"]
