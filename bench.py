@@ -63,6 +63,21 @@ drift-on/drift-off serve wall ratio on identical warmed traffic
 own 'proba' block, so ~1.0 is the expectation). Size knobs:
 GMM_BENCH_DRIFT_{N,D,K,REQUESTS} (run_drift_bench).
 
+Lifecycle mode (``--lifecycle`` or GMM_BENCH_LIFECYCLE=1): rev v2.6
+closed-loop contract -- fit + export a model, serve it with the drift
+plane AND a LifecycleController bound, then drive the whole loop in
+ONE record: injected drift traffic (alarm) -> shadow minibatch-EM
+retrain -> canary gates + duplicate-dispatch shadow window -> atomic
+promotion -> injected post-promotion score regression -> automatic
+rollback, with per-phase walls, the canary gate values (PSI/KS/mean
+regression vs tolerance), and the ``rollback_restored_bit_identical``
+proof bit (restored npz leaves AND a fixed probe's scores match the
+pre-promotion server exactly). ``vs_baseline`` is the lifecycle-on /
+lifecycle-off steady-serve wall ratio on identical warmed traffic
+(the controller rides the tick loop, so ~1.0 is the expectation).
+Size knobs: GMM_BENCH_LIFECYCLE_{N,D,K,REQUESTS}
+(run_lifecycle_bench).
+
 Tenancy mode (``--tenancy`` or GMM_BENCH_TENANCY=1): batched-fleet-vs-
 sequential multi-tenant A/B -- T independent per-tenant datasets fitted
 once through ``fit_fleet`` (packed groups, one fleet EM dispatch per
@@ -1302,6 +1317,225 @@ def run_drift_bench(platform: str, accel_unavailable: bool) -> dict:
     return result
 
 
+def run_lifecycle_bench(platform: str, accel_unavailable: bool) -> dict:
+    """The --lifecycle mode: rev v2.6 closed-loop lifecycle contract.
+
+    One record drives the entire loop end to end against an in-process
+    server with the drift plane and a bound LifecycleController:
+
+    * injected drift traffic raises the alarm and schedules a retrain;
+    * the shadow minibatch-EM refit publishes an invisible candidate,
+      the canary gates (PSI/KS/mean-regression on the holdout slice)
+      pass, and the duplicate-dispatch shadow window scores live ticks
+      under BOTH versions with zero client-visible change;
+    * promotion flips the candidate live atomically via the existing
+      hot-reload path;
+    * injected post-promotion traffic from a worse distribution trips
+      the watch score gate and rolls back to the pinned prior version,
+      quarantining the bad candidate;
+    * ``rollback_restored_bit_identical``: every npz leaf of the
+      restored version equals the pre-promotion version's, AND a fixed
+      probe request scores byte-identically against the rolled-back
+      server vs the pre-promotion server.
+
+    ``vs_baseline`` is the lifecycle-on / lifecycle-off steady-serve
+    wall ratio on identical warmed traffic (idle controller): the
+    controller rides the tick loop, so ~1.0 is the design point.
+
+    Size knobs: GMM_BENCH_LIFECYCLE_{N,D,K,REQUESTS}.
+    """
+    on_accel = platform not in ("cpu",)
+    k = int(os.environ.get("GMM_BENCH_LIFECYCLE_K")
+            or (16 if on_accel else 4))
+    n = int(os.environ.get("GMM_BENCH_LIFECYCLE_N")
+            or (100_000 if on_accel else 4_000))
+    d = int(os.environ.get("GMM_BENCH_LIFECYCLE_D")
+            or (8 if on_accel else 4))
+    n_requests = int(os.environ.get("GMM_BENCH_LIFECYCLE_REQUESTS") or 40)
+
+    import tempfile
+
+    from cuda_gmm_mpi_tpu import telemetry
+    from cuda_gmm_mpi_tpu.config import GMMConfig
+    from cuda_gmm_mpi_tpu.estimator import GaussianMixture
+    from cuda_gmm_mpi_tpu.lifecycle import (LifecycleController,
+                                            LifecyclePolicy)
+    from cuda_gmm_mpi_tpu.serving import GMMServer, ModelRegistry
+
+    rng = np.random.default_rng(42)
+    centers = rng.normal(scale=8.0, size=(k, d))
+    data = (centers[rng.integers(0, k, n)]
+            + rng.normal(scale=1.0, size=(n, d))).astype(np.float32)
+    gm = GaussianMixture(
+        k, target_components=k,
+        config=GMMConfig(min_iters=5, max_iters=5,
+                         chunk_size=min(65536, n)))
+    gm.fit(data)
+
+    def traffic(server, shift, requests, rows=40, start=0):
+        t0 = time.perf_counter()
+        for i in range(requests):
+            lo = ((start + i) * 17) % (n - rows)
+            x = (data[lo:lo + rows] + np.float32(shift)).tolist()
+            resp = server.handle_requests(
+                [{"id": int(i), "model": "bench",
+                  "op": "score_samples", "x": x}])[0]
+            assert resp["ok"], resp
+        return time.perf_counter() - t0
+
+    probe_x = data[:64].tolist()
+
+    def probe(server):
+        resp = server.handle_requests(
+            [{"id": 0, "model": "bench", "op": "score_samples",
+              "x": probe_x}])[0]
+        assert resp["ok"], resp
+        return resp["result"]
+
+    stream = []
+
+    class _Sink:
+        def write(self, line):
+            stream.append(json.loads(line))
+
+        def flush(self):
+            pass
+
+    policy = LifecyclePolicy({
+        "debounce_alarms": 1,
+        "cooldown_s": 600.0,
+        "holdout_rows": 256,
+        "retrain": {"steps": 4, "min_rows": 64,
+                    "chunk_size": min(4096, n)},
+        # A drift-adapting candidate legitimately scores the drifted
+        # holdout very differently from the incumbent -- the bench
+        # widens the distribution gates and keeps the regression gate.
+        "canary": {"max_psi": 100.0, "max_ks": 1.0, "shadow_ticks": 2},
+        "watch": {"probation_ticks": 64, "probation_s": 600.0,
+                  "min_rows": 32},
+    })
+
+    with tempfile.TemporaryDirectory() as root:
+        registry = ModelRegistry(root)
+        gm.to_registry(registry, "bench")
+
+        # Lifecycle-off baseline on identical warmed traffic.
+        server_off = GMMServer(registry, warm=False,
+                               drift_interval_s=3600.0,
+                               drift_psi_threshold=0.2)
+        traffic(server_off, 0.0, 4)  # warm
+        wall_off = traffic(server_off, 0.0, n_requests)
+
+        ctl = LifecycleController(registry, policy)
+        server = GMMServer(registry, warm=False,
+                           drift_interval_s=3600.0,
+                           drift_psi_threshold=0.2, lifecycle=ctl)
+        traffic(server, 0.0, 4)  # warm
+        wall_on = traffic(server, 0.0, n_requests)  # controller idle
+        server.flush_drift()  # discard the in-distribution window
+
+        rec = telemetry.RunRecorder(stream=_Sink())
+        with telemetry.use(rec), rec:
+            probe_before = probe(server)  # pre-promotion scoring pin
+
+            # Phase 1: injected drift -> alarm -> retrain scheduled.
+            t0 = time.perf_counter()
+            traffic(server, 6.0, n_requests)
+            drift_rows = server.flush_drift()
+            wall_drift = time.perf_counter() - t0
+            alarm_fired = bool(drift_rows and drift_rows[0]["alarm"])
+
+            # Phase 2: shadow refit + candidate publish + canary gates.
+            t0 = time.perf_counter()
+            ctl.on_tick()
+            wall_retrain = time.perf_counter() - t0
+
+            # Phase 3: duplicate-dispatch shadow window, then the tick
+            # that closes the canary and promotes.
+            t0 = time.perf_counter()
+            traffic(server, 6.0, max(2, policy.canary["shadow_ticks"]),
+                    start=1000)
+            ctl.on_tick()
+            wall_canary = time.perf_counter() - t0
+            promoted_version = server.resolve("bench").version
+
+            # Phase 4: injected post-promotion regression (traffic from
+            # a far-worse distribution) -> watch violation -> rollback.
+            t0 = time.perf_counter()
+            traffic(server, 40.0, 4, start=2000)
+            ctl.on_tick()
+            wall_rollback = time.perf_counter() - t0
+
+            probe_after = probe(server)  # post-rollback scoring
+
+        counts = dict(ctl.counts)
+        live = registry.versions("bench")
+        restored_version = live[-1] if live else None
+        prior = registry.load("bench", 1)
+        restored = registry.load("bench", int(restored_version))
+        leaves_equal = all(
+            np.array_equal(np.asarray(getattr(prior.state, f)),
+                           np.asarray(getattr(restored.state, f)))
+            for f in ("means", "pi", "R", "Rinv", "N", "active",
+                      "avgvar", "constant")
+        ) and np.array_equal(np.asarray(prior.data_shift),
+                             np.asarray(restored.data_shift))
+        bit_identical = bool(leaves_equal and probe_before == probe_after)
+
+    lc = [e for e in stream if e.get("event") == "lifecycle"]
+    canary_pass = next((e for e in lc if e["phase"] == "canary"
+                        and e.get("outcome") == "pass"), {})
+    rollbacks = [e for e in lc if e["phase"] == "rollback"]
+    overhead = wall_on / max(wall_off, 1e-9)
+    closed_loop = bool(
+        alarm_fired and counts["retrains"] == 1
+        and counts["promotes"] == 1 and counts["rollbacks"] == 1
+        and counts["quarantines"] == 1 and bit_identical)
+    result = {
+        "metric": f"closed-loop lifecycle serve overhead (K={k}, D={d}, "
+                  f"{platform})",
+        "value": round(overhead, 4),
+        "unit": "x",
+        # Lifecycle-on / lifecycle-off steady serve wall on identical
+        # warmed traffic: the controller rides the tick loop, ~1.0.
+        "vs_baseline": round(overhead, 4),
+        "accelerator_unavailable": accel_unavailable,
+        "lifecycle": {
+            "train_n": n, "d": d, "k": k, "requests": n_requests,
+            "alarm_fired": alarm_fired,
+            "phases": {
+                "drift_detect_s": round(wall_drift, 4),
+                "retrain_s": round(wall_retrain, 4),
+                "canary_promote_s": round(wall_canary, 4),
+                "rollback_s": round(wall_rollback, 4),
+            },
+            "gates": {kk: canary_pass.get(kk)
+                      for kk in ("psi", "ks", "mean_incumbent",
+                                 "mean_candidate", "regression",
+                                 "tolerance", "shadow_rows",
+                                 "shadow_ticks")},
+            "promoted_version": int(promoted_version),
+            "rollback_reason": (rollbacks[-1].get("reason")
+                                if rollbacks else None),
+            "restored_version": (int(restored_version)
+                                 if restored_version else None),
+            "live_versions": [int(v) for v in live],
+            "rollback_restored_bit_identical": bit_identical,
+            "counts": counts,
+            "wall_off_s": round(wall_off, 4),
+            "wall_on_s": round(wall_on, 4),
+            "overhead": round(overhead, 4),
+            "closed_loop": closed_loop,
+        },
+        "measured_utc": time.strftime("%Y-%m-%dT%H:%M:%SZ", time.gmtime()),
+    }
+    if accel_unavailable:
+        result["platform_note"] = (
+            "accelerator tunnel unavailable (probe failed); this is a "
+            "CPU-fallback measurement of the lifecycle loop")
+    return result
+
+
 def run_timeline_bench(platform: str, accel_unavailable: bool) -> dict:
     """The --timeline mode: rev v2.3 Perfetto trace-export contract.
 
@@ -1903,6 +2137,8 @@ def main() -> int:
                   or os.environ.get("GMM_BENCH_SERVE") == "1")
     want_drift = ("--drift" in sys.argv[1:]
                   or os.environ.get("GMM_BENCH_DRIFT") == "1")
+    want_lifecycle = ("--lifecycle" in sys.argv[1:]
+                      or os.environ.get("GMM_BENCH_LIFECYCLE") == "1")
     want_tenancy = ("--tenancy" in sys.argv[1:]
                     or os.environ.get("GMM_BENCH_TENANCY") == "1")
     want_ingest = ("--ingest" in sys.argv[1:]
@@ -2032,6 +2268,15 @@ def main() -> int:
         # shifted traffic through the drift plane (ignores --config;
         # sized by GMM_BENCH_DRIFT_*).
         result = run_drift_bench(platform, accel_unavailable)
+        watchdog.cancel()
+        print(json.dumps(result))
+        return 3 if accel_unavailable else 0
+
+    if want_lifecycle:
+        # Closed-loop lifecycle contract: injected drift -> retrain ->
+        # canary -> promote -> injected regression -> rollback (ignores
+        # --config; sized by GMM_BENCH_LIFECYCLE_*).
+        result = run_lifecycle_bench(platform, accel_unavailable)
         watchdog.cancel()
         print(json.dumps(result))
         return 3 if accel_unavailable else 0

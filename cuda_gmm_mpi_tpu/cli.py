@@ -370,6 +370,14 @@ def main(argv=None) -> int:
         from .telemetry.drift import drift_main
 
         return drift_main(argv[1:])
+    if argv and argv[0] == "lifecycle":
+        # `gmm lifecycle STREAM`: drive the drift->retrain->canary->
+        # promote loop offline from a recorded serve stream against a
+        # registry (docs/ROBUSTNESS.md "Model lifecycle"); the live
+        # in-serve form is `gmm serve --lifecycle policy.json`.
+        from .lifecycle.cli import lifecycle_main
+
+        return lifecycle_main(argv[1:])
     if argv and argv[0] == "timeline":
         # `gmm timeline RUN [RUN ...]`: export recorded streams (file,
         # per-rank directory, fit + serve together) as ONE Chrome

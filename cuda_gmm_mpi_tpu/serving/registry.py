@@ -12,8 +12,10 @@ bit-identically to the in-memory estimator it came from.
 
 Layout (``<root>`` is the registry directory)::
 
-    <root>/<name>/<version>/model.npz      # state leaves + data_shift
-    <root>/<name>/<version>/manifest.json  # identity card (below)
+    <root>/<name>/<version>/model.npz       # state leaves + data_shift
+    <root>/<name>/<version>/manifest.json   # identity card (below)
+    <root>/<name>/<version>/stage.candidate # marker: NOT live (lifecycle)
+    <root>/<name>/<version>/quarantine.json # marker: rolled back / rejected
 
 Versions are positive integers assigned monotonically per name;
 ``load(name)`` resolves the newest READABLE version (the checkpoint
@@ -28,6 +30,22 @@ without opening the npz: K (active clusters), D, covariance_type, dtype,
 the training run id, the final loglik, and -- for sweep-checkpoint
 exports -- the model-order criterion and best score, so "which K won and
 under which score" survives into serving (``gmm export``).
+
+Staged versions (lifecycle, rev v2.6): a version saved with
+``stage='candidate'`` carries a ``stage: candidate`` manifest stanza AND
+a ``stage.candidate`` marker file, written BEFORE the npz so the version
+is never transiently visible. Enumeration (:meth:`versions`,
+:meth:`models`), the hot-reload poll (:meth:`latest_fingerprint` /
+:meth:`poll`), and default :meth:`load` all skip marked versions --
+candidates are invisible to every pre-lifecycle consumer -- while an
+explicitly versioned ``load(name, v)`` still opens them (the canary
+scorer's path). :meth:`promote` flips the stanza to ``stage: live``
+first, then removes the marker: the marker is authoritative for
+visibility, so a crash between the two steps (``promote_torn``) leaves
+the candidate invisible and the flip retryable. :meth:`quarantine`
+re-adds the marker plus a ``quarantine.json`` reason file;
+:meth:`rollback` re-publishes a pinned prior version's exact leaves as
+the newest live version (bit-identical scoring by the npz round-trip).
 """
 
 from __future__ import annotations
@@ -54,6 +72,14 @@ MANIFEST_FILE = "manifest.json"
 # drift --rebuild-envelope` can backfill it atomically without touching
 # model.npz/manifest.json bit-identity.
 ENVELOPE_FILE = "envelope.json"
+# Lifecycle staging markers (rev v2.6). CANDIDATE_MARKER's PRESENCE is
+# what enumeration skips -- a pure stat() check, so the hot-reload
+# poll's "polling every few seconds is free" contract survives staging.
+# QUARANTINE_FILE records WHY a version was pulled (rollback reason,
+# failed canary gates); a quarantined version keeps the candidate
+# marker so it can never be promoted or served again.
+CANDIDATE_MARKER = "stage.candidate"
+QUARANTINE_FILE = "quarantine.json"
 MANIFEST_SCHEMA = 1
 
 _NAME_RE = re.compile(r"^[A-Za-z0-9][A-Za-z0-9._-]*$")
@@ -123,21 +149,42 @@ class ModelRegistry:
     # -- enumeration -----------------------------------------------------
 
     def models(self) -> List[str]:
-        """Registered model names (sorted)."""
+        """Registered model names (sorted).
+
+        Names whose only versions are candidates are NOT listed --
+        un-promoted lifecycle output is invisible here just as it is to
+        the poll. A registry root deleted out from under a live server
+        degrades to an empty listing (the tick loop's ``maybe_reload``
+        must keep serving prepared state, not crash on a stat race).
+        """
+        try:
+            entries = sorted(os.listdir(self._root))
+        except OSError:
+            return []
         out = []
-        for name in sorted(os.listdir(self._root)):
+        for name in entries:
             if _NAME_RE.match(name) and self.versions(name):
                 out.append(name)
         return out
 
-    def versions(self, name: str) -> List[int]:
-        """Existing versions of ``name`` (ascending; [] when unknown)."""
+    def versions(self, name: str,
+                 include_candidates: bool = False) -> List[int]:
+        """Existing LIVE versions of ``name`` (ascending; [] when
+        unknown). ``include_candidates=True`` adds versions still
+        carrying the ``stage.candidate`` marker (lifecycle canaries and
+        quarantined versions)."""
         d = os.path.join(self._root, self._check_name(name))
-        if not os.path.isdir(d):
+        try:
+            entries = os.listdir(d)
+        except OSError:
             return []
-        return sorted(int(v) for v in os.listdir(d)
-                      if v.isdigit() and os.path.isfile(
-                          os.path.join(d, v, MODEL_FILE)))
+        return sorted(
+            int(v) for v in entries
+            if v.isdigit()
+            and os.path.isfile(os.path.join(d, v, MODEL_FILE))
+            and (include_candidates
+                 or not os.path.exists(os.path.join(d, v,
+                                                    CANDIDATE_MARKER))))
 
     def _check_name(self, name: str) -> str:
         if not _NAME_RE.match(name or ""):
@@ -197,6 +244,7 @@ class ModelRegistry:
              run_id: Optional[str] = None,
              version: Optional[int] = None,
              source: str = "fit",
+             stage: Optional[str] = None,
              extra: Optional[Dict[str, Any]] = None) -> int:
         """Persist a fitted :class:`GMMResult` as ``name``'s next version.
 
@@ -205,8 +253,13 @@ class ModelRegistry:
         dtype is read off the state itself. Returns the version number.
         The write is atomic (npz first, manifest last): a version whose
         manifest exists is complete, and a crash mid-save leaves only an
-        ignorable orphan.
+        ignorable orphan. ``stage='candidate'`` publishes a lifecycle
+        canary: invisible to enumeration/poll/default-load until
+        :meth:`promote` flips it live.
         """
+        if stage not in (None, "live", "candidate"):
+            raise RegistryError(
+                f"unknown stage {stage!r} (live or candidate)")
         state = result.state
         k = int(result.ideal_num_clusters)
         d = int(result.num_dimensions) or int(state.num_dimensions)
@@ -237,6 +290,8 @@ class ModelRegistry:
         }
         if extra:
             manifest.update(extra)
+        if stage == "candidate":
+            manifest["stage"] = "candidate"
         envelope = getattr(result, "envelope", None)
         if envelope is not None:
             # Small identity stanza only; the full envelope rides its
@@ -247,14 +302,17 @@ class ModelRegistry:
         return self._write_version(name, version, state,
                                    np.asarray(result.data_shift,
                                               np.float64), manifest,
-                                   envelope=envelope)
+                                   envelope=envelope, stage=stage)
 
     def _write_version(self, name: str, version: Optional[int],
                        state: GMMState, data_shift: np.ndarray,
                        manifest: Dict[str, Any],
-                       envelope: Optional[Dict[str, Any]] = None) -> int:
+                       envelope: Optional[Dict[str, Any]] = None,
+                       stage: Optional[str] = None) -> int:
         name = self._check_name(name)
-        existing = self.versions(name)
+        # Candidates occupy version numbers too -- a promotion must not
+        # collide with a version assigned while it was invisible.
+        existing = self.versions(name, include_candidates=True)
         if version is None:
             version = (existing[-1] + 1) if existing else 1
         elif version in existing:
@@ -266,6 +324,14 @@ class ModelRegistry:
         manifest = dict(manifest, version=int(version))
         vdir = os.path.join(self._root, name, str(version))
         os.makedirs(vdir, exist_ok=True)
+        if stage == "candidate":
+            # Marker FIRST: the version directory must never be visible
+            # to enumeration between the npz landing and the stage
+            # becoming known. versions() requires MODEL_FILE, so an
+            # orphan marker alone hides nothing it shouldn't.
+            with open(os.path.join(vdir, CANDIDATE_MARKER), "w",
+                      encoding="utf-8") as f:
+                f.write("candidate\n")
         import jax
 
         host_state = jax.device_get(state)
@@ -298,18 +364,26 @@ class ModelRegistry:
         ``utils/checkpoint.py`` restore semantics -- losing one version
         beats wedging the server) and raises an aggregated
         :class:`RegistryError` only when every version is unreadable.
+        Each walk-back step also emits a counted ``registry_torn``
+        telemetry event (rev v2.6) -- a silent walk-back is exactly what
+        a botched promotion looks like, so it must show up in
+        ``gmm report``/``/metrics`` (``gmm_registry_torn_total``).
+
+        Default resolution sees LIVE versions only; an explicit
+        ``version`` may name a candidate (the canary scorer's path).
         """
+        if version is not None:
+            if version not in self.versions(name,
+                                            include_candidates=True):
+                raise RegistryError(
+                    f"{name!r} has no version {version} "
+                    f"(existing: {self.versions(name)})")
+            return self._load_version(name, int(version))
         versions = self.versions(name)
         if not versions:
             raise RegistryError(
                 f"unknown model {name!r} in registry {self._root!r} "
                 f"(registered: {', '.join(self.models()) or 'none'})")
-        if version is not None:
-            if version not in versions:
-                raise RegistryError(
-                    f"{name!r} has no version {version} "
-                    f"(existing: {versions})")
-            return self._load_version(name, int(version))
         failures: List[Tuple[int, BaseException]] = []
         for v in reversed(versions):
             try:
@@ -320,6 +394,13 @@ class ModelRegistry:
                     f"registry model {name!r} version {v} unreadable "
                     f"({type(e).__name__}: {e}); falling back to the "
                     "previous version", RuntimeWarning)
+                from .. import telemetry
+
+                rec = telemetry.current()
+                if rec.active:
+                    rec.emit("registry_torn", model=name, version=int(v),
+                             error=f"{type(e).__name__}: {e}")
+                    rec.metrics.count("registry_torn")
         raise RegistryError(
             f"every version of {name!r} is unreadable: "
             + "; ".join(f"v{v}: {type(e).__name__}: {e}"
@@ -402,6 +483,120 @@ class ModelRegistry:
                 f"(existing: {self.versions(name)})")
         vdir = os.path.join(self._root, name, str(int(version)))
         _write_json_atomic(os.path.join(vdir, ENVELOPE_FILE), envelope)
+
+    # -- lifecycle staging (rev v2.6) ------------------------------------
+
+    def stage(self, name: str, version: int) -> str:
+        """``'live'``, ``'candidate'``, or ``'quarantined'`` for an
+        existing version (marker-file semantics; pure stat()s)."""
+        vdir = os.path.join(self._root, self._check_name(name),
+                            str(int(version)))
+        if not os.path.isfile(os.path.join(vdir, MODEL_FILE)):
+            raise RegistryError(
+                f"{name!r} has no version {version} "
+                f"(existing: {self.versions(name, include_candidates=True)})")
+        if os.path.exists(os.path.join(vdir, QUARANTINE_FILE)):
+            return "quarantined"
+        if os.path.exists(os.path.join(vdir, CANDIDATE_MARKER)):
+            return "candidate"
+        return "live"
+
+    def promote(self, name: str, version: int) -> None:
+        """Atomically flip a candidate version live.
+
+        Protocol: (1) rewrite the manifest with ``stage: live`` (tmp +
+        fsync + rename -- this changes the manifest's mtime_ns:size, so
+        once visible the version reads as NEW to every poll snapshot);
+        (2) remove the candidate marker. The marker is authoritative for
+        enumeration, so a crash between the steps -- the ``promote_torn``
+        fault point -- leaves the candidate invisible and the promotion
+        retryable; it can never publish a half-flipped version. The
+        existing hot-reload path (``maybe_reload``) then does the actual
+        route swap; breaker state deliberately carries over.
+        """
+        st = self.stage(name, version)
+        if st == "quarantined":
+            raise RegistryError(
+                f"{name!r} v{version} is quarantined; it can never be "
+                "promoted (see its quarantine.json)")
+        if st == "live":
+            raise RegistryError(f"{name!r} v{version} is already live")
+        vdir = os.path.join(self._root, name, str(int(version)))
+        man_path = os.path.join(vdir, MANIFEST_FILE)
+        try:
+            with open(man_path, encoding="utf-8") as f:
+                manifest = json.load(f)
+        except (OSError, ValueError) as e:
+            raise RegistryError(
+                f"{name!r} v{version}: unreadable manifest: {e}") from e
+        manifest["stage"] = "live"
+        manifest["promoted_utc"] = time.strftime(
+            "%Y-%m-%dT%H:%M:%SZ", time.gmtime())
+        _write_json_atomic(man_path, manifest)
+        from ..testing import faults
+
+        if faults.take("promote_torn", name=name,
+                       version=version) is not None:
+            # Crash between the manifest flip and the marker removal:
+            # the candidate stays invisible, the flip stays retryable.
+            raise RegistryError(
+                f"{name!r} v{version}: injected promote_torn fault "
+                "(manifest flipped, marker still present)")
+        os.remove(os.path.join(vdir, CANDIDATE_MARKER))
+
+    def quarantine(self, name: str, version: int,
+                   reason: Optional[Dict[str, Any]] = None) -> None:
+        """Pull a version permanently: write a ``quarantine.json``
+        reason file and (re)add the candidate marker so enumeration,
+        the poll, and default load all skip it. Idempotent; works on
+        candidates (failed canary) and on live versions (rollback of a
+        bad promotion)."""
+        vdir = os.path.join(self._root, self._check_name(name),
+                            str(int(version)))
+        if not os.path.isfile(os.path.join(vdir, MODEL_FILE)):
+            raise RegistryError(
+                f"{name!r} has no version {version} "
+                f"(existing: {self.versions(name, include_candidates=True)})")
+        marker = os.path.join(vdir, CANDIDATE_MARKER)
+        if not os.path.exists(marker):
+            with open(marker, "w", encoding="utf-8") as f:
+                f.write("quarantined\n")
+        _write_json_atomic(
+            os.path.join(vdir, QUARANTINE_FILE),
+            dict(reason or {}, name=name, version=int(version),
+                 quarantined_utc=time.strftime("%Y-%m-%dT%H:%M:%SZ",
+                                               time.gmtime())))
+
+    def rollback(self, name: str, *, to_version: int,
+                 bad_version: Optional[int] = None,
+                 reason: Optional[Dict[str, Any]] = None) -> int:
+        """Restore a pinned prior version as the NEWEST live version.
+
+        Versions are immutable, so rollback RE-PUBLISHES ``to_version``'s
+        exact leaves under a fresh version number (the npz round-trip is
+        bit-exact, so the restored model scores bit-identically to the
+        pinned one); ``bad_version`` (the promotion being undone) is
+        quarantined with ``reason``. Returns the new version number --
+        the next poll sees it as newest and the hot-reload path swaps
+        the route back.
+        """
+        src = self._load_version(self._check_name(name), int(to_version))
+        manifest = {k: v for k, v in src.manifest.items()
+                    if k not in ("version", "stage", "promoted_utc")}
+        manifest.update(
+            source="rollback",
+            restored_version=int(to_version),
+            rollback_of=(int(bad_version) if bad_version is not None
+                         else None))
+        new_v = self._write_version(name, None, src.state,
+                                    src.data_shift, manifest,
+                                    envelope=src.envelope)
+        if bad_version is not None:
+            self.quarantine(name, int(bad_version),
+                            dict(reason or {},
+                                 restored_as=int(new_v),
+                                 restored_version=int(to_version)))
+        return int(new_v)
 
     def _validate(self, name, version, manifest, state: GMMState) -> None:
         """The loud manifest-vs-arrays contract: serving a model whose

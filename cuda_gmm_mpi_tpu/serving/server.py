@@ -133,7 +133,8 @@ class GMMServer:
                  drift_interval_s: Optional[float] = None,
                  drift_psi_threshold: Optional[float] = 0.2,
                  autotune: str = "off",
-                 tuning_db: Optional[str] = None):
+                 tuning_db: Optional[str] = None,
+                 lifecycle=None):
         if autotune not in ("off", "db"):
             raise ValueError(
                 f"serving autotune must be 'off' or 'db', got {autotune!r}"
@@ -213,6 +214,16 @@ class GMMServer:
             if drift_psi_threshold is not None else None)
         # (name, actual version) -> {"sketch", "occ", "env", "version"}
         self._drift_windows: Dict[Tuple[str, int], dict] = {}
+        # Closed-loop lifecycle (rev v2.6; --lifecycle policy.json,
+        # lifecycle/controller.py, docs/ROBUSTNESS.md "Model
+        # lifecycle"): drift alarms feed its debounce, answered
+        # dispatches feed its spool / canary shadow window / watch
+        # gate, and run_loop ticks its state machine between coalesced
+        # dispatches -- all on the tick-loop thread. None (the default)
+        # keeps responses, streams, and /metrics byte-identical.
+        self._lifecycle = lifecycle
+        if lifecycle is not None:
+            lifecycle.bind(self)
         self._drift_last: Dict[str, dict] = {}  # "name@v" -> last stats
         self.drift_events = 0
         self.drift_alarms = 0
@@ -688,6 +699,12 @@ class GMMServer:
         self.breaker.record_success((name, version))
         if self._drift_interval_s is not None:
             self._drift_observe(name, m, w, logz)
+        if self._lifecycle is not None and version is None:
+            # Lifecycle feed (rev v2.6): spools request rows and -- in
+            # a canary/watch window -- shadow-scores THIS block under
+            # the candidate. Replies are already computed from (w,
+            # logz) slices; the hook reads, never mutates.
+            self._lifecycle.observe_dispatch(name, m, rows, logz)
         wall_ms = (time.perf_counter() - t0) * 1e3
         self.batches += 1
         self.rows += int(rows.shape[0])
@@ -805,6 +822,12 @@ class GMMServer:
                              window_rows=stats["window_rows"],
                              flag_names=["drift_psi"])
                     rec.metrics.count("drift_alarms")
+                if self._lifecycle is not None:
+                    # The closed loop's trigger feed (rev v2.6): the
+                    # controller debounces and reacts on later ticks;
+                    # this call never touches the serving path.
+                    self._lifecycle.observe_alarm(name, int(version),
+                                                  stats)
             win["sketch"] = tl_sketch.StreamSketch(sk.bounds)
             win["occ"] = np.zeros_like(win["occ"])
         return out
@@ -1051,6 +1074,13 @@ class GMMServer:
                     and time.perf_counter() >= next_drift):
                 self.flush_drift()
                 next_drift = time.perf_counter() + self._drift_interval_s
+            if self._lifecycle is not None:
+                # Lifecycle state machine (rev v2.6): same thread as
+                # drift windows and hot-reload, so retrain / canary /
+                # promote / rollback transitions interleave between
+                # coalesced dispatches without locks. Cheap when
+                # nothing is scheduled.
+                self._lifecycle.on_tick()
             # Bounded wait so signals/deadline/reload stay responsive
             # even on an idle queue.
             wait = 0.1 if idle_timeout_s is None else min(
@@ -1303,6 +1333,17 @@ def serve_main(argv=None) -> int:
                     help="PSI above this raises a `drift_alarm` event "
                     "(observational only -- never trips the breaker; "
                     "default 0.2, the conventional major-shift line)")
+    dr.add_argument("--lifecycle", default=None, metavar="POLICY.json",
+                    help="opt-in closed-loop lifecycle (rev v2.6, "
+                    "docs/ROBUSTNESS.md \"Model lifecycle\"): "
+                    "debounced drift alarms trigger a shadow "
+                    "minibatch-EM retrain, canary gates + a "
+                    "duplicate-dispatch shadow window guard promotion, "
+                    "and a post-promotion probation auto-rolls back on "
+                    "a breaker trip / drift alarm / score regression. "
+                    "Requires --drift-interval-s (alarms are the "
+                    "trigger). Default: off -- responses and streams "
+                    "stay byte-identical")
     p.add_argument("--stack-models", action="store_true",
                    help="cross-model coalescing: one tick's requests "
                    "for DIFFERENT models of one numeric family score "
@@ -1325,6 +1366,19 @@ def serve_main(argv=None) -> int:
         jax.config.update("jax_platforms", args.device)
 
     registry = ModelRegistry(args.registry)
+    lifecycle = None
+    if args.lifecycle:
+        if args.drift_interval_s is None:
+            p.error("--lifecycle consumes drift alarms; it requires "
+                    "--drift-interval-s")
+        from ..lifecycle import LifecycleController, LifecycleError
+        from ..lifecycle import LifecyclePolicy
+
+        try:
+            lifecycle = LifecycleController(
+                registry, LifecyclePolicy.from_file(args.lifecycle))
+        except LifecycleError as e:
+            p.error(str(e))
     server = GMMServer(registry,
                        max_batch_rows=args.max_batch_rows,
                        tick_s=args.tick_ms / 1e3,
@@ -1338,7 +1392,8 @@ def serve_main(argv=None) -> int:
                        drift_interval_s=args.drift_interval_s,
                        drift_psi_threshold=args.drift_psi_threshold,
                        autotune=args.autotune,
-                       tuning_db=args.tuning_db)
+                       tuning_db=args.tuning_db,
+                       lifecycle=lifecycle)
 
     rec = (telemetry.RunRecorder(args.metrics_file)
            if args.metrics_file else telemetry.RunRecorder())

@@ -59,6 +59,11 @@ DEFAULT_FAIL_ON = (
     "serve.shed>0",
     "serve.deadline_expired>0",
     "tune.regressions>0",
+    # Closed-loop lifecycle (rev v2.6): a promotion that had to be
+    # rolled back, or a candidate/attempt that had to be quarantined,
+    # is a regression even though serving survived it by design.
+    "lifecycle.rollbacks>0",
+    "lifecycle.quarantines>0",
 )
 
 #: a tuned run this much slower than its own recorded profile regresses.
@@ -128,10 +133,35 @@ def summarize_run(records: List[dict]) -> dict:
 
     n_compile_events = 0
     tune_events: List[dict] = []
+    lifecycle_seen = False
+    serve_seen = False
     for r in records:
         ev = r.get("event")
         if ev == "compile":
             n_compile_events += 1
+        elif ev == "lifecycle":
+            # One count per state-machine phase (rev v2.6). ``retrain``
+            # counts PUBLISHED candidates only -- scheduled/retry edges
+            # are progress, not outcomes.
+            lifecycle_seen = True
+            phase = str(r.get("phase"))
+            dst = {"retrain": "lifecycle.retrains",
+                   "canary": "lifecycle.canaries",
+                   "promote": "lifecycle.promotes",
+                   "watch": "lifecycle.watches",
+                   "rollback": "lifecycle.rollbacks",
+                   "quarantine": "lifecycle.quarantines"}.get(phase)
+            if dst is None:
+                continue
+            outcome = r.get("outcome")
+            if phase == "retrain" and outcome != "published":
+                continue
+            if phase == "promote" and outcome != "promoted":
+                continue
+            metrics[dst] = metrics.get(dst, 0.0) + 1
+        elif ev == "registry_torn":
+            metrics["registry.torn"] = (
+                metrics.get("registry.torn", 0.0) + 1)
         elif ev == "tune":
             tune_events.append(r)
         elif ev == "ingest_summary":
@@ -142,6 +172,7 @@ def summarize_run(records: List[dict]) -> dict:
                 if v is not None:
                     metrics[dst] = round(metrics.get(dst, 0.0) + v, 6)
         elif ev == "serve_summary":
+            serve_seen = True
             for src, dst in (("requests", "serve.requests"),
                              ("batches", "serve.batches"),
                              ("rows", "serve.rows"),
@@ -176,6 +207,13 @@ def summarize_run(records: List[dict]) -> dict:
                     metrics[f"fleet.{src}"] = v
     if n_compile_events:
         metrics["compile_events"] = float(n_compile_events)
+    if lifecycle_seen or serve_seen:
+        # Explicit zeros so the count gates (lifecycle.rollbacks>0,
+        # lifecycle.quarantines>0) compare against a baseline serve run
+        # that simply had no lifecycle trouble, instead of evaporating
+        # when one side lacks the metric.
+        for key in ("lifecycle.rollbacks", "lifecycle.quarantines"):
+            metrics.setdefault(key, 0.0)
 
     summaries = [r for r in records if r.get("event") == "run_summary"]
     if summaries:

@@ -130,6 +130,9 @@ def render_report(records: List[dict], max_trajectory_rows: int = 400) -> str:
     drift_windows = [r for r in records if r.get("event") == "drift"]
     drift_alarms = [r for r in records
                     if r.get("event") == "drift_alarm"]
+    lifecycles = [r for r in records if r.get("event") == "lifecycle"]
+    registry_torns = [r for r in records
+                      if r.get("event") == "registry_torn"]
 
     fleet_starts = [r for r in records if r.get("event") == "fleet_start"]
     tenant_dones = [r for r in records if r.get("event") == "tenant_done"]
@@ -353,6 +356,40 @@ def render_report(records: List[dict], max_trajectory_rows: int = 400) -> str:
                     f"({br.get('fastfails', 0)} fast-fails, "
                     f"{br.get('open_routes', 0)} open), "
                     f"{s.get('reloads', 0)} hot-reloads")
+        out.append("")
+
+    if lifecycles or registry_torns:
+        out.append("Lifecycle (rev v2.6; docs/ROBUSTNESS.md "
+                   "\"Model lifecycle\"):")
+        for r in lifecycles:
+            phase = str(r.get("phase"))
+            model = str(r.get("model"))
+            outc = r.get("outcome")
+            bits = [f"  {phase} {model}"]
+            if outc:
+                bits.append(f"{outc}")
+            if phase == "retrain" and r.get("candidate_version") is not None:
+                bits.append(f"candidate v{r['candidate_version']}")
+            if phase == "canary" and r.get("psi") is not None:
+                bits.append(
+                    f"psi {float(r['psi']):.4f} "
+                    f"ks {float(r.get('ks', 0)):.4f} "
+                    f"regression {float(r.get('regression', 0)):.4f} "
+                    f"(tol {float(r.get('tolerance', 0)):.4f})")
+            if phase in ("promote", "rollback") \
+                    and r.get("to_version") is not None:
+                bits.append(f"v{r.get('from_version')} -> "
+                            f"v{r.get('to_version')}")
+            if r.get("reason"):
+                bits.append(f"reason={r['reason']}")
+            if r.get("attempt") is not None:
+                bits.append(f"attempt {r['attempt']}")
+            out.append(": ".join([bits[0], " ".join(bits[1:])])
+                       if len(bits) > 1 else bits[0])
+        for r in registry_torns:
+            out.append(
+                f"  registry torn: {r.get('model')} v{r.get('version')} "
+                f"unreadable, walked back ({r.get('error')})")
         out.append("")
 
     if fleet_starts or tenant_dones or fleet_summaries:
@@ -821,6 +858,24 @@ def render_follow(records: List[dict]) -> str:
         if alarms:
             line += f"  [{alarms} ALARM(s)]"
         out.append(line)
+
+    lifecycles = by.get("lifecycle", [])
+    if lifecycles:
+        # Lifecycle rollup (rev v2.6): phase counts + the newest edge.
+        phases: Dict[str, int] = {}
+        for r in lifecycles:
+            phases[str(r.get("phase"))] = \
+                phases.get(str(r.get("phase")), 0) + 1
+        last = lifecycles[-1]
+        line = "lifecycle: " + ", ".join(
+            f"{n} {phase}" for phase, n in sorted(phases.items()))
+        line += (f"  [last: {last.get('phase')} {last.get('model')}"
+                 + (f" {last.get('outcome')}" if last.get("outcome")
+                    else "") + "]")
+        out.append(line)
+    torns = by.get("registry_torn", [])
+    if torns:
+        out.append(f"registry: {len(torns)} torn version walk-back(s)")
 
     healths = by.get("health", [])
     recoveries = by.get("recovery", [])

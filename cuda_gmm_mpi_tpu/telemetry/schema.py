@@ -289,6 +289,43 @@ EVENT_FIELDS: Dict[str, Tuple[Tuple[str, ...], Tuple[str, ...]]] = {
         ("model", "psi", "threshold"),
         ("version", "ks", "occupancy_l1", "window_rows", "flag_names"),
     ),
+    # Lifecycle transition (stream rev v2.6; lifecycle/controller.py,
+    # docs/ROBUSTNESS.md "Model lifecycle"): one per state-machine edge
+    # of the closed serve->drift->retrain->promote loop. ``phase`` is
+    # retrain / canary / promote / watch / rollback / quarantine /
+    # cooldown; ``outcome`` the edge taken (e.g. retrain: published /
+    # retry / exhausted; canary: pass / rejected; promote: promoted /
+    # torn; watch: passed / violated). Gate values ride the record:
+    # ``psi`` / ``ks`` over the shared score-bucket ladder,
+    # ``mean_incumbent`` / ``mean_candidate`` / ``regression`` /
+    # ``tolerance`` for the health_regression_scale x epsilon score
+    # gate, ``shadow_rows``/``shadow_ticks`` for the duplicate-dispatch
+    # window. ``candidate_version`` names the canary under evaluation;
+    # ``from_version``/``to_version`` the route flip on promote and
+    # rollback; ``reason`` what tripped a rollback / quarantine
+    # (breaker_trip / drift_alarm / score_regression / canary gates /
+    # retrain_exhausted). Counted in the metrics registry
+    # (``lifecycle_<phase>s``) and folded by ``gmm diff`` into the
+    # ``lifecycle.rollbacks`` / ``lifecycle.quarantines`` default gates.
+    "lifecycle": (
+        ("model", "phase"),
+        ("outcome", "version", "candidate_version", "from_version",
+         "to_version", "attempt", "reason", "psi", "ks",
+         "mean_incumbent", "mean_candidate", "regression", "tolerance",
+         "shadow_rows", "shadow_ticks", "alarms", "cooldown_s",
+         "retry_in_s", "flag_names"),
+    ),
+    # Registry walk-back (rev v2.6; serving/registry.py ``load``): the
+    # newest version of ``model`` was unreadable and resolution fell
+    # back to an earlier one. Previously a warning only -- but a silent
+    # walk-back is exactly what a botched promotion looks like, so it
+    # is now a counted event (``gmm_registry_torn_total``) rendered by
+    # ``gmm report`` and ``gmm timeline``. Observational: the fallback
+    # still happens, serving is not interrupted.
+    "registry_torn": (
+        ("model", "version"),
+        ("error",),
+    ),
     # Autotune decision (stream rev v2.5; tuning/, docs/PERF.md
     # "Autotuning"): one per knob the profile-guided resolver touched.
     # ``chosen`` is the value the run actually used, ``source`` the

@@ -81,7 +81,7 @@ _THREAD_INSTANTS = frozenset((
     "health", "recovery", "io_retry", "preempt", "shutdown", "peer_lost",
     "elastic_shrink", "elastic_resume", "circuit", "serve_shed",
     "serve_deadline", "serve_reload", "merge", "rebucket",
-    "drift_alarm",
+    "drift_alarm", "lifecycle", "registry_torn",
 ))
 _PROCESS_INSTANTS = frozenset((
     "run_start", "run_summary", "serve_summary", "fleet_start",

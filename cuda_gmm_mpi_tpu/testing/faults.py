@@ -81,6 +81,23 @@ Supported fault kinds (the spec is ``{kind: {params...}}``):
   artifact were torn on disk (optionally only for one name/version);
   consumed per load attempt, so walk-back and breaker-recovery
   rehearsals observe the next attempt succeed.
+- ``retrain_fail`` ``{"model": name, "times": n}`` -- the lifecycle
+  controller's shadow minibatch-EM refit (lifecycle/controller.py)
+  raises before fitting (optionally only for ``model``), driving the
+  jittered-doubling retry ladder and, at exhaustion, the
+  quarantine-the-attempt path; consumed per attempt, so the n+1-th
+  retry fits clean. The serving path never observes the failure.
+- ``canary_regression`` ``{"model": name, "shift": s, "times": n}`` --
+  the canary gate evaluation scores the CANDIDATE as if its mean
+  holdout score had regressed by ``s`` (default: far past the gate's
+  tolerance), so the mean-regression gate rejects it; consumed per gate
+  evaluation. Client-visible responses stay byte-identical -- only the
+  shadow scores are poisoned.
+- ``promote_torn`` ``{"name": n, "version": v, "times": k}`` -- the
+  registry's promote raises between the manifest stage-flip and the
+  candidate-marker removal, simulating a crash mid-promotion: the
+  candidate stays invisible to enumeration/poll and the flip stays
+  retryable; consumed per promote attempt.
 
 Activation: ``faults.use({...})`` (context manager, in-process tests) or
 the ``GMM_FAULTS`` env var holding the JSON spec (subprocess workers; read
@@ -100,7 +117,8 @@ ENV_VAR = "GMM_FAULTS"
 KNOWN_KINDS = ("nan_loglik", "singular_cov", "poison_block", "read_slow",
                "checkpoint_eio", "preempt", "rank_hang", "rank_lost",
                "collective_timeout", "serve_nan", "serve_slow",
-               "registry_torn")
+               "registry_torn", "retrain_fail", "canary_regression",
+               "promote_torn")
 
 
 def _values_match(spec_val: Any, val: Any) -> bool:

@@ -319,6 +319,55 @@ def test_drift_mode_contract():
     assert stats["last"]["bench@1"]["alarm"] is True
 
 
+def test_lifecycle_mode_contract():
+    """--lifecycle (GMM_BENCH_LIFECYCLE=1) emits ONE JSON record
+    driving the rev v2.6 closed loop end to end: injected drift fires
+    the alarm, the shadow retrain publishes and canaries a candidate
+    (gate values in the record), promotion flips it live, the injected
+    post-promotion regression auto-rolls back, and the restored version
+    scores bit-identically to the pre-promotion server. Per-phase walls
+    are all measured; value/vs_baseline is the lifecycle-on/off steady
+    serve ratio on identical warmed traffic."""
+    r = _run({
+        "GMM_BENCH_CPU": "1",
+        "GMM_BENCH_LIFECYCLE": "1",
+        "GMM_BENCH_LIFECYCLE_N": "2000",
+        "GMM_BENCH_LIFECYCLE_D": "3",
+        "GMM_BENCH_LIFECYCLE_K": "4",
+        "GMM_BENCH_LIFECYCLE_REQUESTS": "20",
+    }, timeout=600)
+    assert r.returncode == 0, r.stderr
+    j = _json_line(r.stdout)
+    assert j["unit"] == "x" and j["value"] > 0
+    assert j["accelerator_unavailable"] is False
+    lc = j["lifecycle"]
+    # the whole arc, in ONE record
+    assert lc["alarm_fired"] is True
+    assert lc["counts"] == {"retrains": 1, "canaries": 1, "promotes": 1,
+                            "rollbacks": 1, "quarantines": 1}
+    assert lc["closed_loop"] is True
+    # per-phase walls all measured
+    for phase in ("drift_detect_s", "retrain_s", "canary_promote_s",
+                  "rollback_s"):
+        assert lc["phases"][phase] > 0, phase
+    # canary gate values ride the record (regression negative = the
+    # candidate scored the drifted holdout better than the incumbent)
+    g = lc["gates"]
+    assert g["psi"] is not None and g["ks"] is not None
+    assert g["regression"] <= g["tolerance"]
+    assert g["shadow_rows"] > 0
+    # promotion flipped v2 live, the rollback re-published v1 as v3 and
+    # quarantined v2 -- and the restored npz + a fixed probe's scores
+    # match the pre-promotion server exactly
+    assert lc["promoted_version"] == 2
+    assert lc["restored_version"] == 3
+    assert lc["live_versions"] == [1, 3]
+    assert lc["rollback_reason"] in ("score_regression", "drift_alarm",
+                                     "breaker_trip")
+    assert lc["rollback_restored_bit_identical"] is True
+    assert j["vs_baseline"] == lc["overhead"] > 0
+
+
 def test_probe_budget_fails_over_after_one_hang():
     """Default probe budget: ONE attempt -- a hung probe fails over to
     CPU immediately instead of burning the old 5 x 90s retry ladder

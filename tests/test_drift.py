@@ -303,6 +303,44 @@ def test_serve_drift_windows_and_alarm_end_to_end(rng, tmp_path):
     assert gauges["gmm_drift_alarms_total"] == 1.0
 
 
+def test_serve_drain_flushes_final_partial_drift_window(rng, tmp_path):
+    """Satellite regression (rev v2.6): a serve session that drains
+    BEFORE its drift interval ever fires must still report the partial
+    window -- ``emit_summary`` closes the windows first, so the final
+    ``drift`` event (and its alarm, when tripped) precede the
+    ``serve_summary`` in the stream instead of being silently dropped.
+    """
+    gm, data = fitted(rng)
+    gm.to_registry(str(tmp_path), "m")
+    server = GMMServer(ModelRegistry(str(tmp_path)),
+                       drift_interval_s=3600.0, drift_psi_threshold=0.2)
+    stream = []
+    rec = telemetry.RunRecorder(stream=_StreamSink(stream))
+    with telemetry.use(rec), rec:
+        # shifted traffic, then the shutdown path -- NO explicit
+        # flush_drift(), only what the drain itself performs
+        serve_traffic(server, data, shift=8.0)
+        server.begin_drain("eof")
+        server.emit_summary()
+
+    assert validate_stream(stream) == []
+    kinds = [r["event"] for r in stream]
+    assert "drift" in kinds and "serve_summary" in kinds
+    assert kinds.index("drift") < kinds.index("serve_summary")
+    drift = [r for r in stream if r["event"] == "drift"]
+    assert len(drift) == 1 and drift[0]["window_rows"] == 480
+    # the shift trips the alarm even in the drain-flushed window
+    alarms = [r for r in stream if r["event"] == "drift_alarm"]
+    assert len(alarms) == 1 and alarms[0]["model"] == "m"
+    # and the window is actually CLOSED: a second summary (idempotent
+    # shutdown paths re-enter) reports no further drift events
+    n_before = len([r for r in stream if r["event"] == "drift"])
+    with telemetry.use(rec), rec:
+        server.emit_summary()
+    assert len([r for r in stream
+                if r["event"] == "drift"]) == n_before
+
+
 def test_drift_event_schema_pinned_both_directions():
     """Schema drift guard for the new rev v2.4 events, both ways: the
     field tables are exactly what the emit sites send (a field added to

@@ -1,0 +1,668 @@
+"""Closed-loop model lifecycle (rev v2.6; docs/ROBUSTNESS.md "Model
+lifecycle"): drift-triggered shadow retrain, canary gates, guarded
+promotion, and automatic rollback.
+
+Covers the PR's contracts:
+- registry staging: ``stage: candidate`` versions are invisible to
+  enumeration / ``latest_fingerprint`` / the poll / default load /
+  ``maybe_reload`` until promoted; promotion is atomic (manifest flip
+  first, marker removal last) and a torn promotion stays invisible AND
+  retryable; quarantine pins a reason file; rollback re-publishes the
+  pinned prior version bit-identically;
+- the full in-process arc: debounced drift alarms -> shadow
+  minibatch-EM retrain from spooled request rows -> canary gates +
+  duplicate-dispatch shadow window -> promote via the EXISTING
+  hot-reload swap -> watch probation -> cooldown;
+- the chaos matrix: ``retrain_fail`` drives the jittered-backoff retry
+  ladder into an attempt quarantine with the serving path untouched;
+  ``canary_regression`` rejects the candidate with BYTE-identical
+  client responses; ``promote_torn`` leaves the candidate invisible
+  and the flip retryable; a post-promotion violation auto-rolls back
+  with bit-identical scoring vs the pre-promotion server;
+- lifecycle is OFF by default: an unbound server's responses and
+  stream shape are untouched, and a bound-but-idle controller adds no
+  events and changes no reply bytes;
+- policy parsing rejects unknown knobs loudly; ``gmm serve
+  --lifecycle`` requires the drift plane; the standalone ``gmm
+  lifecycle`` CLI honours the 0/1/2 exit contract;
+- every transition is a schema-valid ``lifecycle`` event consumed by
+  ``gmm report`` / ``gmm top`` and gated by ``gmm diff`` defaults
+  (``lifecycle.rollbacks>0`` / ``lifecycle.quarantines>0``).
+"""
+
+import json
+import os
+
+import numpy as np
+import pytest
+
+from cuda_gmm_mpi_tpu import GMMConfig, GaussianMixture, telemetry
+from cuda_gmm_mpi_tpu.lifecycle import (LifecycleController,
+                                        LifecycleError, LifecyclePolicy)
+from cuda_gmm_mpi_tpu.serving import (GMMServer, ModelRegistry,
+                                      RegistryError)
+from cuda_gmm_mpi_tpu.telemetry.schema import validate_stream
+from cuda_gmm_mpi_tpu.testing import faults
+
+from .conftest import make_blobs
+
+STATE_LEAVES = ("means", "pi", "R", "Rinv", "N", "active", "avgvar",
+                "constant")
+
+
+@pytest.fixture(scope="module")
+def fitted_world(tmp_path_factory):
+    """One fitted mixture + its training data, shared read-only by the
+    module (every test gets its OWN registry copy via ``world``)."""
+    gen = np.random.default_rng(7)
+    data, _ = make_blobs(gen, n=600, d=4, k=3, dtype=np.float64)
+    data = data.astype(np.float32)
+    gm = GaussianMixture(
+        3, target_components=3,
+        config=GMMConfig(min_iters=4, max_iters=4, chunk_size=256,
+                         dtype="float32"))
+    gm.fit(data)
+    return gm, data
+
+
+def world(fitted_world, tmp_path, **policy_overrides):
+    """Fresh registry + controller + drift-enabled server."""
+    gm, data = fitted_world
+    root = str(tmp_path / "reg")
+    gm.to_registry(root, "m")
+    reg = ModelRegistry(root)
+    spec = {
+        "debounce_alarms": 1,
+        "cooldown_s": 600.0,
+        "holdout_rows": 128,
+        "retrain": {"steps": 3, "min_rows": 64, "chunk_size": 256,
+                    "backoff_base_s": 0.0, "backoff_max_s": 0.0},
+        # A drift-adapting candidate legitimately scores a drifted
+        # holdout very differently; tests gate on the regression arm.
+        "canary": {"max_psi": 100.0, "max_ks": 1.0, "shadow_ticks": 2},
+        "watch": {"probation_ticks": 2, "probation_s": 0.0,
+                  "min_rows": 10},
+    }
+    for key, val in policy_overrides.items():
+        if isinstance(val, dict):
+            spec.setdefault(key, {}).update(val)
+        else:
+            spec[key] = val
+    ctl = LifecycleController(reg, LifecyclePolicy(spec))
+    server = GMMServer(reg, warm=False, drift_interval_s=3600.0,
+                       drift_psi_threshold=0.2, lifecycle=ctl)
+    return reg, ctl, server
+
+
+def traffic(server, data, shift=0.0, requests=12, rows=40, start=0):
+    """Replies (latency scrubbed -- wall clock is not payload)."""
+    outs = []
+    for i in range(requests):
+        lo = ((start + i) * 17) % (len(data) - rows)
+        x = (data[lo:lo + rows] + np.float32(shift)).tolist()
+        resp = server.handle_requests(
+            [{"id": i, "model": "m", "op": "score_samples", "x": x}])[0]
+        assert resp["ok"], resp
+        outs.append(json.dumps({k: v for k, v in resp.items()
+                                if k != "latency_ms"}, sort_keys=True))
+    return outs
+
+
+class _Sink:
+    def __init__(self, records):
+        self._records = records
+
+    def write(self, line):
+        self._records.append(json.loads(line))
+
+    def flush(self):
+        pass
+
+
+def lifecycle_events(stream):
+    return [r for r in stream if r["event"] == "lifecycle"]
+
+
+# ------------------------------------------------------------------ policy
+
+
+def test_policy_defaults_and_unknown_knob_rejection(tmp_path):
+    """A typo in a promotion policy is a silent outage -- the parser
+    must reject unknown knobs at EVERY level, loudly, naming the valid
+    set; valid specs merge over documented defaults."""
+    p = LifecyclePolicy()
+    assert p.debounce_alarms == 2 and p.cooldown_s == 300.0
+    assert p.retrain["retries"] == 3 and p.canary["shadow_ticks"] == 3
+    assert p.watch["probation_ticks"] == 20 and p.models == []
+
+    p = LifecyclePolicy({"models": ["m"], "debounce_alarms": 1,
+                         "retrain": {"steps": 5}})
+    assert p.models == ["m"] and p.retrain["steps"] == 5
+    assert p.retrain["retries"] == 3  # sibling defaults survive
+
+    with pytest.raises(LifecycleError, match="unknown lifecycle policy"):
+        LifecyclePolicy({"debounce": 1})
+    with pytest.raises(LifecycleError, match="retrain.'setps'"):
+        LifecyclePolicy({"retrain": {"setps": 5}})
+    with pytest.raises(LifecycleError, match="must be an object"):
+        LifecyclePolicy({"canary": 3})
+    with pytest.raises(LifecycleError, match="min_rows"):
+        LifecyclePolicy({"retrain": {"min_rows": 0}})
+
+    pol = tmp_path / "p.json"
+    pol.write_text(json.dumps({"cooldown_s": 60}))
+    assert LifecyclePolicy.from_file(str(pol)).cooldown_s == 60.0
+    pol.write_text("[1, 2]")
+    with pytest.raises(LifecycleError, match="JSON object"):
+        LifecyclePolicy.from_file(str(pol))
+    with pytest.raises(LifecycleError, match="cannot read"):
+        LifecyclePolicy.from_file(str(tmp_path / "ghost.json"))
+
+
+def test_serve_cli_lifecycle_flag_requires_drift_plane(fitted_world,
+                                                       tmp_path):
+    """``--lifecycle`` without ``--drift-interval-s`` (and a broken
+    policy file) are usage errors at startup, never a silently inert
+    loop."""
+    from cuda_gmm_mpi_tpu.serving.server import serve_main
+
+    gm, _ = fitted_world
+    root = str(tmp_path / "reg")
+    gm.to_registry(root, "m")
+    pol = tmp_path / "p.json"
+    pol.write_text(json.dumps({"debounce_alarms": 1}))
+
+    with pytest.raises(SystemExit) as e:
+        serve_main(["--registry", root, "--lifecycle", str(pol)])
+    assert e.value.code == 2
+    pol.write_text(json.dumps({"nope": 1}))
+    with pytest.raises(SystemExit) as e:
+        serve_main(["--registry", root, "--lifecycle", str(pol),
+                    "--drift-interval-s", "3600"])
+    assert e.value.code == 2
+
+
+# ------------------------------------------------------- registry staging
+
+
+def test_candidate_stage_invisible_until_promoted(fitted_world, tmp_path):
+    """The staging contract every other guarantee rests on: a
+    ``stage: candidate`` version does not exist for enumeration, the
+    fingerprint poll, default load, or ``maybe_reload`` -- only an
+    explicit version pin (the canary scorer) sees it. Promotion flips
+    it live atomically; a quarantined version refuses promotion."""
+    gm, data = fitted_world
+    root = str(tmp_path / "reg")
+    gm.to_registry(root, "m")
+    reg = ModelRegistry(root)
+    server = GMMServer(reg, warm=False)
+    before = traffic(server, data, requests=2)
+
+    fp1 = reg.latest_fingerprint("m")
+    vc = reg.save("m", gm.result_, covariance_type="full",
+                  source="lifecycle", stage="candidate")
+    assert vc == 2
+    assert reg.versions("m") == [1]
+    assert reg.versions("m", include_candidates=True) == [1, 2]
+    assert reg.models() == ["m"]
+    assert reg.latest_fingerprint("m") == fp1
+    assert reg.poll({"m": fp1}) == {}
+    assert reg.load("m").version == 1          # default load skips it
+    assert reg.load("m", 2).version == 2       # explicit pin sees it
+    assert reg.stage("m", 2) == "candidate"
+    assert server.maybe_reload() == []         # hot reload skips it
+    assert traffic(server, data, requests=2) == before
+
+    reg.promote("m", 2)
+    assert reg.stage("m", 2) == "live"
+    assert reg.versions("m") == [1, 2]
+    swaps = server.maybe_reload()              # NOW the swap happens
+    assert [s["to_version"] for s in swaps] == [2]
+
+    reg.quarantine("m", 2, {"reason": "test"})
+    assert reg.stage("m", 2) == "quarantined"
+    assert reg.versions("m") == [1]
+    with pytest.raises(RegistryError, match="quarantined"):
+        reg.promote("m", 2)
+    qdoc = json.loads(
+        open(os.path.join(root, "m", "2", "quarantine.json")).read())
+    assert qdoc["reason"] == "test" and qdoc["version"] == 2
+
+
+def test_torn_promotion_stays_invisible_and_retryable(fitted_world,
+                                                      tmp_path):
+    """The ``promote_torn`` fault point sits between the manifest flip
+    and the marker removal: a crash there leaves the version invisible
+    (marker is authoritative) and a RETRY of the same promotion
+    completes it."""
+    gm, _ = fitted_world
+    root = str(tmp_path / "reg")
+    gm.to_registry(root, "m")
+    reg = ModelRegistry(root)
+    reg.save("m", gm.result_, covariance_type="full", stage="candidate")
+    with faults.use({"promote_torn": {"name": "m", "times": 1}}) as f:
+        with pytest.raises(RegistryError, match="promote_torn"):
+            reg.promote("m", 2)
+        assert f.fired.get("promote_torn") == 1
+    assert reg.versions("m") == [1]            # still invisible
+    assert reg.stage("m", 2) == "candidate"
+    reg.promote("m", 2)                        # the retry wins
+    assert reg.versions("m") == [1, 2]
+
+
+def test_rollback_republishes_prior_version_bit_identical(fitted_world,
+                                                          tmp_path):
+    """Rollback re-publishes the pinned prior version as the NEWEST
+    live version with every npz leaf bit-equal, and quarantines the bad
+    promotion with the reason + restored-as breadcrumbs."""
+    gm, _ = fitted_world
+    root = str(tmp_path / "reg")
+    gm.to_registry(root, "m")
+    gm.to_registry(root, "m")
+    reg = ModelRegistry(root)
+    new_v = reg.rollback("m", to_version=1, bad_version=2,
+                         reason={"reason": "score_regression"})
+    assert new_v == 3
+    assert reg.versions("m") == [1, 3]
+    assert reg.stage("m", 2) == "quarantined"
+    qdoc = json.loads(
+        open(os.path.join(root, "m", "2", "quarantine.json")).read())
+    assert qdoc["reason"] == "score_regression"
+    assert qdoc["restored_version"] == 1 and qdoc["restored_as"] == 3
+    m1, m3 = reg.load("m", 1), reg.load("m", 3)
+    for leaf in STATE_LEAVES:
+        assert np.array_equal(np.asarray(getattr(m1.state, leaf)),
+                              np.asarray(getattr(m3.state, leaf))), leaf
+    assert np.array_equal(np.asarray(m1.data_shift),
+                          np.asarray(m3.data_shift))
+    assert m3.manifest["source"] == "rollback"
+    assert m3.manifest["restored_version"] == 1
+    assert m3.manifest["rollback_of"] == 2
+
+
+# ------------------------------------------------------------- the arc
+
+
+def test_full_arc_drift_retrain_canary_promote_watch(fitted_world,
+                                                     tmp_path):
+    """The happy path end to end, in process: shifted traffic raises
+    the alarm, the next ticks run retrain (from the request spool) ->
+    canary (holdout gates + 2-tick duplicate-dispatch shadow window) ->
+    promote (the existing hot-reload swap) -> watch -> cooldown ->
+    idle. Every transition is a schema-valid ``lifecycle`` event."""
+    reg, ctl, server = world(fitted_world, tmp_path,
+                             cooldown_s=0.0)
+    gm, data = fitted_world
+    stream = []
+    rec = telemetry.RunRecorder(stream=_Sink(stream))
+    with telemetry.use(rec), rec:
+        assert server.resolve("m").version == 1
+        traffic(server, data, shift=8.0)
+        out = server.flush_drift()
+        assert out and out[0]["alarm"]
+        assert ctl.stats()["routes"]["m"] == "retrain"
+        ctl.on_tick()                          # refit + holdout gates
+        assert ctl.stats()["routes"]["m"] == "canary"
+        assert reg.versions("m") == [1]        # candidate invisible
+        assert server.resolve("m").version == 1
+        traffic(server, data, shift=8.0, requests=2, start=50)  # shadow
+        ctl.on_tick()                          # close canary -> promote
+        st = ctl.stats()
+        assert st["promotes"] == 1 and st["routes"]["m"] == "watch"
+        assert server.resolve("m").version == 2
+        assert reg.versions("m") == [1, 2]
+        traffic(server, data, shift=8.0, requests=3, start=80)
+        ctl.on_tick()                          # probation closes clean
+        assert ctl.stats()["routes"]["m"] == "cooldown"
+        ctl.on_tick()                          # cooldown_s=0 -> idle
+        assert ctl.stats()["routes"]["m"] == "idle"
+
+    assert validate_stream(stream) == []
+    arcs = [(e["phase"], e.get("outcome")) for e in
+            lifecycle_events(stream)]
+    assert arcs == [("retrain", "scheduled"), ("retrain", "published"),
+                    ("canary", "pass"), ("promote", "promoted"),
+                    ("watch", "passed")]
+    canary = [e for e in lifecycle_events(stream)
+              if e["phase"] == "canary"][0]
+    for field in ("psi", "ks", "mean_incumbent", "mean_candidate",
+                  "regression", "tolerance", "shadow_rows",
+                  "shadow_ticks"):
+        assert field in canary, field
+    assert canary["shadow_ticks"] == 2
+    assert ctl.counts == {"retrains": 1, "canaries": 1, "promotes": 1,
+                          "rollbacks": 0, "quarantines": 0}
+    man = reg.load("m", 2).manifest
+    assert man["source"] == "lifecycle" and man["retrain_of"] == 1
+
+
+def test_post_promotion_violation_rolls_back_bit_identical(fitted_world,
+                                                           tmp_path):
+    """The acceptance chaos case: an injected post-promotion score
+    regression (traffic from a far-worse distribution during probation)
+    auto-rolls back to the pinned prior version; afterwards a fixed
+    probe scores BIT-identically to the pre-promotion server and the
+    bad candidate is quarantined with a reason file."""
+    reg, ctl, server = world(fitted_world, tmp_path,
+                             watch={"probation_ticks": 64,
+                                    "probation_s": 600.0,
+                                    "min_rows": 10})
+    gm, data = fitted_world
+    stream = []
+    rec = telemetry.RunRecorder(stream=_Sink(stream))
+    with telemetry.use(rec), rec:
+        probe_before = traffic(server, data, requests=1, start=7)
+        traffic(server, data, shift=8.0)
+        server.flush_drift()
+        ctl.on_tick()                                   # -> canary
+        traffic(server, data, shift=8.0, requests=2, start=50)
+        ctl.on_tick()                                   # -> watch (v2)
+        assert server.resolve("m").version == 2
+        traffic(server, data, shift=40.0, requests=3, start=100)
+        ctl.on_tick()                                   # -> rollback
+        st = ctl.stats()
+        assert st["rollbacks"] == 1 and st["quarantines"] == 1
+        assert st["routes"]["m"] == "cooldown"
+        # v2 quarantined; v1 re-published as v3 and SERVED
+        assert reg.versions("m") == [1, 3]
+        assert reg.stage("m", 2) == "quarantined"
+        assert server.resolve("m").version == 3
+        probe_after = traffic(server, data, requests=1, start=7)
+
+    # scoring after rollback is bit-identical to before the promotion
+    # (the npz round-trip restores the exact leaves) -- only the served
+    # version number moved
+    b = json.loads(probe_before[0])
+    a = json.loads(probe_after[0])
+    assert b.pop("version") == 1 and a.pop("version") == 3
+    assert a == b
+    assert validate_stream(stream) == []
+    ev = lifecycle_events(stream)
+    assert [(e["phase"], e.get("outcome")) for e in ev][-3:] == [
+        ("watch", "violated"), ("rollback", None), ("quarantine", None)]
+    rb = ev[-2]
+    assert rb["from_version"] == 2 and rb["to_version"] == 3
+    assert rb["reason"] == "score_regression"
+
+
+# ----------------------------------------------------------- chaos matrix
+
+
+def test_retrain_fail_fault_retries_then_quarantines(fitted_world,
+                                                     tmp_path):
+    """``retrain_fail`` drives the checkpoint-retries recipe: one retry
+    event per failed attempt, then exhaustion quarantines the ATTEMPT
+    (no artifact exists) and opens a cooldown -- with the serving path
+    never touched."""
+    reg, ctl, server = world(fitted_world, tmp_path)
+    gm, data = fitted_world
+    stream = []
+    rec = telemetry.RunRecorder(stream=_Sink(stream))
+    with telemetry.use(rec), rec, \
+            faults.use({"retrain_fail": {"model": "m", "times": 99}}):
+        before = traffic(server, data, shift=8.0)
+        server.flush_drift()
+        for _ in range(10):
+            ctl.on_tick()
+        st = ctl.stats()
+        assert st["retrains"] == 0 and st["quarantines"] == 1
+        assert st["routes"]["m"] == "cooldown"
+        assert reg.versions("m", include_candidates=True) == [1]
+        assert server.resolve("m").version == 1
+        after = traffic(server, data, shift=8.0)
+    assert after == before                     # byte-identical replies
+    assert validate_stream(stream) == []
+    ev = lifecycle_events(stream)
+    retries = [e for e in ev if e.get("outcome") == "retry"]
+    assert len(retries) == 3                   # retries=3 -> 3 retry edges
+    assert all("retrain_fail" in e["reason"] for e in retries)
+    assert all("retry_in_s" in e for e in retries)
+    q = [e for e in ev if e["phase"] == "quarantine"]
+    assert len(q) == 1 and "retrain_exhausted" in q[0]["reason"]
+
+
+def test_canary_regression_fault_quarantines_byte_identical(fitted_world,
+                                                            tmp_path):
+    """``canary_regression`` poisons only the SHADOW score: the gate
+    rejects, the candidate is quarantined on disk, and the A/B replay
+    proves zero client-visible change -- byte-identical responses
+    before and after the failed canary."""
+    reg, ctl, server = world(fitted_world, tmp_path)
+    gm, data = fitted_world
+    stream = []
+    rec = telemetry.RunRecorder(stream=_Sink(stream))
+    with telemetry.use(rec), rec:
+        a_before = traffic(server, data, shift=8.0)
+        with faults.use({"canary_regression": {"model": "m",
+                                               "times": 1}}) as f:
+            server.flush_drift()
+            ctl.on_tick()
+            assert f.fired.get("canary_regression") == 1
+        st = ctl.stats()
+        assert st["quarantines"] == 1 and st["routes"]["m"] == "cooldown"
+        assert reg.versions("m") == [1]
+        assert reg.stage("m", 2) == "quarantined"
+        a_after = traffic(server, data, shift=8.0)
+    assert a_after == a_before
+    rej = [e for e in lifecycle_events(stream)
+           if e.get("outcome") == "rejected"]
+    assert len(rej) == 1 and rej[0]["phase"] == "canary"
+    assert rej[0]["regression"] > rej[0]["tolerance"]
+
+
+def test_promote_torn_fault_controller_retries_next_tick(fitted_world,
+                                                         tmp_path):
+    """A torn promotion mid-arc: the controller emits the torn edge,
+    the candidate stays invisible to serving, and the NEXT tick retries
+    the same promotion to completion."""
+    reg, ctl, server = world(fitted_world, tmp_path,
+                             canary={"shadow_ticks": 1})
+    gm, data = fitted_world
+    stream = []
+    rec = telemetry.RunRecorder(stream=_Sink(stream))
+    with telemetry.use(rec), rec:
+        traffic(server, data, shift=8.0)
+        with faults.use({"promote_torn": {"name": "m", "times": 1}}):
+            server.flush_drift()
+            ctl.on_tick()                      # retrain -> canary
+            traffic(server, data, shift=8.0, requests=1, start=50)
+            ctl.on_tick()                      # promote: TORN
+        st = ctl.stats()
+        assert st["promotes"] == 0 and st["routes"]["m"] == "canary"
+        assert reg.versions("m") == [1]
+        assert server.resolve("m").version == 1
+        ctl.on_tick()                          # the retry completes
+        st = ctl.stats()
+        assert st["promotes"] == 1 and st["routes"]["m"] == "watch"
+        assert server.resolve("m").version == 2
+    ev = lifecycle_events(stream)
+    torn = [e for e in ev if e.get("outcome") == "torn"]
+    assert len(torn) == 1 and torn[0]["attempt"] == 1
+    promoted = [e for e in ev if e.get("outcome") == "promoted"]
+    assert promoted and promoted[0]["attempt"] == 2
+
+
+# ------------------------------------------------------------ off-by-default
+
+
+def test_lifecycle_off_by_default_byte_identical(fitted_world, tmp_path):
+    """Without ``--lifecycle`` nothing changes (the PR-17 contract);
+    and a BOUND but never-triggered controller adds zero events and
+    zero reply-byte changes vs an unbound server on identical
+    in-distribution traffic."""
+    gm, data = fitted_world
+    root = str(tmp_path / "reg")
+    gm.to_registry(root, "m")
+    reg = ModelRegistry(root)
+
+    def run(lifecycle):
+        server = GMMServer(reg, warm=False, drift_interval_s=3600.0,
+                           drift_psi_threshold=0.2, lifecycle=lifecycle)
+        stream = []
+        rec = telemetry.RunRecorder(stream=_Sink(stream))
+        with telemetry.use(rec), rec:
+            replies = traffic(server, data)    # in-distribution: quiet
+            server.flush_drift()
+        return replies, stream
+
+    plain_replies, plain_stream = run(None)
+    ctl = LifecycleController(
+        reg, LifecyclePolicy({"debounce_alarms": 1}))
+    bound_replies, bound_stream = run(ctl)
+
+    assert bound_replies == plain_replies
+    assert [r["event"] for r in bound_stream] \
+        == [r["event"] for r in plain_stream]
+    assert lifecycle_events(bound_stream) == []
+    assert ctl.stats()["routes"] == {"m": "idle"}
+    assert ctl.counts["retrains"] == 0
+
+
+# ------------------------------------------------------------ offline CLI
+
+
+def test_gmm_lifecycle_cli_offline_promotes_and_exit_codes(fitted_world,
+                                                           tmp_path,
+                                                           capsys):
+    """The standalone loop over a RECORDED stream: debounced alarms
+    drive retrain -> canary -> promote (no shadow window offline; the
+    next serve run adopts the result), exit 0; a quarantining run exits
+    1; unknown policy knobs exit 2."""
+    from cuda_gmm_mpi_tpu.cli import main as cli_main
+
+    gm, data = fitted_world
+    root = str(tmp_path / "reg")
+    gm.to_registry(root, "m")
+
+    stream_path = tmp_path / "serve.jsonl"
+    with open(stream_path, "w") as f:
+        for t in (1.0, 2.0):
+            f.write(json.dumps({"event": "drift_alarm", "t": t,
+                                "model": "m", "version": 1,
+                                "psi": 9.9, "threshold": 0.2}) + "\n")
+        f.write('{"torn tail')                 # live streams end torn
+
+    shifted = data + np.float32(8.0)
+    bin_path = tmp_path / "shift.bin"
+    with open(bin_path, "wb") as f:
+        np.asarray(shifted.shape, np.int32).tofile(f)
+        shifted.astype(np.float32).tofile(f)
+
+    pol = tmp_path / "policy.json"
+    pol.write_text(json.dumps({
+        "debounce_alarms": 2, "cooldown_s": 1.0,
+        "retrain": {"steps": 3, "min_rows": 64},
+        "canary": {"max_psi": 100.0, "max_ks": 1.0}}))
+
+    out_path = tmp_path / "lc.jsonl"
+    rc = cli_main(["lifecycle", str(stream_path), "--registry", root,
+                   "--policy", str(pol), "--data", str(bin_path),
+                   "--out", str(out_path), "--json"])
+    assert rc == 0
+    verdict = json.loads(capsys.readouterr().out.strip())
+    assert verdict["alarms"] == 2
+    assert verdict["counts"]["promotes"] == 1
+    assert verdict["routes"]["m"]["live_versions"] == [1, 2]
+    assert ModelRegistry(root).versions("m") == [1, 2]
+    kinds = [json.loads(line)["event"]
+             for line in open(out_path) if line.strip()]
+    assert "lifecycle" in kinds
+
+    # quarantine path (injected retrain failures) -> exit 1
+    with faults.use({"retrain_fail": {"model": "m", "times": 99}}):
+        rc = cli_main(["lifecycle", str(stream_path), "--registry", root,
+                       "--policy", str(pol), "--data", str(bin_path)])
+    assert rc == 1
+    assert "quarantine" in capsys.readouterr().out
+
+    # unknown knob -> usage error 2
+    pol.write_text(json.dumps({"debounce": 1}))
+    rc = cli_main(["lifecycle", str(stream_path), "--registry", root,
+                   "--policy", str(pol)])
+    assert rc == 2
+    assert "unknown lifecycle policy" in capsys.readouterr().err
+
+
+# ------------------------------------------------- observability surfaces
+
+
+def test_report_top_and_diff_consume_lifecycle_events(fitted_world,
+                                                      tmp_path, capsys):
+    """The rendering/gating surfaces: ``gmm report`` renders the
+    lifecycle section and the torn-registry line, ``gmm top`` shows the
+    rollup, ``summarize_run`` folds the counts, and the DEFAULT ``gmm
+    diff`` gates trip on rollbacks/quarantines."""
+    from cuda_gmm_mpi_tpu.cli import main as cli_main
+    from cuda_gmm_mpi_tpu.telemetry import timeline as tl_timeline
+    from cuda_gmm_mpi_tpu.telemetry.diff import (DEFAULT_FAIL_ON,
+                                                 summarize_run)
+
+    assert "lifecycle.rollbacks>0" in DEFAULT_FAIL_ON
+    assert "lifecycle.quarantines>0" in DEFAULT_FAIL_ON
+    assert "lifecycle" in tl_timeline._THREAD_INSTANTS
+    assert "registry_torn" in tl_timeline._THREAD_INSTANTS
+
+    def synthesize(with_lifecycle):
+        """A minimal serve-shaped stream with the REAL envelope (the
+        recorder stamps schema/ts/run_id/process) so validate_stream
+        and the diff fingerprint logic see production records."""
+        records = []
+        rec = telemetry.RunRecorder(stream=_Sink(records))
+        with telemetry.use(rec), rec:
+            rec.emit("run_start", platform="cpu", num_events=960,
+                     num_dimensions=4, start_k=3, epsilon=1e-4)
+            if with_lifecycle:
+                rec.emit("lifecycle", model="m", phase="retrain",
+                         outcome="published", candidate_version=2)
+                rec.emit("lifecycle", model="m", phase="canary",
+                         outcome="pass", psi=0.01, ks=0.02,
+                         regression=-1.5, tolerance=2.0)
+                rec.emit("lifecycle", model="m", phase="promote",
+                         outcome="promoted", from_version=1,
+                         to_version=2)
+                rec.emit("lifecycle", model="m", phase="watch",
+                         outcome="violated", reason="score_regression")
+                rec.emit("lifecycle", model="m", phase="rollback",
+                         from_version=2, to_version=3, version=1,
+                         reason="score_regression")
+                rec.emit("lifecycle", model="m", phase="quarantine",
+                         version=2, reason="score_regression")
+                rec.emit("registry_torn", model="m", version=9,
+                         error="RegistryError: torn")
+            rec.emit("serve_summary", requests=24, batches=24, rows=960,
+                     wall_s=9.0, qps=2.7, latency_ms={"p50": 1.0},
+                     metrics={}, errors=0)
+        return records
+
+    good, bad = synthesize(False), synthesize(True)
+    assert validate_stream(bad) == []
+    a_path, b_path = str(tmp_path / "a.jsonl"), str(tmp_path / "b.jsonl")
+    for path, records in ((a_path, good), (b_path, bad)):
+        with open(path, "w") as f:
+            for r in records:
+                f.write(json.dumps(r) + "\n")
+
+    m = summarize_run(bad)["metrics"]
+    assert m["lifecycle.retrains"] == 1 and m["lifecycle.promotes"] == 1
+    assert m["lifecycle.rollbacks"] == 1
+    assert m["lifecycle.quarantines"] == 1
+    assert m["registry.torn"] == 1
+    # the baseline's serve run pins explicit zeros for the count gates
+    assert summarize_run(good)["metrics"]["lifecycle.rollbacks"] == 0.0
+
+    assert cli_main(["report", b_path]) == 0
+    out = capsys.readouterr().out
+    assert "Lifecycle" in out
+    assert "promote" in out and "rollback" in out
+    assert "registry torn" in out
+    assert cli_main(["top", b_path, "--interval", "0.01"]) == 0
+    out = capsys.readouterr().out
+    assert "lifecycle" in out
+
+    # default diff gates: rollback + quarantine each name a regression
+    assert cli_main(["diff", a_path, a_path]) == 0
+    capsys.readouterr()
+    assert cli_main(["diff", a_path, b_path]) == 1
+    out = capsys.readouterr().out
+    assert "lifecycle.rollbacks" in out
+    assert "lifecycle.quarantines" in out

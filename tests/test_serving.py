@@ -120,6 +120,98 @@ def test_registry_rejects_bad_names(tmp_path):
             reg._check_name(bad)
 
 
+def test_registry_torn_walkback_is_a_counted_event(rng, tmp_path):
+    """Rev v2.6: the torn-newest walk-back is OBSERVABLE, not just a
+    Python warning -- one schema-valid ``registry_torn`` event naming
+    the skipped version plus the ``registry_torn`` counter (rendered as
+    ``gmm_registry_torn_total`` by the /metrics exporter)."""
+    from cuda_gmm_mpi_tpu import telemetry
+    from cuda_gmm_mpi_tpu.telemetry.exporter import render_openmetrics
+    from cuda_gmm_mpi_tpu.telemetry.schema import validate_stream
+
+    gm, _ = fitted(rng)
+    reg = ModelRegistry(str(tmp_path))
+    gm.to_registry(reg, "m")
+    gm.to_registry(reg, "m")
+    (tmp_path / "m" / "2" / "model.npz").write_bytes(b"torn")
+
+    stream = []
+
+    class _Sink:
+        def write(self, line):
+            stream.append(json.loads(line))
+
+        def flush(self):
+            pass
+
+    rec = telemetry.RunRecorder(stream=_Sink())
+    with telemetry.use(rec), rec:
+        with pytest.warns(RuntimeWarning, match="version 2 unreadable"):
+            assert reg.load("m").version == 1
+        snapshot = rec.metrics.snapshot()
+    assert validate_stream(stream) == []
+    torn = [r for r in stream if r["event"] == "registry_torn"]
+    assert len(torn) == 1
+    assert torn[0]["model"] == "m" and torn[0]["version"] == 2
+    assert "error" in torn[0]
+    assert snapshot["counters"]["registry_torn"] == 1
+    text = render_openmetrics(snapshot)
+    assert "gmm_registry_torn_total 1" in text
+
+
+def test_registry_disappearance_never_crashes_serving(rng, tmp_path):
+    """Lifecycle hard case: the registry being DELETED out from under a
+    live server (rsync flip, operator error) must degrade, not crash --
+    ``latest_fingerprint``/``poll``/``maybe_reload`` go quiet and every
+    already-resolved route keeps answering from its prepared state."""
+    import shutil
+
+    gm, data = fitted(rng)
+    reg = ModelRegistry(str(tmp_path))
+    gm.to_registry(reg, "m")
+    gm.to_registry(reg, "m")
+    server = GMMServer(reg, warm=False)
+    x = data[:16].tolist()
+
+    def ask():
+        resp = server.handle_requests(
+            [{"id": 0, "model": "m", "op": "score_samples", "x": x}])[0]
+        assert resp["ok"], resp
+        return resp
+
+    before = ask()               # pins the default route at v2
+    assert before["version"] == 2
+
+    # 1) newest version dir vanishes: the poll sees a change and the
+    # reload walks BACK to the newest readable version.
+    shutil.rmtree(tmp_path / "m" / "2")
+    swaps = server.maybe_reload()
+    assert [s["to_version"] for s in swaps] == [1]
+    assert ask()["version"] == 1
+
+    # 2) the whole model dir vanishes: no fingerprint, no swap, the
+    # prepared route keeps serving.
+    shutil.rmtree(tmp_path / "m")
+    assert reg.latest_fingerprint("m") is None
+    assert server.maybe_reload() == []
+    assert ask()["version"] == 1
+
+    # 3) the entire registry root vanishes: enumeration and the poll
+    # degrade to empty, reload stays a no-op, routes still answer.
+    shutil.rmtree(tmp_path)
+    assert reg.models() == []
+    assert reg.versions("m") == []
+    assert reg.poll({"m": (1, "x")}) == {}
+    assert server.maybe_reload() == []
+    assert ask()["version"] == 1
+
+    # 4) a NEVER-resolved model is a per-request error (breaker path),
+    # not a server crash.
+    resp = server.handle_requests(
+        [{"id": 1, "model": "ghost", "op": "score_samples", "x": x}])[0]
+    assert not resp["ok"] and "unknown model" in resp["error"]
+
+
 # ---------------------------------------------------------------- executor
 
 

@@ -373,6 +373,14 @@ def test_every_emitted_event_kind_is_declared_in_schema():
     # the known call-site spread: if these move wholesale the pattern
     # is probably matching the wrong thing
     assert "run_start" in found and "serve_request" in found
+    # rev v2.6: the lifecycle plane's kinds are pinned BY NAME in both
+    # directions -- `lifecycle` from the controller, `registry_torn`
+    # from the registry's torn-version walk-back
+    assert "lifecycle" in found and "registry_torn" in found
+    assert any(p.endswith("lifecycle/controller.py")
+               for p in found["lifecycle"])
+    assert any(p.endswith("serving/registry.py")
+               for p in found["registry_torn"])
     undeclared = {k: sorted(v) for k, v in found.items()
                   if k not in EVENT_FIELDS}
     assert undeclared == {}, (
