@@ -52,6 +52,19 @@ breaker trips / reloads -- stream rev v1.7) so soak runs surface
 degradation, all-zero on a clean A/B. Size knobs:
 GMM_BENCH_SERVE_{N,D,K,REQUESTS} (run_serve_bench).
 
+HTTP mode (``--http`` or GMM_BENCH_HTTP=1): rev v2.7 network-tier
+contract -- fit + export a model, launch a REAL ``gmm serve --http 0
+--workers W`` subprocess tree, and drive it closed-loop with C
+concurrent :class:`GMMClient` threads; mid-load, SIGKILL one worker
+process and keep the load running. ONE record carries the warm QPS and
+p50/p99 over TCP, the ``zero_failed_requests`` proof bit (the pool's
+sibling retry + respawn must hide the kill from every client), the
+kill->respawned recovery wall, client retry/shed counters, and the
+server's ``serve_summary.http`` rollup; ``vs_baseline`` is http-p50 /
+in-process-p50 from a same-shape in-process server (what the network +
+pool tier costs per request). Size knobs:
+GMM_BENCH_HTTP_{N,D,K,WORKERS,CLIENTS,REQUESTS} (run_http_bench).
+
 Drift mode (``--drift`` or GMM_BENCH_DRIFT=1): rev v2.4 drift-plane
 contract -- fit + export a model (training envelope in the registry),
 serve it with the drift plane on, replay in-distribution traffic then
@@ -1182,6 +1195,250 @@ def run_serve_bench(platform: str, accel_unavailable: bool) -> dict:
     return result
 
 
+def run_http_bench(platform: str, accel_unavailable: bool) -> dict:
+    """The --http mode: rev v2.7 network-tier contract, measured live.
+
+    Fits + exports a small mixture, launches a REAL ``gmm serve --http 0
+    --workers W`` subprocess tree (HTTP front end + supervised worker
+    pool over TCP), and drives it closed-loop with C concurrent
+    :class:`GMMClient` threads. Mid-load (~40% through), one worker
+    process is SIGKILLed and the load keeps running -- the acceptance
+    contract is that the pool's sibling retry + supervised respawn hide
+    the kill from every client (``zero_failed_requests``). The record
+    carries the TCP warm p50/p99/QPS, the kill->respawned recovery
+    wall, client retry counters, the drain exit code (SIGTERM must
+    yield 75/EX_TEMPFAIL), and the server's own ``serve_summary.http``
+    rollup. ``vs_baseline`` is TCP p50 / in-process p50 on the same
+    model and row count -- what the network + pool tier costs per
+    request. Workers always run on CPU (N subprocesses must not fight
+    over one accelerator tunnel), so the sizes stay small; this mode
+    measures the tier, not the kernel. Size knobs:
+    GMM_BENCH_HTTP_{N,D,K,WORKERS,CLIENTS,REQUESTS}.
+    """
+    k = int(os.environ.get("GMM_BENCH_HTTP_K") or 8)
+    n = int(os.environ.get("GMM_BENCH_HTTP_N") or 4_000)
+    d = int(os.environ.get("GMM_BENCH_HTTP_D") or 4)
+    n_requests = int(os.environ.get("GMM_BENCH_HTTP_REQUESTS") or 200)
+    n_workers = int(os.environ.get("GMM_BENCH_HTTP_WORKERS") or 2)
+    n_clients = int(os.environ.get("GMM_BENCH_HTTP_CLIENTS") or 4)
+    rows = 100
+
+    import signal
+    import tempfile
+    import threading
+
+    from cuda_gmm_mpi_tpu.config import GMMConfig
+    from cuda_gmm_mpi_tpu.estimator import GaussianMixture
+    from cuda_gmm_mpi_tpu.serving import (GMMClient, GMMClientError,
+                                          GMMServer, ModelRegistry)
+
+    rng = np.random.default_rng(42)
+    centers = rng.normal(scale=8.0, size=(k, d))
+    data = (centers[rng.integers(0, k, n)]
+            + rng.normal(scale=1.0, size=(n, d))).astype(np.float32)
+    gm = GaussianMixture(
+        k, target_components=k,
+        config=GMMConfig(min_iters=5, max_iters=5,
+                         chunk_size=min(65536, n)))
+    gm.fit(data)
+
+    def body(i):
+        lo = int(rng.integers(0, n - rows))
+        return data[lo:lo + rows].tolist()
+
+    with tempfile.TemporaryDirectory() as root:
+        reg_dir = os.path.join(root, "reg")
+        registry = ModelRegistry(reg_dir)
+        gm.to_registry(registry, "bench")
+
+        # In-process baseline: the same registry + op behind zero
+        # network, warmed; TCP p50 / this p50 is the tier's unit cost.
+        server = GMMServer(ModelRegistry(reg_dir), warm=False)
+        for i in range(3):
+            server.handle_requests([{"id": i, "model": "bench",
+                                     "op": "score_samples",
+                                     "x": body(i)}])
+        base_lat = []
+        for i in range(30):
+            t1 = time.perf_counter()
+            resp = server.handle_requests(
+                [{"id": i, "model": "bench", "op": "score_samples",
+                  "x": body(i)}])[0]
+            base_lat.append(time.perf_counter() - t1)
+            assert resp["ok"], resp
+        inproc_p50 = float(np.percentile(np.asarray(base_lat), 50))
+
+        port_file = os.path.join(root, "port.txt")
+        worker_dir = os.path.join(root, "wd")
+        metrics_file = os.path.join(root, "serve.jsonl")
+        repo = os.path.dirname(os.path.abspath(__file__))
+        env = dict(os.environ, JAX_PLATFORMS="cpu",
+                   PYTHONPATH=repo + os.pathsep
+                   + os.environ.get("PYTHONPATH", ""))
+        proc = subprocess.Popen(
+            [sys.executable, "-m", "cuda_gmm_mpi_tpu.cli", "serve",
+             "--registry", reg_dir, "--http", "0",
+             "--workers", str(n_workers), "--http-port-file", port_file,
+             "--worker-dir", worker_dir, "--device", "cpu",
+             "--metrics-file", metrics_file,
+             "--worker-backoff-s", "0.2"],
+            cwd=repo, env=env, stdout=subprocess.DEVNULL,
+            stderr=subprocess.DEVNULL)
+        try:
+            t0 = time.perf_counter()
+            while not os.path.exists(port_file):
+                if proc.poll() is not None:
+                    raise RuntimeError(
+                        f"gmm serve --http exited rc={proc.returncode} "
+                        "before publishing its port")
+                if time.perf_counter() - t0 > 300:
+                    raise RuntimeError("gmm serve --http startup timed out")
+                time.sleep(0.05)
+            startup_s = time.perf_counter() - t0
+            with open(port_file) as f:
+                port = int(f.read())
+
+            client = GMMClient(f"127.0.0.1:{port}", timeout_s=60.0,
+                               retries=3, backoff_base_s=0.05,
+                               retry_budget=0.5)
+            for i in range(2 * n_workers):  # warm every worker's caches
+                client.request("bench", "score_samples", body(i))
+
+            # Pre-drawn payloads: the shared numpy Generator is not
+            # thread-safe, so the driver threads index a fixed set.
+            payloads = [body(i) for i in range(16)]
+            counter = {"next": 0, "failed": 0}
+            lock = threading.Lock()
+            lat: list = []
+            kill = {"at": int(n_requests * 0.4) if n_workers >= 2
+                    else None, "t_kill": None, "recovery_s": None,
+                    "pid": None}
+
+            def take() -> bool:
+                with lock:
+                    if counter["next"] >= n_requests:
+                        return False
+                    counter["next"] += 1
+                    return True
+
+            def drive():
+                i = 0
+                while take():
+                    i += 1
+                    t1 = time.perf_counter()
+                    try:
+                        client.request("bench", "score_samples",
+                                       payloads[i % len(payloads)])
+                        with lock:
+                            lat.append(time.perf_counter() - t1)
+                    except GMMClientError:
+                        with lock:
+                            counter["failed"] += 1
+
+            def killer():
+                # SIGKILL worker 0 mid-load, then clock the supervised
+                # respawn: kill -> new pid in worker0.json + live socket.
+                while True:
+                    with lock:
+                        if counter["next"] >= kill["at"]:
+                            break
+                    time.sleep(0.002)
+                state = os.path.join(worker_dir, "worker0.json")
+                with open(state) as f:
+                    w0 = json.load(f)
+                kill["pid"] = w0["pid"]
+                kill["t_kill"] = time.perf_counter()
+                os.kill(w0["pid"], signal.SIGKILL)
+                deadline = time.perf_counter() + 120
+                while time.perf_counter() < deadline:
+                    try:
+                        with open(state) as f:
+                            cur = json.load(f)
+                        if (cur["pid"] != w0["pid"]
+                                and os.path.exists(cur["socket"])):
+                            kill["recovery_s"] = (time.perf_counter()
+                                                  - kill["t_kill"])
+                            return
+                    except (OSError, ValueError, KeyError):
+                        pass
+                    time.sleep(0.01)
+
+            threads = [threading.Thread(target=drive, daemon=True)
+                       for _ in range(n_clients)]
+            kt = None
+            if kill["at"] is not None:
+                kt = threading.Thread(target=killer, daemon=True)
+                kt.start()
+            t_load = time.perf_counter()
+            for t in threads:
+                t.start()
+            for t in threads:
+                t.join()
+            load_wall = time.perf_counter() - t_load
+            if kt is not None:
+                kt.join(timeout=130)
+
+            proc.send_signal(signal.SIGTERM)
+            drain_rc = proc.wait(timeout=120)
+        finally:
+            if proc.poll() is None:
+                proc.kill()
+                proc.wait(timeout=30)
+
+        rollup = None
+        try:
+            with open(metrics_file) as f:
+                for line in f:
+                    rec = json.loads(line)
+                    if rec.get("event") == "serve_summary":
+                        rollup = rec.get("http")
+        except OSError:
+            pass
+
+    lat_arr = np.asarray(sorted(lat))
+    p50 = float(np.percentile(lat_arr, 50)) if lat_arr.size else 0.0
+    p99 = float(np.percentile(lat_arr, 99)) if lat_arr.size else 0.0
+    failed = counter["failed"]
+    result = {
+        "metric": (f"gmm serve --http warm p50 latency over TCP "
+                   f"(K={k}, D={d}, {n_workers} workers, cpu)"),
+        "value": round(p50, 6),
+        "unit": "s",
+        # TCP p50 / in-process p50: the network + pool tier's unit cost.
+        "vs_baseline": round(p50 / max(inproc_p50, 1e-9), 3),
+        "accelerator_unavailable": accel_unavailable,
+        "http": {
+            "train_n": n, "d": d, "k": k, "rows_per_request": rows,
+            "workers": n_workers, "clients": n_clients,
+            "requests": n_requests, "startup_s": round(startup_s, 3),
+            "p50_s": round(p50, 6), "p99_s": round(p99, 6),
+            "qps": round(len(lat) / max(load_wall, 1e-9), 2),
+            "inproc_p50_s": round(inproc_p50, 6),
+            # The acceptance bit: a SIGKILLed worker mid-load cost ZERO
+            # failed client requests (sibling retry + respawn hid it).
+            "failed_requests": int(failed),
+            "zero_failed_requests": bool(failed == 0),
+            "worker_killed": bool(kill["t_kill"] is not None),
+            "kill_recovery_s": (round(kill["recovery_s"], 3)
+                                if kill["recovery_s"] is not None
+                                else None),
+            "client": client.stats(),
+            # SIGTERM drain over TCP keeps the preemption contract.
+            "drain_exit_code": int(drain_rc),
+            "clean_drain_exit_75": bool(drain_rc == 75),
+            # The server's own serve_summary.http rollup, verbatim.
+            "rollup": rollup,
+        },
+        "measured_utc": time.strftime("%Y-%m-%dT%H:%M:%SZ", time.gmtime()),
+    }
+    if accel_unavailable:
+        result["platform_note"] = (
+            "accelerator tunnel unavailable (probe failed); the http "
+            "tier always measures CPU workers, so this note only "
+            "records how the session got here")
+    return result
+
+
 def run_drift_bench(platform: str, accel_unavailable: bool) -> dict:
     """The --drift mode: rev v2.4 serve-time drift-detection contract.
 
@@ -2135,6 +2392,8 @@ def main() -> int:
                      or os.environ.get("GMM_BENCH_ENVELOPE") == "1")
     want_serve = ("--serve" in sys.argv[1:]
                   or os.environ.get("GMM_BENCH_SERVE") == "1")
+    want_http = ("--http" in sys.argv[1:]
+                 or os.environ.get("GMM_BENCH_HTTP") == "1")
     want_drift = ("--drift" in sys.argv[1:]
                   or os.environ.get("GMM_BENCH_DRIFT") == "1")
     want_lifecycle = ("--lifecycle" in sys.argv[1:]
@@ -2259,6 +2518,15 @@ def main() -> int:
         # Serving cold-vs-warm A/B over the AOT executable cache
         # (ignores --config; sized by GMM_BENCH_SERVE_*).
         result = run_serve_bench(platform, accel_unavailable)
+        watchdog.cancel()
+        print(json.dumps(result))
+        return 3 if accel_unavailable else 0
+
+    if want_http:
+        # Network-tier contract: closed-loop TCP load against a real
+        # `gmm serve --http --workers` subprocess tree, with a mid-load
+        # worker SIGKILL (ignores --config; sized by GMM_BENCH_HTTP_*).
+        result = run_http_bench(platform, accel_unavailable)
         watchdog.cancel()
         print(json.dumps(result))
         return 3 if accel_unavailable else 0

@@ -29,7 +29,8 @@ def build_parser() -> argparse.ArgumentParser:
         "trajectory, sweep summary) offline; `gmm export` persists a "
         "fitted model (sweep checkpoint or .summary) into a serving "
         "registry; `gmm serve` runs the micro-batched scoring loop over "
-        "a registry (JSONL protocol; docs/SERVING.md); `gmm fleet` fits "
+        "a registry (JSONL protocol, or `--http PORT [--workers N]` for "
+        "the supervised HTTP tier; docs/SERVING.md); `gmm fleet` fits "
         "a manifest of per-tenant datasets as packed multi-tenant "
         "dispatches (docs/TENANCY.md); `gmm diff A B` compares two runs "
         "with --fail-on regression gates (exit 0 clean / 1 regressed); "
@@ -344,7 +345,8 @@ def main(argv=None) -> int:
         return export_main(argv[1:])
     if argv and argv[0] == "serve":
         # `gmm serve`: the micro-batched scoring loop over a registry
-        # (JSONL protocol on stdin/socket; docs/SERVING.md).
+        # (JSONL protocol on stdin/socket, or --http [--workers N] for
+        # the supervised HTTP front end; docs/SERVING.md).
         from .serving.server import serve_main
 
         return serve_main(argv[1:])

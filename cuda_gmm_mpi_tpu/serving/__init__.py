@@ -9,13 +9,18 @@ per model (:mod:`.server`, the ``gmm serve`` CLI).
 """
 
 from .breaker import CircuitBreakers
+from .client import GMMClient, GMMClientError
 from .executor import (ScoringExecutor, executor_for_config,
                        executor_for_model, pow2_bucket)
+from .http import HTTPFrontEnd, InprocBackend
+from .pool import WorkerPool
 from .registry import ModelRegistry, RegistryError, ServedModel
 from .server import GMMServer, serve_main
 
 __all__ = [
-    "CircuitBreakers", "GMMServer", "ModelRegistry", "RegistryError",
-    "ScoringExecutor", "ServedModel", "executor_for_config",
-    "executor_for_model", "pow2_bucket", "serve_main",
+    "CircuitBreakers", "GMMClient", "GMMClientError", "GMMServer",
+    "HTTPFrontEnd", "InprocBackend", "ModelRegistry", "RegistryError",
+    "ScoringExecutor", "ServedModel", "WorkerPool",
+    "executor_for_config", "executor_for_model", "pow2_bucket",
+    "serve_main",
 ]

@@ -547,6 +547,17 @@ class GMMServer:
         slow = faults.take("serve_slow", model=name)
         if slow is not None:
             time.sleep(float(slow.get("ms", 0)) / 1e3)
+        crash = faults.take(
+            "worker_crash", model=name,
+            worker=int(os.environ.get("GMM_SERVE_WORKER", "-1") or -1),
+            gen=int(os.environ.get("GMM_SERVE_WORKER_GEN", "-1") or -1))
+        if crash is not None:
+            # Hard process death mid-dispatch (no flush, no summary, no
+            # atexit) -- indistinguishable from a SIGKILL'd or OOM'd pool
+            # worker, which is the point: the worker pool's sibling
+            # retry + respawn arc (serving/pool.py) must contain exactly
+            # this.
+            os._exit(int(crash.get("exitcode", 9)))
         return m, good, rows, t0
 
     def _dispatch(self, name: str, version: Optional[int],
@@ -867,7 +878,15 @@ class GMMServer:
                         if p.trace_id is not None else {}))
             rec.metrics.count("serve_requests")
             rec.metrics.observe("serve.latency_ms", latency_ms)
-        p.reply(resp)
+        try:
+            p.reply(resp)
+        except Exception:
+            # The reply callback crosses into front-end-owned I/O (a
+            # socket wfile, an HTTP handler's event). A client that
+            # vanished mid-flight must cost us one undeliverable
+            # response, never the tick loop or the process.
+            if rec.active:
+                rec.metrics.count("serve_reply_failed")
 
     def _reply_error(self, p: _Pending, msg: str, model=None,
                      detail: Optional[str] = None) -> None:
@@ -906,11 +925,13 @@ class GMMServer:
                             fastfails=int(self.breaker_fastfails)),
         }
 
-    def emit_summary(self) -> Optional[dict]:
+    def emit_summary(self, **extra) -> Optional[dict]:
         """The closing ``serve_summary`` record (run_summary's serving
         sibling): volume, QPS, latency percentiles, executor counters,
         the resilience counters (rev v1.7), and the metrics-registry
-        snapshot."""
+        snapshot. ``extra`` carries opt-in plane rollups (the HTTP front
+        end's ``http`` block, rev v2.7); an empty extra keeps the record
+        byte-identical to pre-v2.7 streams."""
         rec = telemetry.current()
         wall = time.perf_counter() - self._t_start
         # Close out any partial drift windows first (rev v2.4): a serve
@@ -942,6 +963,7 @@ class GMMServer:
             **({"drift": self.drift_stats()}
                if self._drift_interval_s is not None else {}),
             **self.resilience_stats(),
+            **extra,
         )
 
     # -- streaming loops -------------------------------------------------
@@ -1168,17 +1190,38 @@ def _json_default(o):
     return str(o)
 
 
+#: Per-connection read deadline and line bound shared by the UNIX-socket
+#: and HTTP front ends (serving/http.py mirrors them as body bounds): a
+#: stalled client must time out instead of wedging a reader thread, and
+#: an unbounded line must be rejected instead of buffered.
+READ_TIMEOUT_S = 30.0
+MAX_LINE_BYTES = 8 << 20
+
+
 def _serve_socket(server: GMMServer, path: str,
                   max_requests: Optional[int],
-                  reload_interval_s: Optional[float] = None) -> str:
+                  reload_interval_s: Optional[float] = None,
+                  read_timeout_s: float = READ_TIMEOUT_S,
+                  max_line_bytes: int = MAX_LINE_BYTES) -> str:
     """UNIX-socket front end: every connection speaks the same JSONL
     protocol; requests from ALL connections land on one batching queue,
     so concurrent clients coalesce into shared dispatches (the
     micro-batching win a per-connection loop could never get). Returns
-    the tick loop's stop reason."""
+    the tick loop's stop reason.
+
+    Reader containment (rev v2.7): each connection's reads carry a
+    deadline (``read_timeout_s``; a slowloris client used to park its
+    reader thread on an unbounded ``readline()`` forever) and a line
+    bound (``max_line_bytes``; an oversized request is answered
+    ``line_too_long`` and the connection closed, instead of the line
+    growing without bound in the read buffer)."""
     import socketserver
 
     class Handler(socketserver.StreamRequestHandler):
+        # StreamRequestHandler.setup() applies this as the connection's
+        # socket timeout; a stalled read raises instead of blocking.
+        timeout = read_timeout_s
+
         def handle(self):
             lock = threading.Lock()
 
@@ -1188,10 +1231,37 @@ def _serve_socket(server: GMMServer, path: str,
                     with lock:
                         self.wfile.write(line.encode() + b"\n")
                         self.wfile.flush()
-                except (BrokenPipeError, OSError):
-                    pass  # client went away; the dispatch already ran
+                except (BrokenPipeError, OSError, ValueError):
+                    # Client went away; the dispatch already ran. A
+                    # closed BufferedWriter raises ValueError, not
+                    # OSError -- missing it here once let an abandoned
+                    # connection kill the whole worker process.
+                    pass
 
-            for raw in self.rfile:
+            while True:
+                try:
+                    raw = self.rfile.readline(max_line_bytes + 1)
+                except OSError:
+                    # Read deadline hit (socket.timeout is an OSError) or
+                    # the client vanished: release this reader thread.
+                    break
+                if not raw:
+                    break  # clean EOF
+                if len(raw) > max_line_bytes:
+                    reply({"ok": False, "error": "line_too_long",
+                           "detail": "request line exceeds the "
+                           f"{max_line_bytes}-byte bound"})
+                    # Drain the rest of the offending line (bounded:
+                    # a few more chunks, never the whole stream) so
+                    # closing doesn't RST the un-read reply away.
+                    try:
+                        for _ in range(64):
+                            tail = self.rfile.readline(max_line_bytes + 1)
+                            if not tail or tail.endswith(b"\n"):
+                                break
+                    except OSError:
+                        pass
+                    break
                 server.submit_line(raw.decode("utf-8", "replace"), reply)
                 if server._stop.is_set():
                     break
@@ -1215,6 +1285,150 @@ def _serve_socket(server: GMMServer, path: str,
                 os.remove(path)
             except OSError:
                 pass
+
+
+def _write_port_file(path: Optional[str], port: Optional[int]) -> None:
+    """Atomically publish the bound HTTP port (resolves ``--http 0``)."""
+    if not path or port is None:
+        return
+    tmp = path + ".tmp"
+    with open(tmp, "w", encoding="utf-8") as f:
+        f.write(str(int(port)))
+    os.replace(tmp, path)
+
+
+def _worker_argv(args, worker_sock: str) -> List[str]:
+    """One pool worker's command line: the SAME serve CLI, minus the
+    pool/http flags, plus its own --socket -- every already-tested
+    single-process behavior (coalescing, breakers, drift, lifecycle,
+    drain-on-SIGTERM) carries over unchanged."""
+    cmd = [sys.executable, "-m", "cuda_gmm_mpi_tpu.cli", "serve",
+           "--registry", args.registry, "--socket", worker_sock,
+           "--max-batch-rows", str(args.max_batch_rows),
+           "--tick-ms", str(args.tick_ms),
+           "--read-timeout-s", str(args.read_timeout_s),
+           "--max-body-bytes", str(args.max_body_bytes),
+           "--breaker-threshold", str(args.breaker_threshold),
+           "--breaker-backoff-s", str(args.breaker_backoff_s)]
+    if args.models is not None:
+        cmd += ["--models", *args.models]
+    if args.no_warmup:
+        cmd.append("--no-warmup")
+    if args.device:
+        cmd += ["--device", args.device]
+    if args.autotune != "off":
+        cmd += ["--autotune", args.autotune]
+    if args.tuning_db:
+        cmd += ["--tuning-db", args.tuning_db]
+    if args.max_queue_rows is not None:
+        cmd += ["--max-queue-rows", str(args.max_queue_rows)]
+    if args.default_deadline_ms is not None:
+        cmd += ["--default-deadline-ms", str(args.default_deadline_ms)]
+    if args.reload_interval_s is not None:
+        cmd += ["--reload-interval-s", str(args.reload_interval_s)]
+    if args.drift_interval_s is not None:
+        cmd += ["--drift-interval-s", str(args.drift_interval_s),
+                "--drift-psi-threshold", str(args.drift_psi_threshold)]
+    if args.lifecycle:
+        cmd += ["--lifecycle", args.lifecycle]
+    if args.stack_models:
+        cmd.append("--stack-models")
+    return cmd
+
+
+def _serve_pool_main(args) -> int:
+    """``gmm serve --http PORT --workers N``: the supervised pool mode.
+
+    The parent is a router + supervisor only (serving/pool.py owns the
+    containment arc); its telemetry stream carries the HTTP edge --
+    http_request / worker_spawn / worker_exit events and a closing
+    serve_summary whose ``http`` rollup ``gmm diff`` gates on. Worker
+    streams land next to the parent's (``<base>.worker<i>.jsonl``)."""
+    import tempfile
+
+    from .http import HTTPFrontEnd
+    from .pool import WorkerPool
+
+    worker_dir = args.worker_dir or tempfile.mkdtemp(
+        prefix="gmm-serve-pool-")
+
+    def command_for(idx: int, sock: str) -> List[str]:
+        cmd = _worker_argv(args, sock)
+        if args.metrics_file:
+            base, ext = os.path.splitext(args.metrics_file)
+            cmd += ["--metrics-file",
+                    f"{base}.worker{idx}{ext or '.jsonl'}"]
+        return cmd
+
+    rec = (telemetry.RunRecorder(args.metrics_file)
+           if args.metrics_file else telemetry.RunRecorder())
+    rec.set_context(path="serve")
+    sup = supervisor_mod.RunSupervisor(max_runtime_s=args.max_runtime)
+    pool = WorkerPool(args.workers, worker_dir, command_for,
+                      backoff_base_s=args.worker_backoff_s,
+                      quarantine_after=args.worker_quarantine_after)
+    t_start = time.perf_counter()
+    with telemetry.use(rec), rec, supervisor_mod.use(sup), \
+            tl_exporter.live_plane(
+                args.metrics_port,
+                registry_provider=lambda: telemetry.current().metrics,
+                gauges_provider=pool.gauges,
+                recorder=rec):
+        rec.heartbeat("serve")
+        try:
+            pool.start()
+        except (RuntimeError, OSError) as e:
+            print(f"worker pool failed to start: {e}", file=sys.stderr)
+            pool.close()
+            return 1
+        front = HTTPFrontEnd(
+            pool, host=args.http_host, port=args.http,
+            max_body_bytes=args.max_body_bytes,
+            read_timeout_s=args.read_timeout_s,
+            max_connections=args.http_max_connections,
+            stopping=lambda: sup.stop_requested)
+        front.start()
+        _write_port_file(args.http_port_file, front.port)
+        try:
+            reason = "max_requests"
+            while True:
+                if sup.active and sup.poll(where="serve"):
+                    reason = "preempted"
+                    break
+                if (args.max_requests is not None
+                        and front.requests >= args.max_requests):
+                    reason = "max_requests"
+                    break
+                time.sleep(0.05)
+            # Drain order is the /readyz contract: the probe already
+            # flips 503 (sup.stop_requested / pool.draining), THEN the
+            # workers flush their queues and exit 75, THEN we summarize.
+            pool.begin_drain()
+            pool.wait(timeout_s=60.0)
+        finally:
+            front.stop()
+            pool.close()
+        if rec.active:
+            wall = time.perf_counter() - t_start
+            rec.emit(
+                "serve_summary",
+                requests=int(front.requests), batches=0,
+                rows=int(front.rows), errors=int(front.errors_5xx),
+                wall_s=round(wall, 6),
+                qps=(round(front.requests / wall, 3) if wall > 0
+                     else 0.0),
+                latency_ms=front.latency_summary(),
+                metrics=rec.metrics.snapshot(),
+                http=front.http_rollup())
+        if reason == "preempted":
+            stop_reason = sup.stop_reason or "preempt"
+            if rec.active:
+                rec.emit("shutdown", reason=stop_reason,
+                         checkpointed=False)
+            print(f"Preempted -- worker pool drained ({stop_reason}); "
+                  "workers flushed their queues", file=sys.stderr)
+            return supervisor_mod.EX_TEMPFAIL
+    return 0
 
 
 def serve_main(argv=None) -> int:
@@ -1280,6 +1494,62 @@ def serve_main(argv=None) -> int:
     p.add_argument("--trace-dir", default=None, metavar="DIR",
                    help="capture a jax.profiler trace of the serve "
                    "loop into DIR (view with TensorBoard or Perfetto)")
+    net = p.add_argument_group(
+        "network front end (docs/SERVING.md \"HTTP front end\")")
+    net.add_argument("--http", type=int, default=None, metavar="PORT",
+                     help="serve POST /v1/models/NAME[@VER]:OP over "
+                     "HTTP on this port (0 = OS-assigned; see "
+                     "--http-port-file), with /healthz /readyz "
+                     "/metrics probes. Requests ride the same "
+                     "micro-batch queue, deadlines, and breakers as "
+                     "the JSONL protocol. Default: off -- responses "
+                     "and streams stay byte-identical")
+    net.add_argument("--http-host", default="127.0.0.1", metavar="HOST",
+                     help="HTTP bind address (default 127.0.0.1; bind "
+                     "0.0.0.0 only behind a load balancer you trust)")
+    net.add_argument("--workers", type=int, default=0, metavar="N",
+                     help="fork N supervised worker processes behind "
+                     "the HTTP front end (requires --http): consistent "
+                     "(model,version)->worker routing, sibling retry "
+                     "of a crashed worker's in-flight requests, "
+                     "jittered-doubling respawn, crash-loop "
+                     "quarantine (docs/ROBUSTNESS.md). Default 0: "
+                     "serve in-process")
+    net.add_argument("--http-port-file", default=None, metavar="FILE",
+                     help="write the BOUND http port here once "
+                     "listening (resolves --http 0 for tests/benches)")
+    net.add_argument("--http-max-connections", type=int, default=64,
+                     metavar="N",
+                     help="live HTTP connection cap; arrivals past it "
+                     "shed 503 + Retry-After instead of exhausting "
+                     "handler threads (default 64)")
+    net.add_argument("--max-body-bytes", type=int, default=MAX_LINE_BYTES,
+                     metavar="BYTES",
+                     help="bound on one HTTP request body / one JSONL "
+                     "socket line; oversized requests are rejected "
+                     "(413 / line_too_long) before buffering "
+                     "(default 8 MiB)")
+    net.add_argument("--read-timeout-s", type=float,
+                     default=READ_TIMEOUT_S, metavar="SECONDS",
+                     help="per-connection read deadline for the HTTP "
+                     "and UNIX-socket front ends: a stalled (slowloris) "
+                     "client times out instead of wedging a reader "
+                     "thread forever (default 30)")
+    net.add_argument("--worker-dir", default=None, metavar="DIR",
+                     help="worker pool state directory: per-worker "
+                     "sockets, {pid, socket, gen} state files, logs, "
+                     "and quarantine reason files (default: a fresh "
+                     "temp directory)")
+    net.add_argument("--worker-backoff-s", type=float, default=0.5,
+                     metavar="SECONDS",
+                     help="base respawn backoff after a worker crash; "
+                     "doubles per consecutive crash with deterministic "
+                     "jitter (default 0.5)")
+    net.add_argument("--worker-quarantine-after", type=int, default=5,
+                     metavar="N",
+                     help="consecutive crashes that quarantine a "
+                     "worker slot (reason file written; siblings keep "
+                     "serving; default 5)")
     r = p.add_argument_group(
         "resilience (docs/ROBUSTNESS.md \"Serving\")")
     r.add_argument("--max-runtime", type=float, default=None,
@@ -1358,6 +1628,23 @@ def serve_main(argv=None) -> int:
         # take effect.
         p.error("--socket conflicts with --input/--output (socket "
                 "clients carry their own request/response streams)")
+    if args.http is not None and (args.socket or args.input
+                                  or args.output):
+        p.error("--http conflicts with --socket/--input/--output "
+                "(HTTP clients carry their own request/response "
+                "streams)")
+    if args.workers and args.http is None:
+        p.error("--workers forks processes behind the HTTP front end; "
+                "it requires --http")
+    if args.workers < 0:
+        p.error("--workers must be >= 0")
+
+    if args.http is not None and args.workers > 0:
+        # Pool mode: this process becomes a pure HTTP router +
+        # supervisor over N forked `gmm serve --socket` workers. It
+        # never loads a model or touches an executor, so a worker's
+        # death can never take the front end with it.
+        return _serve_pool_main(args)
 
     if args.device:
         os.environ["JAX_PLATFORMS"] = args.device
@@ -1436,9 +1723,33 @@ def serve_main(argv=None) -> int:
             print(f"cannot load {spec!r}: {e}", file=sys.stderr)
             return 1
 
-        if args.socket:
+        front = None
+        if args.http is not None:
+            from .http import HTTPFrontEnd, InprocBackend
+
+            front = HTTPFrontEnd(
+                InprocBackend(server), host=args.http_host,
+                port=args.http, max_body_bytes=args.max_body_bytes,
+                read_timeout_s=args.read_timeout_s,
+                max_connections=args.http_max_connections,
+                # /readyz flips the instant the stop flag trips (signal
+                # time), BEFORE the tick loop notices and flushes: a
+                # load balancer stops routing while the drain answers
+                # what it already admitted.
+                stopping=lambda: sup.stop_requested)
+            front.start()
+            _write_port_file(args.http_port_file, front.port)
+            try:
+                reason = server.run_loop(
+                    max_requests=args.max_requests,
+                    reload_interval_s=args.reload_interval_s)
+            finally:
+                front.stop()
+        elif args.socket:
             reason = _serve_socket(server, args.socket, args.max_requests,
-                                   args.reload_interval_s)
+                                   args.reload_interval_s,
+                                   read_timeout_s=args.read_timeout_s,
+                                   max_line_bytes=args.max_body_bytes)
         else:
             out = (open(args.output, "w", encoding="utf-8")
                    if args.output else sys.stdout)
@@ -1466,7 +1777,8 @@ def serve_main(argv=None) -> int:
                     src.close()
                 if args.output:
                     out.close()
-        server.emit_summary()
+        server.emit_summary(**({"http": front.http_rollup()}
+                               if front is not None else {}))
         if reason == "preempted":
             # The PR-4 exit contract: drained by signal/deadline ->
             # telemetry shutdown record + exit 75 (EX_TEMPFAIL), so a

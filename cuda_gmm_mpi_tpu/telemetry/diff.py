@@ -64,6 +64,12 @@ DEFAULT_FAIL_ON = (
     # is a regression even though serving survived it by design.
     "lifecycle.rollbacks>0",
     "lifecycle.quarantines>0",
+    # Network front end (rev v2.7): a 5xx answered to a client, a worker
+    # process crash, or a request that exhausted the pool's sibling
+    # retry is a regression even when the tier absorbed it.
+    "http.errors_5xx>0",
+    "http.worker_crashes>0",
+    "http.retries_exhausted>0",
 )
 
 #: a tuned run this much slower than its own recorded profile regresses.
@@ -200,6 +206,14 @@ def summarize_run(records: List[dict]) -> dict:
             self_prof = r.get("profile")
             if isinstance(self_prof, dict):
                 _fold_profile(self_prof, metrics)
+            # HTTP front-end rollup (rev v2.7): flatten the ``http``
+            # dict so its counters gate like any other serve metric.
+            http = r.get("http")
+            if isinstance(http, dict):
+                for k, raw in http.items():
+                    v = _num(raw)
+                    if v is not None:
+                        metrics[f"http.{k}"] = v
         elif ev == "fleet_summary":
             for src in ("tenants", "dropped", "groups", "wall_s"):
                 v = _num(r.get(src))
@@ -213,6 +227,13 @@ def summarize_run(records: List[dict]) -> dict:
         # that simply had no lifecycle trouble, instead of evaporating
         # when one side lacks the metric.
         for key in ("lifecycle.rollbacks", "lifecycle.quarantines"):
+            metrics.setdefault(key, 0.0)
+    if serve_seen:
+        # Same explicit-zero contract for the HTTP gates: a serve run
+        # with the front end off (or one that simply saw no trouble)
+        # reads 0, so baselines stay comparable across http on/off.
+        for key in ("http.errors_5xx", "http.worker_crashes",
+                    "http.retries_exhausted"):
             metrics.setdefault(key, 0.0)
 
     summaries = [r for r in records if r.get("event") == "run_summary"]

@@ -127,6 +127,10 @@ def render_report(records: List[dict], max_trajectory_rows: int = 400) -> str:
     serve_reloads = [r for r in records
                      if r.get("event") == "serve_reload"]
     circuits = [r for r in records if r.get("event") == "circuit"]
+    http_reqs = [r for r in records if r.get("event") == "http_request"]
+    worker_spawns = [r for r in records
+                     if r.get("event") == "worker_spawn"]
+    worker_exits = [r for r in records if r.get("event") == "worker_exit"]
     drift_windows = [r for r in records if r.get("event") == "drift"]
     drift_alarms = [r for r in records
                     if r.get("event") == "drift_alarm"]
@@ -253,7 +257,8 @@ def render_report(records: List[dict], max_trajectory_rows: int = 400) -> str:
 
     if (serve_reqs or serve_batches or serve_summaries or serve_sheds
             or serve_deadlines or serve_reloads or circuits
-            or drift_windows):
+            or drift_windows or http_reqs or worker_spawns
+            or worker_exits):
         out.append("Serving (rev v1.6; docs/SERVING.md):")
         if serve_reqs:
             by_model: Dict[str, List[dict]] = {}
@@ -308,6 +313,38 @@ def render_report(records: List[dict], max_trajectory_rows: int = 400) -> str:
                         f"backoff {r.get('backoff_s')}s)")
             out.append(f"  circuit {r.get('model')}{ver}: "
                        f"{r.get('state')}{tail}")
+        # Network front end (rev v2.7; docs/SERVING.md "HTTP front end").
+        if http_reqs:
+            by_status: Dict[str, int] = {}
+            for r in http_reqs:
+                key = f"{int(r.get('status', 0)) // 100}xx"
+                by_status[key] = by_status.get(key, 0) + 1
+            lat = sorted(float(r.get("latency_ms", 0.0))
+                         for r in http_reqs)
+            retried = sum(1 for r in http_reqs if r.get("retried"))
+            line = (f"  http: {len(http_reqs)} requests ("
+                    + ", ".join(f"{n} {k}"
+                                for k, n in sorted(by_status.items()))
+                    + f"), p50 {lat[len(lat) // 2]:.3f} ms")
+            if retried:
+                line += f", {retried} answered via sibling retry"
+            out.append(line)
+        if worker_spawns or worker_exits:
+            crashes = [r for r in worker_exits if r.get("crash")]
+            quarantined = [r for r in worker_exits
+                           if r.get("quarantined")]
+            respawns = sum(1 for r in worker_spawns if r.get("respawn"))
+            line = (f"  workers: {len(worker_spawns)} spawn(s) "
+                    f"({respawns} respawns), {len(crashes)} crash(es)")
+            if quarantined:
+                line += f", {len(quarantined)} quarantined"
+            out.append(line)
+            for r in crashes:
+                out.append(
+                    f"    worker {r.get('worker')} pid {r.get('pid')} "
+                    f"exited {r.get('exitcode')}"
+                    + (" -> QUARANTINED" if r.get("quarantined")
+                       else ""))
         if drift_windows:
             # Drift plane (rev v2.4): latest window per (model, version);
             # alarm count from the dedicated drift_alarm records so a
@@ -356,6 +393,19 @@ def render_report(records: List[dict], max_trajectory_rows: int = 400) -> str:
                     f"({br.get('fastfails', 0)} fast-fails, "
                     f"{br.get('open_routes', 0)} open), "
                     f"{s.get('reloads', 0)} hot-reloads")
+            http = s.get("http") or {}
+            if http:
+                out.append(
+                    f"  http: {http.get('requests', 0)} requests "
+                    f"({http.get('errors_4xx', 0)} 4xx, "
+                    f"{http.get('errors_5xx', 0)} 5xx, "
+                    f"{http.get('shed_connections', 0)} shed); "
+                    f"workers {http.get('workers', 0)}: "
+                    f"{http.get('worker_crashes', 0)} crash(es), "
+                    f"{http.get('worker_respawns', 0)} respawn(s), "
+                    f"{http.get('worker_quarantines', 0)} quarantined; "
+                    f"{http.get('retries', 0)} sibling retries "
+                    f"({http.get('retries_exhausted', 0)} exhausted)")
         out.append("")
 
     if lifecycles or registry_torns:
@@ -838,6 +888,32 @@ def render_follow(records: List[dict]) -> str:
             extras.append(f"{opens} breaker trip(s)")
         if extras:
             line += "  [" + ", ".join(extras) + "]"
+        out.append(line)
+
+    http_reqs = by.get("http_request", [])
+    if http_reqs:
+        # HTTP front-end rollup (rev v2.7): status classes + tail p50.
+        err5 = sum(1 for r in http_reqs
+                   if int(r.get("status", 0)) >= 500)
+        retried = sum(1 for r in http_reqs if r.get("retried"))
+        lat = sorted(float(r.get("latency_ms", 0.0))
+                     for r in http_reqs[-200:])
+        p50 = lat[len(lat) // 2] if lat else 0.0
+        line = (f"http: {len(http_reqs)} requests ({err5} 5xx), "
+                f"p50 {p50:.2f} ms")
+        if retried:
+            line += f"  [{retried} sibling retr{'y' if retried == 1 else 'ies'}]"
+        out.append(line)
+    worker_exits = by.get("worker_exit", [])
+    worker_spawns = by.get("worker_spawn", [])
+    if worker_spawns or worker_exits:
+        crashes = sum(1 for r in worker_exits if r.get("crash"))
+        quarantined = sum(1 for r in worker_exits
+                          if r.get("quarantined"))
+        line = (f"workers: {len(worker_spawns)} spawn(s), "
+                f"{crashes} crash(es)")
+        if quarantined:
+            line += f"  [{quarantined} QUARANTINED]"
         out.append(line)
 
     drifts = by.get("drift", [])

@@ -260,8 +260,44 @@ EVENT_FIELDS: Dict[str, Tuple[Tuple[str, ...], Tuple[str, ...]]] = {
         # {windows, alarms, last {model-> last stats}}; present only
         # when --drift-interval-s was set, so drift-off streams stay
         # byte-identical.
+        # ``http`` (optional, rev v2.7): the HTTP front-end rollup --
+        # {requests, errors_4xx, errors_5xx, shed_connections, retries,
+        # retries_exhausted, worker_crashes, worker_respawns,
+        # worker_quarantines, workers}; present only under ``--http``,
+        # so HTTP-off streams stay byte-identical. ``gmm diff`` folds it
+        # into the ``http.errors_5xx`` / ``http.worker_crashes`` /
+        # ``http.retries_exhausted`` default gates.
         ("models", "executor", "errors", "shed", "deadline_expired",
-         "reloads", "breaker", "stacked_batches", "profile", "drift"),
+         "reloads", "breaker", "stacked_batches", "profile", "drift",
+         "http"),
+    ),
+    # One per answered HTTP request (stream rev v2.7; serving/http.py,
+    # docs/SERVING.md "HTTP front end"): ``status`` is the HTTP status
+    # actually sent, ``latency_ms`` receive-to-reply on the handler
+    # thread. ``worker`` (pool mode) names the worker slot that scored
+    # it; ``retried`` marks answers that needed the sibling retry after
+    # a worker crash. ``trace_id`` is the echoed X-GMM-Trace-Id, joining
+    # the HTTP edge to the server-side serve_request/span records.
+    "http_request": (
+        ("method", "path", "status", "latency_ms"),
+        ("model", "op", "n", "error", "worker", "retried", "trace_id"),
+    ),
+    # One per worker-pool process start (rev v2.7; serving/pool.py):
+    # first launch and every respawn. ``respawn`` marks restarts after a
+    # crash; ``attempt`` the consecutive-crash count driving the
+    # jittered doubling ``backoff_s``.
+    "worker_spawn": (
+        ("worker", "pid"),
+        ("socket", "attempt", "backoff_s", "respawn"),
+    ),
+    # One per worker-pool process exit (rev v2.7): ``crash`` is true for
+    # unexpected deaths (anything outside a requested drain), and
+    # ``quarantined`` marks the crash that tripped the crash-loop
+    # quarantine (reason file written; the slot stops respawning while
+    # siblings keep serving).
+    "worker_exit": (
+        ("worker", "exitcode"),
+        ("pid", "reason", "crash", "quarantined"),
     ),
     # One per elapsed drift window per served (model, version) route
     # (stream rev v2.4; serving/server.py --drift-interval-s,

@@ -15,8 +15,9 @@ Event mapping (the full table lives in docs/OBSERVABILITY.md):
 * ``span`` records -> nested ``X`` (complete) duration events, one
   Perfetto track per (stream = pid, emitting thread = tid);
 * ``em_iter`` / ``chunk_flush`` / ``serve_batch`` / ``serve_request`` /
-  ``compile`` -> ``X`` slices with args (loglik, prefetch wait, batch
-  rows, flops), each ending at its record's emission time;
+  ``http_request`` / ``compile`` -> ``X`` slices with args (loglik,
+  prefetch wait, batch rows, HTTP status, flops), each ending at its
+  record's emission time;
 * sampler ``heartbeat`` resource stamps and stream-derived rates ->
   ``C`` counter tracks (host RSS, device bytes, EM iters/s, queued
   rows), and rev v2.4 ``drift`` windows -> per-model PSI/KS counter
@@ -82,6 +83,7 @@ _THREAD_INSTANTS = frozenset((
     "elastic_shrink", "elastic_resume", "circuit", "serve_shed",
     "serve_deadline", "serve_reload", "merge", "rebucket",
     "drift_alarm", "lifecycle", "registry_torn",
+    "worker_spawn", "worker_exit",
 ))
 _PROCESS_INSTANTS = frozenset((
     "run_start", "run_summary", "serve_summary", "fleet_start",
@@ -99,6 +101,8 @@ _SLICE_ARGS = {
                     "compiled", "stacked", "version"),
     "serve_request": ("model", "op", "n", "ok", "error", "trace_id",
                       "version"),
+    "http_request": ("method", "path", "status", "model", "op", "n",
+                     "error", "worker", "retried", "trace_id"),
     "compile": ("source", "site", "phase", "key", "flops",
                 "bytes_accessed", "argument_bytes", "output_bytes"),
 }
@@ -280,7 +284,7 @@ def _slice_of(rec: dict, align: dict) -> Optional[Tuple[float, float]]:
                + (_num(rec.get("compute_s")) or 0.0))
     elif kind == "serve_batch":
         dur = (_num(rec.get("wall_ms")) or 0.0) / 1e3
-    elif kind == "serve_request":
+    elif kind in ("serve_request", "http_request"):
         dur = (_num(rec.get("latency_ms")) or 0.0) / 1e3
     elif kind == "compile":
         dur = _num(rec.get("seconds")) or 0.0
@@ -374,7 +378,8 @@ def build_timeline(targets: List[str]) -> dict:
                 start, dur = sliced
                 if kind in ("em_iter", "chunk_flush"):
                     tid = track(_TID_EM, "em")
-                elif kind in ("serve_request", "serve_batch"):
+                elif kind in ("serve_request", "serve_batch",
+                              "http_request"):
                     tid = track(_TID_SERVE, "serve")
                 else:
                     tid = track(_TID_COMPILE, "compile")
@@ -385,12 +390,15 @@ def build_timeline(targets: List[str]) -> dict:
                     name = f"compile:{rec.get('site') or rec.get('source')}"
                 elif kind == "serve_request":
                     name = f"serve:{rec.get('op', 'request')}"
+                elif kind == "http_request":
+                    name = (f"http:{rec.get('op')}" if rec.get("op")
+                            else f"http:{rec.get('path', 'request')}")
                 ev = {"ph": "X", "name": name, "cat": kind, "pid": s.pid,
                       "tid": tid, "ts": _us(start, t0),
                       "dur": round(dur * 1e6, 3),
                       "args": _args_for(rec, kind)}
                 events.append(ev)
-                if kind == "serve_request" \
+                if kind in ("serve_request", "http_request") \
                         and isinstance(rec.get("trace_id"), str):
                     flows_s.append({"ph": "s", "cat": "serve",
                                     "name": "request",

@@ -76,6 +76,19 @@ Supported fault kinds (the spec is ``{kind: {params...}}``):
   dispatch sleeps ``m`` milliseconds before the executor call
   (optionally only for ``model``): deterministic latency injection for
   the deadline/coalescing paths; consumed per dispatch.
+- ``worker_crash`` ``{"worker": w, "gen": g, "model": name,
+  "exitcode": c, "times": n}`` -- the serving dispatch hard-kills its
+  own process (``os._exit``, default code 9 -- indistinguishable from a
+  SIGKILL'd worker) just before the executor call, optionally only in
+  pool worker ``w`` and/or respawn generation ``g`` (matched against the
+  ``GMM_SERVE_WORKER`` / ``GMM_SERVE_WORKER_GEN`` env the pool stamps on
+  each child; generation 0 is the first launch) or for ``model``. The
+  deterministic driver for the worker pool's containment arc
+  (serving/pool.py): sibling retry of the dead worker's in-flight
+  requests, jittered-doubling respawn, crash-loop quarantine. A
+  respawned worker is a FRESH process that re-reads GMM_FAULTS, so pin
+  ``gen: 0`` to crash once and observe the respawn serve clean, or omit
+  ``gen`` to crash every generation and drive the quarantine path.
 - ``registry_torn`` ``{"name": n, "version": v, "times": k}`` -- the
   registry's version load raises :class:`RegistryError` as if the
   artifact were torn on disk (optionally only for one name/version);
@@ -117,8 +130,8 @@ ENV_VAR = "GMM_FAULTS"
 KNOWN_KINDS = ("nan_loglik", "singular_cov", "poison_block", "read_slow",
                "checkpoint_eio", "preempt", "rank_hang", "rank_lost",
                "collective_timeout", "serve_nan", "serve_slow",
-               "registry_torn", "retrain_fail", "canary_regression",
-               "promote_torn")
+               "worker_crash", "registry_torn", "retrain_fail",
+               "canary_regression", "promote_torn")
 
 
 def _values_match(spec_val: Any, val: Any) -> bool:

@@ -368,6 +368,55 @@ def test_lifecycle_mode_contract():
     assert j["vs_baseline"] == lc["overhead"] > 0
 
 
+def test_http_mode_contract():
+    """--http (GMM_BENCH_HTTP=1) emits ONE JSON record proving the rev
+    v2.7 network tier end to end: a real `gmm serve --http --workers 2`
+    subprocess tree driven closed-loop over TCP, a worker SIGKILLed
+    mid-load with ZERO failed client requests (the acceptance bit), the
+    supervised respawn's recovery wall measured, SIGTERM still draining
+    to exit 75, and the server's own serve_summary.http rollup riding
+    the record. value/vs_baseline is TCP p50 over in-process p50 --
+    what the tier costs per request."""
+    r = _run({
+        "GMM_BENCH_CPU": "1",
+        "GMM_BENCH_HTTP": "1",
+        "GMM_BENCH_HTTP_N": "2000",
+        "GMM_BENCH_HTTP_D": "3",
+        "GMM_BENCH_HTTP_K": "4",
+        "GMM_BENCH_HTTP_REQUESTS": "40",
+        "GMM_BENCH_HTTP_WORKERS": "2",
+        "GMM_BENCH_HTTP_CLIENTS": "2",
+    }, timeout=600)
+    assert r.returncode == 0, r.stderr
+    j = _json_line(r.stdout)
+    assert j["unit"] == "s" and j["value"] > 0
+    assert j["accelerator_unavailable"] is False
+    h = j["http"]
+    assert h["workers"] == 2 and h["requests"] == 40
+    assert h["startup_s"] > 0
+    assert h["p50_s"] > 0 and h["p99_s"] >= h["p50_s"]
+    assert h["qps"] > 0
+    # the acceptance bits: the mid-load SIGKILL happened, cost zero
+    # failed requests, and the slot came back under supervision
+    assert h["worker_killed"] is True
+    assert h["failed_requests"] == 0
+    assert h["zero_failed_requests"] is True
+    assert h["kill_recovery_s"] is not None and h["kill_recovery_s"] > 0
+    # SIGTERM over TCP keeps the preemption exit-code contract
+    assert h["drain_exit_code"] == 75
+    assert h["clean_drain_exit_75"] is True
+    # the server's own rollup rode the record: the crash was counted,
+    # nothing 5xx'd, nothing exhausted the sibling retry
+    roll = h["rollup"]
+    assert roll["worker_crashes"] >= 1 and roll["worker_respawns"] >= 1
+    assert roll["errors_5xx"] == 0 and roll["retries_exhausted"] == 0
+    # vs_baseline is the TCP/in-process p50 ratio (independently
+    # rounded fields, so compare with slack)
+    ratio = h["p50_s"] / h["inproc_p50_s"]
+    assert abs(j["vs_baseline"] - ratio) <= 0.01 * ratio + 0.01
+    assert j["vs_baseline"] > 0
+
+
 def test_probe_budget_fails_over_after_one_hang():
     """Default probe budget: ONE attempt -- a hung probe fails over to
     CPU immediately instead of burning the old 5 x 90s retry ladder

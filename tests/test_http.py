@@ -1,0 +1,680 @@
+"""Network-native serving tier (round 20): HTTP front end, retrying
+client, supervised worker pool, and the v2.7 http telemetry contract.
+
+Contracts under test (docs/SERVING.md "HTTP front end",
+docs/ROBUSTNESS.md "Network failure containment"):
+
+  * routing: ``POST /v1/models/<name>[@<version>]:<op>`` parses for
+    exactly the four scoring ops; everything else is a 404, and the
+    error->status taxonomy maps each server-side error token to one
+    unambiguous HTTP status;
+  * the in-process front end answers bit-comparable scores over TCP,
+    echoes ``X-GMM-Trace-Id``, honours ``X-GMM-Deadline-Ms``, serves
+    /healthz /readyz /metrics, and flips /readyz to 503 (Retry-After
+    set) the moment the drain starts -- before the queue flushes;
+  * body bounds (413 for oversize, 411 for missing length) and the
+    connection cap (503 shed + Retry-After) hold;
+  * GMMClient: bounded jittered retries on 429/502/503, a token-bucket
+    retry budget that fails fast under a down pool, deadline
+    propagation over the wire, and hedged duplicates that win when the
+    primary stalls;
+  * the worker pool routes (model, version) to a stable slot with ring
+    failover, skips quarantined slots, and fails fast while draining;
+  * chaos: a worker killed mid-stream (fault-injected exit AND a real
+    SIGKILL) costs ZERO failed client requests -- the sibling retry
+    answers, the supervisor respawns the slot, SIGTERM still drains to
+    exit 75 -- and the stream stays schema-valid with the v2.7 rollup
+    (`errors_5xx == 0`) that `gmm diff` gates on;
+  * HTTP off => the telemetry stream is byte-identical to the pre-HTTP
+    shape: no http/worker events, no ``http`` rollup key.
+"""
+
+import json
+import os
+import signal
+import socket
+import subprocess
+import sys
+import threading
+import time
+import zlib
+from http.client import HTTPConnection
+from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
+
+import numpy as np
+import pytest
+
+from cuda_gmm_mpi_tpu.serving import GMMServer, ModelRegistry
+from cuda_gmm_mpi_tpu.serving.client import GMMClient, GMMClientError
+from cuda_gmm_mpi_tpu.serving.http import (HTTP_OPS, HTTPFrontEnd,
+                                           InprocBackend, parse_model_path,
+                                           status_for_error)
+from cuda_gmm_mpi_tpu.serving.pool import NO_WORKER_WAIT_S, WorkerPool, _Worker
+from cuda_gmm_mpi_tpu.telemetry import read_stream
+from cuda_gmm_mpi_tpu.telemetry.diff import DEFAULT_FAIL_ON, summarize_run
+from cuda_gmm_mpi_tpu.telemetry.schema import validate_stream
+
+from .conftest import communicate_or_kill, worker_env
+from .test_serving import fitted
+
+
+# ------------------------------------------------------------- http plumbing
+
+
+def _post(port, path, body, headers=None, timeout=60.0):
+    """One raw POST; returns (status, headers-dict, decoded-body|raw)."""
+    conn = HTTPConnection("127.0.0.1", port, timeout=timeout)
+    try:
+        data = (body if isinstance(body, (bytes, bytearray))
+                else json.dumps(body).encode("utf-8"))
+        conn.request("POST", path, data,
+                     {"Content-Type": "application/json", **(headers or {})})
+        r = conn.getresponse()
+        raw = r.read()
+        hdrs = {k.lower(): v for k, v in r.getheaders()}
+        try:
+            return r.status, hdrs, json.loads(raw)
+        except ValueError:
+            return r.status, hdrs, raw
+    finally:
+        conn.close()
+
+
+def _get(port, path, timeout=60.0):
+    conn = HTTPConnection("127.0.0.1", port, timeout=timeout)
+    try:
+        conn.request("GET", path)
+        r = conn.getresponse()
+        return r.status, {k.lower(): v for k, v in r.getheaders()}, r.read()
+    finally:
+        conn.close()
+
+
+# ---------------------------------------------------------- routing taxonomy
+
+
+def test_parse_model_path_grammar():
+    assert parse_model_path("/v1/models/m:predict") == ("m", None, "predict")
+    assert parse_model_path("/v1/models/m@3:score_samples") == (
+        "m", 3, "score_samples")
+    assert parse_model_path("/v1/models/blobs-v2@12:predict_proba") == (
+        "blobs-v2", 12, "predict_proba")
+    assert parse_model_path("/v1/models/m:score") == ("m", None, "score")
+    for op in HTTP_OPS:
+        assert parse_model_path(f"/v1/models/m:{op}")[2] == op
+    # everything off-grammar is a route miss, not a crash
+    for bad in ("/v1/models/m:frobnicate", "/v1/models/m", "/healthz",
+                "/v1/models/:predict", "/v2/models/m:predict",
+                "/v1/models/m@x:predict", "/v1/models/m@:predict", ""):
+        assert parse_model_path(bad) is None
+
+
+def test_status_for_error_taxonomy():
+    """Each server-side error token has ONE status: load-shed and drain
+    are retryable (429/503), budget expiry is 504, a crashed-pool miss
+    is 502, model math going non-finite is the server's fault (500),
+    an unknown model is the client's (404)."""
+    assert status_for_error("overloaded") == 429
+    assert status_for_error("shutting_down") == 503
+    assert status_for_error("circuit_open") == 503
+    assert status_for_error("deadline_expired") == 504
+    assert status_for_error("http_timeout") == 504
+    assert status_for_error("worker_unavailable") == 502
+    assert status_for_error("non_finite_scores") == 500
+    assert status_for_error("dispatch failed: boom") == 500
+    assert status_for_error("unknown model 'ghost'") == 404
+    assert status_for_error("registry: torn artifact") == 404
+    assert status_for_error("line_too_long") == 400
+    assert status_for_error("anything else") == 400
+
+
+# -------------------------------------------------------- in-process tier
+
+
+@pytest.fixture
+def inproc(rng, tmp_path):
+    """A live GMMServer loop + HTTP front end in this process: HTTP
+    handler threads feed the same micro-batch queue the socket readers
+    do, so everything downstream of routing is the already-tested
+    server core."""
+    gm, data = fitted(rng)
+    reg_dir = str(tmp_path / "reg")
+    gm.to_registry(reg_dir, "m")
+    server = GMMServer(ModelRegistry(reg_dir))
+    t = threading.Thread(target=server.run_loop, daemon=True)
+    t.start()
+    front = HTTPFrontEnd(InprocBackend(server)).start()
+    try:
+        yield front, server, gm, data
+    finally:
+        front.stop()
+        server._stop.set()   # works even once a test began a drain
+        t.join(timeout=60)
+        assert not t.is_alive()
+
+
+def test_http_scores_match_estimator_and_echo_trace(inproc):
+    front, server, gm, data = inproc
+    port = front.port
+    x = data[:17]
+    st, hdrs, body = _post(port, "/v1/models/m:score_samples",
+                           {"x": x.tolist()},
+                           headers={"X-GMM-Trace-Id": "t-abc123"})
+    assert st == 200, body
+    assert body["ok"] and body["model"] == "m" and body["version"] == 1
+    np.testing.assert_allclose(np.asarray(body["result"]),
+                               gm.score_samples(x), rtol=1e-6)
+    assert hdrs.get("x-gmm-trace-id") == "t-abc123"
+    # explicit version pin routes to the same (only) version
+    st, _, pinned = _post(port, "/v1/models/m@1:predict", {"x": x.tolist()})
+    assert st == 200 and pinned["version"] == 1
+    assert pinned["result"] == gm.predict(x).tolist()
+    # the GMMClient speaks the same dialect end to end
+    client = GMMClient(f"127.0.0.1:{port}")
+    got = client.score(model="m", x=x.tolist())
+    assert np.isclose(got, float(gm.score(x)), rtol=1e-6)
+    assert client.stats()["requests"] == 1
+    assert front.requests >= 3 and front.errors_5xx == 0
+
+
+def test_http_client_errors_map_to_statuses(inproc):
+    front, server, _, data = inproc
+    port = front.port
+    x = data[:4].tolist()
+    st, _, body = _post(port, "/v1/models/ghost:predict", {"x": x})
+    assert st == 404 and not body["ok"]
+    assert "unknown model" in body["error"]
+    st, _, body = _post(port, "/v1/models/m:frobnicate", {"x": x})
+    assert st == 404
+    st, _, body = _post(port, "/v1/models/m:predict", b"{not json")
+    assert st == 400 and body["error"] == "bad_json"
+    st, _, body = _post(port, "/v1/models/m:predict", {"x": x},
+                        headers={"X-GMM-Deadline-Ms": "banana"})
+    assert st == 400 and body["error"] == "bad_deadline"
+    # missing Content-Length (chunked is not part of the dialect)
+    conn = HTTPConnection("127.0.0.1", port, timeout=30.0)
+    try:
+        conn.putrequest("POST", "/v1/models/m:predict",
+                        skip_accept_encoding=True)
+        conn.putheader("Transfer-Encoding", "chunked")
+        conn.endheaders()
+        conn.send(b"0\r\n\r\n")
+        assert conn.getresponse().status == 411
+    finally:
+        conn.close()
+    assert front.errors_4xx >= 4 and front.errors_5xx == 0
+
+
+def test_http_probes_and_metrics_and_drain_flip(inproc):
+    front, server, _, data = inproc
+    port = front.port
+    assert _get(port, "/healthz")[0] == 200
+    assert _get(port, "/readyz")[0] == 200
+    st, _, payload = _get(port, "/metrics")
+    assert st == 200
+    text = payload.decode("utf-8")
+    assert "gmm_http_connections" in text and "# EOF" in text
+    # the drain flips /readyz BEFORE the queue flushes; /healthz stays
+    # 200 (the process is alive, just not accepting new work)
+    server.begin_drain("test")
+    st, hdrs, _ = _get(port, "/readyz")
+    assert st == 503
+    assert int(hdrs["retry-after"]) >= 1
+    assert _get(port, "/healthz")[0] == 200
+
+
+def test_http_deadline_header_expires_to_504(inproc):
+    front, server, _, data = inproc
+    st, _, body = _post(front.port, "/v1/models/m:score",
+                        {"x": data[:4].tolist()},
+                        headers={"X-GMM-Deadline-Ms": "0.0001"})
+    assert st == 504, body
+    assert body["error"] in ("deadline_expired", "http_timeout")
+
+
+def test_http_body_bound_and_connection_cap(rng, tmp_path):
+    """A tight front end: 2 KiB bodies, ONE connection. The oversize
+    body is refused 413 without reading it; the second concurrent
+    connection is shed 503 + Retry-After and counted."""
+    gm, data = fitted(rng)
+    reg_dir = str(tmp_path / "reg")
+    gm.to_registry(reg_dir, "m")
+    server = GMMServer(ModelRegistry(reg_dir))
+    t = threading.Thread(target=server.run_loop, daemon=True)
+    t.start()
+    front = HTTPFrontEnd(InprocBackend(server), max_body_bytes=2048,
+                         max_connections=1).start()
+    try:
+        port = front.port
+        st, hdrs, body = _post(port, "/v1/models/m:score_samples",
+                               {"x": data[:400].tolist()})
+        assert st == 413 and not body["ok"]
+        assert hdrs.get("connection") == "close"
+        # hold the single slot open with a raw idle connection...
+        hog = socket.create_connection(("127.0.0.1", port), timeout=30)
+        try:
+            time.sleep(0.1)
+            st, hdrs, _ = _get(port, "/readyz")
+            assert st == 503
+            assert int(hdrs["retry-after"]) >= 1
+        finally:
+            hog.close()
+        assert front.shed_connections >= 1
+        # slot released: the next request is served again. Poll the
+        # POST itself — a probe GET can still hold the single slot in
+        # its handler teardown when the next connection arrives.
+        deadline = time.monotonic() + 30
+        while True:
+            st, _, body = _post(port, "/v1/models/m:score",
+                                {"x": data[:4].tolist()})
+            if st == 200 or time.monotonic() >= deadline:
+                break
+            time.sleep(0.05)
+        assert st == 200 and body["ok"]
+    finally:
+        front.stop()
+        server._stop.set()   # works even once a test began a drain
+        t.join(timeout=60)
+
+
+# ------------------------------------------------------------- GMMClient
+
+
+class _Script:
+    """A scripted origin: pops (status, body) per request, records what
+    each attempt sent (path + headers) for the propagation asserts."""
+
+    def __init__(self, plays):
+        self.plays = list(plays)
+        self.seen = []
+        self.lock = threading.Lock()
+        self.stall_first_s = 0.0
+
+    def next_play(self):
+        with self.lock:
+            return self.plays.pop(0) if len(self.plays) > 1 \
+                else self.plays[0]
+
+
+@pytest.fixture
+def stub():
+    """A stdlib HTTP origin driven by a :class:`_Script`."""
+    script = _Script([(200, {"ok": True, "result": 1.0})])
+
+    class Handler(BaseHTTPRequestHandler):
+        def do_POST(self):
+            n = int(self.headers.get("Content-Length", 0))
+            self.rfile.read(n)
+            with script.lock:
+                first = not script.seen
+                script.seen.append(
+                    {"path": self.path,
+                     "deadline": self.headers.get("X-GMM-Deadline-Ms"),
+                     "trace": self.headers.get("X-GMM-Trace-Id")})
+            if first and script.stall_first_s:
+                time.sleep(script.stall_first_s)
+            status, body = script.next_play()
+            payload = json.dumps(body).encode("utf-8")
+            self.send_response(status)
+            if status in (429, 503):
+                self.send_header("Retry-After", "0")
+            self.send_header("Content-Type", "application/json")
+            self.send_header("Content-Length", str(len(payload)))
+            self.end_headers()
+            self.wfile.write(payload)
+
+        def log_message(self, *a):
+            pass
+
+    httpd = ThreadingHTTPServer(("127.0.0.1", 0), Handler)
+    t = threading.Thread(target=httpd.serve_forever, daemon=True)
+    t.start()
+    try:
+        yield script, httpd.server_address[1]
+    finally:
+        httpd.shutdown()
+        httpd.server_close()
+        t.join(timeout=30)
+
+
+def test_client_retries_transient_503_then_succeeds(stub):
+    script, port = stub
+    script.plays = [(503, {"ok": False, "error": "shutting_down"}),
+                    (503, {"ok": False, "error": "shutting_down"}),
+                    (200, {"ok": True, "result": [1.0, 2.0]})]
+    client = GMMClient(f"127.0.0.1:{port}", retries=4,
+                       backoff_base_s=0.01)
+    assert client.score_samples("m", [[0.0]]) == [1.0, 2.0]
+    s = client.stats()
+    assert s["requests"] == 1 and s["retries"] == 2
+    assert s["budget_denied"] == 0
+    assert len(script.seen) == 3
+
+
+def test_client_retry_budget_fails_fast_when_pool_is_down(stub):
+    """The token bucket: a cold client carries 2.0 tokens, earns
+    +retry_budget per success, and a retry costs 1.0 -- so a hard-down
+    origin gets exactly two retries before the budget denies the third
+    instead of amplifying the outage."""
+    script, port = stub
+    script.plays = [(503, {"ok": False, "error": "shutting_down"})]
+    client = GMMClient(f"127.0.0.1:{port}", retries=10,
+                       backoff_base_s=0.01, retry_budget=0.0)
+    with pytest.raises(GMMClientError, match="retry budget"):
+        client.request("m", "score", [[0.0]])
+    s = client.stats()
+    assert s["retries"] == 2 and s["budget_denied"] == 1
+    assert len(script.seen) == 3           # initial + the 2 funded retries
+
+
+def test_client_does_not_retry_non_retryable_status(stub):
+    script, port = stub
+    script.plays = [(404, {"ok": False, "error": "unknown model 'x'"})]
+    client = GMMClient(f"127.0.0.1:{port}", retries=5)
+    with pytest.raises(GMMClientError, match="unknown model"):
+        client.predict("x", [[0.0]])
+    assert client.stats()["retries"] == 0
+    assert len(script.seen) == 1
+
+
+def test_client_propagates_deadline_and_version_over_the_wire(stub):
+    script, port = stub
+    script.plays = [(200, {"ok": True, "result": [0]})]
+    client = GMMClient(f"127.0.0.1:{port}")
+    client.predict("m", [[0.0]], version=3, deadline_ms=5000)
+    seen = script.seen[0]
+    assert seen["path"] == "/v1/models/m@3:predict"
+    assert 0 < float(seen["deadline"]) <= 5000
+
+
+def test_client_hedge_duplicates_a_stalled_request(stub):
+    """Hedging: the first attempt stalls server-side past hedge_ms, the
+    duplicate answers, the client records the hedge win."""
+    script, port = stub
+    script.plays = [(200, {"ok": True, "result": 7.0})]
+    script.stall_first_s = 1.5
+    client = GMMClient(f"127.0.0.1:{port}", hedge_ms=100,
+                       timeout_s=30.0)
+    assert client.score("m", [[0.0]]) == 7.0
+    s = client.stats()
+    assert s["hedges"] == 1 and s["hedge_wins"] == 1
+    assert len(script.seen) == 2
+
+
+# ------------------------------------------------------------ worker pool
+
+
+def test_pool_route_order_affinity_ring_and_quarantine(tmp_path,
+                                                       monkeypatch):
+    """Routing is a crc32 ring: (model, version) pins a home slot (the
+    executor-cache affinity), siblings follow in ring order for
+    failover, quarantined slots are invisible."""
+    monkeypatch.setattr(_Worker, "alive", property(lambda self: True))
+    pool = WorkerPool(4, str(tmp_path), lambda i, s: ["true"])
+    start = zlib.crc32(b"m@None") % 4
+    order = pool._route_order("m", None)
+    assert [w.idx for w in order] == [(start + i) % 4 for i in range(4)]
+    # stable: the same key always routes home; a different key may not
+    assert pool._route_order("m", None)[0].idx == start
+    start2 = zlib.crc32(b"m@2") % 4
+    assert pool._route_order("m", 2)[0].idx == start2
+    # a quarantined home slot disappears; the ring order is preserved
+    pool._workers[start].quarantined = True
+    order = pool._route_order("m", None)
+    assert [w.idx for w in order] == [(start + i) % 4 for i in range(1, 4)]
+
+
+def test_pool_drain_fails_fast_without_parking(tmp_path):
+    """While draining, an empty routing ring must NOT park for the
+    whole-pool-dead window (NO_WORKER_WAIT_S): the request 502s
+    immediately and is counted as retries_exhausted."""
+    pool = WorkerPool(2, str(tmp_path), lambda i, s: ["true"])
+    pool._draining.set()
+    t0 = time.monotonic()
+    resp, meta = pool.score({"id": 1, "model": "m", "op": "score",
+                             "x": [[0.0]]})
+    assert time.monotonic() - t0 < NO_WORKER_WAIT_S / 2
+    assert not resp["ok"] and resp["error"] == "worker_unavailable"
+    assert pool.retries_exhausted == 1 and meta["retried"] is False
+    assert pool.ready() is False
+    g = pool.gauges()
+    assert g["gmm_http_workers"] == 2.0
+    assert g["gmm_http_workers_alive"] == 0.0
+    assert pool.http_stats()["retries_exhausted"] == 1
+
+
+# ----------------------------------------------------------- chaos, e2e
+
+
+def _start_pool_serve(tmp_path, reg_dir, *, env_extra=None, workers=2):
+    """Launch `gmm serve --http 0 --workers N` and wait for the bound
+    port; returns (proc, port, paths)."""
+    port_file = str(tmp_path / "port")
+    metrics = str(tmp_path / "serve.jsonl")
+    wd = str(tmp_path / "wd")
+    env = worker_env()
+    env.update(env_extra or {})
+    p = subprocess.Popen(
+        [sys.executable, "-m", "cuda_gmm_mpi_tpu.cli", "serve",
+         "--registry", reg_dir, "--http", "0", "--workers", str(workers),
+         "--http-port-file", port_file, "--worker-dir", wd,
+         "--worker-backoff-s", "0.2", "--device", "cpu",
+         "--metrics-file", metrics],
+        stdout=subprocess.PIPE, stderr=subprocess.PIPE, env=env, text=True)
+    deadline = time.monotonic() + 300.0
+    while not os.path.exists(port_file):
+        assert p.poll() is None, p.communicate()
+        assert time.monotonic() < deadline, "http port never bound"
+        time.sleep(0.05)
+    port = int(open(port_file).read().strip())
+    return p, port, {"metrics": metrics, "wd": wd}
+
+
+def _worker_pid(wd, idx, *, not_pid=None, min_gen=0, timeout=120.0):
+    """The pool's published pid for slot idx (waits out a respawn)."""
+    path = os.path.join(wd, f"worker{idx}.json")
+    deadline = time.monotonic() + timeout
+    while time.monotonic() < deadline:
+        try:
+            doc = json.loads(open(path).read())
+            pid = int(doc["pid"])
+            if pid != (not_pid or -1) and doc.get("gen", 0) >= min_gen:
+                return pid, doc
+        except (OSError, ValueError, KeyError):
+            pass
+        time.sleep(0.05)
+    raise AssertionError(f"worker{idx}.json never advanced past "
+                         f"pid {not_pid} / gen {min_gen}")
+
+
+def test_pool_survives_fault_injected_worker_crash(rng, tmp_path):
+    """Chaos arc #1 (deterministic): the `worker_crash` fault kind kills
+    the routed worker's process (os._exit) on its FIRST request. The
+    client must see only answers -- sibling retry covers the crash, the
+    supervisor respawns the slot (gen 1 serves clean, the fault pins
+    gen 0) -- and the stream carries the whole story schema-valid."""
+    gm, data = fitted(rng)
+    reg_dir = str(tmp_path / "reg")
+    gm.to_registry(reg_dir, "m")
+    home = zlib.crc32(b"m@None") % 2       # the slot requests route to
+    faults_env = json.dumps({"worker_crash": {
+        "worker": home, "gen": 0, "times": 1, "exitcode": 9}})
+    p, port, paths = _start_pool_serve(
+        tmp_path, reg_dir, env_extra={"GMM_FAULTS": faults_env})
+    try:
+        client = GMMClient(f"127.0.0.1:{port}", timeout_s=120.0,
+                           retries=3, backoff_base_s=0.05,
+                           retry_budget=1.0)
+        for i in range(8):
+            got = client.score_samples("m", data[:5].tolist(),
+                                       deadline_ms=60_000)
+            assert len(got) == 5           # every request answered
+        assert client.stats()["requests"] == 8
+        # the crashed slot came back under a fresh generation before
+        # we drain (the respawn is what the stream must carry)
+        _worker_pid(paths["wd"], home, min_gen=1)
+        p.send_signal(signal.SIGTERM)
+        out_, err_ = communicate_or_kill(p, timeout=180)
+    finally:
+        if p.poll() is None:
+            p.kill()
+            p.wait(timeout=60)
+    assert p.returncode == 75, f"expected EX_TEMPFAIL:\n{out_}\n{err_}"
+    records = read_stream(paths["metrics"])
+    assert validate_stream(records) == []
+    exits = [r for r in records if r["event"] == "worker_exit"
+             and r.get("crash")]
+    assert any(r["worker"] == home and r["exitcode"] == 9 for r in exits)
+    spawns = [r for r in records if r["event"] == "worker_spawn"]
+    assert any(r.get("respawn") for r in spawns)
+    https = [r for r in records if r["event"] == "http_request"]
+    assert len(https) == 8
+    assert all(r["status"] == 200 for r in https)
+    assert any(r.get("retried") for r in https)  # the sibling answered
+    summary = [r for r in records if r["event"] == "serve_summary"][-1]
+    roll = summary["http"]
+    assert roll["errors_5xx"] == 0 and roll["retries_exhausted"] == 0
+    assert roll["worker_crashes"] >= 1 and roll["worker_respawns"] >= 1
+
+
+def test_pool_survives_real_sigkill_with_zero_failed_requests(rng,
+                                                              tmp_path):
+    """Chaos arc #2 (the acceptance criterion, with a REAL signal):
+    SIGKILL the routed worker mid-stream under --workers 2. ZERO client
+    requests may fail; the slot respawns under a new pid; SIGTERM still
+    drains the whole tier to exit 75 with a clean v2.7 rollup."""
+    gm, data = fitted(rng)
+    reg_dir = str(tmp_path / "reg")
+    gm.to_registry(reg_dir, "m")
+    home = zlib.crc32(b"m@None") % 2
+    p, port, paths = _start_pool_serve(tmp_path, reg_dir)
+    try:
+        client = GMMClient(f"127.0.0.1:{port}", timeout_s=120.0,
+                           retries=3, backoff_base_s=0.05,
+                           retry_budget=1.0)
+        assert client.readyz()
+        victim, _ = _worker_pid(paths["wd"], home)
+        failed = 0
+        for i in range(20):
+            if i == 5:
+                os.kill(victim, signal.SIGKILL)
+            try:
+                got = client.score_samples("m", data[:5].tolist(),
+                                           deadline_ms=60_000)
+                assert len(got) == 5
+            except GMMClientError:
+                failed += 1
+        assert failed == 0, f"{failed} request(s) failed across the kill"
+        respawned, doc = _worker_pid(paths["wd"], home, not_pid=victim)
+        assert respawned != victim and doc["gen"] >= 1
+        p.send_signal(signal.SIGTERM)
+        # the probe goes dark at drain start (503 while the workers
+        # flush, connection-refused once the tier exits -- both False)
+        deadline = time.monotonic() + 60
+        while client.readyz() and time.monotonic() < deadline:
+            time.sleep(0.05)
+        assert not client.readyz()
+        out_, err_ = communicate_or_kill(p, timeout=180)
+    finally:
+        if p.poll() is None:
+            p.kill()
+            p.wait(timeout=60)
+    assert p.returncode == 75, f"expected EX_TEMPFAIL:\n{out_}\n{err_}"
+    assert "Preempted" in err_
+    records = read_stream(paths["metrics"])
+    assert validate_stream(records) == []
+    events = [r["event"] for r in records]
+    assert events.count("http_request") == 20
+    crashes = [r for r in records if r["event"] == "worker_exit"
+               and r.get("crash")]
+    assert any(r["worker"] == home and r["exitcode"] == -9
+               for r in crashes)
+    summary = [r for r in records if r["event"] == "serve_summary"][-1]
+    roll = summary["http"]
+    assert roll["requests"] == 20
+    assert roll["errors_5xx"] == 0 and roll["errors_4xx"] == 0
+    assert roll["retries_exhausted"] == 0
+    assert roll["worker_crashes"] >= 1 and roll["worker_respawns"] >= 1
+    assert roll["worker_quarantines"] == 0
+
+
+def test_http_off_stream_is_byte_identical_shape(rng, tmp_path):
+    """HTTP off => the stream has NO v2.7 surface at all: no
+    http_request/worker_spawn/worker_exit events and no ``http`` key in
+    serve_summary. The default JSONL pipeline must not pay for the
+    network tier it isn't using."""
+    from cuda_gmm_mpi_tpu.cli import main
+
+    gm, data = fitted(rng)
+    gm.to_registry(str(tmp_path / "reg"), "m")
+    reqs = tmp_path / "req.jsonl"
+    with open(reqs, "w") as f:
+        for i in range(3):
+            f.write(json.dumps({"id": i, "model": "m", "op": "score",
+                                "x": data[:4].tolist()}) + "\n")
+    metrics = str(tmp_path / "m.jsonl")
+    assert main(["serve", "--registry", str(tmp_path / "reg"),
+                 "--input", str(reqs), "--output", str(tmp_path / "o"),
+                 "--metrics-file", metrics]) == 0
+    records = read_stream(metrics)
+    events = {r["event"] for r in records}
+    assert not events & {"http_request", "worker_spawn", "worker_exit"}
+    summary = [r for r in records if r["event"] == "serve_summary"][-1]
+    assert "http" not in summary
+
+
+# ------------------------------------------------------------- diff gates
+
+
+def test_diff_folds_http_rollup_with_explicit_zeros():
+    """summarize_run lifts serve_summary.http into http.* metrics and
+    pins the three gated counters to EXPLICIT zeros on every serve
+    stream -- so a regression from 0 crashes to 1 is a visible 0->1
+    transition, not a silent missing-metric skip."""
+    clean = summarize_run([{
+        "event": "serve_summary", "run_id": "a", "requests": 4,
+        "wall_s": 1.0}])
+    for key in ("http.errors_5xx", "http.worker_crashes",
+                "http.retries_exhausted"):
+        assert clean["metrics"][key] == 0.0
+    crashed = summarize_run([{
+        "event": "serve_summary", "run_id": "b", "requests": 4,
+        "wall_s": 1.0,
+        "http": {"requests": 4, "errors_4xx": 0, "errors_5xx": 1,
+                 "shed_connections": 0, "retries": 2,
+                 "retries_exhausted": 1, "worker_crashes": 1,
+                 "worker_respawns": 1, "worker_quarantines": 0,
+                 "workers": 2}}])
+    m = crashed["metrics"]
+    assert m["http.errors_5xx"] == 1.0
+    assert m["http.worker_crashes"] == 1.0
+    assert m["http.retries_exhausted"] == 1.0
+    assert m["http.requests"] == 4.0 and m["http.retries"] == 2.0
+    # a fit-only stream grows NO http keys (byte-identity discipline)
+    fit_only = summarize_run([{"event": "run_summary", "run_id": "c",
+                               "wall_s": 2.0, "total_iters": 3}])
+    assert not any(k.startswith("http.") for k in fit_only["metrics"])
+
+
+def test_diff_default_gates_cover_the_network_tier(tmp_path):
+    """The three v2.7 gates ship in DEFAULT_FAIL_ON and trip on a 0->1
+    regression between two serve streams."""
+    from cuda_gmm_mpi_tpu.telemetry.diff import diff_main
+
+    for gate in ("http.errors_5xx>0", "http.worker_crashes>0",
+                 "http.retries_exhausted>0"):
+        assert gate in DEFAULT_FAIL_ON
+    base = {"event": "serve_summary", "run_id": "a", "requests": 4,
+            "wall_s": 1.0,
+            "http": {"requests": 4, "errors_5xx": 0, "errors_4xx": 0,
+                     "worker_crashes": 0, "retries_exhausted": 0,
+                     "retries": 0, "worker_respawns": 0,
+                     "worker_quarantines": 0, "shed_connections": 0,
+                     "workers": 2}}
+    cur = json.loads(json.dumps(base))
+    cur["http"]["worker_crashes"] = 1
+    a, b = str(tmp_path / "a.jsonl"), str(tmp_path / "b.jsonl")
+    open(a, "w").write(json.dumps(base) + "\n")
+    open(b, "w").write(json.dumps(cur) + "\n")
+    assert diff_main([a, b]) == 1          # the gate trips...
+    assert diff_main([a, a]) == 0          # ...and clean stays clean

@@ -499,6 +499,88 @@ def test_serve_unix_socket(rng, tmp_path):
     assert all(r["ok"] for r in got)
 
 
+def _socket_serve_thread(tmp_path, extra_args, max_requests):
+    """A serve_main --socket thread + the path, for hardening tests."""
+    import threading
+
+    from cuda_gmm_mpi_tpu.serving.server import serve_main
+
+    sock_path = str(tmp_path / "gmm.sock")
+    t = threading.Thread(target=serve_main, args=(
+        ["--registry", str(tmp_path / "reg"), "--socket", sock_path,
+         "--max-requests", str(max_requests)] + extra_args,), daemon=True)
+    t.start()
+    import time as _t
+    t0 = _t.monotonic()
+    while not os.path.exists(sock_path):
+        assert _t.monotonic() - t0 < 30.0, "socket never appeared"
+        _t.sleep(0.02)
+    return t, sock_path
+
+
+def test_serve_socket_read_deadline_frees_stalled_reader(rng, tmp_path):
+    """Rev v2.7 reader containment: a client that connects and sends
+    NOTHING (slowloris) is disconnected at --read-timeout-s instead of
+    parking its reader thread forever; a healthy client on the same
+    server is served throughout."""
+    import socket
+
+    gm, data = fitted(rng)
+    gm.to_registry(str(tmp_path / "reg"), "m")
+    t, sock_path = _socket_serve_thread(
+        tmp_path, ["--read-timeout-s", "0.3"], max_requests=1)
+    staller = socket.socket(socket.AF_UNIX, socket.SOCK_STREAM)
+    staller.connect(sock_path)
+    staller.settimeout(30.0)
+    healthy = socket.socket(socket.AF_UNIX, socket.SOCK_STREAM)
+    healthy.connect(sock_path)
+    f = healthy.makefile("rw")
+    f.write(json.dumps({"id": 0, "model": "m", "op": "score",
+                        "x": data[:4].tolist()}) + "\n")
+    f.flush()
+    assert json.loads(f.readline())["ok"]  # stall never blocks service
+    # the stalled connection is CLOSED server-side at the deadline
+    assert staller.recv(1) == b""
+    staller.close()
+    healthy.close()
+    t.join(timeout=60)
+    assert not t.is_alive()
+
+
+def test_serve_socket_oversized_line_is_rejected_not_buffered(rng,
+                                                              tmp_path):
+    """Rev v2.7 reader containment: a request line past --max-body-bytes
+    is answered ``line_too_long`` and the connection closed -- the line
+    never reaches the parser or the batching queue."""
+    import socket
+
+    gm, data = fitted(rng)
+    gm.to_registry(str(tmp_path / "reg"), "m")
+    t, sock_path = _socket_serve_thread(
+        tmp_path, ["--max-body-bytes", "4096"], max_requests=1)
+    c = socket.socket(socket.AF_UNIX, socket.SOCK_STREAM)
+    c.connect(sock_path)
+    f = c.makefile("rw")
+    f.write(json.dumps({"id": 9, "model": "m", "op": "score",
+                        "x": data[:500].tolist()}) + "\n")
+    f.flush()
+    resp = json.loads(f.readline())
+    assert not resp["ok"] and resp["error"] == "line_too_long"
+    assert f.readline() == ""              # connection closed after it
+    c.close()
+    # a bounded request on a fresh connection still serves
+    c2 = socket.socket(socket.AF_UNIX, socket.SOCK_STREAM)
+    c2.connect(sock_path)
+    f2 = c2.makefile("rw")
+    f2.write(json.dumps({"id": 1, "model": "m", "op": "score",
+                         "x": data[:4].tolist()}) + "\n")
+    f2.flush()
+    assert json.loads(f2.readline())["ok"]
+    c2.close()
+    t.join(timeout=60)
+    assert not t.is_alive()
+
+
 # ------------------------------------------------------------------ export
 
 

@@ -311,6 +311,9 @@ def test_compile_event_schema_and_profile_rollup():
         assert f in opt, f
     assert "profile" in EVENT_FIELDS["run_summary"][1]
     assert "profile" in EVENT_FIELDS["serve_summary"][1]
+    # rev v2.7: serve_summary's optional http rollup (the block `gmm
+    # diff` gates on) is a declared name, not an ad-hoc extra
+    assert "http" in EVENT_FIELDS["serve_summary"][1]
 
 
 def test_ambient_recorder_is_reused(tmp_path, rng):
@@ -381,6 +384,14 @@ def test_every_emitted_event_kind_is_declared_in_schema():
                for p in found["lifecycle"])
     assert any(p.endswith("serving/registry.py")
                for p in found["registry_torn"])
+    # rev v2.7: the network tier's kinds, pinned by name and call site
+    # in both directions -- http_request from the front end, the worker
+    # lifecycle pair from the pool supervisor
+    assert "http_request" in found
+    assert "worker_spawn" in found and "worker_exit" in found
+    assert any(p.endswith("serving/http.py") for p in found["http_request"])
+    assert any(p.endswith("serving/pool.py") for p in found["worker_spawn"])
+    assert any(p.endswith("serving/pool.py") for p in found["worker_exit"])
     undeclared = {k: sorted(v) for k, v in found.items()
                   if k not in EVENT_FIELDS}
     assert undeclared == {}, (
