@@ -1195,6 +1195,194 @@ def run_serve_bench(platform: str, accel_unavailable: bool) -> dict:
     return result
 
 
+def _http_payload_window_ab(root: str, env: dict, repo: str) -> dict:
+    """The rev v2.8 data-plane A/B riding ``--http``: the SAME D>=16
+    batch traffic driven through two live single-process ``gmm serve
+    --http`` servers -- arm A posts JSON bodies against a fixed
+    ``--tick-ms`` gather window, arm B posts x-gmm-rows binary frames
+    against the adaptive ``--tick-min-ms/--tick-max-ms`` controller.
+    One record carries both p50/p99s plus:
+
+    * ``parity`` -- the same probe rows scored via BOTH encodings on
+      BOTH servers come back exactly equal (the zero-copy plane and the
+      adaptive window are transport/scheduling changes, not math);
+    * ``zero_recompile_after_warm`` -- per arm, every serve_batch past
+      the warm phase dispatched with ``compiled == 0``;
+    * ``host_staging`` -- per arm, the executor's host_stagings counter
+      out of serve_summary (warm pinned-route traffic must read 0);
+    * ``p50_ratio`` -- binary+adaptive p50 over json+fixed p50, and
+      ``meets_target`` for the <= 0.7 acceptance line (the ratio is
+      recorded either way).
+
+    Size knobs: GMM_BENCH_HTTP_AB_{N,D,K,ROWS,REQUESTS}.
+    """
+    import signal
+    import threading
+
+    from cuda_gmm_mpi_tpu.config import GMMConfig
+    from cuda_gmm_mpi_tpu.estimator import GaussianMixture
+    from cuda_gmm_mpi_tpu.serving import GMMClient, ModelRegistry
+
+    k = int(os.environ.get("GMM_BENCH_HTTP_AB_K") or 8)
+    d = int(os.environ.get("GMM_BENCH_HTTP_AB_D") or 16)
+    n = int(os.environ.get("GMM_BENCH_HTTP_AB_N") or 4_000)
+    rows = int(os.environ.get("GMM_BENCH_HTTP_AB_ROWS") or 256)
+    n_requests = int(os.environ.get("GMM_BENCH_HTTP_AB_REQUESTS") or 120)
+    warm_requests = 10
+    n_clients = 2
+
+    rng = np.random.default_rng(7)
+    centers = rng.normal(scale=8.0, size=(k, d))
+    data = (centers[rng.integers(0, k, n)]
+            + rng.normal(scale=1.0, size=(n, d)))
+    gm = GaussianMixture(
+        k, target_components=k,
+        config=GMMConfig(min_iters=5, max_iters=5,
+                         chunk_size=min(65536, n)))
+    gm.fit(data)
+    reg_dir = os.path.join(root, "ab_reg")
+    gm.to_registry(ModelRegistry(reg_dir), "ab")
+
+    payloads = [np.ascontiguousarray(data[i * rows:(i + 1) * rows])
+                for i in range(8)]
+    payloads_json = [p.tolist() for p in payloads]
+    probe = payloads[0]
+
+    arms = (
+        ("json_fixed", "json", ["--tick-ms", "2"]),
+        ("binary_adaptive", "binary",
+         ["--tick-ms", "2", "--tick-min-ms", "0", "--tick-max-ms", "2"]),
+    )
+    out: dict = {"d": d, "k": k, "rows_per_request": rows,
+                 "requests": n_requests, "clients": n_clients}
+    parity_results: dict = {}
+    for arm, enc, extra in arms:
+        port_file = os.path.join(root, f"ab_{arm}.port")
+        metrics_file = os.path.join(root, f"ab_{arm}.jsonl")
+        proc = subprocess.Popen(
+            [sys.executable, "-m", "cuda_gmm_mpi_tpu.cli", "serve",
+             "--registry", reg_dir, "--http", "0",
+             "--http-port-file", port_file, "--device", "cpu",
+             "--metrics-file", metrics_file, *extra],
+            cwd=repo, env=env, stdout=subprocess.DEVNULL,
+            stderr=subprocess.DEVNULL)
+        try:
+            t0 = time.perf_counter()
+            while not os.path.exists(port_file):
+                if proc.poll() is not None:
+                    raise RuntimeError(
+                        f"ab arm {arm} exited rc={proc.returncode} "
+                        "before publishing its port")
+                if time.perf_counter() - t0 > 300:
+                    raise RuntimeError(f"ab arm {arm} startup timed out")
+                time.sleep(0.05)
+            with open(port_file) as f:
+                port = int(f.read())
+            client = GMMClient(f"127.0.0.1:{port}", timeout_s=60.0,
+                               retries=2, backoff_base_s=0.05,
+                               encoding=enc)
+
+            counter = {"next": 0}
+            lock = threading.Lock()
+            lat: list = []
+
+            def drive(budget: int, timed: bool):
+                def take() -> bool:
+                    with lock:
+                        if counter["next"] >= budget:
+                            return False
+                        counter["next"] += 1
+                        return True
+                i = 0
+                while take():
+                    i += 1
+                    x = (payloads[i % len(payloads)] if enc == "binary"
+                         else payloads_json[i % len(payloads)])
+                    t1 = time.perf_counter()
+                    client.request("ab", "score_samples", x)
+                    if timed:
+                        with lock:
+                            lat.append(time.perf_counter() - t1)
+
+            def run_phase(budget: int, timed: bool) -> None:
+                counter["next"] = 0
+                threads = [threading.Thread(target=drive,
+                                            args=(budget, timed),
+                                            daemon=True)
+                           for _ in range(n_clients)]
+                for t in threads:
+                    t.start()
+                for t in threads:
+                    t.join()
+
+            # Warm under the SAME concurrency as the timed phase so
+            # both the solo and the coalesced row buckets compile now.
+            run_phase(warm_requests, timed=False)
+            # Parity probes: the same rows via BOTH encodings on THIS
+            # server must score exactly equal.
+            parity_results[arm] = (
+                client.request("ab", "score_samples", probe.tolist(),
+                               encoding="json")["result"],
+                client.request("ab", "score_samples", probe,
+                               encoding="binary")["result"])
+            warm_rows = (warm_requests + 2) * rows
+            t_load = time.perf_counter()
+            run_phase(n_requests, timed=True)
+            load_wall = time.perf_counter() - t_load
+            proc.send_signal(signal.SIGTERM)
+            proc.wait(timeout=120)
+        finally:
+            if proc.poll() is None:
+                proc.kill()
+                proc.wait(timeout=30)
+
+        compiled_after_warm = 0
+        seen_rows = 0
+        host_stagings = None
+        window = None
+        adaptations = 0
+        with open(metrics_file) as f:
+            for line in f:
+                rec = json.loads(line)
+                ev = rec.get("event")
+                if ev == "serve_batch":
+                    if seen_rows >= warm_rows:
+                        compiled_after_warm += int(rec.get("compiled", 0))
+                    seen_rows += int(rec.get("rows", 0))
+                elif ev == "serve_window":
+                    adaptations += 1
+                elif ev == "serve_summary":
+                    ex = rec.get("executor") or {}
+                    host_stagings = ex.get("host_stagings")
+                    window = rec.get("window")
+        lat_arr = np.asarray(sorted(lat))
+        out[arm] = {
+            "encoding": enc,
+            "p50_s": round(float(np.percentile(lat_arr, 50)), 6),
+            "p99_s": round(float(np.percentile(lat_arr, 99)), 6),
+            "qps": round(len(lat) / max(load_wall, 1e-9), 2),
+            # Warm pinned-route traffic must never stage host-side.
+            "host_staging": host_stagings,
+            "compiled_after_warm": int(compiled_after_warm),
+            "zero_recompile_after_warm": bool(compiled_after_warm == 0),
+            **({"window_adaptations": adaptations, "window": window}
+               if arm == "binary_adaptive" else {}),
+        }
+
+    # The parity bit: every probe answer -- json vs binary, fixed vs
+    # adaptive -- is exactly the same floats.
+    flat = [r for pair in parity_results.values() for r in pair]
+    parity = all(r == flat[0] for r in flat[1:])
+    assert parity, "payload/window A/B parity broke: " \
+        f"{[r[:2] for r in flat]}"
+    ratio = (out["binary_adaptive"]["p50_s"]
+             / max(out["json_fixed"]["p50_s"], 1e-9))
+    out["parity"] = bool(parity)
+    out["p50_ratio"] = round(ratio, 3)
+    out["meets_target"] = bool(ratio <= 0.7)
+    return out
+
+
 def run_http_bench(platform: str, accel_unavailable: bool) -> dict:
     """The --http mode: rev v2.7 network-tier contract, measured live.
 
@@ -1212,8 +1400,13 @@ def run_http_bench(platform: str, accel_unavailable: bool) -> dict:
     model and row count -- what the network + pool tier costs per
     request. Workers always run on CPU (N subprocesses must not fight
     over one accelerator tunnel), so the sizes stay small; this mode
-    measures the tier, not the kernel. Size knobs:
-    GMM_BENCH_HTTP_{N,D,K,WORKERS,CLIENTS,REQUESTS}.
+    measures the tier, not the kernel. The record also carries the rev
+    v2.8 data-plane A/B (``http.ab``): json+fixed-tick vs
+    binary+adaptive-window on identical D>=16 batch traffic, with the
+    parity bit and per-arm zero-recompile/host-staging proof
+    (:func:`_http_payload_window_ab`). Size knobs:
+    GMM_BENCH_HTTP_{N,D,K,WORKERS,CLIENTS,REQUESTS} and
+    GMM_BENCH_HTTP_AB_{N,D,K,ROWS,REQUESTS}.
     """
     k = int(os.environ.get("GMM_BENCH_HTTP_K") or 8)
     n = int(os.environ.get("GMM_BENCH_HTTP_N") or 4_000)
@@ -1395,6 +1588,10 @@ def run_http_bench(platform: str, accel_unavailable: bool) -> dict:
         except OSError:
             pass
 
+        # Payload-format x window-policy A/B (rev v2.8): json+fixed
+        # vs binary+adaptive on identical D>=16 batch traffic.
+        ab = _http_payload_window_ab(root, env, repo)
+
     lat_arr = np.asarray(sorted(lat))
     p50 = float(np.percentile(lat_arr, 50)) if lat_arr.size else 0.0
     p99 = float(np.percentile(lat_arr, 99)) if lat_arr.size else 0.0
@@ -1428,6 +1625,8 @@ def run_http_bench(platform: str, accel_unavailable: bool) -> dict:
             "clean_drain_exit_75": bool(drain_rc == 75),
             # The server's own serve_summary.http rollup, verbatim.
             "rollup": rollup,
+            # json+fixed-tick vs binary+adaptive-window, same traffic.
+            "ab": ab,
         },
         "measured_utc": time.strftime("%Y-%m-%dT%H:%M:%SZ", time.gmtime()),
     }

@@ -38,6 +38,10 @@ import time
 from typing import Any, Dict, List, Optional
 from urllib.parse import urlsplit
 
+import numpy as np
+
+from . import wire
+
 RETRYABLE_STATUSES = (429, 502, 503)
 
 
@@ -65,7 +69,17 @@ class GMMClient:
     def __init__(self, base_url: str, *, timeout_s: float = 30.0,
                  retries: int = 2, backoff_base_s: float = 0.05,
                  retry_budget: float = 0.2, hedge_ms: Optional[float] = None,
-                 seed: int = 0):
+                 encoding: str = "json", seed: int = 0):
+        if encoding not in ("json", "binary"):
+            raise ValueError(
+                f"encoding must be 'json' or 'binary', got {encoding!r}")
+        # 'binary' posts each request's rows as ONE x-gmm-rows frame
+        # (serving/wire.py) instead of a JSON body: no float
+        # stringification client-side, no JSON float parsing
+        # server-side, bit-identical responses either way (a JSON body
+        # parses to float64 before the executor cast; the binary
+        # encoder packs float64 unless handed float32 rows).
+        self._encoding = encoding
         parts = urlsplit(base_url if "//" in base_url
                          else f"http://{base_url}")
         self._host = parts.hostname or "127.0.0.1"
@@ -137,15 +151,31 @@ class GMMClient:
     def request(self, model: str, op: str, x, *,
                 version: Optional[int] = None,
                 deadline_ms: Optional[float] = None,
-                request_id: Any = None) -> dict:
+                request_id: Any = None,
+                encoding: Optional[str] = None) -> dict:
         """One scored request under the full policy. Returns the decoded
         response body of the first 200; raises :class:`GMMClientError`
-        otherwise."""
+        otherwise. ``encoding`` overrides the client default per
+        request ('binary' sends one x-gmm-rows frame; the request id
+        only rides JSON bodies)."""
+        enc = encoding or self._encoding
+        if enc not in ("json", "binary"):
+            raise ValueError(
+                f"encoding must be 'json' or 'binary', got {enc!r}")
         spec = model if version is None else f"{model}@{version}"
         path = f"/v1/models/{spec}:{op}"
-        body = json.dumps(
-            {"x": x, **({"id": request_id} if request_id is not None
-                        else {})}).encode("utf-8")
+        if enc == "binary":
+            if request_id is not None:
+                raise ValueError(
+                    "binary encoding has no body field for request_id; "
+                    "use encoding='json' when an id must round-trip")
+            body = wire.encode_rows(np.asarray(x))
+            headers = {"Content-Type": wire.CONTENT_TYPE}
+        else:
+            body = json.dumps(
+                {"x": x, **({"id": request_id} if request_id is not None
+                            else {})}).encode("utf-8")
+            headers = None
         t_end = (time.perf_counter() + deadline_ms / 1e3
                  if deadline_ms else None)
         with self._lock:
@@ -170,8 +200,8 @@ class GMMClient:
                     "instead of amplifying load): " + last_err,
                     last_status, last_body)
             try:
-                status, headers, decoded = self._attempt_hedged(
-                    path, body, remaining_ms)
+                status, resp_headers, decoded = self._attempt_hedged(
+                    path, body, remaining_ms, headers)
             except OSError as e:
                 last_err = f"connection failed: {e}"
                 last_status, last_body = None, None
@@ -186,8 +216,8 @@ class GMMClient:
             if status not in RETRYABLE_STATUSES:
                 raise GMMClientError(f"{path}: {last_err}", status,
                                      decoded)
-            self._sleep_backoff(attempt, headers.get("Retry-After"),
-                                t_end)
+            self._sleep_backoff(attempt,
+                                resp_headers.get("Retry-After"), t_end)
         raise GMMClientError(
             f"{path}: retries exhausted after {self._retries + 1} "
             "attempts: " + last_err, last_status, last_body)
@@ -225,11 +255,13 @@ class GMMClient:
     # -- transport -------------------------------------------------------
 
     def _attempt_hedged(self, path: str, body: bytes,
-                        remaining_ms: Optional[float]):
+                        remaining_ms: Optional[float],
+                        req_headers: Optional[Dict[str, str]] = None):
         """One POST attempt, optionally racing a single hedge duplicate
         launched after ``hedge_ms`` of silence; first answer wins."""
         if self._hedge_ms is None:
-            return self._attempt("POST", path, body, remaining_ms, None)
+            return self._attempt("POST", path, body, remaining_ms,
+                                 req_headers)
         done = threading.Event()
         results: List[tuple] = []
         errors: List[BaseException] = []
@@ -238,7 +270,7 @@ class GMMClient:
         def run(is_hedge: bool):
             try:
                 out = self._attempt("POST", path, body, remaining_ms,
-                                    None)
+                                    req_headers)
                 with lock:
                     results.append((is_hedge, out))
             except OSError as e:

@@ -97,10 +97,21 @@ class ScoringExecutor:
         # strong state ref pins the id against recycling; bounded LRU.
         self._state_memo: "collections.OrderedDict[tuple, tuple]" = \
             collections.OrderedDict()
+        # Device-resident route states (docs/SERVING.md "Device-resident
+        # routes"): same key shape as the memo, but EXEMPT from its LRU
+        # bound -- a pinned route's prepared state stays resident until
+        # release_state, so warm dispatches never re-place leaves
+        # host->device. Bounded by the served route set, which the
+        # server already bounds.
+        self._pinned: Dict[tuple, tuple] = {}
         self.hits = 0
         self.misses = 0
         self.compiles = 0
         self.evictions = 0
+        # Dispatch-time state preparations that could NOT be served from
+        # a pinned entry -- the silent fallback to per-request staging
+        # the serve.host_staging counter makes observable.
+        self.host_stagings = 0
 
     # -- observability ---------------------------------------------------
 
@@ -117,7 +128,9 @@ class ScoringExecutor:
     def stats(self) -> Dict[str, int]:
         return {"hits": self.hits, "misses": self.misses,
                 "compiles": self.compiles, "evictions": self.evictions,
-                "live_executables": len(self._cache)}
+                "live_executables": len(self._cache),
+                "pinned_states": len(self._pinned),
+                "host_stagings": self.host_stagings}
 
     def cached_keys(self) -> Tuple[tuple, ...]:
         return tuple(self._cache.keys())
@@ -144,23 +157,17 @@ class ScoringExecutor:
 
     # -- state preparation ----------------------------------------------
 
-    def prepared_state(self, state: GMMState,
-                       k_bucket: Optional[int] = None) -> GMMState:
-        """``state`` cast to the executor dtype and K-padded to its pow2
-        bucket with inert inactive slots; memoized per state object.
-
-        ``k_bucket`` overrides the bucket upward (stacked cross-model
-        dispatches pad every participant to the family's shared width;
-        inactive slots are algebraically inert, so a wider pad never
-        changes a model's scores)."""
+    def _resolve_bucket(self, state: GMMState,
+                        k_bucket: Optional[int]) -> int:
         kb = pow2_bucket(state.num_clusters_padded)
         if k_bucket is not None:
             kb = max(kb, int(k_bucket))
-        key = (id(state), kb)
-        hit = self._state_memo.get(key)
-        if hit is not None and hit[0] is state:
-            self._state_memo.move_to_end(key)
-            return hit[1]
+        return kb
+
+    def _prepare(self, state: GMMState, kb: int) -> GMMState:
+        """Cast ``state`` to the executor dtype and K-pad to ``kb`` with
+        inert inactive slots -- the one host->device placement both the
+        memo and pin planes cache."""
         import jax.numpy as jnp
 
         from ..parallel.sharded_em import pad_state_clusters
@@ -173,22 +180,73 @@ class ScoringExecutor:
             means=jnp.asarray(state.means, dt),
             R=jnp.asarray(state.R, dt), Rinv=jnp.asarray(state.Rinv, dt),
             active=jnp.asarray(state.active, bool))
-        padded = pad_state_clusters(cast, kb)
+        return pad_state_clusters(cast, kb)
+
+    def pin_state(self, state: GMMState,
+                  k_bucket: Optional[int] = None) -> GMMState:
+        """Pin ``state``'s prepared form device-resident (the route-
+        prepare half of the device-resident serving plane): later
+        dispatches hit the resident handle instead of re-placing leaves,
+        and the entry survives any amount of cross-route traffic --
+        unlike the LRU-8 dispatch memo. Idempotent per (state, bucket);
+        released by :meth:`release_state` exactly as the memo is."""
+        kb = self._resolve_bucket(state, k_bucket)
+        key = (id(state), kb)
+        hit = self._pinned.get(key)
+        if hit is not None and hit[0] is state:
+            return hit[1]
+        padded = self._prepare(state, kb)
+        self._pinned[key] = (state, padded)
+        return padded
+
+    def prepared_state(self, state: GMMState,
+                       k_bucket: Optional[int] = None) -> GMMState:
+        """``state`` cast to the executor dtype and K-padded to its pow2
+        bucket with inert inactive slots; served from the pinned plane
+        when the route was pinned (:meth:`pin_state`), else memoized per
+        state object.
+
+        ``k_bucket`` overrides the bucket upward (stacked cross-model
+        dispatches pad every participant to the family's shared width;
+        inactive slots are algebraically inert, so a wider pad never
+        changes a model's scores). A wider-bucket variant of a PINNED
+        state pins too -- the route is resident, so its stacked pad
+        should be -- while preparing an unpinned state at dispatch time
+        counts ``host_stagings``: the observable fallback to
+        per-request staging."""
+        kb = self._resolve_bucket(state, k_bucket)
+        key = (id(state), kb)
+        hit = self._pinned.get(key)
+        if hit is not None and hit[0] is state:
+            return hit[1]
+        hit = self._state_memo.get(key)
+        if hit is not None and hit[0] is state:
+            self._state_memo.move_to_end(key)
+            return hit[1]
+        padded = self._prepare(state, kb)
+        if any(v[0] is state for v in self._pinned.values()):
+            self._pinned[key] = (state, padded)
+            return padded
+        self.host_stagings += 1
         self._state_memo[key] = (state, padded)
         while len(self._state_memo) > 8:
             self._state_memo.popitem(last=False)
         return padded
 
     def release_state(self, state: GMMState) -> int:
-        """Drop ``state``'s prepared-state memo entries (a hot-reload
-        replaced its registry version, serving/server.py). Compiled
-        executables stay -- they are keyed by shapes and shared across
-        models -- and a later pinned-version request simply re-prepares
-        the state. Returns the number of entries released."""
+        """Drop ``state``'s prepared-state memo AND pinned entries (a
+        hot-reload replaced its registry version, serving/server.py).
+        Compiled executables stay -- they are keyed by shapes and shared
+        across models -- and a later pinned-version request simply
+        re-prepares the state. Returns the number of entries released."""
         dead = [k for k, v in self._state_memo.items() if v[0] is state]
         for k in dead:
             del self._state_memo[k]
-        return len(dead)
+        pinned_dead = [k for k, v in self._pinned.items()
+                       if v[0] is state]
+        for k in pinned_dead:
+            del self._pinned[k]
+        return len(dead) + len(pinned_dead)
 
     # -- executables -----------------------------------------------------
 

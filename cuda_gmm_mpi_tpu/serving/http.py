@@ -49,6 +49,7 @@ from typing import Any, Callable, Dict, Optional, Tuple
 
 from .. import telemetry
 from ..telemetry.exporter import render_openmetrics
+from . import wire
 
 #: ops accepted in the URL (mirrors server.OPS; ping/shutdown stay
 #: JSONL-protocol-only -- an HTTP caller probes /healthz and drains via
@@ -97,8 +98,12 @@ def status_for_error(error: str) -> int:
         return 502
     if error == "non_finite_scores" or error.startswith("dispatch failed"):
         return 500
+    if error in ("frame_too_large", "body_too_large", "line_too_long"):
+        return 413
     if "unknown model" in error or "registry" in error:
         return 404
+    # bad_request / bad_frame / bad_json and every other client-content
+    # token: deterministic 400, never retried.
     return 400
 
 
@@ -112,8 +117,6 @@ class InprocBackend:
 
     def score(self, req: dict,
               trace_id: Optional[str] = None) -> Tuple[dict, Dict[str, Any]]:
-        from .server import _Pending
-
         srv = self._server
         done = threading.Event()
         box: Dict[str, dict] = {}
@@ -122,14 +125,17 @@ class InprocBackend:
             box["resp"] = resp
             done.set()
 
-        p = _Pending(req, reply, srv._default_deadline_ms,
-                     trace_id=trace_id or srv._mint_trace_id())
-        srv.submit(p)  # sheds reply synchronously on this thread
+        # admit_request decodes x at admission (bad_request / bad_frame
+        # answer synchronously on this thread) and sheds synchronously
+        # too, exactly as submit did.
+        srv.admit_request(req, reply, trace_id=trace_id)
         # Bound the wait by the request's own budget plus grace for the
         # in-flight dispatch; a budget-less request waits for the loop.
-        timeout = None
-        if p.deadline is not None:
-            timeout = max(0.0, p.deadline - time.perf_counter()) + 10.0
+        ms = srv._default_deadline_ms
+        raw = req.get("deadline_ms") if isinstance(req, dict) else None
+        if isinstance(raw, (int, float)) and not isinstance(raw, bool):
+            ms = float(raw)
+        timeout = (ms / 1e3 + 10.0) if ms and ms > 0 else None
         if not done.wait(timeout):
             return ({"id": req.get("id"), "ok": False,
                      "error": "http_timeout",
@@ -467,16 +473,38 @@ class HTTPFrontEnd:
         except (socket.timeout, TimeoutError, OSError):
             h.close_connection = True  # slowloris body: drop the thread
             return
-        try:
-            payload = json.loads(body.decode("utf-8")) if n_bytes else {}
-            if not isinstance(payload, dict):
-                raise ValueError("body must be a JSON object")
-        except (ValueError, UnicodeDecodeError) as e:
-            self._emit(h, 400, t0, model=name, op=op, error="bad_json")
-            self._send_json(h, 400, {"ok": False, "error": "bad_json",
-                                     "detail": str(e)})
-            return
-        req = {"model": name, "op": op, "x": payload.get("x")}
+        ctype = (h.headers.get("Content-Type")
+                 or "").split(";", 1)[0].strip().lower()
+        if ctype == wire.CONTENT_TYPE:
+            # Zero-copy binary payload (docs/SERVING.md "Binary
+            # payloads"): the entire body is one x-gmm-rows frame;
+            # model/op/version ride the URL, the deadline rides the
+            # X-GMM-Deadline-Ms header. Decoded via np.frombuffer --
+            # no JSON float parsing on the scoring hot path.
+            try:
+                x: Any = wire.decode_rows(body)
+            except wire.WireError as e:
+                self._emit(h, 400, t0, model=name, op=op,
+                           error="bad_frame")
+                self._send_json(h, 400,
+                                {"ok": False, "error": "bad_frame",
+                                 "detail": str(e)})
+                return
+            payload: Dict[str, Any] = {}
+        else:
+            try:
+                payload = (json.loads(body.decode("utf-8"))
+                           if n_bytes else {})
+                if not isinstance(payload, dict):
+                    raise ValueError("body must be a JSON object")
+            except (ValueError, UnicodeDecodeError) as e:
+                self._emit(h, 400, t0, model=name, op=op,
+                           error="bad_json")
+                self._send_json(h, 400, {"ok": False, "error": "bad_json",
+                                         "detail": str(e)})
+                return
+            x = payload.get("x")
+        req = {"model": name, "op": op, "x": x}
         if version is not None:
             req["version"] = version
         if payload.get("id") is not None:

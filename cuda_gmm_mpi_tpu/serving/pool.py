@@ -47,7 +47,10 @@ import time
 import zlib
 from typing import Any, Dict, List, Optional, Tuple
 
+import numpy as np
+
 from .. import telemetry
+from . import wire
 
 #: extra seconds of socket patience past a request's own deadline: the
 #: worker answers deadline_expired itself; the transport must outlive it.
@@ -336,7 +339,18 @@ class WorkerPool:
         under it) retry ONCE on the next live sibling -- scoring is
         idempotent, so the client sees an answer, not the crash."""
         del trace_id  # the JSONL protocol mints its own ids worker-side
-        payload = (json.dumps(req) + "\n").encode("utf-8")
+        x = req.get("x")
+        if isinstance(x, np.ndarray):
+            # A binary (x-gmm-rows) POST decoded to rows in the router;
+            # re-frame instead of JSON-ifying the floats so the zero-copy
+            # plane survives the hop to the worker: one header line
+            # declaring x_bytes, then the raw frame.
+            frame = wire.encode_rows(x)
+            head = {k: v for k, v in req.items() if k != "x"}
+            head["x_bytes"] = len(frame)
+            payload = (json.dumps(head) + "\n").encode("utf-8") + frame
+        else:
+            payload = (json.dumps(req) + "\n").encode("utf-8")
         timeout_s = self._request_timeout_s
         deadline_ms = req.get("deadline_ms")
         if isinstance(deadline_ms, (int, float)) and deadline_ms > 0:

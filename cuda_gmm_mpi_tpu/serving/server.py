@@ -85,30 +85,78 @@ from ..telemetry import profiling as tl_profiling
 from ..telemetry import sketch as tl_sketch
 from ..telemetry import spans as tl_spans
 from ..testing import faults
+from . import wire
 from .breaker import CircuitBreakers
 from .executor import ScoringExecutor, executor_for_model
 from .registry import ModelRegistry, RegistryError, ServedModel
 
 OPS = ("predict", "predict_proba", "score_samples", "score")
 
+
+class _BadRequest(ValueError):
+    """A request body that is not even a numeric row matrix (ragged
+    rows, strings, a dict): answered with the machine token
+    ``bad_request`` at ADMISSION -- HTTP 400 via ``status_for_error`` --
+    instead of raising from the tick loop's decode."""
+
+
+def _decode_x(raw) -> np.ndarray:
+    """Decode one request's ``x`` into the ``[n, d]`` float64/float32
+    block the dispatch concatenates. Accepts an ndarray (the binary
+    wire path hands the ``np.frombuffer`` view straight through -- no
+    JSON parsing, no Python lists) or anything ``np.asarray`` can make
+    numeric. Raises :class:`_BadRequest` for non-numeric/ragged input
+    and ``ValueError`` for shape/NaN violations (those keep their
+    established error spellings)."""
+    if isinstance(raw, np.ndarray):
+        x = raw
+        if x.dtype not in (np.float32, np.float64):
+            x = x.astype(np.float64)
+    else:
+        try:
+            x = np.asarray(raw, np.float64)
+        except (ValueError, TypeError) as e:
+            raise _BadRequest(
+                f"'x' is not a numeric [n, d] row matrix: {e}") from e
+    if x.ndim == 1 and x.size:
+        x = x[None, :]
+    if x.ndim != 2 or x.shape[0] == 0:
+        raise ValueError(
+            f"'x' must be a non-empty [n, d] row list, got "
+            f"shape {x.shape}")
+    if not np.isfinite(x).all():
+        raise ValueError("'x' contains NaN/Inf rows")
+    return x
+
 # Latency samples kept for the summary percentiles (bounded).
 _LATENCY_CAP = 100_000
+
+# Auto-stacking hysteresis (adaptive micro-batching): consecutive
+# windows with a stackable same-family pair before stacked dispatch
+# flips on, and consecutive windows without one before it flips off.
+_AUTO_STACK_ON_STREAK = 3
+_AUTO_STACK_OFF_STREAK = 16
 
 
 class _Pending:
     """One in-flight request: the decoded body, where to reply, when it
     arrived, when its budget runs out (None = no deadline), and -- under
-    the live plane (rev v2.1) -- its minted trace identity."""
+    the live plane (rev v2.1) -- its minted trace identity. ``x`` holds
+    the admission-decoded row block when the front end decoded it on the
+    reader thread (the data-plane fast path); None falls back to the
+    tick loop's decode."""
 
-    __slots__ = ("req", "reply", "t0", "deadline", "trace_id")
+    __slots__ = ("req", "reply", "t0", "deadline", "trace_id", "x")
 
     def __init__(self, req: dict, reply: Callable[[dict], None],
                  default_deadline_ms: Optional[float] = None,
-                 trace_id: Optional[str] = None):
+                 trace_id: Optional[str] = None,
+                 x: Optional[np.ndarray] = None):
         self.req = req
         self.reply = reply
         self.t0 = time.perf_counter()
         self.trace_id = trace_id
+        self.x = x
         ms = default_deadline_ms
         if isinstance(req, dict):
             raw = req.get("deadline_ms")
@@ -122,6 +170,8 @@ class GMMServer:
 
     def __init__(self, registry: ModelRegistry, *,
                  max_batch_rows: int = 8192, tick_s: float = 0.002,
+                 tick_s_min: Optional[float] = None,
+                 tick_s_max: Optional[float] = None,
                  executor: Optional[ScoringExecutor] = None,
                  warm: bool = True,
                  max_queue_rows: Optional[int] = None,
@@ -151,7 +201,51 @@ class GMMServer:
         self._registry = registry
         self._max_batch_rows = max(1, int(max_batch_rows))
         self._tick_s = max(0.0, float(tick_s))
+        # Adaptive micro-batching (docs/SERVING.md "Adaptive window"):
+        # passing either bound replaces the FIXED gather window with a
+        # bounded controller -- deep backlog snaps the window to
+        # tick_s_min (dispatch immediately), an idle queue widens it
+        # toward tick_s_max to coalesce more rows per executor call.
+        # Off (both None, the default) keeps the fixed tick_s path and
+        # a byte-identical stream.
+        self._adaptive = (tick_s_min is not None
+                          or tick_s_max is not None)
+        if self._adaptive:
+            lo = max(0.0, float(tick_s_min if tick_s_min is not None
+                                else 0.0))
+            hi = float(tick_s_max if tick_s_max is not None
+                       else max(self._tick_s, lo))
+            if hi < lo:
+                raise ValueError(
+                    f"adaptive window needs tick_s_min <= tick_s_max, "
+                    f"got {lo}/{hi}")
+            self._tick_min = lo
+            self._tick_max = hi
+            self._tick_cur = min(max(self._tick_s, lo), hi)
+        self._arrivals = 0
+        self._arrival_rate = 0.0
+        self._last_window_t = time.perf_counter()
+        self.window_adaptations = 0
+        # Auto-stacking (adaptive mode): windows that repeatedly carry
+        # >= 2 routes of one numeric family flip stacked dispatch on
+        # without --stack-models; sustained single-family windows flip
+        # it back off.
+        self._auto_stack = False
+        self._stack_streak = 0
+        self._unstack_streak = 0
+        # Device-resident routes: dispatch-time state preparations that
+        # missed the pinned plane (executor host_stagings delta), the
+        # serve.host_staging observability counter.
+        self.host_stagings = 0
+        self._host_staging_seen = 0
+        # Family executors are process-shared (executor_for_model) --
+        # an embedded server must not inherit staging counts from the
+        # estimator surface or a sibling server, so each executor's
+        # count is baselined at adoption and reported as a delta.
+        self._staging_base: Dict[int, int] = {}
         self._executor_override = executor
+        if executor is not None:
+            self._adopt_executor(executor)
         self._warm = bool(warm)
         self._models: Dict[Tuple[str, Optional[int]], ServedModel] = {}
         self._executors: Dict[tuple, ScoringExecutor] = {}
@@ -189,6 +283,7 @@ class GMMServer:
         # dispatches, parity-tested. Opt-in (--stack-models).
         self._stack_models = bool(stack_models)
         self.stacked_batches = 0
+        self.stacked_fallthrough = 0
         # Live plane (rev v2.1; --metrics-port): mint a trace_id per
         # admitted request (echoed in its response + tagged on its
         # serve_request record) and emit spans around the route path.
@@ -249,8 +344,16 @@ class GMMServer:
                 fp = self._registry.latest_fingerprint(name)
                 if fp is not None:
                     self._route_snapshot[name] = fp
+            # Device-resident route: place the prepared state ONCE at
+            # route-prepare time; every later dispatch hits the
+            # resident handle (executor.pin_state) instead of
+            # re-placing leaves per tick. Released on hot-reload
+            # exactly as the dispatch memo is (maybe_reload ->
+            # release_state).
+            ex = self._executor_for(m)
+            ex.pin_state(m.state)
             if self._warm:
-                self._executor_for(m).warmup(m.state)
+                ex.warmup(m.state)
         return m
 
     def maybe_reload(self) -> List[dict]:
@@ -284,8 +387,10 @@ class GMMServer:
                 continue
             if new_m.version == cur.version:
                 continue  # walk-back landed on the already-served version
+            new_ex = self._executor_for(new_m)
+            new_ex.pin_state(new_m.state)
             if self._warm:
-                self._executor_for(new_m).warmup(new_m.state)
+                new_ex.warmup(new_m.state)
             self._models[(name, None)] = new_m  # the atomic route swap
             self._models.setdefault((name, new_m.version), new_m)
             self.breaker.reset((name, None))
@@ -314,15 +419,28 @@ class GMMServer:
                     tuning_db=self._tuning_db)
                 kw.update(blocks)
             ex = self._executors[key] = executor_for_model(m, **kw)
+            self._adopt_executor(ex)
         return ex
 
+    def _adopt_executor(self, ex: ScoringExecutor) -> None:
+        """Record the executor's host_stagings at adoption: stagings
+        that predate this server are other surfaces' traffic, not this
+        route plane's fallbacks."""
+        self._staging_base.setdefault(
+            id(ex), ex.stats().get("host_stagings", 0))
+
     def executor_stats(self) -> Dict[str, int]:
-        """Aggregated executor counters across every family served."""
+        """Aggregated executor counters across every family served;
+        ``host_stagings`` is since-adoption (process-shared executors
+        carry other surfaces' counts)."""
         execs = ([self._executor_override] if self._executor_override
                  else list(self._executors.values()))
         tot: Dict[str, int] = {}
         for ex in execs:
+            base = self._staging_base.get(id(ex), 0)
             for k, v in ex.stats().items():
+                if k == "host_stagings":
+                    v -= base
                 tot[k] = tot.get(k, 0) + v
         return tot
 
@@ -386,8 +504,22 @@ class GMMServer:
                 "gmm_drift_events_total": float(self.drift_events),
                 "gmm_drift_alarms_total": float(self.drift_alarms),
             }
+        # Adaptive-window gauges appear ONLY when the controller is on:
+        # a fixed-tick server's /metrics text stays byte-identical.
+        window: Dict[str, float] = {}
+        if self._adaptive:
+            window = {
+                "gmm_serve_window_ms": float(
+                    round(self._tick_cur * 1e3, 4)),
+                "gmm_serve_window_adaptations": float(
+                    self.window_adaptations),
+                "gmm_serve_arrival_per_s": float(
+                    round(self._arrival_rate, 3)),
+                "gmm_serve_auto_stack": float(self._auto_stack),
+            }
         return {
             **drift,
+            **window,
             "gmm_serve_queue_rows": float(self._queued_rows),
             "gmm_serve_requests": float(self.requests),
             "gmm_serve_batches": float(self.batches),
@@ -400,6 +532,10 @@ class GMMServer:
             "gmm_serve_breaker_open_routes": float(br["open_routes"]),
             "gmm_serve_breaker_trips": float(br["trips"]),
             "gmm_serve_stacked_batches": float(self.stacked_batches),
+            "gmm_serve_host_stagings": float(
+                ex.get("host_stagings", 0)),
+            "gmm_executor_pinned_states": float(
+                ex.get("pinned_states", 0)),
             "gmm_serve_draining": float(self._draining.is_set()),
             "gmm_executor_cache_hit_rate": (
                 float(ex.get("hits", 0)) / lookups if lookups else 0.0),
@@ -472,25 +608,136 @@ class GMMServer:
             if version is not None and not isinstance(version, int):
                 self._reply_error(p, "'version' must be an integer")
                 continue
-            try:
-                x = np.asarray(req.get("x"), np.float64)
-                if x.ndim == 1 and x.size:
-                    x = x[None, :]
-                if x.ndim != 2 or x.shape[0] == 0:
-                    raise ValueError(
-                        f"'x' must be a non-empty [n, d] row list, got "
-                        f"shape {x.shape}")
-                if not np.isfinite(x).all():
-                    raise ValueError("'x' contains NaN/Inf rows")
-            except (ValueError, TypeError) as e:
-                self._reply_error(p, f"bad 'x': {e}")
-                continue
+            x = p.x
+            if x is None:
+                # Front ends decode at admission (reader thread); this
+                # is the fallback for direct handle_requests callers.
+                try:
+                    x = _decode_x(req.get("x"))
+                except _BadRequest as e:
+                    self._reply_error(p, "bad_request", detail=str(e))
+                    continue
+                except (ValueError, TypeError) as e:
+                    self._reply_error(p, f"bad 'x': {e}")
+                    continue
             groups.setdefault((name, version), []).append((p, x))
-        if self._stack_models and len(groups) > 1:
+        if self._adaptive and not self._stack_models:
+            self._observe_stacking(groups)
+        stack = self._stack_models or (self._adaptive
+                                       and self._auto_stack)
+        if stack and len(groups) > 1:
             self._dispatch_stacked(list(groups.items()))
         else:
             for (name, version), items in groups.items():
                 self._dispatch(name, version, items)
+
+    # -- adaptive micro-batching (rev v2.8) ------------------------------
+
+    def _emit_window(self, reason: str, *, prev_ms: Optional[float]
+                     = None, queue_rows: int = 0, requests: int = 0,
+                     stacked_auto: Optional[bool] = None,
+                     streak: Optional[int] = None) -> None:
+        """One ``serve_window`` record (stream rev v2.8) per controller
+        adaptation: window moves and auto-stacking flips, rendered by
+        ``gmm report`` and folded by ``gmm diff``."""
+        self.window_adaptations += 1
+        rec = telemetry.current()
+        if not rec.active:
+            return
+        rec.emit(
+            "serve_window",
+            window_ms=round(self._tick_cur * 1e3, 4), reason=reason,
+            arrival_per_s=round(self._arrival_rate, 3),
+            queue_rows=int(queue_rows), requests=int(requests),
+            **({"prev_window_ms": round(prev_ms * 1e3, 4)}
+               if prev_ms is not None else {}),
+            **({"stacked_auto": bool(stacked_auto)}
+               if stacked_auto is not None else {}),
+            **({"streak": int(streak)} if streak is not None else {}))
+        rec.metrics.count("serve_window_adaptations")
+        rec.metrics.gauge("serve.window_ms",
+                          round(self._tick_cur * 1e3, 4))
+
+    def _observe_window(self, requests: int) -> None:
+        """The bounded window controller, run once per gathered batch:
+        backlog left in the queue after a full gather snaps the next
+        window to ``tick_s_min`` (a deep queue must dispatch
+        immediately), a window that coalesced nothing widens toward
+        ``tick_s_max`` (idle traffic can afford to wait for more rows
+        per executor call). The window NEVER leaves [tick_s_min,
+        tick_s_max] -- both moves clamp -- and the gather loop still
+        bounds every window by the first request's deadline budget."""
+        now = time.perf_counter()
+        dt = now - self._last_window_t
+        self._last_window_t = now
+        arrived, self._arrivals = self._arrivals, 0
+        if dt > 0:
+            self._arrival_rate = (0.7 * self._arrival_rate
+                                  + 0.3 * (arrived / dt))
+        # Row accounting only runs under --max-queue-rows; the queue
+        # depth (pending requests) is the always-on backlog signal.
+        backlog = (self._queued_rows if self._max_queue_rows is not None
+                   else self._queue.qsize())
+        prev = self._tick_cur
+        if backlog > 0:
+            if prev > self._tick_min:
+                self._tick_cur = self._tick_min
+                self._emit_window("backlog", prev_ms=prev,
+                                  queue_rows=backlog,
+                                  requests=requests)
+        elif requests <= 1:
+            widened = min(self._tick_max,
+                          max(prev * 2.0, self._tick_min,
+                              self._tick_max / 64.0))
+            if widened > prev:
+                self._tick_cur = widened
+                self._emit_window("idle", prev_ms=prev,
+                                  queue_rows=backlog,
+                                  requests=requests)
+
+    def _observe_stacking(self, groups) -> None:
+        """Auto-stacking streaks (adaptive mode, --stack-models off):
+        a window carrying >= 2 routes of one numeric family (shared
+        dtype x covariance structure x D -- the ``infer_stacked``
+        admission rule) counts toward flipping stacked dispatch ON;
+        sustained windows without such a pair flip it back OFF. Both
+        flips emit ``serve_window`` so the controller's behavior is
+        visible in ``gmm report`` / ``gmm diff``."""
+        if len(groups) > 1 and self._window_stackable(groups):
+            self._stack_streak += 1
+            self._unstack_streak = 0
+            if (not self._auto_stack
+                    and self._stack_streak >= _AUTO_STACK_ON_STREAK):
+                self._auto_stack = True
+                self._emit_window("auto_stack_on", stacked_auto=True,
+                                  streak=self._stack_streak,
+                                  requests=sum(
+                                      len(v) for v in groups.values()))
+        elif groups:
+            self._unstack_streak += 1
+            self._stack_streak = 0
+            if (self._auto_stack
+                    and self._unstack_streak >= _AUTO_STACK_OFF_STREAK):
+                self._auto_stack = False
+                self._emit_window("auto_stack_off", stacked_auto=False,
+                                  streak=self._unstack_streak,
+                                  requests=sum(
+                                      len(v) for v in groups.values()))
+
+    def _window_stackable(self, groups) -> bool:
+        """Whether this window's groups hold >= 2 already-resolved
+        routes of one stacked family. Unresolved routes don't count --
+        the check must stay free of registry IO on the tick loop."""
+        fams: Dict[tuple, int] = {}
+        for (name, version) in groups:
+            m = self._models.get((name, version))
+            if m is None:
+                continue
+            key = (m.dtype, m.diag_only, m.d)
+            fams[key] = fams.get(key, 0) + 1
+            if fams[key] >= 2:
+                return True
+        return False
 
     def _prepare_route(self, name: str, version: Optional[int],
                        items: List[Tuple[_Pending, np.ndarray]]):
@@ -616,13 +863,26 @@ class GMMServer:
         families: "collections.OrderedDict[tuple, list]" = \
             collections.OrderedDict()
         singles = []
+        fallthrough = 0
         for entry in preps:
             name, version, m, good, rows, t0 = entry
             ex = self._executor_for(m)
             if not ex.stackable_rows(rows.shape[0]):
+                # Oversized group: it splits into max_block slices,
+                # which the stacked layout does not model. COUNTED, not
+                # silent -- its solo dispatch emits `serve_batch` with
+                # `stacked` absent, and serve_summary.stacked_fallthrough
+                # reconciles stacked_batches against dispatch counts.
+                fallthrough += 1
                 singles.append(entry)
             else:
                 families.setdefault((id(ex), m.d), []).append(entry)
+        if fallthrough:
+            self.stacked_fallthrough += fallthrough
+            rec_ft = telemetry.current()
+            if rec_ft.active:
+                rec_ft.metrics.count("serve_stacked_fallthrough",
+                                     fallthrough)
         for fam in families.values():
             if len(fam) < 2:
                 singles.extend(fam)
@@ -719,6 +979,17 @@ class GMMServer:
         wall_ms = (time.perf_counter() - t0) * 1e3
         self.batches += 1
         self.rows += int(rows.shape[0])
+        # Device-resident audit: any state preparation this dispatch
+        # performed OUTSIDE the pinned plane is a fallback to
+        # per-request host->device staging -- counted so it can never
+        # be silent (the serve.host_staging diff gate).
+        staged = self.executor_stats().get("host_stagings", 0)
+        if staged > self._host_staging_seen:
+            delta = staged - self._host_staging_seen
+            self._host_staging_seen = staged
+            self.host_stagings += delta
+            if rec.active:
+                rec.metrics.count("serve_host_staging", delta)
         if rec.active:
             rec.emit("serve_batch", model=name, version=m.version,
                      requests=len(good), rows=int(rows.shape[0]),
@@ -952,6 +1223,15 @@ class GMMServer:
                            for (n, _), m in self._models.items()}),
             executor=self.executor_stats(),
             stacked_batches=int(self.stacked_batches),
+            **({"stacked_fallthrough": int(self.stacked_fallthrough)}
+               if self.stacked_fallthrough else {}),
+            **({"window": {
+                "adaptations": int(self.window_adaptations),
+                "window_ms": round(self._tick_cur * 1e3, 4),
+                "min_ms": round(self._tick_min * 1e3, 4),
+                "max_ms": round(self._tick_max * 1e3, 4),
+                "auto_stack": bool(self._auto_stack),
+            }} if self._adaptive else {}),
             metrics=rec.metrics.snapshot(),
             # CompileWatch rollup (rev v2.2): run_summary.profile's
             # serving sibling -- AOT compile counts/seconds + cost and
@@ -980,8 +1260,59 @@ class GMMServer:
             p = _Pending({}, reply)
             self._reply_error(p, f"not JSON: {e}")
             return
-        self.submit(_Pending(req, reply, self._default_deadline_ms,
-                             trace_id=self._mint_trace_id()))
+        self.admit_request(req, reply)
+
+    def admit_request(self, req, reply: Callable[[dict], None], *,
+                      trace_id: Optional[str] = None) -> bool:
+        """Admit one decoded request dict: scoring ops decode ``x`` HERE
+        -- on the reader thread, at admission -- so a ragged or
+        non-numeric body answers ``bad_request`` immediately (never
+        raising from the tick loop) and the JSON-list -> ndarray
+        conversion cost stays off the dispatch path. Returns True when
+        queued."""
+        p = _Pending(req, reply, self._default_deadline_ms,
+                     trace_id=(trace_id if trace_id is not None
+                               else self._mint_trace_id()))
+        if isinstance(req, dict) and req.get("op") in OPS:
+            try:
+                p.x = _decode_x(req.get("x"))
+            except _BadRequest as e:
+                self._reply_error(p, "bad_request", detail=str(e))
+                return False
+            except (ValueError, TypeError) as e:
+                self._reply_error(p, f"bad 'x': {e}")
+                return False
+        return self.submit(p)
+
+    def submit_frame(self, req: dict, frame: bytes,
+                     reply: Callable[[dict], None], *,
+                     trace_id: Optional[str] = None) -> bool:
+        """Admit one binary-payload request: a header dict (the JSONL
+        header line minus its ``x_bytes``, or the HTTP URL-derived
+        fields) plus one ``application/x-gmm-rows`` frame, decoded
+        straight into the dispatch block via ``np.frombuffer``
+        (serving/wire.py) -- no JSON float parsing, no intermediate
+        Python lists. A malformed frame answers ``bad_frame``."""
+        p = _Pending(req, reply, self._default_deadline_ms,
+                     trace_id=(trace_id if trace_id is not None
+                               else self._mint_trace_id()))
+        try:
+            rows = wire.decode_rows(frame)
+        except wire.WireError as e:
+            self._reply_error(p, "bad_frame", detail=str(e))
+            return False
+        req.pop("x_bytes", None)
+        req["x"] = rows
+        if req.get("op") in OPS:
+            try:
+                p.x = _decode_x(rows)
+            except _BadRequest as e:
+                self._reply_error(p, "bad_request", detail=str(e))
+                return False
+            except (ValueError, TypeError) as e:
+                self._reply_error(p, f"bad 'x': {e}")
+                return False
+        return self.submit(p)
 
     def submit(self, p: _Pending) -> bool:
         """Admit ``p`` onto the batching queue, or shed it.
@@ -994,6 +1325,7 @@ class GMMServer:
         still admitted when the queue is empty -- it can never fit
         better later). Returns True when queued.
         """
+        self._arrivals += 1
         if self._draining.is_set():
             self._shed(p, "shutting_down")
             return False
@@ -1125,11 +1457,24 @@ class GMMServer:
                 break
             batch = [first]
             rows = _rows_of(first)
-            tick_end = time.perf_counter() + self._tick_s
+            tick = self._tick_cur if self._adaptive else self._tick_s
+            tick_end = time.perf_counter() + tick
             if first.deadline is not None:
                 # Never let the gather window outwait the first
-                # request's remaining budget.
-                tick_end = min(tick_end, first.deadline)
+                # request's remaining budget. Adaptive windows can be
+                # WIDER than a request's whole budget, so the
+                # controller only ever spends half the remaining
+                # budget gathering -- the other half stays for the
+                # dispatch to answer inside the deadline. Fixed mode
+                # keeps the original cap (tick_s is normally orders of
+                # magnitude under any real deadline).
+                if self._adaptive:
+                    now = time.perf_counter()
+                    budget = first.deadline - now
+                    tick_end = min(tick_end,
+                                   now + max(0.0, budget / 2.0))
+                else:
+                    tick_end = min(tick_end, first.deadline)
             while rows < self._max_batch_rows:
                 remaining = tick_end - time.perf_counter()
                 try:
@@ -1141,6 +1486,8 @@ class GMMServer:
                     break
                 batch.append(p)
                 rows += _rows_of(p)
+            if self._adaptive:
+                self._observe_window(len(batch))
             self._process(batch)
         # Flush whatever was admitted before the stop (EOF/shutdown/
         # preemption must not drop accepted requests on the floor). On a
@@ -1164,6 +1511,8 @@ class GMMServer:
 
 
 def _rows_of(p: _Pending) -> int:
+    if p.x is not None:
+        return max(int(p.x.shape[0]), 1)
     x = p.req.get("x") if isinstance(p.req, dict) else None
     try:
         return max(len(x), 1)
@@ -1262,9 +1611,53 @@ def _serve_socket(server: GMMServer, path: str,
                     except OSError:
                         pass
                     break
+                # Binary payload (docs/SERVING.md "Binary payloads"): a
+                # header line declaring "x_bytes" is followed by exactly
+                # that many raw x-gmm-rows frame bytes. The substring
+                # probe keeps the JSON-only fast path single-pass.
+                if b'"x_bytes"' in raw:
+                    if self._handle_frame(raw, reply):
+                        continue
+                    break  # unrecoverable framing: close the stream
                 server.submit_line(raw.decode("utf-8", "replace"), reply)
                 if server._stop.is_set():
                     break
+
+        def _handle_frame(self, raw: bytes, reply) -> bool:
+            """One length-prefixed binary request. Returns False when
+            the connection must close (the raw byte stream can no
+            longer be trusted to be line-aligned)."""
+            try:
+                req = json.loads(raw)
+            except ValueError as e:
+                reply({"ok": False, "error": f"not JSON: {e}"})
+                return True
+            n = req.get("x_bytes") if isinstance(req, dict) else None
+            if (isinstance(n, bool) or not isinstance(n, int)
+                    or n <= 0):
+                reply({"ok": False, "error": "bad_frame",
+                       "detail": "'x_bytes' must declare a positive "
+                       "frame length in bytes"})
+                return True
+            if n > max_line_bytes:
+                # Reject BEFORE buffering; the unread frame bytes make
+                # the stream unusable, so the connection closes (the
+                # reply flushes first), exactly like line_too_long.
+                reply({"ok": False, "error": "frame_too_large",
+                       "detail": f"declared frame of {n} bytes exceeds "
+                       f"the {max_line_bytes}-byte bound"})
+                return False
+            try:
+                frame = self.rfile.read(n)
+            except OSError:
+                return False  # read deadline / client vanished
+            if len(frame) < n:
+                reply({"ok": False, "error": "bad_frame",
+                       "detail": f"stream ended after {len(frame)} of "
+                       f"{n} declared frame bytes"})
+                return False
+            server.submit_frame(req, frame, reply)
+            return not server._stop.is_set()
 
     class Srv(socketserver.ThreadingMixIn,
               socketserver.UnixStreamServer):
@@ -1310,6 +1703,10 @@ def _worker_argv(args, worker_sock: str) -> List[str]:
            "--max-body-bytes", str(args.max_body_bytes),
            "--breaker-threshold", str(args.breaker_threshold),
            "--breaker-backoff-s", str(args.breaker_backoff_s)]
+    if args.tick_min_ms is not None:
+        cmd += ["--tick-min-ms", str(args.tick_min_ms)]
+    if args.tick_max_ms is not None:
+        cmd += ["--tick-max-ms", str(args.tick_max_ms)]
     if args.models is not None:
         cmd += ["--models", *args.models]
     if args.no_warmup:
@@ -1459,7 +1856,24 @@ def serve_main(argv=None) -> int:
                    help="coalesced rows per dispatch tick (default 8192)")
     p.add_argument("--tick-ms", type=float, default=2.0,
                    help="micro-batch gather window in milliseconds "
-                   "(default 2)")
+                   "(default 2). Fixed unless an adaptive bound is "
+                   "given (--tick-min-ms / --tick-max-ms)")
+    p.add_argument("--tick-min-ms", type=float, default=None,
+                   metavar="MS",
+                   help="adaptive micro-batching lower bound: passing "
+                   "this (or --tick-max-ms) replaces the fixed tick "
+                   "with a bounded controller -- a backlogged queue "
+                   "snaps the gather window down to this floor "
+                   "(dispatch immediately). Default: off -- fixed "
+                   "--tick-ms, byte-identical stream")
+    p.add_argument("--tick-max-ms", type=float, default=None,
+                   metavar="MS",
+                   help="adaptive micro-batching upper bound: idle "
+                   "traffic widens the gather window toward this "
+                   "ceiling to coalesce more rows per executor call. "
+                   "Windows repeatedly carrying >= 2 same-family "
+                   "routes auto-enable stacked dispatch. Each "
+                   "adaptation emits a `serve_window` event (rev v2.8)")
     p.add_argument("--max-requests", type=int, default=None,
                    help="exit after this many responses (benchmarks, "
                    "tests)")
@@ -1633,6 +2047,9 @@ def serve_main(argv=None) -> int:
         p.error("--http conflicts with --socket/--input/--output "
                 "(HTTP clients carry their own request/response "
                 "streams)")
+    if (args.tick_min_ms is not None and args.tick_max_ms is not None
+            and args.tick_max_ms < args.tick_min_ms):
+        p.error("--tick-max-ms must be >= --tick-min-ms")
     if args.workers and args.http is None:
         p.error("--workers forks processes behind the HTTP front end; "
                 "it requires --http")
@@ -1669,6 +2086,12 @@ def serve_main(argv=None) -> int:
     server = GMMServer(registry,
                        max_batch_rows=args.max_batch_rows,
                        tick_s=args.tick_ms / 1e3,
+                       tick_s_min=(args.tick_min_ms / 1e3
+                                   if args.tick_min_ms is not None
+                                   else None),
+                       tick_s_max=(args.tick_max_ms / 1e3
+                                   if args.tick_max_ms is not None
+                                   else None),
                        warm=not args.no_warmup,
                        max_queue_rows=args.max_queue_rows,
                        default_deadline_ms=args.default_deadline_ms,

@@ -70,6 +70,11 @@ DEFAULT_FAIL_ON = (
     "http.errors_5xx>0",
     "http.worker_crashes>0",
     "http.retries_exhausted>0",
+    # Device-resident routes (rev v2.8): warm serve traffic must score
+    # against pinned device state -- any request that had to stage its
+    # model host-side fell off the resident fast path (a reload released
+    # the pin, or an unpinned version was addressed explicitly).
+    "serve.host_staging>0",
 )
 
 #: a tuned run this much slower than its own recorded profile regresses.
@@ -201,6 +206,9 @@ def summarize_run(records: List[dict]) -> dict:
             v = _num(ex.get("compiles"))
             if v is not None:
                 metrics["serve.compiles"] = v
+            v = _num(ex.get("host_stagings"))
+            if v is not None:
+                metrics["serve.host_staging"] = v
             if info["run_id"] is None:
                 info["run_id"] = r.get("run_id")
             self_prof = r.get("profile")
@@ -233,7 +241,7 @@ def summarize_run(records: List[dict]) -> dict:
         # with the front end off (or one that simply saw no trouble)
         # reads 0, so baselines stay comparable across http on/off.
         for key in ("http.errors_5xx", "http.worker_crashes",
-                    "http.retries_exhausted"):
+                    "http.retries_exhausted", "serve.host_staging"):
             metrics.setdefault(key, 0.0)
 
     summaries = [r for r in records if r.get("event") == "run_summary"]

@@ -126,6 +126,8 @@ def render_report(records: List[dict], max_trajectory_rows: int = 400) -> str:
                        if r.get("event") == "serve_deadline"]
     serve_reloads = [r for r in records
                      if r.get("event") == "serve_reload"]
+    serve_windows = [r for r in records
+                     if r.get("event") == "serve_window"]
     circuits = [r for r in records if r.get("event") == "circuit"]
     http_reqs = [r for r in records if r.get("event") == "http_request"]
     worker_spawns = [r for r in records
@@ -256,8 +258,8 @@ def render_report(records: List[dict], max_trajectory_rows: int = 400) -> str:
         out.append("")
 
     if (serve_reqs or serve_batches or serve_summaries or serve_sheds
-            or serve_deadlines or serve_reloads or circuits
-            or drift_windows or http_reqs or worker_spawns
+            or serve_deadlines or serve_reloads or serve_windows
+            or circuits or drift_windows or http_reqs or worker_spawns
             or worker_exits):
         out.append("Serving (rev v1.6; docs/SERVING.md):")
         if serve_reqs:
@@ -303,6 +305,19 @@ def render_report(records: List[dict], max_trajectory_rows: int = 400) -> str:
             out.append(
                 f"  hot-reload {r.get('model')}: "
                 f"v{r.get('from_version')} -> v{r.get('to_version')}")
+        if serve_windows:
+            # Adaptive micro-batching (rev v2.8): adaptation mix plus
+            # where the gather window ended up.
+            by_reason: Dict[str, int] = {}
+            for r in serve_windows:
+                reason = str(r.get("reason"))
+                by_reason[reason] = by_reason.get(reason, 0) + 1
+            last = serve_windows[-1]
+            out.append(
+                f"  adaptive window: {len(serve_windows)} adaptation(s) ("
+                + ", ".join(f"{n} {reason}"
+                            for reason, n in sorted(by_reason.items()))
+                + f"), now {float(last.get('window_ms', 0)):.3f} ms")
         for r in circuits:
             ver = (f"@{r['version']}" if r.get("version") is not None
                    else "")
@@ -382,7 +397,17 @@ def render_report(records: List[dict], max_trajectory_rows: int = 400) -> str:
                     f"executables, {ex.get('compiles', 0)} compiles, "
                     f"{ex.get('hits', 0)} hits / "
                     f"{ex.get('misses', 0)} misses, "
-                    f"{ex.get('evictions', 0)} evictions")
+                    f"{ex.get('evictions', 0)} evictions, "
+                    f"{ex.get('pinned_states', 0)} pinned state(s), "
+                    f"{ex.get('host_stagings', 0)} host staging(s)")
+            win = s.get("window") or {}
+            if win:
+                out.append(
+                    f"  window: {win.get('adaptations', 0)} "
+                    f"adaptation(s), {win.get('window_ms', 0)} ms in "
+                    f"[{win.get('min_ms', 0)}, {win.get('max_ms', 0)}]"
+                    + (", auto-stack on" if win.get("auto_stack")
+                       else ""))
             br = s.get("breaker") or {}
             if any(s.get(k) for k in ("shed", "deadline_expired",
                                       "reloads")) or any(br.values()):
@@ -886,6 +911,11 @@ def render_follow(records: List[dict]) -> str:
                     if r.get("state") == "open")
         if opens:
             extras.append(f"{opens} breaker trip(s)")
+        windows = by.get("serve_window", [])
+        if windows:
+            extras.append(
+                f"{len(windows)} window adaptation(s) -> "
+                f"{float(windows[-1].get('window_ms', 0)):.2f} ms")
         if extras:
             line += "  [" + ", ".join(extras) + "]"
         out.append(line)

@@ -210,6 +210,21 @@ EVENT_FIELDS: Dict[str, Tuple[Tuple[str, ...], Tuple[str, ...]]] = {
         ("model", "requests", "rows", "padded_rows", "wall_ms"),
         ("version", "compiled", "stacked"),
     ),
+    # One per adaptive micro-batching window adaptation (stream rev
+    # v2.8; serving/server.py --tick-min-ms/--tick-max-ms,
+    # docs/SERVING.md "Adaptive micro-batching"): the controller moved
+    # the gather window or flipped auto-stacking. ``window_ms`` is the
+    # NEW window; ``reason`` is ``backlog`` (queue still deep after a
+    # gather -> snap to the floor), ``idle`` (a near-empty window ->
+    # widen toward the ceiling), or ``auto_stack_on``/``auto_stack_off``
+    # (the stackable-window streak crossed the hysteresis thresholds).
+    # Present only when the adaptive bounds are set, so fixed --tick-ms
+    # streams stay byte-identical.
+    "serve_window": (
+        ("window_ms", "reason"),
+        ("prev_window_ms", "queue_rows", "arrival_per_s", "requests",
+         "stacked_auto", "streak"),
+    ),
     # One per shed request (stream rev v1.7; serving resilience,
     # docs/ROBUSTNESS.md "Serving"): admission control rejected the
     # request before it entered the batching queue. ``reason`` is
@@ -267,9 +282,16 @@ EVENT_FIELDS: Dict[str, Tuple[Tuple[str, ...], Tuple[str, ...]]] = {
         # so HTTP-off streams stay byte-identical. ``gmm diff`` folds it
         # into the ``http.errors_5xx`` / ``http.worker_crashes`` /
         # ``http.retries_exhausted`` default gates.
+        # ``window`` (optional, rev v2.8): the adaptive micro-batching
+        # rollup -- {adaptations, window_ms, min_ms, max_ms,
+        # auto_stack}; present only under --tick-min-ms/--tick-max-ms.
+        # ``stacked_fallthrough`` (optional, rev v2.8): rows-groups that
+        # arrived in a stacked window but failed ``stackable_rows`` and
+        # dispatched solo -- reconciles serve_batch counts against
+        # ``stacked_batches``.
         ("models", "executor", "errors", "shed", "deadline_expired",
-         "reloads", "breaker", "stacked_batches", "profile", "drift",
-         "http"),
+         "reloads", "breaker", "stacked_batches", "stacked_fallthrough",
+         "profile", "drift", "http", "window"),
     ),
     # One per answered HTTP request (stream rev v2.7; serving/http.py,
     # docs/SERVING.md "HTTP front end"): ``status`` is the HTTP status

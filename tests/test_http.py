@@ -49,6 +49,7 @@ from cuda_gmm_mpi_tpu.serving.client import GMMClient, GMMClientError
 from cuda_gmm_mpi_tpu.serving.http import (HTTP_OPS, HTTPFrontEnd,
                                            InprocBackend, parse_model_path,
                                            status_for_error)
+from cuda_gmm_mpi_tpu.serving import wire
 from cuda_gmm_mpi_tpu.serving.pool import NO_WORKER_WAIT_S, WorkerPool, _Worker
 from cuda_gmm_mpi_tpu.telemetry import read_stream
 from cuda_gmm_mpi_tpu.telemetry.diff import DEFAULT_FAIL_ON, summarize_run
@@ -113,7 +114,8 @@ def test_status_for_error_taxonomy():
     """Each server-side error token has ONE status: load-shed and drain
     are retryable (429/503), budget expiry is 504, a crashed-pool miss
     is 502, model math going non-finite is the server's fault (500),
-    an unknown model is the client's (404)."""
+    an unknown model is the client's (404), and an oversize payload --
+    JSON line, HTTP body, or binary frame -- is 413."""
     assert status_for_error("overloaded") == 429
     assert status_for_error("shutting_down") == 503
     assert status_for_error("circuit_open") == 503
@@ -124,7 +126,11 @@ def test_status_for_error_taxonomy():
     assert status_for_error("dispatch failed: boom") == 500
     assert status_for_error("unknown model 'ghost'") == 404
     assert status_for_error("registry: torn artifact") == 404
-    assert status_for_error("line_too_long") == 400
+    assert status_for_error("line_too_long") == 413
+    assert status_for_error("body_too_large") == 413
+    assert status_for_error("frame_too_large") == 413
+    assert status_for_error("bad_request") == 400
+    assert status_for_error("bad_frame") == 400
     assert status_for_error("anything else") == 400
 
 
@@ -673,6 +679,167 @@ def test_diff_default_gates_cover_the_network_tier(tmp_path):
                      "workers": 2}}
     cur = json.loads(json.dumps(base))
     cur["http"]["worker_crashes"] = 1
+    a, b = str(tmp_path / "a.jsonl"), str(tmp_path / "b.jsonl")
+    open(a, "w").write(json.dumps(base) + "\n")
+    open(b, "w").write(json.dumps(cur) + "\n")
+    assert diff_main([a, b]) == 1          # the gate trips...
+    assert diff_main([a, a]) == 0          # ...and clean stays clean
+
+
+# ------------------------------------------------- data plane (rev v2.8)
+
+
+def _live_front(reg_dir, **front_kw):
+    server = GMMServer(ModelRegistry(reg_dir))
+    t = threading.Thread(target=server.run_loop, daemon=True)
+    t.start()
+    front = HTTPFrontEnd(InprocBackend(server), **front_kw).start()
+    return server, t, front
+
+
+def test_http_binary_payloads_bit_identical_across_ops(rng, tmp_path):
+    """Zero-copy contract: for every HTTP op x {full, diag} covariance,
+    an x-gmm-rows body yields a response BIT-IDENTICAL to the JSON body
+    carrying the same rows -- encoding is transport, never math."""
+    reg_dir = str(tmp_path / "reg")
+    gm_full, data_full = fitted(rng)
+    gm_full.to_registry(reg_dir, "full")
+    gm_diag, data_diag = fitted(rng, diag=True)
+    gm_diag.to_registry(reg_dir, "diag")
+    server, t, front = _live_front(reg_dir)
+    try:
+        client = GMMClient(f"127.0.0.1:{front.port}")
+        for model, rows in (("full", data_full[:9]),
+                            ("diag", data_diag[:9])):
+            x = rows.astype(np.float64)
+            for op in HTTP_OPS:
+                a = client.request(model, op, x.tolist(),
+                                   encoding="json")
+                b = client.request(model, op, x, encoding="binary")
+                a.pop("latency_ms", None)
+                b.pop("latency_ms", None)
+                assert a == b, (model, op)
+    finally:
+        front.stop()
+        server._stop.set()
+        t.join(timeout=60)
+
+
+def test_http_bad_frames_rejected(inproc):
+    """Every malformed x-gmm-rows body answers 400 bad_frame (never a
+    500, never a silent misread): bad magic, truncation, and trailing
+    bytes past the declared N*D payload."""
+    front, server, _, data = inproc
+    port = front.port
+    hdrs = {"Content-Type": wire.CONTENT_TYPE}
+    good = wire.encode_rows(data[:3].astype(np.float64))
+    bad_magic = bytearray(good)
+    bad_magic[:4] = b"NOPE"
+    for label, frame in (("bad magic", bytes(bad_magic)),
+                         ("truncated", good[:-1]),
+                         ("header only", good[:wire.HEADER.size]),
+                         ("trailing", good + b"\x00")):
+        st, _, body = _post(port, "/v1/models/m:score", frame,
+                            headers=hdrs)
+        assert st == 400, (label, st, body)
+        assert not body["ok"] and body["error"] == "bad_frame", label
+    # the intact frame still scores on the very same connection state
+    st, _, body = _post(port, "/v1/models/m:score", good, headers=hdrs)
+    assert st == 200 and body["ok"]
+    assert front.errors_5xx == 0
+
+
+def test_http_oversized_binary_body_answers_413(rng, tmp_path):
+    """A binary frame past the body bound is refused 413 like its JSON
+    twin -- size policy is format-independent."""
+    gm, data = fitted(rng)
+    reg_dir = str(tmp_path / "reg")
+    gm.to_registry(reg_dir, "m")
+    server, t, front = _live_front(reg_dir, max_body_bytes=2048)
+    try:
+        frame = wire.encode_rows(
+            np.zeros((200, 4), np.float64))        # 6416 bytes > 2048
+        st, _, body = _post(front.port, "/v1/models/m:score", frame,
+                            headers={"Content-Type": wire.CONTENT_TYPE})
+        assert st == 413 and not body["ok"]
+    finally:
+        front.stop()
+        server._stop.set()
+        t.join(timeout=60)
+
+
+def test_http_warm_binary_requests_never_recompile_or_stage(inproc):
+    """Perf acceptance: once a route is warm at a bucket, binary
+    traffic at that bucket triggers ZERO executor compiles and ZERO
+    host stagings -- the zero-copy path reuses the pinned device state
+    and the cached executable."""
+    front, server, _, data = inproc
+    client = GMMClient(f"127.0.0.1:{front.port}")
+    x = data[:16].astype(np.float64)
+    client.request("m", "score_samples", x.tolist(), encoding="json")
+    client.request("m", "score_samples", x, encoding="binary")
+    stats = server.executor_stats()
+    before = stats["compiles"]
+    for _ in range(5):
+        client.request("m", "score_samples", x, encoding="binary")
+    stats = server.executor_stats()
+    assert stats["compiles"] == before
+    assert stats["host_stagings"] == 0
+    assert server.host_stagings == 0
+
+
+@pytest.mark.slow
+def test_pool_forwards_binary_frames(rng, tmp_path):
+    """One binary request through the real worker pool: the front end
+    re-encodes the decoded rows as a frame on the worker hop, and the
+    response matches the JSON twin bit-for-bit."""
+    gm, data = fitted(rng)
+    reg_dir = str(tmp_path / "reg")
+    gm.to_registry(reg_dir, "m")
+    p, port, _ = _start_pool_serve(tmp_path, reg_dir, workers=1)
+    try:
+        client = GMMClient(f"127.0.0.1:{port}", timeout_s=120.0)
+        x = data[:8].astype(np.float64)
+        a = client.request("m", "score_samples", x.tolist(),
+                           encoding="json")
+        b = client.request("m", "score_samples", x, encoding="binary")
+        a.pop("latency_ms", None)
+        b.pop("latency_ms", None)
+        assert a == b
+    finally:
+        p.send_signal(signal.SIGTERM)
+        communicate_or_kill(p, 120)
+
+
+def test_diff_gate_covers_host_staging(tmp_path):
+    """The rev v2.8 gate: serve.host_staging ships in DEFAULT_FAIL_ON,
+    folds from serve_summary.executor.host_stagings, pins an explicit
+    zero on every serve stream, and trips on a 0->1 regression."""
+    from cuda_gmm_mpi_tpu.telemetry.diff import diff_main
+
+    assert "serve.host_staging>0" in DEFAULT_FAIL_ON
+    clean = summarize_run([{
+        "event": "serve_summary", "run_id": "a", "requests": 4,
+        "wall_s": 1.0}])
+    assert clean["metrics"]["serve.host_staging"] == 0.0
+    staged = summarize_run([{
+        "event": "serve_summary", "run_id": "b", "requests": 4,
+        "wall_s": 1.0,
+        "executor": {"hits": 3, "misses": 1, "compiles": 1,
+                     "evictions": 0, "live_executables": 1,
+                     "pinned_states": 1, "host_stagings": 2}}])
+    assert staged["metrics"]["serve.host_staging"] == 2.0
+    # a fit-only stream grows no serve keys (byte-identity discipline)
+    fit_only = summarize_run([{"event": "run_summary", "run_id": "c",
+                               "wall_s": 2.0, "total_iters": 3}])
+    assert "serve.host_staging" not in fit_only["metrics"]
+    base = {"event": "serve_summary", "run_id": "a", "requests": 4,
+            "wall_s": 1.0,
+            "executor": {"hits": 4, "misses": 0, "compiles": 0,
+                         "evictions": 0, "live_executables": 1,
+                         "pinned_states": 1, "host_stagings": 0}}
+    cur = json.loads(json.dumps(base))
+    cur["executor"]["host_stagings"] = 1
     a, b = str(tmp_path / "a.jsonl"), str(tmp_path / "b.jsonl")
     open(a, "w").write(json.dumps(base) + "\n")
     open(b, "w").write(json.dumps(cur) + "\n")

@@ -1307,3 +1307,390 @@ def test_drift_off_server_is_byte_identical(rng, tmp_path):
     assert off.drift_stats()["windows"] == 0
     assert not any(k.startswith("gmm_drift") for k in off.live_gauges())
     assert any(k.startswith("gmm_drift") for k in on.live_gauges())
+
+
+# --------------------------------- data plane (rev v2.8) ----------------
+#
+# Serving data-plane overhaul contracts (docs/SERVING.md "Binary
+# payloads" / "Adaptive micro-batching", docs/OBSERVABILITY.md):
+# malformed-x hardening at admission, device-resident pinned routes with
+# the serve.host_staging audit counter, the bounded adaptive window
+# controller (never outside [tick_s_min, tick_s_max], never past a
+# request's deadline budget), auto-stacking hysteresis, stacked
+# fallthrough reconciliation, and the binary socket frames.
+
+
+def test_malformed_x_answers_bad_request(rng, tmp_path):
+    """Satellite hardening: ragged or non-numeric 'x' is caught at
+    ADMISSION and answers the machine token ``bad_request`` (HTTP 400
+    via status_for_error) -- it never reaches the tick loop, and batch
+    mates are unharmed."""
+    gm, data = fitted(rng)
+    gm.to_registry(str(tmp_path), "m")
+    server = GMMServer(ModelRegistry(str(tmp_path)))
+    reqs = [
+        {"id": 0, "model": "m", "op": "score",
+         "x": [[1.0, 2.0, 3.0, 4.0], [5.0, 6.0]]},       # ragged
+        {"id": 1, "model": "m", "op": "score",
+         "x": [["a", "b", "c", "d"]]},                    # non-numeric
+        {"id": 2, "model": "m", "op": "score", "x": {"not": "rows"}},
+        {"id": 3, "model": "m", "op": "score", "x": data[:2].tolist()},
+    ]
+    resps = {r["id"]: r for r in server.handle_requests(reqs)}
+    for i in (0, 1, 2):
+        assert not resps[i]["ok"]
+        assert resps[i]["error"] == "bad_request"
+    assert resps[3]["ok"]
+    # the reader-thread path (admit_request) answers inline, pre-queue
+    got = []
+    admitted = server.admit_request(
+        {"id": 9, "model": "m", "op": "score", "x": [[1.0], [2.0, 3.0]]},
+        _collecting_reply(got))
+    assert admitted is False
+    assert got and got[0]["error"] == "bad_request"
+    assert server._queue.qsize() == 0
+
+
+def test_warm_routes_are_pinned_and_never_host_stage(rng, tmp_path):
+    """Device-resident routes: resolve pins the prepared state ONCE;
+    warm traffic (varied N, all ops) performs ZERO dispatch-time host
+    stagings -- the serve.host_staging counter every layer (executor
+    stats, server counter, /metrics gauge, serve_summary) reads 0.
+    Deliberately on the process-shared family executor: counts are
+    baselined at adoption, so another surface's stagings (estimator
+    ops, a sibling server, earlier tests) never leak in."""
+    gm, data = fitted(rng)
+    # pollute the SHARED family executor before the server adopts it
+    gm.score_samples(data[:5])
+    gm.to_registry(str(tmp_path), "m")
+    server = GMMServer(ModelRegistry(str(tmp_path)))
+    stream = []
+    rec = telemetry.RunRecorder(stream=_StreamSink(stream))
+    with telemetry.use(rec), rec:
+        for i, n in enumerate((7, 19, 3, 41, 11)):
+            resp = server.handle_requests(
+                [{"id": i, "model": "m", "op": "score_samples",
+                  "x": data[:n].tolist()}])[0]
+            assert resp["ok"]
+        server.emit_summary()
+    stats = server.executor_stats()
+    assert stats["pinned_states"] >= 1
+    assert stats["host_stagings"] == 0
+    assert server.host_stagings == 0
+    assert server.live_gauges()["gmm_serve_host_stagings"] == 0.0
+    assert server.live_gauges()["gmm_executor_pinned_states"] >= 1.0
+    summary = next(r for r in stream if r["event"] == "serve_summary")
+    assert summary["executor"]["host_stagings"] == 0
+    assert validate_stream(stream) == []
+
+
+def test_release_state_unpins_and_restage_is_counted(rng):
+    """The pin lifecycle mirrors release_state (hot-reload/eviction):
+    releasing drops the pinned entry, and a LATER preparation of that
+    state is a counted host staging -- the observable fallback."""
+    gm, _ = fitted(rng)
+    ex = ScoringExecutor()
+    state = gm.result_.state
+    ex.pin_state(state)
+    assert ex.stats()["pinned_states"] == 1
+    assert ex.prepared_state(state) is not None
+    assert ex.stats()["host_stagings"] == 0   # pinned hit, no staging
+    assert ex.release_state(state) >= 1
+    assert ex.stats()["pinned_states"] == 0
+    ex.prepared_state(state)
+    assert ex.stats()["host_stagings"] == 1
+
+
+def test_adaptive_window_never_leaves_bounds(rng, tmp_path):
+    """Property: over a random mix of backlog/idle/normal windows the
+    controller NEVER moves the window outside [tick_s_min, tick_s_max],
+    and every serve_window record it emits carries an in-bounds
+    window_ms."""
+    gm, data = fitted(rng)
+    gm.to_registry(str(tmp_path), "m")
+    lo, hi = 0.001, 0.016
+    server = GMMServer(ModelRegistry(str(tmp_path)), warm=False,
+                       tick_s_min=lo, tick_s_max=hi)
+    stream = []
+    rec = telemetry.RunRecorder(stream=_StreamSink(stream))
+    with telemetry.use(rec), rec:
+        for _ in range(300):
+            roll = int(rng.integers(0, 3))
+            if roll == 0:  # backlog window: leave items in the queue
+                server._queue.put(_Pending_dummy())
+            requests = int(rng.integers(0, 5))
+            server._observe_window(requests)
+            assert lo <= server._tick_cur <= hi, (
+                f"window {server._tick_cur} escaped [{lo}, {hi}]")
+            while server._queue.qsize():
+                server._queue.get_nowait()
+    windows = [r for r in stream if r["event"] == "serve_window"]
+    assert windows, "the random schedule never adapted once"
+    reasons = {r["reason"] for r in windows}
+    assert reasons <= {"backlog", "idle"}
+    for r in windows:
+        assert lo * 1e3 <= r["window_ms"] <= hi * 1e3
+    assert validate_stream(stream) == []
+
+
+def _Pending_dummy():
+    from cuda_gmm_mpi_tpu.serving.server import _Pending
+    return _Pending({"model": "m", "op": "score", "x": [[0.0] * 4]},
+                    lambda resp: None)
+
+
+def test_adaptive_window_respects_deadline_budget(rng, tmp_path):
+    """A window widened PAST a request's whole deadline budget must not
+    starve it: the gather loop spends at most half the remaining budget
+    waiting, so the answer still lands inside the deadline instead of
+    expiring at it."""
+    gm, data = fitted(rng)
+    gm.to_registry(str(tmp_path), "m")
+    server = GMMServer(ModelRegistry(str(tmp_path)), warm=False,
+                       tick_s_min=0.001, tick_s_max=5.0)
+    # warm the route so dispatch cost is not compile cost
+    server.handle_requests([{"id": 0, "model": "m", "op": "score",
+                             "x": data[:4].tolist()}])
+    server._tick_cur = 5.0  # the controller widened all the way out
+    got = []
+    t0 = time.perf_counter()
+    server.submit_line(json.dumps(_req(0, data, deadline_ms=800.0)),
+                       _collecting_reply(got))
+    server.run_loop(max_requests=server.requests + 1)
+    waited = time.perf_counter() - t0
+    assert got and got[0]["ok"], got
+    assert waited < 2.0, (
+        f"a 5s window starved an 800ms-deadline request for {waited}s")
+
+
+def test_adaptive_auto_stack_hysteresis(rng, tmp_path):
+    """Auto-stacking: three consecutive windows with a same-family pair
+    flip stacked dispatch ON (serve_window auto_stack_on), stacked
+    responses stay bit-identical to the solo baseline, and sustained
+    single-route windows flip it back OFF."""
+    reg, data1, data2 = _two_family_models(rng, tmp_path)
+    server = GMMServer(reg, warm=False, tick_s_min=0.0,
+                       tick_s_max=0.002)
+    baseline = GMMServer(reg, warm=False)
+    reqs = _mixed_requests(data1, data2)
+    want = baseline.handle_requests(reqs, coalesce=False)
+    stream = []
+    rec = telemetry.RunRecorder(stream=_StreamSink(stream))
+    with telemetry.use(rec), rec:
+        # the first window resolves the routes but cannot count toward
+        # the streak -- the stackability probe is registry-IO-free, so
+        # unresolved routes are invisible to it
+        server.handle_requests(reqs, coalesce=True)
+        assert server._auto_stack is False
+        for _ in range(2):           # two counted windows build streak
+            got = server.handle_requests(reqs, coalesce=True)
+            assert server._auto_stack is False
+            assert server.stacked_batches == 0
+        # the third counted window completes the streak AND rides the
+        # stacked dispatch it just enabled
+        got = server.handle_requests(reqs, coalesce=True)
+        assert server._auto_stack is True
+        assert server.stacked_batches == 1
+        for a, b in zip(got, want):
+            a = {k: v for k, v in a.items() if k != "latency_ms"}
+            b = {k: v for k, v in b.items() if k != "latency_ms"}
+            assert a == b
+        solo = [{"id": 0, "model": "m1", "op": "score",
+                 "x": data1[:4].tolist()}]
+        for _ in range(16):          # the OFF streak
+            server.handle_requests(solo, coalesce=True)
+        assert server._auto_stack is False
+    flips = [r for r in stream if r["event"] == "serve_window"
+             and r["reason"].startswith("auto_stack")]
+    assert [r["reason"] for r in flips] == ["auto_stack_on",
+                                            "auto_stack_off"]
+    assert flips[0]["stacked_auto"] is True
+    assert flips[1]["stacked_auto"] is False
+    assert validate_stream(stream) == []
+
+
+def test_stacked_fallthrough_is_counted_not_silent(rng, tmp_path):
+    """Satellite fix: a same-family group whose rows exceed max_block
+    cannot ride the stacked call -- it dispatches solo, its serve_batch
+    carries NO `stacked` field, and serve_summary.stacked_fallthrough
+    counts it so stacked_batches reconciles against dispatch counts."""
+    reg = ModelRegistry(str(tmp_path))
+    gm1, data1 = fitted(rng, k=3, d=4)
+    gm2, data2 = fitted(rng, k=5, d=4, n=700)
+    gm3, data3 = fitted(rng, k=4, d=4, n=700)
+    gm1.to_registry(reg, "m1")
+    gm2.to_registry(reg, "m2")
+    gm3.to_registry(reg, "m3")
+    ex = ScoringExecutor(min_block=8, max_block=32)
+    server = GMMServer(reg, executor=ex, warm=False, stack_models=True)
+    reqs = [
+        {"id": 0, "model": "m1", "op": "score_samples",
+         "x": data1[:10].tolist()},
+        {"id": 1, "model": "m2", "op": "score_samples",
+         "x": data2[:12].tolist()},
+        {"id": 2, "model": "m3", "op": "score_samples",
+         "x": data3[:40].tolist()},     # 40 > max_block=32: fallthrough
+    ]
+    baseline = GMMServer(reg, executor=ScoringExecutor(
+        min_block=8, max_block=32), warm=False).handle_requests(
+        reqs, coalesce=False)
+    stream = []
+    rec = telemetry.RunRecorder(stream=_StreamSink(stream))
+    with telemetry.use(rec), rec:
+        got = server.handle_requests(reqs, coalesce=True)
+        server.emit_summary()
+    for a, b in zip(got, baseline):
+        a = {k: v for k, v in a.items() if k != "latency_ms"}
+        b = {k: v for k, v in b.items() if k != "latency_ms"}
+        assert a == b
+    assert server.stacked_batches == 1
+    assert server.stacked_fallthrough == 1
+    batches = [r for r in stream if r["event"] == "serve_batch"]
+    stacked = [r for r in batches if "stacked" in r]
+    plain = [r for r in batches if "stacked" not in r]
+    assert len(stacked) == 2 and len(plain) == 1
+    assert plain[0]["model"] == "m3"
+    summary = next(r for r in stream if r["event"] == "serve_summary")
+    assert summary["stacked_fallthrough"] == 1
+    # reconciliation: every serve_batch is either part of a stacked
+    # call or accounted as fallthrough/unstackable -- nothing silent
+    assert summary["metrics"]["counters"].get(
+        "serve_stacked_fallthrough") == 1
+    assert validate_stream(stream) == []
+
+
+def test_fixed_tick_stream_is_unchanged_and_matches_adaptive(rng,
+                                                             tmp_path):
+    """Opt-in contract: WITHOUT --tick-min-ms/--tick-max-ms the stream
+    carries no serve_window records, no summary `window` rollup, and no
+    window gauges -- while an adaptive server's responses to the same
+    requests stay bit-identical (scheduling never touches math)."""
+    gm, data = fitted(rng)
+    gm.to_registry(str(tmp_path), "m")
+    reg = ModelRegistry(str(tmp_path))
+    fixed = GMMServer(reg, warm=False)
+    adaptive = GMMServer(reg, warm=False, tick_s_min=0.0,
+                         tick_s_max=0.004)
+    reqs = serve_requests(data)
+    stream = []
+    rec = telemetry.RunRecorder(stream=_StreamSink(stream))
+    with telemetry.use(rec), rec:
+        got_fixed = fixed.handle_requests(reqs)
+        fixed.emit_summary()
+    got_adaptive = adaptive.handle_requests(reqs)
+    for a, b in zip(got_fixed, got_adaptive):
+        a = {k: v for k, v in a.items() if k != "latency_ms"}
+        b = {k: v for k, v in b.items() if k != "latency_ms"}
+        assert a == b
+    assert not any(r["event"] == "serve_window" for r in stream)
+    summary = next(r for r in stream if r["event"] == "serve_summary")
+    assert "window" not in summary
+    assert not any(k.startswith("gmm_serve_window")
+                   for k in fixed.live_gauges())
+    assert any(k.startswith("gmm_serve_window")
+               for k in adaptive.live_gauges())
+    assert validate_stream(stream) == []
+
+
+def test_server_rejects_inverted_tick_bounds(rng, tmp_path):
+    gm, _ = fitted(rng)
+    gm.to_registry(str(tmp_path), "m")
+    with pytest.raises(ValueError, match="tick_s_min"):
+        GMMServer(ModelRegistry(str(tmp_path)), tick_s_min=0.01,
+                  tick_s_max=0.001)
+
+
+def test_serve_cli_rejects_inverted_tick_bounds(tmp_path):
+    from cuda_gmm_mpi_tpu.serving.server import serve_main
+
+    with pytest.raises(SystemExit) as exc:
+        serve_main(["--registry", str(tmp_path / "reg"),
+                    "--socket", str(tmp_path / "s.sock"),
+                    "--tick-min-ms", "4", "--tick-max-ms", "1"])
+    assert exc.value.code == 2
+
+
+def _socket_binary_payload(req: dict, rows) -> bytes:
+    from cuda_gmm_mpi_tpu.serving import wire
+
+    frame = wire.encode_rows(np.asarray(rows, np.float64))
+    head = dict(req)
+    head["x_bytes"] = len(frame)
+    return (json.dumps(head) + "\n").encode("utf-8") + frame
+
+
+def test_serve_socket_binary_frame_bit_identical(rng, tmp_path):
+    """The JSONL socket's binary binding: a header line declaring
+    x_bytes followed by one x-gmm-rows frame answers byte-identically
+    to the same request spelled as JSON floats."""
+    import socket
+
+    gm, data = fitted(rng)
+    gm.to_registry(str(tmp_path / "reg"), "m")
+    t, sock_path = _socket_serve_thread(tmp_path, [], max_requests=2)
+    rows = data[:9].tolist()
+    c = socket.socket(socket.AF_UNIX, socket.SOCK_STREAM)
+    c.connect(sock_path)
+    f = c.makefile("rwb")
+    f.write((json.dumps({"id": 7, "model": "m", "op": "score_samples",
+                         "x": rows}) + "\n").encode("utf-8"))
+    f.write(_socket_binary_payload(
+        {"id": 7, "model": "m", "op": "score_samples"}, rows))
+    f.flush()
+    raw_json = f.readline()
+    raw_bin = f.readline()
+    c.close()
+    t.join(timeout=60)
+    assert not t.is_alive()
+    a, b = json.loads(raw_json), json.loads(raw_bin)
+    assert a["ok"] and b["ok"]
+    a.pop("latency_ms"), b.pop("latency_ms")
+    assert a == b
+
+
+def test_serve_socket_bad_frames_answer_bad_frame(rng, tmp_path):
+    """Binary-frame hardening on the socket: a short read answers
+    ``bad_frame`` and closes; an oversized declared frame answers
+    ``frame_too_large`` BEFORE buffering and closes; a malformed frame
+    body answers ``bad_frame`` and the stream continues (the length
+    prefix kept it aligned)."""
+    import socket
+
+    gm, data = fitted(rng)
+    gm.to_registry(str(tmp_path / "reg"), "m")
+    t, sock_path = _socket_serve_thread(
+        tmp_path, ["--max-body-bytes", "4096"], max_requests=1)
+
+    # oversized declared frame: rejected pre-buffering, connection ends
+    c = socket.socket(socket.AF_UNIX, socket.SOCK_STREAM)
+    c.connect(sock_path)
+    f = c.makefile("rwb")
+    f.write((json.dumps({"id": 0, "model": "m", "op": "score",
+                         "x_bytes": 1 << 20}) + "\n").encode())
+    f.flush()
+    resp = json.loads(f.readline())
+    assert not resp["ok"] and resp["error"] == "frame_too_large"
+    assert f.readline() == b""  # server closed the stream
+    c.close()
+
+    # corrupt frame body behind an honest length prefix: answered, and
+    # the SAME connection then serves a good request
+    from cuda_gmm_mpi_tpu.serving import wire
+    frame = bytearray(wire.encode_rows(data[:4].astype(np.float64)))
+    frame[:4] = b"NOPE"
+    c = socket.socket(socket.AF_UNIX, socket.SOCK_STREAM)
+    c.connect(sock_path)
+    f = c.makefile("rwb")
+    f.write((json.dumps({"id": 1, "model": "m", "op": "score",
+                         "x_bytes": len(frame)}) + "\n").encode()
+            + bytes(frame))
+    f.write(_socket_binary_payload(
+        {"id": 2, "model": "m", "op": "score"}, data[:4].tolist()))
+    f.flush()
+    bad = json.loads(f.readline())
+    good = json.loads(f.readline())
+    assert not bad["ok"] and bad["error"] == "bad_frame"
+    assert good["ok"] and good["id"] == 2
+    c.close()
+    t.join(timeout=60)
+    assert not t.is_alive()

@@ -1,0 +1,104 @@
+"""The x-gmm-rows binary row codec (serving/wire.py, rev v2.8).
+
+The zero-copy data plane's wire contract (docs/SERVING.md "Binary
+payloads"): a 16-byte little-endian header (magic GMR1, dtype code,
+reserved zeros, D, N) followed by exactly N*D packed float32/float64
+row values. The codec must round-trip bit-exactly, reject every
+malformed frame LOUDLY (bad magic, unknown dtype, nonzero reserved
+bytes, zero D, truncation, trailing bytes), and hand decoders a
+read-only np.frombuffer view -- no float stringification anywhere.
+"""
+
+import struct
+
+import numpy as np
+import pytest
+
+from cuda_gmm_mpi_tpu.serving import wire
+
+
+@pytest.mark.parametrize("dtype", [np.float32, np.float64])
+def test_round_trip_bit_exact(dtype):
+    rng = np.random.default_rng(0)
+    x = rng.normal(size=(37, 5)).astype(dtype)
+    buf = wire.encode_rows(x)
+    assert buf[:4] == wire.MAGIC
+    assert len(buf) == wire.HEADER.size + x.nbytes
+    y = wire.decode_rows(buf)
+    assert y.dtype == dtype and y.shape == x.shape
+    np.testing.assert_array_equal(np.asarray(y), x)
+
+
+def test_one_dim_promotes_to_single_row():
+    y = wire.decode_rows(wire.encode_rows(np.arange(4.0)))
+    assert y.shape == (1, 4) and y.dtype == np.float64
+
+
+def test_non_float_input_packs_as_float64():
+    x = np.arange(12, dtype=np.int64).reshape(3, 4)
+    y = wire.decode_rows(wire.encode_rows(x))
+    assert y.dtype == np.float64
+    np.testing.assert_array_equal(np.asarray(y), x.astype(np.float64))
+
+
+def test_decoded_view_is_read_only():
+    """decode_rows returns a view over the received buffer -- zero-copy
+    means shared memory, so the view must be immutable."""
+    y = wire.decode_rows(wire.encode_rows(np.ones((2, 3))))
+    assert not y.flags.writeable
+    with pytest.raises((ValueError, RuntimeError)):
+        y[0, 0] = 7.0
+
+
+def test_frame_bytes_matches_encoder():
+    x = np.zeros((9, 3), np.float32)
+    assert wire.frame_bytes(9, 3, np.float32) == len(wire.encode_rows(x))
+
+
+def _valid_frame(n=4, d=3, dtype=np.float64):
+    return bytearray(wire.encode_rows(np.ones((n, d), dtype)))
+
+
+def test_bad_magic_rejected():
+    buf = _valid_frame()
+    buf[:4] = b"NOPE"
+    with pytest.raises(wire.WireError, match="magic"):
+        wire.decode_rows(bytes(buf))
+
+
+def test_unknown_dtype_code_rejected():
+    buf = _valid_frame()
+    buf[4] = 9
+    with pytest.raises(wire.WireError, match="dtype"):
+        wire.decode_rows(bytes(buf))
+
+
+def test_nonzero_reserved_bytes_rejected():
+    """The reserved pad bytes must be zero -- a future header revision
+    must fail loudly against this decoder, not be silently misread."""
+    for off in (5, 6):
+        buf = _valid_frame()
+        buf[off] = 1
+        with pytest.raises(wire.WireError, match="reserved"):
+            wire.decode_rows(bytes(buf))
+
+
+def test_zero_d_rejected():
+    hdr = wire.HEADER.pack(wire.MAGIC, 0, 0, 0, 0, 1)
+    with pytest.raises(wire.WireError, match="D"):
+        wire.decode_rows(hdr + struct.pack("<d", 1.0))
+
+
+def test_truncated_frame_rejected():
+    buf = bytes(_valid_frame())
+    for cut in (0, 3, wire.HEADER.size - 1, len(buf) - 1):
+        with pytest.raises(wire.WireError):
+            wire.decode_rows(buf[:cut])
+
+
+def test_trailing_bytes_rejected():
+    """Exact length both ways: a frame with bytes past N*D values is as
+    corrupt as a short one (the socket protocol's length prefix and the
+    HTTP body length must agree with the header)."""
+    with pytest.raises(wire.WireError, match="trailing"):
+        wire.decode_rows(bytes(_valid_frame()) + b"\x00")
